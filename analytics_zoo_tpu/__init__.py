@@ -1,0 +1,17 @@
+"""analytics_zoo_tpu — a TPU-native analytics + AI framework with the
+capabilities of robert-sbd/analytics-zoo, re-designed for JAX/XLA/pjit/pallas.
+
+Layer map (mirrors SURVEY.md §1):
+  common/    runtime bring-up (ZooContext ≅ NNContext)
+  feature/   data layer (FeatureSet, image/text pipelines, Preprocessing)
+  pipeline/  model API (keras-style + autograd), estimator, nnframes, inference
+  models/    built-in model zoo (NCF, Wide&Deep, TextClassifier, ...)
+  ops/       pallas TPU kernels
+  parallel/  mesh, shardings, collectives, ring attention
+  serving/   cluster-serving equivalent
+  utils/     tensorboard writer, checkpointing
+"""
+
+__version__ = "0.1.0"
+
+from .common.context import init_zoo_context, get_zoo_context  # noqa: F401

@@ -1,0 +1,3 @@
+from .context import ZooContext, init_zoo_context, get_zoo_context, reset_zoo_context  # noqa: F401
+from .triggers import (EveryEpoch, SeveralIteration, MaxEpoch, MaxIteration,  # noqa: F401
+                       MinLoss, TrainLoopState, Trigger)

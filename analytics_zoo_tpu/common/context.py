@@ -1,0 +1,209 @@
+"""Runtime bring-up — the TPU-native equivalent of the reference's
+``NNContext.initNNContext`` (``common/NNContext.scala:133-149``) and pyzoo's
+``init_nncontext`` (``pyzoo/zoo/common/nncontext.py:104``).
+
+Where the reference creates a tuned SparkContext (conf merge at
+``NNContext.scala:188-200``, KMP/OMP env pinning at ``NNContext.scala:209-237``)
+and calls BigDL ``Engine.init``, this module:
+
+* discovers JAX devices and process topology (multi-host over DCN),
+* builds the global device ``Mesh`` (data/seq/expert/model axes),
+* loads layered configuration (defaults < yaml file < env < kwargs),
+* seeds the global PRNG and sets matmul precision policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Dict, Mapping, Optional
+
+import jax
+
+from ..parallel import mesh as mesh_lib
+
+log = logging.getLogger("analytics_zoo_tpu")
+
+#: Bundled defaults — the analogue of ``spark-analytics-zoo.conf``
+#: (``zoo/src/main/resources/spark-analytics-zoo.conf``, loaded at
+#: ``NNContext.scala:188-200``).
+DEFAULT_CONF: Dict[str, Any] = {
+    "zoo.mesh.data": -1,        # -1 = all remaining devices
+    "zoo.mesh.model": 1,
+    "zoo.mesh.seq": 1,
+    "zoo.mesh.expert": 1,
+    "zoo.seed": 0,
+    "zoo.matmul.precision": "default",   # default | high | highest
+    "zoo.compute.dtype": "float32",      # float32 | bfloat16
+    "zoo.failure.retry_times": 5,        # ≅ bigdl.failure.retryTimes (Topology.scala:1172)
+    "zoo.failure.retry_window_sec": 3600,
+    "zoo.checkpoint.keep": 3,
+    "zoo.log.level": "INFO",
+}
+
+_ENV_PREFIX = "ZOO_TPU_"
+
+
+def _env_overrides() -> Dict[str, Any]:
+    """``ZOO_TPU_MESH_MODEL=2`` → ``{"zoo.mesh.model": 2}`` — the analogue of
+    the reference's env-var config channel (``NNContext.scala:216-229``)."""
+    out: Dict[str, Any] = {}
+    for k, v in os.environ.items():
+        if k.startswith(_ENV_PREFIX):
+            key = "zoo." + k[len(_ENV_PREFIX):].lower().replace("_", ".")
+            out[key] = _parse_scalar(v)
+    return out
+
+
+def _parse_scalar(v: str) -> Any:
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            pass
+    if v.lower() in ("true", "false"):
+        return v.lower() == "true"
+    return v
+
+
+def _load_yaml(path: str) -> Dict[str, Any]:
+    """Flat yaml config loader (``config.yaml`` channel of the reference,
+    ``serving/utils/ClusterServingHelper.scala``). Minimal parser: only
+    ``key: value`` and one level of nesting, so we don't depend on pyyaml."""
+    try:
+        import yaml  # type: ignore
+
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+        return _flatten(data)
+    except ImportError:
+        out: Dict[str, Any] = {}
+        prefix = ""
+        with open(path) as f:
+            for raw in f:
+                line = raw.rstrip()
+                if not line or line.lstrip().startswith("#"):
+                    continue
+                indented = line.startswith((" ", "\t"))
+                key, _, val = line.strip().partition(":")
+                val = val.strip()
+                if not val:
+                    prefix = key + "."
+                    continue
+                out[(prefix if indented else "") + key] = _parse_scalar(val)
+                if not indented:
+                    prefix = ""
+        return out
+
+
+def _flatten(d: Mapping[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in d.items():
+        if isinstance(v, Mapping):
+            out.update(_flatten(v, prefix + k + "."))
+        else:
+            out[prefix + k] = v
+    return out
+
+
+@dataclasses.dataclass
+class ZooContext:
+    """Process-wide runtime handle — what ``NNContext``/BigDL ``Engine`` is in
+    the reference. Holds the mesh, config, and root PRNG key."""
+
+    conf: Dict[str, Any]
+    mesh: Any  # jax.sharding.Mesh
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.devices.size
+
+    @property
+    def data_parallel_size(self) -> int:
+        return self.mesh.shape[mesh_lib.DATA_AXIS]
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def process_count(self) -> int:
+        return jax.process_count()
+
+    @property
+    def seed(self) -> int:
+        return int(self.conf["zoo.seed"])
+
+    def rng(self) -> jax.Array:
+        return jax.random.key(self.seed)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.conf.get(key, default)
+
+
+_context: Optional[ZooContext] = None
+
+
+def init_zoo_context(
+    conf: Optional[Mapping[str, Any]] = None,
+    conf_path: Optional[str] = None,
+    **kwargs: Any,
+) -> ZooContext:
+    """Initialise (or fetch) the global context.
+
+    Precedence (lowest → highest): bundled defaults, yaml ``conf_path``, env
+    vars ``ZOO_TPU_*``, explicit ``conf`` dict, ``kwargs`` — mirroring the
+    reference's conf-file < spark-conf < user-conf merge
+    (``NNContext.scala:239-246``).
+
+    Idempotent like ``SparkContext.getOrCreate``: a second call returns the
+    existing context unless new settings are passed.
+    """
+    global _context
+    if _context is not None and conf is None and conf_path is None and not kwargs:
+        return _context
+
+    merged: Dict[str, Any] = dict(DEFAULT_CONF)
+    if conf_path:
+        merged.update(_load_yaml(conf_path))
+    merged.update(_env_overrides())
+    if conf:
+        merged.update(conf)
+    for k, v in kwargs.items():
+        merged["zoo." + k.replace("_", ".")] = v
+
+    logging.basicConfig(level=merged.get("zoo.log.level", "INFO"))
+
+    precision = merged.get("zoo.matmul.precision", "default")
+    if precision != "default":
+        jax.config.update("jax_default_matmul_precision", precision)
+
+    mesh = mesh_lib.create_mesh(
+        data=int(merged["zoo.mesh.data"]),
+        model=int(merged["zoo.mesh.model"]),
+        seq=int(merged["zoo.mesh.seq"]),
+        expert=int(merged["zoo.mesh.expert"]),
+    )
+    mesh_lib.set_global_mesh(mesh)
+
+    _context = ZooContext(conf=merged, mesh=mesh)
+    log.info(
+        "ZooContext: %d device(s), mesh %s, %d process(es)",
+        _context.num_devices,
+        dict(mesh.shape),
+        jax.process_count(),
+    )
+    return _context
+
+
+def get_zoo_context() -> ZooContext:
+    """Fetch the context, initialising with defaults if needed."""
+    return init_zoo_context()
+
+
+def reset_zoo_context() -> None:
+    """Tear down the global context (mainly for tests)."""
+    global _context
+    _context = None
+    mesh_lib.reset_global_mesh()

@@ -1,0 +1,3 @@
+from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS,  # noqa: F401
+                   create_mesh, global_mesh, set_global_mesh, reset_global_mesh,
+                   batch_sharding, replicated_sharding, data_parallel_size)

@@ -1,0 +1,110 @@
+"""Device-mesh management — the TPU-native replacement for the reference's
+Spark executor topology.
+
+In the reference (robert-sbd/analytics-zoo), physical parallelism is organised by
+``Engine.init`` counting Spark executors and cores
+(``common/NNContext.scala:133-149``) and data parallelism is the only axis
+(``docs/docs/wp-bigdl.md:113``).  Here the physical layer is a
+``jax.sharding.Mesh`` over TPU chips with up to four logical axes:
+
+* ``data``  — data parallelism (the reference's per-partition model replicas,
+  ``Topology.scala:1150-1158``),
+* ``model`` — tensor/model parallelism (absent in the reference; greenfield),
+* ``seq``   — sequence/context parallelism (absent in the reference),
+* ``expert`` — expert parallelism for MoE layers (absent in the reference).
+
+Collectives ride ICI within a mesh; XLA inserts psum/all-gather from sharding
+annotations, replacing BigDL's Spark-BlockManager ``AllReduceParameter``
+(``wp-bigdl.md:140-160``).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+ALL_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS)
+
+_global_mesh: Optional[Mesh] = None
+
+
+def create_mesh(
+    data: int = -1,
+    model: int = 1,
+    seq: int = 1,
+    expert: int = 1,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Create a logical mesh over the available devices.
+
+    ``data=-1`` means "absorb all remaining devices", mirroring how the
+    reference sizes data parallelism to the cluster (one model replica per
+    Spark partition, ``Topology.scala:1102-1110``).
+
+    The axis order is (data, seq, expert, model), placing the model axis
+    innermost so tensor-parallel collectives ride the fastest ICI links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    fixed = model * seq * expert
+    if data == -1:
+        if n % fixed != 0:
+            raise ValueError(
+                f"device count {n} not divisible by model*seq*expert={fixed}"
+            )
+        data = n // fixed
+    total = data * fixed
+    if total != n:
+        raise ValueError(
+            f"mesh {data}x{seq}x{expert}x{model}={total} != device count {n}"
+        )
+    dev_array = np.asarray(devices).reshape(data, seq, expert, model)
+    return Mesh(dev_array, (DATA_AXIS, SEQ_AXIS, EXPERT_AXIS, MODEL_AXIS))
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def global_mesh() -> Mesh:
+    """Return the process-wide mesh, creating a pure-DP mesh on first use."""
+    global _global_mesh
+    if _global_mesh is None:
+        _global_mesh = create_mesh()
+    return _global_mesh
+
+
+def reset_global_mesh() -> None:
+    global _global_mesh
+    _global_mesh = None
+
+
+def data_parallel_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or global_mesh()
+    return mesh.shape[DATA_AXIS]
+
+
+def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Sharding for a batch: leading dim split over the data axis."""
+    mesh = mesh or global_mesh()
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Fully-replicated sharding (the reference replicates parameters whole
+    per worker, ``Topology.scala:1118-1120``)."""
+    mesh = mesh or global_mesh()
+    return NamedSharding(mesh, P())

@@ -1,0 +1,7 @@
+from ..engine import Input, InputLayer, Lambda  # noqa: F401
+from .core import (Activation, Dense, Dropout, Flatten, Reshape, Permute,  # noqa: F401
+                   RepeatVector, Merge, merge, Select, Squeeze, ExpandDim,
+                   Narrow, Masking, GaussianNoise, GaussianDropout,
+                   TimeDistributed, Highway, SparseDense, get_activation)
+from .embeddings import Embedding, SparseEmbedding, WordEmbedding  # noqa: F401
+from .normalization import BatchNormalization, LayerNorm, L2Normalize  # noqa: F401

@@ -1,0 +1,97 @@
+"""Embedding layers — parity with ``keras/layers/Embedding.scala``,
+``SparseEmbedding.scala``, ``WordEmbedding.scala``.
+
+TPU note: embedding lookup compiles to a gather from an HBM-resident table;
+for model-parallel meshes the table shards along the vocab axis and XLA turns
+the lookup into a sharded gather + psum.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine import Layer, get_initializer, param_dtype
+
+
+class Embedding(Layer):
+    """``Embedding(input_dim, output_dim, init, input_length)`` —
+    ``keras/layers/Embedding.scala``. Input int ids (B, T) → (B, T, D).
+
+    Unlike the reference (which 1-indexes ids to match BigDL LookupTable),
+    ids here are 0-based."""
+
+    def __init__(self, input_dim: int, output_dim: int, init: str = "uniform",
+                 input_length: Optional[int] = None, **kwargs):
+        if input_length is not None and "input_shape" not in kwargs:
+            kwargs["input_shape"] = (input_length,)
+        super().__init__(**kwargs)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.init = init
+
+    def build(self, rng, input_shape):
+        w = get_initializer(self.init)(
+            rng, (self.input_dim, self.output_dim), param_dtype())
+        return {"embeddings": w}
+
+    def call(self, params, x, *, training=False, rng=None):
+        ids = x.astype(jnp.int32)
+        return jnp.take(params["embeddings"], ids, axis=0)
+
+
+class SparseEmbedding(Layer):
+    """``keras/layers/SparseEmbedding.scala`` — multi-hot bag embedding: the
+    input is a 0/1 (or weighted) row over the vocab, output is the weighted
+    sum of embeddings. On TPU this is just a matmul onto the MXU."""
+
+    def __init__(self, input_dim: int, output_dim: int, init: str = "uniform",
+                 combiner: str = "sum", **kwargs):
+        super().__init__(**kwargs)
+        self.input_dim, self.output_dim = input_dim, output_dim
+        self.init = init
+        self.combiner = combiner
+
+    def build(self, rng, input_shape):
+        w = get_initializer(self.init)(
+            rng, (self.input_dim, self.output_dim), param_dtype())
+        return {"embeddings": w}
+
+    def call(self, params, x, *, training=False, rng=None):
+        y = jnp.matmul(x.astype(params["embeddings"].dtype), params["embeddings"],
+                       preferred_element_type=jnp.float32)
+        if self.combiner == "mean":
+            denom = jnp.maximum(jnp.sum(x, axis=-1, keepdims=True), 1.0)
+            y = y / denom
+        elif self.combiner == "sqrtn":
+            denom = jnp.sqrt(jnp.maximum(jnp.sum(x * x, axis=-1, keepdims=True), 1.0))
+            y = y / denom
+        return y.astype(params["embeddings"].dtype)
+
+
+class WordEmbedding(Layer):
+    """``keras/layers/WordEmbedding.scala`` — embedding initialised from
+    pretrained vectors (GloVe in the reference), frozen by default."""
+
+    def __init__(self, weights: np.ndarray, trainable: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self.weights = np.asarray(weights)
+        self.trainable = trainable
+
+    def build(self, rng, input_shape):
+        w = jnp.asarray(self.weights, param_dtype())
+        if self.trainable:
+            return {"embeddings": w}
+        return {}
+
+    def initial_state(self, input_shape):
+        if self.trainable:
+            return {}
+        return {"embeddings": jnp.asarray(self.weights, param_dtype())}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        table = params["embeddings"] if self.trainable else state["embeddings"]
+        return jnp.take(table, x.astype(jnp.int32), axis=0), state

@@ -1,0 +1,113 @@
+"""Metrics — parity with ``pipeline/api/keras/metrics/`` (Accuracy, AUC,
+MAE) plus the validation methods the reference pulls from BigDL (Top1/Top5
+accuracy, Loss).
+
+A metric is a pair of jittable functions so evaluation streams over batches
+without host sync:
+
+* ``update(y_true, y_pred) -> stats``  — per-batch sufficient statistics
+* ``finalize(stats) -> scalar``        — combine (stats are summed over batches)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Union
+
+import jax.numpy as jnp
+
+
+class Metric(NamedTuple):
+    name: str
+    update: Callable  # (y_true, y_pred) -> stats pytree (summable)
+    finalize: Callable  # stats -> scalar
+
+
+def _binary_or_top1(y_true, y_pred):
+    y_pred = jnp.asarray(y_pred)
+    y_true = jnp.asarray(y_true)
+    if y_pred.ndim > 1 and y_pred.shape[-1] > 1:
+        pred = jnp.argmax(y_pred, axis=-1)
+        true = (jnp.argmax(y_true, axis=-1)
+                if y_true.ndim == y_pred.ndim else y_true.reshape(pred.shape))
+        correct = (pred == true.astype(pred.dtype))
+    else:
+        pred = (y_pred.reshape(-1) > 0.5)
+        correct = (pred == (y_true.reshape(-1) > 0.5))
+    return {"correct": jnp.sum(correct.astype(jnp.float32)),
+            "count": jnp.asarray(correct.size, jnp.float32)}
+
+
+def accuracy() -> Metric:
+    """Top-1 / binary accuracy (``metrics/Accuracy.scala``)."""
+    return Metric("accuracy", _binary_or_top1,
+                  lambda s: s["correct"] / jnp.maximum(s["count"], 1.0))
+
+
+def top5_accuracy() -> Metric:
+    def update(y_true, y_pred):
+        true = (jnp.argmax(y_true, axis=-1) if y_true.ndim == y_pred.ndim
+                else y_true.reshape(y_pred.shape[:-1])).astype(jnp.int32)
+        top5 = jnp.argsort(y_pred, axis=-1)[..., -5:]
+        correct = jnp.any(top5 == true[..., None], axis=-1)
+        return {"correct": jnp.sum(correct.astype(jnp.float32)),
+                "count": jnp.asarray(correct.size, jnp.float32)}
+    return Metric("top5_accuracy", update,
+                  lambda s: s["correct"] / jnp.maximum(s["count"], 1.0))
+
+
+def mae() -> Metric:
+    def update(y_true, y_pred):
+        err = jnp.abs(jnp.asarray(y_pred, jnp.float32)
+                      - jnp.asarray(y_true, jnp.float32).reshape(jnp.asarray(y_pred).shape))
+        return {"sum": jnp.sum(err), "count": jnp.asarray(err.size, jnp.float32)}
+    return Metric("mae", update, lambda s: s["sum"] / jnp.maximum(s["count"], 1.0))
+
+
+def mse() -> Metric:
+    def update(y_true, y_pred):
+        err = jnp.square(jnp.asarray(y_pred, jnp.float32)
+                         - jnp.asarray(y_true, jnp.float32).reshape(jnp.asarray(y_pred).shape))
+        return {"sum": jnp.sum(err), "count": jnp.asarray(err.size, jnp.float32)}
+    return Metric("mse", update, lambda s: s["sum"] / jnp.maximum(s["count"], 1.0))
+
+
+def auc(n_thresholds: int = 200) -> Metric:
+    """Streaming AUC via fixed thresholds (``metrics/AUC.scala``).
+    Static-shape histogram accumulation — no sort, XLA-friendly."""
+
+    def update(y_true, y_pred):
+        scores = jnp.asarray(y_pred, jnp.float32).reshape(-1)
+        labels = jnp.asarray(y_true, jnp.float32).reshape(-1)
+        thresholds = jnp.linspace(0.0, 1.0, n_thresholds)
+        pred_pos = scores[None, :] >= thresholds[:, None]  # (T, N)
+        tp = jnp.sum(pred_pos * labels[None, :], axis=1)
+        fp = jnp.sum(pred_pos * (1.0 - labels[None, :]), axis=1)
+        return {"tp": tp, "fp": fp,
+                "pos": jnp.sum(labels), "neg": jnp.sum(1.0 - labels)}
+
+    def finalize(s):
+        tpr = s["tp"] / jnp.maximum(s["pos"], 1.0)
+        fpr = s["fp"] / jnp.maximum(s["neg"], 1.0)
+        # thresholds ascending → fpr descending; integrate |d fpr| * avg tpr
+        return jnp.sum((fpr[:-1] - fpr[1:]) * 0.5 * (tpr[:-1] + tpr[1:]))
+
+    return Metric("auc", update, finalize)
+
+
+METRICS = {
+    "accuracy": accuracy,
+    "acc": accuracy,
+    "top5": top5_accuracy,
+    "top5_accuracy": top5_accuracy,
+    "mae": mae,
+    "mse": mse,
+    "auc": auc,
+}
+
+
+def get_metric(m: Union[str, Metric]) -> Metric:
+    if isinstance(m, Metric):
+        return m
+    if m not in METRICS:
+        raise ValueError(f"unknown metric {m!r}; available: {sorted(METRICS)}")
+    return METRICS[m]()

@@ -1,0 +1,165 @@
+"""Loss functions — parity with the reference's 15 objectives
+(``pipeline/api/keras/objectives/*.scala``: BinaryCrossEntropy,
+CategoricalCrossEntropy, SparseCategoricalCrossEntropy, MeanSquaredError,
+MeanAbsoluteError, MeanAbsolutePercentageError, MeanSquaredLogarithmicError,
+Hinge, SquaredHinge, RankHinge, KullbackLeiblerDivergence, Poisson,
+CosineProximity).
+
+Every loss is ``fn(y_true, y_pred) -> scalar`` (mean over batch), computed in
+float32 for numerical stability regardless of the compute dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def _f32(y_true, y_pred):
+    return jnp.asarray(y_true, jnp.float32), jnp.asarray(y_pred, jnp.float32)
+
+
+def mean_squared_error(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    return jnp.mean(jnp.square(y_pred - y_true))
+
+
+def mean_absolute_error(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    return jnp.mean(jnp.abs(y_pred - y_true))
+
+
+def mean_absolute_percentage_error(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    diff = jnp.abs((y_true - y_pred) / jnp.maximum(jnp.abs(y_true), _EPS))
+    return 100.0 * jnp.mean(diff)
+
+
+def mean_squared_logarithmic_error(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    a = jnp.log(jnp.maximum(y_pred, _EPS) + 1.0)
+    b = jnp.log(jnp.maximum(y_true, _EPS) + 1.0)
+    return jnp.mean(jnp.square(a - b))
+
+
+def binary_crossentropy(y_true, y_pred):
+    """Probability-space BCE (the model emits sigmoid outputs, as the
+    reference's ``BinaryCrossEntropy`` expects)."""
+    y_true, y_pred = _f32(y_true, y_pred)
+    p = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
+    return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log1p(-p))
+
+
+def binary_crossentropy_from_logits(y_true, y_pred):
+    """Fused logits BCE — numerically superior; preferred TPU path."""
+    y_true, y_pred = _f32(y_true, y_pred)
+    return jnp.mean(jnp.maximum(y_pred, 0) - y_pred * y_true
+                    + jnp.log1p(jnp.exp(-jnp.abs(y_pred))))
+
+
+def categorical_crossentropy(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    p = jnp.clip(y_pred, _EPS, 1.0)
+    return -jnp.mean(jnp.sum(y_true * jnp.log(p), axis=-1))
+
+
+def categorical_crossentropy_from_logits(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    logp = jax.nn.log_softmax(y_pred, axis=-1)
+    return -jnp.mean(jnp.sum(y_true * logp, axis=-1))
+
+
+def sparse_categorical_crossentropy(y_true, y_pred):
+    """``SparseCategoricalCrossEntropy.scala`` — integer labels (0-based here;
+    the reference uses zeroBasedLabel=true by default too)."""
+    y_pred = jnp.asarray(y_pred, jnp.float32)
+    labels = jnp.asarray(y_true, jnp.int32).reshape(y_pred.shape[:-1])
+    p = jnp.clip(y_pred, _EPS, 1.0)
+    logp = jnp.log(p)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def sparse_categorical_crossentropy_from_logits(y_true, y_pred):
+    y_pred = jnp.asarray(y_pred, jnp.float32)
+    labels = jnp.asarray(y_true, jnp.int32).reshape(y_pred.shape[:-1])
+    logp = jax.nn.log_softmax(y_pred, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def hinge(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
+
+
+def squared_hinge(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    return jnp.mean(jnp.square(jnp.maximum(1.0 - y_true * y_pred, 0.0)))
+
+
+def rank_hinge(y_true, y_pred, margin: float = 1.0):
+    """``RankHinge.scala`` — pairwise ranking loss for QA ranking. Assumes
+    consecutive (positive, negative) pairs in the batch, as the reference's
+    text-matching pipeline arranges (``feature/common/Relations.scala``)."""
+    y_pred = jnp.asarray(y_pred, jnp.float32).reshape(-1)
+    pos = y_pred[0::2]
+    neg = y_pred[1::2]
+    return jnp.mean(jnp.maximum(margin - pos + neg, 0.0))
+
+
+def kullback_leibler_divergence(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    p = jnp.clip(y_true, _EPS, 1.0)
+    q = jnp.clip(y_pred, _EPS, 1.0)
+    return jnp.mean(jnp.sum(p * jnp.log(p / q), axis=-1))
+
+
+def poisson(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    return jnp.mean(y_pred - y_true * jnp.log(y_pred + _EPS))
+
+
+def cosine_proximity(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    t = y_true / (jnp.linalg.norm(y_true, axis=-1, keepdims=True) + _EPS)
+    p = y_pred / (jnp.linalg.norm(y_pred, axis=-1, keepdims=True) + _EPS)
+    return -jnp.mean(jnp.sum(t * p, axis=-1))
+
+
+LOSSES = {
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "mape": mean_absolute_percentage_error,
+    "msle": mean_squared_logarithmic_error,
+    "binary_crossentropy": binary_crossentropy,
+    "bce": binary_crossentropy,
+    "bce_with_logits": binary_crossentropy_from_logits,
+    "categorical_crossentropy": categorical_crossentropy,
+    "cce": categorical_crossentropy,
+    "cce_with_logits": categorical_crossentropy_from_logits,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "scce": sparse_categorical_crossentropy,
+    "scce_with_logits": sparse_categorical_crossentropy_from_logits,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "rank_hinge": rank_hinge,
+    "kld": kullback_leibler_divergence,
+    "kullback_leibler_divergence": kullback_leibler_divergence,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+}
+
+
+def get_loss(loss: Union[str, Callable]) -> Callable:
+    if callable(loss):
+        return loss
+    if loss not in LOSSES:
+        raise ValueError(f"unknown loss {loss!r}; available: {sorted(LOSSES)}")
+    return LOSSES[loss]

@@ -1,0 +1,111 @@
+"""Engine tests: Sequential/Model building, shape inference, autograd
+Variables — the counterpart of the reference's layer specs + ZooSpecHelper
+(``keras/ZooSpecHelper.scala:34-80``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.keras import Sequential, Model, Input
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Dense, Dropout, Flatten, Embedding, Merge, merge, Activation, Reshape,
+    BatchNormalization, LayerNorm, TimeDistributed, Highway,
+)
+
+
+def test_sequential_build_and_forward(rng):
+    m = Sequential([
+        Dense(16, activation="relu", input_shape=(8,)),
+        Dropout(0.5),
+        Dense(4, activation="softmax"),
+    ])
+    params, state = m.init(rng)
+    x = jnp.ones((2, 8))
+    y = m.call(params, x)
+    assert y.shape == (2, 4)
+    np.testing.assert_allclose(np.sum(np.asarray(y), axis=-1), 1.0, rtol=1e-5)
+
+
+def test_sequential_dropout_train_vs_eval(rng):
+    m = Sequential([Dense(32, input_shape=(8,)), Dropout(0.9)])
+    params, state = m.init(rng)
+    x = jnp.ones((4, 8))
+    y_eval = m.call(params, x, training=False)
+    y_train = m.call(params, x, training=True, rng=jax.random.key(1))
+    assert not np.allclose(y_eval, y_train)
+
+
+def test_graph_model_multi_input(rng):
+    a = Input(shape=(4,))
+    b = Input(shape=(4,))
+    ha = Dense(8)(a)
+    hb = Dense(8)(b)
+    out = Dense(2)(merge([ha, hb], mode="concat"))
+    m = Model(input=[a, b], output=out)
+    params, state = m.init(rng)
+    y = m.call(params, [jnp.ones((3, 4)), jnp.zeros((3, 4))])
+    assert y.shape == (3, 2)
+
+
+def test_autograd_variable_ops(rng):
+    a = Input(shape=(5,))
+    out = (a * 2.0 + 1.0) / 2.0 - 0.5
+    m = Model(input=a, output=out)
+    params, _ = m.init(rng)
+    x = jnp.arange(5.0)[None, :]
+    y = m.call(params, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6)
+
+
+def test_embedding(rng):
+    m = Sequential([Embedding(10, 6, input_length=3), Flatten()])
+    params, state = m.init(rng)
+    x = jnp.array([[1, 2, 3], [0, 0, 9]])
+    y = m.call(params, x)
+    assert y.shape == (2, 18)
+
+
+def test_batchnorm_state_updates(rng):
+    m = Sequential([BatchNormalization(input_shape=(4,))])
+    params, state = m.init(rng)
+    x = jnp.asarray(np.random.default_rng(0).normal(5.0, 2.0, (64, 4)), jnp.float32)
+    y, new_state = m.apply(params, state, x, training=True)
+    bn_state = list(new_state.values())[0]
+    assert not np.allclose(bn_state["moving_mean"], 0.0)
+    # training output is standardized
+    assert abs(float(jnp.mean(y))) < 0.1
+
+
+def test_layernorm(rng):
+    m = Sequential([LayerNorm(input_shape=(6,))])
+    params, _ = m.init(rng)
+    x = jnp.asarray(np.random.default_rng(0).normal(3.0, 4.0, (2, 6)), jnp.float32)
+    y = m.call(params, x)
+    np.testing.assert_allclose(np.mean(np.asarray(y), axis=-1), 0.0, atol=1e-5)
+
+
+def test_time_distributed(rng):
+    m = Sequential([TimeDistributed(Dense(3), input_shape=(5, 4))])
+    params, _ = m.init(rng)
+    y = m.call(params, jnp.ones((2, 5, 4)))
+    assert y.shape == (2, 5, 3)
+
+
+def test_nested_sequential(rng):
+    inner = Sequential([Dense(8, input_shape=(4,))])
+    outer = Sequential([inner, Dense(2)])
+    params, _ = outer.init(rng, input_shape=(4,))
+    y = outer.call(params, jnp.ones((2, 4)))
+    assert y.shape == (2, 2)
+
+
+def test_new_graph_surgery(rng):
+    a = Input(shape=(4,))
+    h = Dense(8, name="feat")(a)
+    out = Dense(2)(h)
+    m = Model(input=a, output=out)
+    params, _ = m.init(rng)
+    sub = m.new_graph(["feat"])
+    y = sub.call(params, jnp.ones((2, 4)))
+    assert y.shape == (2, 8)

@@ -44,6 +44,24 @@ DEFAULT_CONF: Dict[str, Any] = {
 
 _ENV_PREFIX = "ZOO_TPU_"
 
+#: normalized ("zoo_failure_retry_times") → canonical ("zoo.failure.retry_times")
+#: so env/kwargs spellings of multi-word leaf keys land on the right conf entry
+_CANONICAL = {k.lower().replace(".", "_"): k for k in DEFAULT_CONF}
+
+
+def _canonical_key(raw: str) -> str:
+    """Map an underscore-separated key (env var / kwarg) to its canonical
+    dotted form. Known keys resolve via DEFAULT_CONF regardless of whether an
+    underscore is a namespace separator or part of a leaf name
+    (``failure_retry_times`` → ``zoo.failure.retry_times``); unknown keys fall
+    back to dots-for-underscores."""
+    norm = raw.lower().replace(".", "_")
+    if not norm.startswith("zoo_"):
+        norm = "zoo_" + norm
+    if norm in _CANONICAL:
+        return _CANONICAL[norm]
+    return norm.replace("_", ".")
+
 
 def _env_overrides() -> Dict[str, Any]:
     """``ZOO_TPU_MESH_MODEL=2`` → ``{"zoo.mesh.model": 2}`` — the analogue of
@@ -51,8 +69,7 @@ def _env_overrides() -> Dict[str, Any]:
     out: Dict[str, Any] = {}
     for k, v in os.environ.items():
         if k.startswith(_ENV_PREFIX):
-            key = "zoo." + k[len(_ENV_PREFIX):].lower().replace("_", ".")
-            out[key] = _parse_scalar(v)
+            out[_canonical_key(k[len(_ENV_PREFIX):])] = _parse_scalar(v)
     return out
 
 
@@ -171,7 +188,7 @@ def init_zoo_context(
     if conf:
         merged.update(conf)
     for k, v in kwargs.items():
-        merged["zoo." + k.replace("_", ".")] = v
+        merged[_canonical_key(k)] = v
 
     logging.basicConfig(level=merged.get("zoo.log.level", "INFO"))
 

@@ -1,0 +1,4 @@
+from .common import (ArrayToTensor, ChainedPreprocessing,  # noqa: F401
+                     FeatureLabelPreprocessing, FnPreprocessing, Normalize,
+                     Preprocessing, ScalarToTensor, SeqToTensor)
+from .feature_set import FeatureSet, prefetch_to_device  # noqa: F401

@@ -1,0 +1,211 @@
+"""FeatureSet — the TPU-native data-caching layer, replacing the reference's
+``FeatureSet.scala`` family:
+
+* ``CachedDistributedFeatureSet`` (``FeatureSet.scala:222-322``): per-partition
+  in-memory cache + shuffled index + an *infinite looped iterator* for
+  training → here an in-host-RAM numpy cache with a per-epoch reshuffled
+  permutation and an infinite batch generator.
+* ``DiskFeatureSet`` DRAM-slice semantics (``FeatureSet.scala:332-409``) →
+  ``numpy.memmap``-backed arrays pass straight through: the OS page cache is
+  the slice manager, so datasets larger than RAM stream from disk.
+* factory ``FeatureSet.rdd(memoryType=...)`` (``FeatureSet.scala:423-466``) →
+  ``FeatureSet.array(...)`` / ``FeatureSet.from_iterable(...)``.
+
+TPU-critical difference from round 1's synchronous per-batch indexing: batches
+are assembled on a background thread and transferred with double-buffered
+``device_put`` (``prefetch_to_device``), so the chip never waits on the host —
+the role Spark's per-partition parallelism plays for the reference.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel import mesh as mesh_lib
+from .common import Preprocessing
+
+
+def _as_list(x) -> List[np.ndarray]:
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class FeatureSet:
+    """In-memory (host-RAM) cached dataset of ``x`` (array or list of arrays)
+    and optional ``y``. One instance per host process; under multi-host each
+    host holds its shard of the global dataset, mirroring the reference's
+    per-partition caches."""
+
+    def __init__(self, x, y=None, shuffle: bool = True, seed: int = 0):
+        self.xs = [np.asarray(a) for a in _as_list(x)]
+        if not self.xs:
+            raise ValueError("FeatureSet needs at least one feature array")
+        n = self.xs[0].shape[0]
+        for a in self.xs:
+            if a.shape[0] != n:
+                raise ValueError("feature arrays disagree on leading dim")
+        self.y = None if y is None else np.asarray(y)
+        if self.y is not None and self.y.shape[0] != n:
+            raise ValueError("labels disagree with features on leading dim")
+        self.shuffle = shuffle
+        self.seed = seed
+
+    # ---- factories (FeatureSet.scala:423-466) -----------------------------
+    @staticmethod
+    def array(x, y=None, *, shuffle: bool = True, seed: int = 0) -> "FeatureSet":
+        return FeatureSet(x, y, shuffle=shuffle, seed=seed)
+
+    @staticmethod
+    def from_iterable(records: Sequence[Tuple[Any, Any]], *, shuffle: bool = True,
+                      seed: int = 0) -> "FeatureSet":
+        """Build from an iterable of ``(x, y)`` records (the RDD-of-Samples
+        role). Stacks everything into contiguous arrays once."""
+        xs, ys = [], []
+        for rec in records:
+            if isinstance(rec, tuple) and len(rec) == 2:
+                xs.append(rec[0])
+                ys.append(rec[1])
+            else:
+                xs.append(rec)
+        x = np.stack([np.asarray(a) for a in xs])
+        y = np.stack([np.asarray(a) for a in ys]) if ys else None
+        return FeatureSet(x, y, shuffle=shuffle, seed=seed)
+
+    # ---- basic protocol ---------------------------------------------------
+    def __len__(self) -> int:
+        return self.xs[0].shape[0]
+
+    @property
+    def x(self):
+        return self.xs if len(self.xs) > 1 else self.xs[0]
+
+    def transform(self, fn: Union[Preprocessing, Callable]) -> "FeatureSet":
+        """Apply a (vectorized) preprocessing to the cached arrays — the
+        ``featureSet.transform(preprocessing)`` step of the reference
+        (cache-after-transform, ``FeatureSet.scala:222-322``). ``fn`` receives
+        ``(x, y)`` and returns ``(x', y')``."""
+        out = fn((self.x, self.y))
+        x2, y2 = out
+        return FeatureSet(x2, y2, shuffle=self.shuffle, seed=self.seed)
+
+    # ---- iterators --------------------------------------------------------
+    def _order(self, epoch: int) -> np.ndarray:
+        n = len(self)
+        if not self.shuffle:
+            return np.arange(n)
+        return np.random.default_rng(self.seed + epoch).permutation(n)
+
+    def _slice(self, idx) -> Tuple[Any, Any]:
+        bx = [a[idx] for a in self.xs]
+        bx = bx if len(bx) > 1 else bx[0]
+        by = None if self.y is None else self.y[idx]
+        return bx, by
+
+    def iter_batches(self, batch_size: int, *, epoch: int = 0,
+                     drop_last: bool = True) -> Iterator[Tuple[Any, Any]]:
+        """One pass (one 'epoch'), reshuffled by ``epoch`` number."""
+        order = self._order(epoch)
+        n = len(self)
+        end = n - (n % batch_size) if drop_last else n
+        for i in range(0, end, batch_size):
+            yield self._slice(order[i:i + batch_size])
+
+    def infinite_batches(self, batch_size: int, *, start_epoch: int = 0,
+                         ) -> Iterator[Tuple[Any, Any]]:
+        """The training iterator: loops forever, reshuffling every pass —
+        ``CachedDistributedFeatureSet``'s infinite looped iterator
+        (``FeatureSet.scala:264-322``)."""
+        epoch = start_epoch
+        while True:
+            yield from self.iter_batches(batch_size, epoch=epoch, drop_last=True)
+            epoch += 1
+
+    def steps_per_epoch(self, batch_size: int, drop_last: bool = True) -> int:
+        n = len(self)
+        return n // batch_size if drop_last else (n + batch_size - 1) // batch_size
+
+
+# ---------------------------------------------------------------------------
+# async host prefetch + double-buffered device transfer
+# ---------------------------------------------------------------------------
+
+class _ThreadedIterator:
+    """Run a host iterator on a background thread with a bounded queue —
+    overlaps numpy batch assembly with device compute (the reference gets
+    this overlap from Spark's task threads; here it is explicit)."""
+
+    _END = object()
+
+    def __init__(self, it: Iterator, buffer_size: int = 4):
+        self._q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
+        self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+
+        def run():
+            try:
+                for item in it:
+                    if self._stop.is_set():
+                        return
+                    self._q.put(item)
+            except BaseException as e:  # propagate to consumer
+                self._err = e
+            finally:
+                self._q.put(self._END)
+
+        self._t = threading.Thread(target=run, daemon=True)
+        self._t.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._END:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._stop.set()
+        # drain so the producer can observe the stop flag
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+def prefetch_to_device(it: Iterator, mesh=None, *, buffer_size: int = 2,
+                       threaded: bool = True) -> Iterator:
+    """Double-buffered device transfer: keep ``buffer_size`` batches already
+    dispatched to the devices while the current one computes. ``device_put``
+    is async in JAX, so this pipeline hides both host batch assembly (via the
+    background thread) and PCIe/DMA transfer behind the previous step."""
+    sharding = mesh_lib.batch_sharding(mesh)
+
+    def put(item):
+        return jax.tree.map(
+            lambda a: jax.device_put(jnp.asarray(a), sharding) if a is not None else None,
+            item, is_leaf=lambda a: a is None or not isinstance(a, (list, tuple, dict)))
+
+    src = _ThreadedIterator(it, buffer_size=buffer_size + 2) if threaded else it
+    buf: collections.deque = collections.deque()
+    try:
+        for item in src:
+            buf.append(put(item))
+            if len(buf) > buffer_size:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+    finally:
+        if threaded:
+            src.close()

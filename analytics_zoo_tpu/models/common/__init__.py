@@ -1,0 +1,1 @@
+from .zoo_model import ZooModel, load_model, register_model  # noqa: F401

@@ -1,0 +1,121 @@
+"""ZooModel — the built-in model-zoo base, parity with
+``models/common/ZooModel.scala:38-154`` (save/load, predict_classes,
+summary) re-designed for the functional JAX core:
+
+* a ZooModel subclass declares its constructor config and builds an inner
+  Keras-style graph in ``build_model()``; all training/inference methods come
+  from ``KerasNet`` (compile/fit/evaluate/predict are the same jitted paths),
+* ``save(path)`` writes ONE ``.npz`` holding the constructor config (JSON),
+  the registered class name, and every param/state leaf in deterministic
+  ``tree_flatten`` order — ``loadModel`` (``ZooModel.scala:119-154``) becomes
+  ``load_model(path)`` via the class registry.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Type
+
+import jax
+import numpy as np
+
+from ...common.context import get_zoo_context
+from ...pipeline.api.keras.engine import KerasNet
+
+_REGISTRY: Dict[str, Type["ZooModel"]] = {}
+
+
+def register_model(cls: Type["ZooModel"]) -> Type["ZooModel"]:
+    """Class decorator: make a ZooModel loadable by name."""
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class ZooModel(KerasNet):
+    """Base for built-in models. Subclasses implement ``build_model()``
+    returning a ``Sequential``/``Model`` and ``get_config()`` returning the
+    constructor kwargs (used to rebuild on load)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.model = self.build_model()
+
+    # ---- to be overridden -------------------------------------------------
+    def build_model(self) -> KerasNet:
+        raise NotImplementedError(type(self).__name__)
+
+    def get_config(self) -> Dict[str, Any]:
+        raise NotImplementedError(type(self).__name__)
+
+    # ---- Layer protocol: delegate to the inner graph ----------------------
+    @property
+    def input_shape(self):
+        return self.model.input_shape
+
+    def build(self, rng, input_shape=None):
+        return self.model.build(rng, input_shape or self.model.input_shape)
+
+    def initial_state(self, input_shape=None):
+        return self.model.initial_state(input_shape or self.model.input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self.model.apply(params, state, x, training=training, rng=rng)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return self.model.call(params, x, training=training, rng=rng)
+
+    # ---- save / load (ZooModel.scala:38-154) ------------------------------
+    def save(self, path: str, over_write: bool = True) -> str:
+        """``saveModel(path, overWrite)``: one .npz with config + weights."""
+        import os
+        if os.path.exists(path) and not over_write:
+            raise FileExistsError(f"{path} exists and over_write=False")
+        if self.params is None:
+            self.init_weights()
+        p_leaves = jax.tree_util.tree_leaves(self.params)
+        s_leaves = jax.tree_util.tree_leaves(self.net_state)
+        arrays = {f"p_{i}": np.asarray(jax.device_get(l))
+                  for i, l in enumerate(p_leaves)}
+        arrays.update({f"s_{i}": np.asarray(jax.device_get(l))
+                       for i, l in enumerate(s_leaves)})
+        header = json.dumps({"class": type(self).__name__,
+                             "config": self.get_config(),
+                             "n_params": len(p_leaves),
+                             "n_state": len(s_leaves)})
+        np.savez(path, __zoo_header__=np.frombuffer(
+            header.encode("utf-8"), dtype=np.uint8), **arrays)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "ZooModel":
+        return load_model(path)
+
+    def summary(self) -> str:
+        """Param-count summary (``ZooModel`` summary parity)."""
+        if self.params is None:
+            self.init_weights()
+        n = sum(int(np.prod(np.shape(l)))
+                for l in jax.tree_util.tree_leaves(self.params))
+        lines = [f"Model: {type(self).__name__} ({self.name})",
+                 f"Trainable parameters: {n:,}"]
+        return "\n".join(lines)
+
+
+def load_model(path: str) -> ZooModel:
+    """``ZooModel.loadModel`` (``ZooModel.scala:119-154``): rebuild from the
+    registered class + config, then install saved weights."""
+    with np.load(path) as data:
+        header = json.loads(bytes(data["__zoo_header__"]).decode("utf-8"))
+        p_loaded = [data[f"p_{i}"] for i in range(header["n_params"])]
+        s_loaded = [data[f"s_{i}"] for i in range(header["n_state"])]
+    cls = _REGISTRY.get(header["class"])
+    if cls is None:
+        raise ValueError(f"unknown model class {header['class']!r}; "
+                         f"registered: {sorted(_REGISTRY)}")
+    model = cls(**header["config"])
+    model.init_weights(rng=get_zoo_context().rng())
+    _, p_def = jax.tree_util.tree_flatten(model.params)
+    _, s_def = jax.tree_util.tree_flatten(model.net_state)
+    model.params = jax.tree_util.tree_unflatten(p_def, p_loaded)
+    model.net_state = jax.tree_util.tree_unflatten(s_def, s_loaded)
+    return model

@@ -1,0 +1,1 @@
+from .neural_cf import NeuralCF, Recommender  # noqa: F401

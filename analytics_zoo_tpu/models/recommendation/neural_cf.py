@@ -1,0 +1,101 @@
+"""NeuralCF — neural collaborative filtering recommender, parity with
+``models/recommendation/NeuralCF.scala:45-104`` (and pyzoo
+``models/recommendation/neuralcf.py:30``).
+
+Graph (same topology as the reference): input (B, 2) of [user_id, item_id] →
+MLP tower (user/item embeddings concat → Dense-relu stack) and optionally a
+matrix-factorization tower (separate embeddings, elementwise product), concat
+→ softmax over ``class_num`` classes.
+
+TPU notes: both towers are embedding gathers feeding dense matmuls — the
+whole model is one fused XLA program on the MXU; embedding tables live in
+HBM and shard over the ``model`` axis when tensor parallelism is on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...pipeline.api.keras.engine import Input, Model
+from ...pipeline.api.keras.layers import Dense, Embedding, Merge, Select
+from ..common.zoo_model import ZooModel, register_model
+
+
+class Recommender(ZooModel):
+    """Base recommender — ``models/recommendation/Recommender.scala``:
+    convenience prediction APIs over (user, item) pairs."""
+
+    def predict_user_item_pair(self, user_item_pairs: np.ndarray,
+                               batch_size: int = 1024) -> np.ndarray:
+        """Probability per (user, item) row — ``predictUserItemPair``."""
+        return self.predict(np.asarray(user_item_pairs), batch_size=batch_size)
+
+    def recommend_for_user(self, user_id: int, candidate_items: np.ndarray,
+                           max_items: int = 10,
+                           batch_size: int = 1024) -> np.ndarray:
+        """Top-``max_items`` item ids for one user — ``recommendForUser``.
+        Scores every candidate item in one batched forward."""
+        items = np.asarray(candidate_items).reshape(-1)
+        pairs = np.stack([np.full_like(items, user_id), items], axis=1)
+        probs = self.predict(pairs, batch_size=batch_size)
+        # rank by the probability of the highest class (rating), as the
+        # reference ranks by predicted class score
+        scores = probs[:, -1] if probs.ndim > 1 else probs
+        top = np.argsort(-scores)[:max_items]
+        return items[top]
+
+
+@register_model
+class NeuralCF(Recommender):
+    """``NeuralCF(userCount, itemCount, numClasses, userEmbed, itemEmbed,
+    hiddenLayers, includeMF, mfEmbed)`` — NeuralCF.scala:45-104."""
+
+    def __init__(self, user_count: int, item_count: int, class_num: int,
+                 user_embed: int = 20, item_embed: int = 20,
+                 hidden_layers: Sequence[int] = (40, 20, 10),
+                 include_mf: bool = True, mf_embed: int = 20,
+                 name: Optional[str] = None):
+        self.user_count = int(user_count)
+        self.item_count = int(item_count)
+        self.class_num = int(class_num)
+        self.user_embed = int(user_embed)
+        self.item_embed = int(item_embed)
+        self.hidden_layers = tuple(int(h) for h in hidden_layers)
+        self.include_mf = bool(include_mf)
+        self.mf_embed = int(mf_embed)
+        super().__init__(name=name)
+
+    def build_model(self) -> Model:
+        inp = Input(shape=(2,), name=self.name + "_input" if self.name else None)
+        user = Select(1, 0)(inp)   # (B,) user ids
+        item = Select(1, 1)(inp)   # (B,) item ids
+
+        # +1: the reference reserves id 0 / uses 1-based ids (NeuralCF.scala:67)
+        mlp_user = Embedding(self.user_count + 1, self.user_embed,
+                             init="normal")(user)
+        mlp_item = Embedding(self.item_count + 1, self.item_embed,
+                             init="normal")(item)
+        h = Merge(mode="concat", concat_axis=-1)([mlp_user, mlp_item])
+        for units in self.hidden_layers:
+            h = Dense(units, activation="relu")(h)
+
+        if self.include_mf:
+            if self.mf_embed <= 0:
+                raise ValueError("mf_embed must be positive when include_mf")
+            mf_user = Embedding(self.user_count + 1, self.mf_embed,
+                                init="normal")(user)
+            mf_item = Embedding(self.item_count + 1, self.mf_embed,
+                                init="normal")(item)
+            mf = Merge(mode="mul")([mf_user, mf_item])
+            h = Merge(mode="concat", concat_axis=-1)([h, mf])
+        out = Dense(self.class_num, activation="softmax")(h)
+        return Model(inp, out)
+
+    def get_config(self) -> Dict[str, Any]:
+        return {"user_count": self.user_count, "item_count": self.item_count,
+                "class_num": self.class_num, "user_embed": self.user_embed,
+                "item_embed": self.item_embed,
+                "hidden_layers": list(self.hidden_layers),
+                "include_mf": self.include_mf, "mf_embed": self.mf_embed}

@@ -116,6 +116,10 @@ class Layer:
     """
 
     def __init__(self, name: Optional[str] = None, input_shape: Optional[Tuple] = None):
+        # _auto_name marks names eligible for deterministic renaming when the
+        # layer joins a container — cross-instance checkpoint/weight files
+        # must not depend on process-global uid counters
+        self._auto_name = name is None
         self.name = name or unique_name(type(self).__name__.lower() + "_")
         # Keras-1 convention: user-facing input_shape excludes the batch dim
         # (``KerasLayer.inputShape``); internally we carry (None, *dims).
@@ -323,6 +327,16 @@ class Sequential(KerasNet):
             self.add(l)
 
     def add(self, layer: Layer) -> "Sequential":
+        if getattr(layer, "_auto_name", False):
+            # deterministic position-based name: two identically-built models
+            # (even in one process) produce identical param keys, so saved
+            # weights/checkpoints restore by structure, not by uid counters
+            taken = {l.name for l in self.layers}
+            cand = f"{type(layer).__name__.lower()}_{len(self.layers)}"
+            while cand in taken:  # dodge user-chosen names
+                cand += "_"
+            layer.name = cand
+            layer._auto_name = False  # keep one name if the layer is reused
         self.layers.append(layer)
         return self
 
@@ -340,6 +354,12 @@ class Sequential(KerasNet):
         if shape is None:
             raise ValueError(
                 f"{self.name}: first layer needs input_shape=..., or pass one to init()")
+        names = [l.name for l in self.layers]
+        if len(set(names)) != len(names):
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(
+                f"{self.name}: duplicate layer names {dupes} — params would "
+                f"silently collide; give the layers distinct name=...")
         params: Dict[str, Any] = {}
         self._shapes = []
         keys = jax.random.split(rng, max(len(self.layers), 1))
@@ -388,6 +408,19 @@ class Model(KerasNet):
         self.outputs: List[Variable] = list(output) if isinstance(output, (list, tuple)) else [output]
         self._multi_output = isinstance(output, (list, tuple))
         self._topo = self._toposort()
+        # deterministic topo-order names (see Sequential.add): identical
+        # graphs get identical param keys regardless of uid-counter state
+        taken = {n.layer.name for n in self._topo
+                 if not getattr(n.layer, "_auto_name", False)}
+        for i, node in enumerate(self._topo):
+            if getattr(node.layer, "_auto_name", False):
+                cand = f"{type(node.layer).__name__.lower()}_{i}"
+                while cand in taken:  # dodge user-chosen names
+                    cand += "_"
+                node.layer.name = cand
+                taken.add(cand)
+                node.layer._auto_name = False  # shared layers keep one name
+            node.name = node.layer.name
 
     def _toposort(self) -> List[Node]:
         seen: Dict[int, Node] = {}
@@ -411,6 +444,15 @@ class Model(KerasNet):
         return shapes if len(shapes) > 1 else shapes[0]
 
     def build(self, rng, input_shape=None):
+        by_name: Dict[str, int] = {}
+        for n in self._topo:
+            if n.parents:  # param-bearing nodes only
+                prev = by_name.setdefault(n.name, id(n.layer))
+                if prev != id(n.layer):  # same layer object = weight sharing, OK
+                    raise ValueError(
+                        f"{self.name}: two different layers named {n.name!r} — "
+                        f"params would silently collide; give them distinct "
+                        f"name=...")
         shapes = input_shape or self.input_shape
         if not isinstance(shapes, list):
             shapes = [shapes]

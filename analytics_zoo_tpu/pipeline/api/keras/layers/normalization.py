@@ -3,10 +3,12 @@ and ``keras/layers/LayerNorm.scala``.
 
 BatchNorm carries its moving statistics as non-trainable *state* threaded
 functionally through ``apply`` (no mutation — jit/shard safe). Under data
-parallelism the batch statistics are computed per-shard; XLA's SPMD partitioner
-keeps them consistent because the reduction runs inside the sharded program
-(cross-replica syncing of moving stats matches the reference's per-replica
-behaviour, which also keeps local stats, ``Topology.scala:1150-1158``).
+parallelism the batch-axis reduction runs *inside* the sharded program, so
+XLA's SPMD partitioner turns it into a global (all-reduced) mean/var — i.e.
+sync-BatchNorm: statistics are identical for dp=1 and dp=N (asserted by
+``tests/test_layers.py::test_batchnorm_dp_invariant``). This is a deliberate
+improvement over the reference, whose per-replica modules keep local stats
+(``Topology.scala:1150-1158``).
 """
 
 from __future__ import annotations
@@ -52,8 +54,11 @@ class BatchNormalization(Layer):
         }
 
     def apply(self, params, state, x, *, training=False, rng=None):
-        reduce_axes = tuple(i for i in range(x.ndim) if i != (x.ndim + self.axis
-                            if self.axis < 0 else self.axis))
+        axis = x.ndim + self.axis if self.axis < 0 else self.axis
+        reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+        # broadcast (d,)-shaped stats/params against the normalized axis, not
+        # blindly against the last axis — axis=1 on (B, C, L) must work
+        bshape = tuple(x.shape[axis] if i == axis else 1 for i in range(x.ndim))
         if training:
             mean = jnp.mean(x.astype(jnp.float32), axis=reduce_axes)
             var = jnp.var(x.astype(jnp.float32), axis=reduce_axes)
@@ -66,11 +71,12 @@ class BatchNormalization(Layer):
             mean, var = state["moving_mean"], state["moving_var"]
             new_state = state
         inv = jax.lax.rsqrt(var + self.epsilon)
-        y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+        y = (x - mean.astype(x.dtype).reshape(bshape)) \
+            * inv.astype(x.dtype).reshape(bshape)
         if self.scale:
-            y = y * params["gamma"].astype(x.dtype)
+            y = y * params["gamma"].astype(x.dtype).reshape(bshape)
         if self.center:
-            y = y + params["beta"].astype(x.dtype)
+            y = y + params["beta"].astype(x.dtype).reshape(bshape)
         return y, new_state
 
 

@@ -5,8 +5,11 @@ accuracy, Loss).
 A metric is a pair of jittable functions so evaluation streams over batches
 without host sync:
 
-* ``update(y_true, y_pred) -> stats``  — per-batch sufficient statistics
-* ``finalize(stats) -> scalar``        — combine (stats are summed over batches)
+* ``update(y_true, y_pred, mask=None) -> stats`` — per-batch sufficient
+  statistics. ``mask`` is an optional (B,) 0/1 weight used by ``evaluate`` to
+  exclude padded tail rows (the reference pads the last minibatch; here the
+  padding is masked out of the statistics instead of miscounted).
+* ``finalize(stats) -> scalar`` — combine (stats are summed over batches).
 """
 
 from __future__ import annotations
@@ -18,11 +21,26 @@ import jax.numpy as jnp
 
 class Metric(NamedTuple):
     name: str
-    update: Callable  # (y_true, y_pred) -> stats pytree (summable)
+    update: Callable  # (y_true, y_pred, mask=None) -> stats pytree (summable)
     finalize: Callable  # stats -> scalar
 
 
-def _binary_or_top1(y_true, y_pred):
+def _mask_of(mask, batch):
+    if mask is None:
+        return jnp.ones((batch,), jnp.float32)
+    return jnp.asarray(mask, jnp.float32).reshape(-1)
+
+
+def _example_weights(mask, shape):
+    """Broadcast a per-example (B,) mask over an array of ``shape`` whose
+    leading axis is the batch — every element of example i gets weight
+    mask[i]. The single place the weighting rule lives."""
+    w = _mask_of(mask, shape[0])
+    w = w.reshape((shape[0],) + (1,) * (len(shape) - 1))
+    return jnp.broadcast_to(w, shape)
+
+
+def _binary_or_top1(y_true, y_pred, mask=None):
     y_pred = jnp.asarray(y_pred)
     y_true = jnp.asarray(y_true)
     if y_pred.ndim > 1 and y_pred.shape[-1] > 1:
@@ -31,10 +49,12 @@ def _binary_or_top1(y_true, y_pred):
                 if y_true.ndim == y_pred.ndim else y_true.reshape(pred.shape))
         correct = (pred == true.astype(pred.dtype))
     else:
-        pred = (y_pred.reshape(-1) > 0.5)
-        correct = (pred == (y_true.reshape(-1) > 0.5))
-    return {"correct": jnp.sum(correct.astype(jnp.float32)),
-            "count": jnp.asarray(correct.size, jnp.float32)}
+        # keep the batch axis leading (no flatten) so masking stays per-example
+        pred = (y_pred > 0.5)
+        correct = (pred == (y_true.reshape(y_pred.shape) > 0.5))
+    w = _example_weights(mask, correct.shape)
+    return {"correct": jnp.sum(correct.astype(jnp.float32) * w),
+            "count": jnp.sum(w)}
 
 
 def accuracy() -> Metric:
@@ -44,30 +64,37 @@ def accuracy() -> Metric:
 
 
 def top5_accuracy() -> Metric:
-    def update(y_true, y_pred):
+    def update(y_true, y_pred, mask=None):
         true = (jnp.argmax(y_true, axis=-1) if y_true.ndim == y_pred.ndim
                 else y_true.reshape(y_pred.shape[:-1])).astype(jnp.int32)
         top5 = jnp.argsort(y_pred, axis=-1)[..., -5:]
         correct = jnp.any(top5 == true[..., None], axis=-1)
-        return {"correct": jnp.sum(correct.astype(jnp.float32)),
-                "count": jnp.asarray(correct.size, jnp.float32)}
+        w = _example_weights(mask, correct.shape)
+        return {"correct": jnp.sum(correct.astype(jnp.float32) * w),
+                "count": jnp.sum(w)}
     return Metric("top5_accuracy", update,
                   lambda s: s["correct"] / jnp.maximum(s["count"], 1.0))
 
 
+def _elementwise_stats(err, mask):
+    """Sum/count of an elementwise error array, weighted per example."""
+    w = _example_weights(mask, err.shape)
+    return {"sum": jnp.sum(err * w), "count": jnp.sum(w)}
+
+
 def mae() -> Metric:
-    def update(y_true, y_pred):
+    def update(y_true, y_pred, mask=None):
         err = jnp.abs(jnp.asarray(y_pred, jnp.float32)
                       - jnp.asarray(y_true, jnp.float32).reshape(jnp.asarray(y_pred).shape))
-        return {"sum": jnp.sum(err), "count": jnp.asarray(err.size, jnp.float32)}
+        return _elementwise_stats(err, mask)
     return Metric("mae", update, lambda s: s["sum"] / jnp.maximum(s["count"], 1.0))
 
 
 def mse() -> Metric:
-    def update(y_true, y_pred):
+    def update(y_true, y_pred, mask=None):
         err = jnp.square(jnp.asarray(y_pred, jnp.float32)
                          - jnp.asarray(y_true, jnp.float32).reshape(jnp.asarray(y_pred).shape))
-        return {"sum": jnp.sum(err), "count": jnp.asarray(err.size, jnp.float32)}
+        return _elementwise_stats(err, mask)
     return Metric("mse", update, lambda s: s["sum"] / jnp.maximum(s["count"], 1.0))
 
 
@@ -75,15 +102,19 @@ def auc(n_thresholds: int = 200) -> Metric:
     """Streaming AUC via fixed thresholds (``metrics/AUC.scala``).
     Static-shape histogram accumulation — no sort, XLA-friendly."""
 
-    def update(y_true, y_pred):
-        scores = jnp.asarray(y_pred, jnp.float32).reshape(-1)
+    def update(y_true, y_pred, mask=None):
+        y_pred = jnp.asarray(y_pred, jnp.float32)
+        # weight per element BEFORE flattening so a (B,) mask covers
+        # multi-dim outputs like (B, T, 1)
+        w = _example_weights(mask, y_pred.shape).reshape(-1)
+        scores = y_pred.reshape(-1)
         labels = jnp.asarray(y_true, jnp.float32).reshape(-1)
         thresholds = jnp.linspace(0.0, 1.0, n_thresholds)
         pred_pos = scores[None, :] >= thresholds[:, None]  # (T, N)
-        tp = jnp.sum(pred_pos * labels[None, :], axis=1)
-        fp = jnp.sum(pred_pos * (1.0 - labels[None, :]), axis=1)
+        tp = jnp.sum(pred_pos * (labels * w)[None, :], axis=1)
+        fp = jnp.sum(pred_pos * ((1.0 - labels) * w)[None, :], axis=1)
         return {"tp": tp, "fp": fp,
-                "pos": jnp.sum(labels), "neg": jnp.sum(1.0 - labels)}
+                "pos": jnp.sum(labels * w), "neg": jnp.sum((1.0 - labels) * w)}
 
     def finalize(s):
         tpr = s["tp"] / jnp.maximum(s["pos"], 1.0)

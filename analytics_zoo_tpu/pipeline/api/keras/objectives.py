@@ -5,13 +5,18 @@ MeanAbsoluteError, MeanAbsolutePercentageError, MeanSquaredLogarithmicError,
 Hinge, SquaredHinge, RankHinge, KullbackLeiblerDivergence, Poisson,
 CosineProximity).
 
-Every loss is ``fn(y_true, y_pred) -> scalar`` (mean over batch), computed in
-float32 for numerical stability regardless of the compute dtype.
+Every loss has two forms:
+
+* ``fn(y_true, y_pred) -> scalar`` — mean over the batch (the training path);
+* a *per-example* form ``(y_true, y_pred) -> (B,)`` in ``PER_EXAMPLE_LOSSES``
+  used by ``evaluate`` to mask padded tail rows out of the statistics.
+
+All computed in float32 for numerical stability regardless of compute dtype.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Union
+from typing import Callable, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -23,112 +28,154 @@ def _f32(y_true, y_pred):
     return jnp.asarray(y_true, jnp.float32), jnp.asarray(y_pred, jnp.float32)
 
 
-def mean_squared_error(y_true, y_pred):
+def _per_example(x):
+    """Mean over all non-batch axes → shape (B,)."""
+    x = jnp.asarray(x)
+    if x.ndim <= 1:
+        return x.reshape(-1)
+    return jnp.mean(x.reshape(x.shape[0], -1), axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# per-example forms
+# ---------------------------------------------------------------------------
+
+def mean_squared_error_pe(y_true, y_pred):
     y_true, y_pred = _f32(y_true, y_pred)
-    return jnp.mean(jnp.square(y_pred - y_true))
+    return _per_example(jnp.square(y_pred - y_true))
 
 
-def mean_absolute_error(y_true, y_pred):
+def mean_absolute_error_pe(y_true, y_pred):
     y_true, y_pred = _f32(y_true, y_pred)
-    return jnp.mean(jnp.abs(y_pred - y_true))
+    return _per_example(jnp.abs(y_pred - y_true))
 
 
-def mean_absolute_percentage_error(y_true, y_pred):
+def mean_absolute_percentage_error_pe(y_true, y_pred):
     y_true, y_pred = _f32(y_true, y_pred)
     diff = jnp.abs((y_true - y_pred) / jnp.maximum(jnp.abs(y_true), _EPS))
-    return 100.0 * jnp.mean(diff)
+    return 100.0 * _per_example(diff)
 
 
-def mean_squared_logarithmic_error(y_true, y_pred):
+def mean_squared_logarithmic_error_pe(y_true, y_pred):
     y_true, y_pred = _f32(y_true, y_pred)
     a = jnp.log(jnp.maximum(y_pred, _EPS) + 1.0)
     b = jnp.log(jnp.maximum(y_true, _EPS) + 1.0)
-    return jnp.mean(jnp.square(a - b))
+    return _per_example(jnp.square(a - b))
 
 
-def binary_crossentropy(y_true, y_pred):
+def binary_crossentropy_pe(y_true, y_pred):
     """Probability-space BCE (the model emits sigmoid outputs, as the
     reference's ``BinaryCrossEntropy`` expects)."""
     y_true, y_pred = _f32(y_true, y_pred)
     p = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
-    return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log1p(-p))
+    return _per_example(-(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log1p(-p)))
 
 
-def binary_crossentropy_from_logits(y_true, y_pred):
+def binary_crossentropy_from_logits_pe(y_true, y_pred):
     """Fused logits BCE — numerically superior; preferred TPU path."""
     y_true, y_pred = _f32(y_true, y_pred)
-    return jnp.mean(jnp.maximum(y_pred, 0) - y_pred * y_true
-                    + jnp.log1p(jnp.exp(-jnp.abs(y_pred))))
+    return _per_example(jnp.maximum(y_pred, 0) - y_pred * y_true
+                        + jnp.log1p(jnp.exp(-jnp.abs(y_pred))))
 
 
-def categorical_crossentropy(y_true, y_pred):
+def categorical_crossentropy_pe(y_true, y_pred):
     y_true, y_pred = _f32(y_true, y_pred)
     p = jnp.clip(y_pred, _EPS, 1.0)
-    return -jnp.mean(jnp.sum(y_true * jnp.log(p), axis=-1))
+    return _per_example(-jnp.sum(y_true * jnp.log(p), axis=-1))
 
 
-def categorical_crossentropy_from_logits(y_true, y_pred):
+def categorical_crossentropy_from_logits_pe(y_true, y_pred):
     y_true, y_pred = _f32(y_true, y_pred)
     logp = jax.nn.log_softmax(y_pred, axis=-1)
-    return -jnp.mean(jnp.sum(y_true * logp, axis=-1))
+    return _per_example(-jnp.sum(y_true * logp, axis=-1))
 
 
-def sparse_categorical_crossentropy(y_true, y_pred):
+def sparse_categorical_crossentropy_pe(y_true, y_pred):
     """``SparseCategoricalCrossEntropy.scala`` — integer labels (0-based here;
     the reference uses zeroBasedLabel=true by default too)."""
     y_pred = jnp.asarray(y_pred, jnp.float32)
     labels = jnp.asarray(y_true, jnp.int32).reshape(y_pred.shape[:-1])
     p = jnp.clip(y_pred, _EPS, 1.0)
-    logp = jnp.log(p)
-    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return -jnp.mean(picked)
+    picked = jnp.take_along_axis(jnp.log(p), labels[..., None], axis=-1)[..., 0]
+    return _per_example(-picked)
 
 
-def sparse_categorical_crossentropy_from_logits(y_true, y_pred):
+def sparse_categorical_crossentropy_from_logits_pe(y_true, y_pred):
     y_pred = jnp.asarray(y_pred, jnp.float32)
     labels = jnp.asarray(y_true, jnp.int32).reshape(y_pred.shape[:-1])
     logp = jax.nn.log_softmax(y_pred, axis=-1)
     picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return -jnp.mean(picked)
+    return _per_example(-picked)
 
 
-def hinge(y_true, y_pred):
+def hinge_pe(y_true, y_pred):
     y_true, y_pred = _f32(y_true, y_pred)
-    return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
+    return _per_example(jnp.maximum(1.0 - y_true * y_pred, 0.0))
 
 
-def squared_hinge(y_true, y_pred):
+def squared_hinge_pe(y_true, y_pred):
     y_true, y_pred = _f32(y_true, y_pred)
-    return jnp.mean(jnp.square(jnp.maximum(1.0 - y_true * y_pred, 0.0)))
+    return _per_example(jnp.square(jnp.maximum(1.0 - y_true * y_pred, 0.0)))
+
+
+def kullback_leibler_divergence_pe(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    p = jnp.clip(y_true, _EPS, 1.0)
+    q = jnp.clip(y_pred, _EPS, 1.0)
+    return _per_example(jnp.sum(p * jnp.log(p / q), axis=-1))
+
+
+def poisson_pe(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    return _per_example(y_pred - y_true * jnp.log(y_pred + _EPS))
+
+
+def cosine_proximity_pe(y_true, y_pred):
+    y_true, y_pred = _f32(y_true, y_pred)
+    t = y_true / (jnp.linalg.norm(y_true, axis=-1, keepdims=True) + _EPS)
+    p = y_pred / (jnp.linalg.norm(y_pred, axis=-1, keepdims=True) + _EPS)
+    return _per_example(-jnp.sum(t * p, axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# scalar (batch-mean) forms — the training-path API
+# ---------------------------------------------------------------------------
+
+def _scalarize(pe_fn):
+    def fn(y_true, y_pred):
+        return jnp.mean(pe_fn(y_true, y_pred))
+    fn.__name__ = pe_fn.__name__[:-3]
+    fn.per_example = pe_fn
+    return fn
+
+
+mean_squared_error = _scalarize(mean_squared_error_pe)
+mean_absolute_error = _scalarize(mean_absolute_error_pe)
+mean_absolute_percentage_error = _scalarize(mean_absolute_percentage_error_pe)
+mean_squared_logarithmic_error = _scalarize(mean_squared_logarithmic_error_pe)
+binary_crossentropy = _scalarize(binary_crossentropy_pe)
+binary_crossentropy_from_logits = _scalarize(binary_crossentropy_from_logits_pe)
+categorical_crossentropy = _scalarize(categorical_crossentropy_pe)
+categorical_crossentropy_from_logits = _scalarize(categorical_crossentropy_from_logits_pe)
+sparse_categorical_crossentropy = _scalarize(sparse_categorical_crossentropy_pe)
+sparse_categorical_crossentropy_from_logits = _scalarize(
+    sparse_categorical_crossentropy_from_logits_pe)
+hinge = _scalarize(hinge_pe)
+squared_hinge = _scalarize(squared_hinge_pe)
+kullback_leibler_divergence = _scalarize(kullback_leibler_divergence_pe)
+poisson = _scalarize(poisson_pe)
+cosine_proximity = _scalarize(cosine_proximity_pe)
 
 
 def rank_hinge(y_true, y_pred, margin: float = 1.0):
     """``RankHinge.scala`` — pairwise ranking loss for QA ranking. Assumes
     consecutive (positive, negative) pairs in the batch, as the reference's
-    text-matching pipeline arranges (``feature/common/Relations.scala``)."""
+    text-matching pipeline arranges (``feature/common/Relations.scala``).
+    Cross-batch structure means there is no per-example form."""
     y_pred = jnp.asarray(y_pred, jnp.float32).reshape(-1)
     pos = y_pred[0::2]
     neg = y_pred[1::2]
     return jnp.mean(jnp.maximum(margin - pos + neg, 0.0))
-
-
-def kullback_leibler_divergence(y_true, y_pred):
-    y_true, y_pred = _f32(y_true, y_pred)
-    p = jnp.clip(y_true, _EPS, 1.0)
-    q = jnp.clip(y_pred, _EPS, 1.0)
-    return jnp.mean(jnp.sum(p * jnp.log(p / q), axis=-1))
-
-
-def poisson(y_true, y_pred):
-    y_true, y_pred = _f32(y_true, y_pred)
-    return jnp.mean(y_pred - y_true * jnp.log(y_pred + _EPS))
-
-
-def cosine_proximity(y_true, y_pred):
-    y_true, y_pred = _f32(y_true, y_pred)
-    t = y_true / (jnp.linalg.norm(y_true, axis=-1, keepdims=True) + _EPS)
-    p = y_pred / (jnp.linalg.norm(y_pred, axis=-1, keepdims=True) + _EPS)
-    return -jnp.mean(jnp.sum(t * p, axis=-1))
 
 
 LOSSES = {
@@ -163,3 +210,10 @@ def get_loss(loss: Union[str, Callable]) -> Callable:
     if loss not in LOSSES:
         raise ValueError(f"unknown loss {loss!r}; available: {sorted(LOSSES)}")
     return LOSSES[loss]
+
+
+def per_example_loss(loss: Union[str, Callable]) -> Optional[Callable]:
+    """Per-example form of a loss, or None if the loss has cross-batch
+    structure (rank_hinge) or is a custom callable without one."""
+    fn = get_loss(loss)
+    return getattr(fn, "per_example", None)

@@ -10,9 +10,13 @@ parameter-server allreduce, ``wp-bigdl.md:113-160``):
 * Data parallelism = batch sharded over the mesh ``data`` axis
   (``NamedSharding``); params replicated. XLA GSPMD inserts the gradient
   psum over ICI — there is no separate communication runtime to operate.
+* Input batches stream through ``FeatureSet`` with a background assembly
+  thread + double-buffered ``device_put`` so the chip never waits on the host.
 * Failure handling keeps the reference's semantics
   (``Topology.scala:1171-1253``): on a step failure, reload the latest
-  checkpoint and retry, bounded by ``zoo.failure.retry_times``.
+  checkpoint and retry, bounded by ``zoo.failure.retry_times`` within
+  ``zoo.failure.retry_window_sec``; checkpoints are cut on the
+  ``set_checkpoint`` trigger (``Topology.scala:245-255,1161-1168``).
 """
 
 from __future__ import annotations
@@ -27,8 +31,10 @@ import numpy as np
 import optax
 
 from ....common.context import get_zoo_context
-from ....common.triggers import (EveryEpoch, MaxEpoch, TrainLoopState, Trigger)
+from ....common.triggers import EveryEpoch, TrainLoopState, Trigger
+from ....feature.feature_set import FeatureSet, prefetch_to_device
 from ....parallel import mesh as mesh_lib
+from ....utils.checkpoint import CheckpointManager
 from . import metrics as metrics_lib
 from . import objectives, optimizers as optim_lib
 from .engine import KerasNet
@@ -62,8 +68,8 @@ def _take(x, idx):
 
 def iter_batches(x, y, batch_size: int, *, shuffle: bool, seed: int,
                  drop_last: bool):
-    """Host-side minibatch iterator over numpy arrays. The FeatureSet layer
-    provides richer iterators; this covers the plain ``fit(x, y)`` path."""
+    """Host-side minibatch iterator over numpy arrays (evaluate/predict path;
+    training streams through ``FeatureSet`` instead)."""
     n = _num_examples(x)
     order = np.arange(n)
     if shuffle:
@@ -90,6 +96,23 @@ def _pad_to(x, size: int):
             a = np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0)
         out.append(a)
     return out if len(out) > 1 else out[0]
+
+
+def _round_up(n: int, k: int) -> int:
+    return ((n + k - 1) // k) * k
+
+
+def _clone_tree(tree):
+    """Fresh buffers for every array leaf. The donated train step deletes its
+    input buffers, so any tree that outlives a step (``model.params``, the
+    retry snapshot) must never alias one that enters the step."""
+    def clone(a):
+        if isinstance(a, jax.Array):
+            return jnp.copy(a)
+        if isinstance(a, np.ndarray):
+            return np.copy(a)
+        return a
+    return jax.tree.map(clone, tree)
 
 
 # ---------------------------------------------------------------------------
@@ -128,12 +151,19 @@ class TrainingLoop:
 
     def build_eval_step(self):
         model, loss_fn, metrics = self.model, self.loss, self.metrics
+        pe_loss = objectives.per_example_loss(loss_fn)
 
-        def step(params, net_state, x, y):
+        def step(params, net_state, x, y, mask):
             yp, _ = model.apply(params, net_state, x, training=False, rng=None)
-            stats = {m.name: m.update(y, yp) for m in metrics}
-            stats["loss"] = {"sum": loss_fn(y, yp) * _first_dim(x),
-                            "count": jnp.asarray(_first_dim(x), jnp.float32)}
+            stats = {m.name: m.update(y, yp, mask) for m in metrics}
+            if pe_loss is not None:
+                stats["loss"] = {"sum": jnp.sum(pe_loss(y, yp) * mask),
+                                 "count": jnp.sum(mask)}
+            else:
+                # cross-batch losses (rank_hinge, custom callables) have no
+                # per-example form; fall back to whole-batch statistics
+                stats["loss"] = {"sum": loss_fn(y, yp) * _first_dim(x),
+                                 "count": jnp.asarray(_first_dim(x), jnp.float32)}
             return stats
 
         self._eval_step = jax.jit(step)
@@ -149,94 +179,261 @@ class TrainingLoop:
         self._predict_step = jax.jit(step)
         return self._predict_step
 
-    # -- loops --------------------------------------------------------------
+    # -- checkpoint plumbing ------------------------------------------------
+    def _ckpt_manager(self) -> Optional[CheckpointManager]:
+        spec = getattr(self.model, "_checkpoint", None)
+        if spec is None:
+            return None
+        ctx = get_zoo_context()
+        keep = spec.get("keep")
+        if keep is None:  # keep=0 means keep-all, so no falsy check
+            keep = int(ctx.get("zoo.checkpoint.keep", 3))
+        return CheckpointManager(spec["path"], keep=keep)
+
+    def _ckpt_trigger(self) -> Trigger:
+        spec = getattr(self.model, "_checkpoint", None) or {}
+        return spec.get("trigger") or EveryEpoch()
+
+    def _save_checkpoint(self, mgr: CheckpointManager, loop_state, params,
+                         opt_state, net_state) -> None:
+        mgr.save(loop_state.iteration,
+                 {"params": params, "opt_state": opt_state,
+                  "net_state": net_state},
+                 meta={"epoch": loop_state.epoch,
+                       "iteration": loop_state.iteration,
+                       "epoch_finished": loop_state.epoch_finished})
+
+    def _try_resume(self, mgr: CheckpointManager, params, opt_state, net_state):
+        """Restore the newest snapshot (``Topology.scala:1220-1246``).
+        Returns (params, opt_state, net_state, meta) — inputs unchanged if
+        there is nothing to restore."""
+        step = mgr.latest()
+        if step is None or step < self.model.finished_iterations:
+            # never regress: in-memory progress is ahead of the newest
+            # snapshot (it was cut mid-epoch before further completed epochs)
+            return params, opt_state, net_state, None
+        trees, meta = mgr.restore(step, {"params": params,
+                                         "opt_state": opt_state,
+                                         "net_state": net_state})
+        log.info("resumed from checkpoint ckpt-%d (epoch %s)", step,
+                 meta.get("epoch"))
+        return trees["params"], trees["opt_state"], trees["net_state"], meta
+
+    # -- fit ---------------------------------------------------------------
     def fit(self, x, y, *, batch_size: int, nb_epoch: int,
             validation_data=None, rng=None,
             callbacks: Sequence[Callable[[Dict[str, Any]], None]] = (),
-            shuffle: bool = True) -> Dict[str, List[float]]:
+            shuffle: bool = True, end_trigger: Optional[Trigger] = None,
+            ) -> Dict[str, List[float]]:
+        ctx = get_zoo_context()
+        fs = FeatureSet.array(x, y, shuffle=shuffle, seed=ctx.seed)
+        return self.fit_feature_set(fs, batch_size=batch_size,
+                                    nb_epoch=nb_epoch,
+                                    validation_data=validation_data, rng=rng,
+                                    callbacks=callbacks,
+                                    end_trigger=end_trigger)
+
+    def fit_feature_set(self, fs: FeatureSet, *, batch_size: int,
+                        nb_epoch: int, validation_data=None, rng=None,
+                        callbacks: Sequence[Callable] = (),
+                        end_trigger: Optional[Trigger] = None,
+                        ) -> Dict[str, List[float]]:
+        """Train on a FeatureSet with retry-on-failure semantics
+        (``Topology.scala:1171-1253``): any step failure reloads the latest
+        checkpoint (when ``set_checkpoint`` is configured) and retries, at
+        most ``zoo.failure.retry_times`` times per
+        ``zoo.failure.retry_window_sec`` window."""
+        ctx = get_zoo_context()
+        retry_times = int(ctx.get("zoo.failure.retry_times", 5))
+        window_sec = float(ctx.get("zoo.failure.retry_window_sec", 3600))
+        attempts = 0
+        window_start = time.time()
+        # the epoch target is fixed once, after any checkpoint resume inside
+        # the first attempt — retries must not extend it
+        target_holder: Dict[str, int] = {}
+        while True:
+            try:
+                return self._fit_impl(fs, batch_size=batch_size,
+                                      nb_epoch=nb_epoch,
+                                      target_holder=target_holder,
+                                      validation_data=validation_data,
+                                      rng=rng, callbacks=callbacks,
+                                      end_trigger=end_trigger)
+            except KeyboardInterrupt:
+                raise
+            except (ValueError, TypeError):
+                # user/config errors are not transient — the reference likewise
+                # excludes IllegalArgumentException from its retry loop
+                # (Topology.scala:1171-1253)
+                raise
+            except Exception:
+                mgr = self._ckpt_manager()
+                if mgr is None or mgr.latest() is None:
+                    raise  # nothing to recover from
+                if time.time() - window_start > window_sec:
+                    attempts = 0
+                    window_start = time.time()
+                attempts += 1
+                if attempts > retry_times:
+                    log.exception("giving up after %d failed attempts", attempts)
+                    raise
+                log.warning("training step failed (attempt %d/%d); reloading "
+                            "latest checkpoint and retrying", attempts,
+                            retry_times, exc_info=True)
+                # the next _fit_impl attempt restores params/opt_state from
+                # the latest snapshot via _try_resume
+
+    def _fit_impl(self, fs: FeatureSet, *, batch_size: int, nb_epoch: int,
+                  target_holder: Dict[str, int], validation_data=None,
+                  rng=None, callbacks: Sequence[Callable] = (),
+                  end_trigger: Optional[Trigger] = None,
+                  ) -> Dict[str, List[float]]:
         ctx = get_zoo_context()
         model = self.model
+        dp = mesh_lib.data_parallel_size(self.mesh)
+        if batch_size % dp != 0:
+            rounded = _round_up(batch_size, dp)
+            log.warning("batch_size %d not divisible by data-parallel size %d; "
+                        "rounding up to %d", batch_size, dp, rounded)
+            batch_size = rounded
+
         if model.params is None:
-            model.init_weights(rng=rng, sample_input=_take(x, np.arange(1)))
+            model.init_weights(rng=rng, sample_input=_take(fs.x, np.arange(1)))
         if self._train_step is None:
             self.build_train_step()
 
-        params = jax.device_put(model.params, mesh_lib.replicated_sharding(self.mesh))
-        net_state = jax.device_put(model.net_state, mesh_lib.replicated_sharding(self.mesh))
-        opt_state = (model.opt_state if model.opt_state is not None
+        repl = mesh_lib.replicated_sharding(self.mesh)
+        # clone: the donated train step must own its buffers exclusively —
+        # without the copy, device_put of an already-replicated model.params
+        # is a no-op alias and step 1 would delete the model's weights
+        params = jax.device_put(_clone_tree(model.params), repl)
+        net_state = jax.device_put(_clone_tree(model.net_state), repl)
+        opt_state = (_clone_tree(model.opt_state)
+                     if model.opt_state is not None
                      else self.optimizer.init(params))
-        opt_state = jax.device_put(opt_state, mesh_lib.replicated_sharding(self.mesh))
+        opt_state = jax.device_put(opt_state, repl)
+
+        # resume: if a checkpoint directory is configured and holds a snapshot
+        # newer than this model's progress, restore it (process-death resume)
+        mgr = self._ckpt_manager()
+        ckpt_trigger = self._ckpt_trigger()
+        if mgr is not None:
+            params, opt_state, net_state, meta = self._try_resume(
+                mgr, params, opt_state, net_state)
+            if meta is not None and meta.get("epoch") is not None:
+                resumed_epoch = int(meta["epoch"]) - (
+                    0 if meta.get("epoch_finished") else 1)
+                if resumed_epoch > model.finished_epochs:
+                    model.finished_epochs = resumed_epoch
+                model.finished_iterations = int(meta.get(
+                    "iteration", model.finished_iterations))
+        if "target" not in target_holder:
+            # "train nb_epoch more" counts from post-resume progress, matching
+            # the reference's getFinishedEpoch continuation (Topology.scala:373-386)
+            target_holder["target"] = model.finished_epochs + nb_epoch
+        target_epoch = target_holder["target"]
 
         base_rng = rng if rng is not None else ctx.rng()
         history: Dict[str, List[float]] = {"loss": []}
         loop_state = TrainLoopState(iteration=model.finished_iterations,
                                     epoch=model.finished_epochs + 1)
+        stop = False
 
-        for epoch in range(model.finished_epochs + 1,
-                           model.finished_epochs + nb_epoch + 1):
+        epoch = model.finished_epochs  # so nb_epoch=0 is a clean no-op
+        for epoch in range(model.finished_epochs + 1, target_epoch + 1):
             t0 = time.time()
             losses = []
             n_seen = 0
-            for bx, by in iter_batches(x, y, batch_size, shuffle=shuffle,
-                                       seed=ctx.seed + epoch, drop_last=True):
+            loop_state.epoch = epoch
+            batches = fs.iter_batches(batch_size, epoch=ctx.seed + epoch,
+                                      drop_last=True)
+            for bx_d, by_d in prefetch_to_device(batches, self.mesh):
                 step_rng = jax.random.fold_in(base_rng, loop_state.iteration)
-                bx_d, by_d = shard_batch((bx, by), self.mesh)
                 params, opt_state, net_state, l = self._train_step(
                     params, opt_state, net_state, step_rng, bx_d, by_d)
                 losses.append(l)
                 n_seen += batch_size
                 loop_state.iteration += 1
+                if mgr is not None and ckpt_trigger(loop_state):
+                    self._save_checkpoint(mgr, loop_state, params, opt_state,
+                                          net_state)
+                if end_trigger is not None and end_trigger(loop_state):
+                    stop = True
+                    break
+            completed = not stop  # stop=True means the epoch was cut short
             epoch_loss = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
             dt = time.time() - t0
             history["loss"].append(epoch_loss)
-            loop_state.epoch = epoch
-            loop_state.epoch_finished = True
+            loop_state.epoch_finished = completed
+            if hasattr(end_trigger, "record"):
+                end_trigger.record(epoch_loss)
+            # cut a snapshot at the trigger, or unconditionally on a mid-epoch
+            # stop so the truncated epoch's progress survives (its meta says
+            # epoch_finished=False, so a resume retrains that epoch)
+            if mgr is not None and (stop or ckpt_trigger(loop_state)):
+                self._save_checkpoint(mgr, loop_state, params, opt_state,
+                                      net_state)
+
+            # publish progress every epoch — clones, because the live trees
+            # feed the donating train step next epoch; this is also what a
+            # retry attempt falls back to when the newest snapshot is older
+            model.params = _clone_tree(params)
+            model.net_state = _clone_tree(net_state)
+            model.opt_state = _clone_tree(opt_state)
+            if completed:
+                model.finished_epochs = epoch
+            model.finished_iterations = loop_state.iteration
 
             record = {"epoch": epoch, "loss": epoch_loss,
                       "iteration": loop_state.iteration,
                       "throughput": n_seen / dt if dt > 0 else 0.0,
-                      "params": params, "opt_state": opt_state,
-                      "net_state": net_state, "loop_state": loop_state}
+                      "params": model.params, "opt_state": model.opt_state,
+                      "net_state": model.net_state, "loop_state": loop_state}
+            val = None
             if validation_data is not None:
-                # publish latest weights for eval
-                model.params, model.net_state = params, net_state
-                val = self.evaluate(validation_data[0], validation_data[1],
-                                    batch_size=batch_size)
+                if isinstance(validation_data, FeatureSet):
+                    vx, vy = validation_data.x, validation_data.y
+                else:
+                    vx, vy = validation_data
+                val = self.evaluate(vx, vy, batch_size=batch_size)
                 for k, v in val.items():
                     history.setdefault("val_" + k, []).append(v)
                 record.update({"val_" + k: v for k, v in val.items()})
-            log.info("Epoch %d: loss=%.6f (%.1f ex/s)%s", epoch, epoch_loss,
+            log.info("Epoch %d%s: loss=%.6f (%.1f ex/s)%s", epoch,
+                     "" if completed else " (stopped mid-epoch)", epoch_loss,
                      record["throughput"],
                      "".join(f" val_{k}={v:.4f}" for k, v in
-                             (val.items() if validation_data is not None else ())))
+                             (val.items() if val is not None else ())))
             for cb in callbacks:
                 cb(record)
             loop_state.epoch_finished = False
+            if stop or (end_trigger is not None and end_trigger(loop_state)):
+                break
 
-        model.params = params
-        model.net_state = net_state
-        model.opt_state = opt_state
-        model.finished_epochs = epoch
-        model.finished_iterations = loop_state.iteration
         return history
 
-    def evaluate(self, x, y, *, batch_size: int = 32) -> Dict[str, float]:
+    # -- evaluate / predict -------------------------------------------------
+    def evaluate(self, x, y=None, *, batch_size: int = 32) -> Dict[str, float]:
+        if isinstance(x, FeatureSet):
+            x, y = x.x, x.y
         model = self.model
         if self._eval_step is None:
             self.build_eval_step()
         totals = None
         dp = mesh_lib.data_parallel_size(self.mesh)
-        eff_bs = max(batch_size, dp)
+        eff_bs = _round_up(max(batch_size, dp), dp)
         for bx, by in iter_batches(x, y, eff_bs, shuffle=False, seed=0,
                                    drop_last=False):
             n = _num_examples(bx)
-            if n % dp != 0:
-                padded = ((n + dp - 1) // dp) * dp
+            padded = _round_up(n, dp)
+            if n != padded:
                 bx, by = _pad_to(bx, padded), _pad_to(by, padded)
-                # padding inflates counts slightly; acceptable for parity with
-                # the reference, which also pads the tail minibatch
-            bx_d, by_d = shard_batch((bx, by), self.mesh)
-            stats = self._eval_step(model.params, model.net_state, bx_d, by_d)
+            # padded tail rows are masked out of every statistic
+            mask = np.concatenate(
+                [np.ones(n, np.float32), np.zeros(padded - n, np.float32)])
+            bx_d, by_d, mask_d = shard_batch((bx, by, mask), self.mesh)
+            stats = self._eval_step(model.params, model.net_state, bx_d, by_d,
+                                    mask_d)
             stats = jax.device_get(stats)
             totals = stats if totals is None else jax.tree.map(
                 lambda a, b: a + b, totals, stats)
@@ -249,17 +446,18 @@ class TrainingLoop:
         return out
 
     def predict(self, x, *, batch_size: int = 32):
+        if isinstance(x, FeatureSet):
+            x = x.x
         model = self.model
         if self._predict_step is None:
             self.build_predict_step()
         dp = mesh_lib.data_parallel_size(self.mesh)
         outs = []
-        n_total = _num_examples(x)
-        eff_bs = max(batch_size, dp)
+        eff_bs = _round_up(max(batch_size, dp), dp)
         for bx, _ in iter_batches(x, None, eff_bs, shuffle=False, seed=0,
                                   drop_last=False):
             n = _num_examples(bx)
-            padded = ((n + dp - 1) // dp) * dp
+            padded = _round_up(n, dp)
             if n != padded:
                 bx = _pad_to(bx, padded)
             bx_d = shard_batch(bx, self.mesh)
@@ -314,24 +512,31 @@ def _init_weights(self: KerasNet, rng=None, input_shape=None, sample_input=None)
     return self
 
 
+def _set_checkpoint(self: KerasNet, path: str, trigger: Optional[Trigger] = None,
+                    keep: Optional[int] = None):
+    """``KerasNet.setCheckpoint`` (``Topology.scala:245-255``): snapshot
+    params + optimizer state + net state into ``path`` whenever ``trigger``
+    fires (default: every epoch, ``Topology.scala:1161-1168``)."""
+    self._checkpoint = {"path": path, "trigger": trigger, "keep": keep}
+    return self
+
+
 def _fit(self: KerasNet, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
-         validation_data=None, shuffle: bool = True, rng=None, callbacks=()):
+         validation_data=None, shuffle: bool = True, rng=None, callbacks=(),
+         end_trigger: Optional[Trigger] = None):
     """``KerasNet.fit`` (``Topology.scala:418``). ``x`` may be an array, a
     list of arrays (multi-input), or a FeatureSet (then ``y=None``)."""
     if self._compiled is None:
         raise RuntimeError("call compile() before fit()")
-    try:
-        from ....feature.feature_set import FeatureSet  # local import, avoid cycle
-    except ImportError:
-        FeatureSet = None
-    if FeatureSet is not None and isinstance(x, FeatureSet):
+    if isinstance(x, FeatureSet):
         return self._loop.fit_feature_set(x, batch_size=batch_size,
                                           nb_epoch=nb_epoch,
                                           validation_data=validation_data,
-                                          rng=rng, callbacks=callbacks)
+                                          rng=rng, callbacks=callbacks,
+                                          end_trigger=end_trigger)
     return self._loop.fit(x, y, batch_size=batch_size, nb_epoch=nb_epoch,
                           validation_data=validation_data, shuffle=shuffle,
-                          rng=rng, callbacks=callbacks)
+                          rng=rng, callbacks=callbacks, end_trigger=end_trigger)
 
 
 def _evaluate(self: KerasNet, x, y=None, batch_size: int = 32):
@@ -354,7 +559,7 @@ def _predict(self: KerasNet, x, batch_size: int = 32, distributed: bool = True):
 
 def _predict_classes(self: KerasNet, x, batch_size: int = 32, zero_based: bool = True):
     """``predictClass`` (``Predictor.scala:210``)."""
-    probs = self._predict(x, batch_size=batch_size)
+    probs = self.predict(x, batch_size=batch_size)
     if probs.ndim > 1 and probs.shape[-1] > 1:
         cls = np.argmax(probs, axis=-1)
     else:
@@ -369,9 +574,11 @@ KerasNet.opt_state = None
 KerasNet.finished_epochs = 0
 KerasNet.finished_iterations = 0
 KerasNet._loop = None
+KerasNet._checkpoint = None
 
 KerasNet.compile = _compile
 KerasNet.init_weights = _init_weights
+KerasNet.set_checkpoint = _set_checkpoint
 KerasNet.fit = _fit
 KerasNet.evaluate = _evaluate
 KerasNet.predict = _predict

@@ -1,0 +1,117 @@
+"""Checkpoint/resume — the TPU-native equivalent of the reference's
+epoch-triggered snapshots (``Topology.scala:109-114,1161-1168``), the
+``setCheckpoint`` API (``Topology.scala:245-255``) and the latest-file
+resume logic (``Topology.scala:1220-1246``, ``getLatestFile`` ``:1511-1528``).
+
+Format: one directory per snapshot (``ckpt-<iteration>/``) holding one ``.npz``
+per pytree (params / opt_state / net_state — leaves in deterministic
+``tree_flatten`` order, restored against a same-structure template) plus a
+``meta.json``. Writes are atomic (tmp dir + rename) so a crash mid-save never
+corrupts the latest snapshot; old snapshots are pruned to ``keep``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+_CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+
+
+def _save_tree(path: str, tree: Any) -> None:
+    leaves = jax.tree_util.tree_leaves(tree)
+    arrays = {f"leaf_{i}": np.asarray(jax.device_get(l)) for i, l in enumerate(leaves)}
+    np.savez(path, **arrays)
+
+
+def _restore_tree(path: str, template: Any) -> Any:
+    """Rebuild a pytree from saved leaves using ``template``'s structure.
+    The template supplies the treedef (avoids pickling treedefs to disk)."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    with np.load(path) as data:
+        if len(data.files) != len(leaves):
+            raise ValueError(
+                f"{path}: checkpoint has {len(data.files)} leaves, "
+                f"template has {len(leaves)} — architecture mismatch")
+        loaded = [data[f"leaf_{i}"] for i in range(len(leaves))]
+    # preserve template leaf dtypes for non-array leaves (e.g. optax counts),
+    # and fail loudly on any shape mismatch — silently installing permuted
+    # leaves would train on scrambled weights
+    out = []
+    for i, (tmpl, arr) in enumerate(zip(leaves, loaded)):
+        if tuple(np.shape(tmpl)) != tuple(arr.shape):
+            raise ValueError(
+                f"{path}: leaf {i} shape {arr.shape} != template "
+                f"{np.shape(tmpl)} — architecture mismatch")
+        if np.ndim(tmpl) == 0 and not isinstance(tmpl, (np.ndarray, jax.Array)):
+            out.append(type(tmpl)(arr.item()) if not isinstance(tmpl, jax.Array) else arr)
+        else:
+            out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class CheckpointManager:
+    """Directory of snapshots with atomic save, latest-lookup, and pruning."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    # ---- save -------------------------------------------------------------
+    def save(self, step: int, trees: Dict[str, Any],
+             meta: Optional[Dict[str, Any]] = None) -> str:
+        final = os.path.join(self.directory, f"ckpt-{step}")
+        tmp = tempfile.mkdtemp(prefix=".tmp-ckpt-", dir=self.directory)
+        try:
+            for name, tree in trees.items():
+                _save_tree(os.path.join(tmp, name + ".npz"), tree)
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump({"step": step, **(meta or {})}, f)
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._prune()
+        return final
+
+    def _prune(self) -> None:
+        steps = self.steps()
+        for s in steps[:-self.keep] if self.keep > 0 else []:
+            shutil.rmtree(os.path.join(self.directory, f"ckpt-{s}"),
+                          ignore_errors=True)
+
+    # ---- lookup -----------------------------------------------------------
+    def steps(self) -> list:
+        out = []
+        for name in os.listdir(self.directory):
+            m = _CKPT_RE.match(name)
+            if m and os.path.exists(os.path.join(self.directory, name, "meta.json")):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    # ---- restore ----------------------------------------------------------
+    def restore(self, step: int, templates: Dict[str, Any],
+                ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Load snapshot ``step``; each named tree is rebuilt against the
+        same-structure template (fresh ``optimizer.init`` output, fresh
+        ``build`` params)."""
+        d = os.path.join(self.directory, f"ckpt-{step}")
+        trees = {name: _restore_tree(os.path.join(d, name + ".npz"), tmpl)
+                 for name, tmpl in templates.items()}
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        return trees, meta

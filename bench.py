@@ -1,0 +1,71 @@
+"""Benchmark — NCF training throughput on MovieLens-1M-shaped data.
+
+This is the parity config #1 from BASELINE.md ("NCF recommender on
+MovieLens-1M", reference model ``models/recommendation/NeuralCF.scala:45-104``,
+reference hardware: 2-socket Intel Xeon running BigDL's DistriOptimizer).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is measured against an estimated 1.0e6 recs/sec for the
+2-socket Xeon BigDL baseline (the reference publishes no absolute number —
+``BASELINE.json.published = {}`` — so this constant is a deliberately
+generous stand-in documented here).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+XEON_BASELINE_RECS_PER_SEC = 1.0e6
+
+# MovieLens-1M shape: 6040 users, 3706 movies, ratings 1..5 (~1M examples)
+N_USERS, N_ITEMS, N_CLASSES = 6040, 3706, 5
+N_EXAMPLES = 1_000_000
+BATCH = 8192
+
+
+def main():
+    from analytics_zoo_tpu import init_zoo_context
+    from analytics_zoo_tpu.feature import FeatureSet
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+    init_zoo_context()
+
+    rng = np.random.default_rng(0)
+    x = np.stack([rng.integers(1, N_USERS + 1, N_EXAMPLES),
+                  rng.integers(1, N_ITEMS + 1, N_EXAMPLES)],
+                 axis=1).astype(np.int32)
+    y = rng.integers(0, N_CLASSES, N_EXAMPLES).astype(np.int32)
+
+    # reference parity config: default NeuralCF dims (NeuralCF.scala:45-104)
+    model = NeuralCF(N_USERS, N_ITEMS, N_CLASSES)
+    model.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=1e-3)
+
+    # warmup epoch on a slice: triggers XLA compile of the train step
+    model.fit(x[:BATCH * 2], y[:BATCH * 2], batch_size=BATCH, nb_epoch=1)
+
+    tp = {}
+
+    def cb(record):
+        tp["recs_per_sec"] = record["throughput"]
+        tp["loss"] = record["loss"]
+
+    fs = FeatureSet.array(x, y, seed=0)
+    t0 = time.time()
+    model.fit(fs, batch_size=BATCH, nb_epoch=1, callbacks=[cb])
+    wall = time.time() - t0
+
+    value = float(tp["recs_per_sec"])
+    print(json.dumps({
+        "metric": "ncf_train_recs_per_sec",
+        "value": round(value, 1),
+        "unit": "recs/s",
+        "vs_baseline": round(value / XEON_BASELINE_RECS_PER_SEC, 3),
+    }))
+    print(f"# epoch wall={wall:.2f}s loss={tp['loss']:.4f} "
+          f"batch={BATCH} examples={N_EXAMPLES}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,14 +6,19 @@ Spark ``local[4]`` (``pipeline/estimator/DistriEstimatorSpec.scala:118``).
 
 import os
 
-# Must be set before jax initializes its backends.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax initializes its backends (they are lazy, so this
+# works even though sitecustomize pre-imports jax). Hard override: the driver
+# environment presets JAX_PLATFORMS=axon (the real-TPU tunnel), but unit tests
+# always run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,52 @@
+"""Config/context tests — layered config merge and multi-word key
+canonicalization (round-1 ADVICE #3: ``ZOO_TPU_FAILURE_RETRY_TIMES`` and
+``init_zoo_context(failure_retry_times=...)`` must land on
+``zoo.failure.retry_times``)."""
+
+import os
+
+import numpy as np
+
+from analytics_zoo_tpu.common.context import (get_zoo_context,
+                                              init_zoo_context,
+                                              reset_zoo_context)
+
+
+def test_kwargs_override_multiword_leaf_key():
+    ctx = init_zoo_context(failure_retry_times=3)
+    assert ctx.get("zoo.failure.retry_times") == 3
+
+
+def test_kwargs_override_retry_window():
+    ctx = init_zoo_context(failure_retry_window_sec=120)
+    assert ctx.get("zoo.failure.retry_window_sec") == 120
+
+
+def test_env_override_multiword_leaf_key(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_FAILURE_RETRY_TIMES", "7")
+    reset_zoo_context()
+    ctx = init_zoo_context()
+    assert ctx.get("zoo.failure.retry_times") == 7
+
+
+def test_env_override_namespaced_key(monkeypatch):
+    monkeypatch.setenv("ZOO_TPU_MESH_MODEL", "1")
+    reset_zoo_context()
+    ctx = init_zoo_context()
+    assert ctx.get("zoo.mesh.model") == 1
+
+
+def test_unknown_key_falls_back_to_dots():
+    ctx = init_zoo_context(custom_flag=True)
+    assert ctx.get("zoo.custom.flag") is True
+
+
+def test_conf_dict_highest_besides_kwargs():
+    ctx = init_zoo_context(conf={"zoo.seed": 123})
+    assert ctx.seed == 123
+
+
+def test_context_idempotent():
+    a = init_zoo_context()
+    b = get_zoo_context()
+    assert a is b

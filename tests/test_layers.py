@@ -1,0 +1,208 @@
+"""Layer tests filling round-1 gaps: BatchNorm axis handling + dp-invariance,
+masked evaluation of ragged tails, multi_optimizer, and the previously
+untested layers (Highway, Masking, GaussianNoise/Dropout, SparseEmbedding,
+WordEmbedding, Narrow, Select)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import init_zoo_context
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Activation, BatchNormalization, Dense, GaussianDropout, GaussianNoise,
+    Highway, Masking, Narrow, Select, SparseEmbedding, WordEmbedding)
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import multi_optimizer
+
+
+# ---------------------------------------------------------------------------
+# BatchNormalization
+# ---------------------------------------------------------------------------
+
+def test_batchnorm_axis1_normalizes_channel_dim(rng):
+    """axis=1 on (B, C, L) must normalize per-channel (ADVICE round-1 #2)."""
+    bn = BatchNormalization(axis=1, epsilon=1e-5)
+    x = np.random.default_rng(0).normal(3.0, 2.0, (16, 4, 10)).astype(np.float32)
+    shape = (None, 4, 10)
+    params = bn.build(rng, shape)
+    state = bn.initial_state(shape)
+    assert params["gamma"].shape == (4,)
+    y, new_state = bn.apply(params, state, jnp.asarray(x), training=True)
+    y = np.asarray(y)
+    # per-channel statistics over (batch, length) must be ~standardized
+    np.testing.assert_allclose(y.mean(axis=(0, 2)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(y.std(axis=(0, 2)), 1.0, atol=1e-3)
+    assert new_state["moving_mean"].shape == (4,)
+
+
+def test_batchnorm_dp_invariant(rng):
+    """Batch stats are global under GSPMD: dp=8 output == single-device
+    reference computed with plain numpy (sync-BN semantics)."""
+    init_zoo_context()
+    bn = BatchNormalization(epsilon=1e-5)
+    shape = (None, 6)
+    params = bn.build(rng, shape)
+    state = bn.initial_state(shape)
+    x = np.random.default_rng(1).normal(2.0, 3.0, (32, 6)).astype(np.float32)
+
+    mesh = mesh_lib.global_mesh()
+    assert mesh_lib.data_parallel_size(mesh) == 8
+    xd = jax.device_put(jnp.asarray(x), mesh_lib.batch_sharding(mesh))
+
+    @jax.jit
+    def run(p, s, xx):
+        return bn.apply(p, s, xx, training=True)
+
+    y_sharded, st_sharded = run(params, state, xd)
+    # reference: global (whole-batch) statistics
+    mean, var = x.mean(0), x.var(0)
+    expect = (x - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(np.asarray(y_sharded), expect, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(st_sharded["moving_mean"]),
+                               0.99 * 0 + 0.01 * mean, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# masked evaluation of ragged tails (round-1 Weak #5)
+# ---------------------------------------------------------------------------
+
+def test_evaluate_masks_padded_tail():
+    init_zoo_context()
+    # identity model: predictions == inputs, so expected stats are exact
+    m = Sequential([Activation("linear", input_shape=(3,))])
+    m.compile(optimizer="adam", loss="mse", metrics=["mae"])
+    m.init_weights()
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(10, 3)).astype(np.float32)  # 10 % 8 != 0 → padded
+    y = rng.normal(size=(10, 3)).astype(np.float32)
+    res = m.evaluate(x, y, batch_size=8)
+    np.testing.assert_allclose(res["loss"], np.mean((x - y) ** 2), rtol=1e-5)
+    np.testing.assert_allclose(res["mae"], np.mean(np.abs(x - y)), rtol=1e-5)
+
+
+def test_evaluate_accuracy_counts_only_real_rows():
+    init_zoo_context()
+    m = Sequential([Activation("sigmoid", input_shape=(1,))])
+    m.compile(optimizer="adam", loss="bce", metrics=["accuracy"])
+    m.init_weights()
+    # 9 examples: 6 correct, 3 wrong → accuracy must be exactly 2/3
+    x = np.array([[3.0]] * 6 + [[-3.0]] * 3, np.float32)
+    y = np.array([[1.0]] * 6 + [[1.0]] * 3, np.float32)
+    res = m.evaluate(x, y, batch_size=8)
+    np.testing.assert_allclose(res["accuracy"], 6 / 9, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# multi_optimizer (round-1 Weak #10)
+# ---------------------------------------------------------------------------
+
+def test_multi_optimizer_routes_by_layer_name():
+    init_zoo_context()
+    frozen = Dense(4, name="frozen_head", input_shape=(4,))
+    live = Dense(1, name="live_head")
+    m = Sequential([frozen, live])
+    opt = multi_optimizer({"frozen_head": "sgd"}, default="adam")
+    import optax
+    # freeze by zero-lr sgd
+    opt = multi_optimizer({"frozen_head": optax.sgd(0.0)}, default="adam")
+    m.compile(optimizer=opt, loss="mse", lr=0.05)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = rng.normal(size=(64, 1)).astype(np.float32)
+    m.init_weights()
+    w_frozen_before = np.asarray(m.params["frozen_head"]["W"]).copy()
+    w_live_before = np.asarray(m.params["live_head"]["W"]).copy()
+    m.fit(x, y, batch_size=32, nb_epoch=2)
+    np.testing.assert_array_equal(np.asarray(m.params["frozen_head"]["W"]),
+                                  w_frozen_before)
+    assert not np.allclose(np.asarray(m.params["live_head"]["W"]),
+                           w_live_before)
+
+
+# ---------------------------------------------------------------------------
+# previously-untested layers
+# ---------------------------------------------------------------------------
+
+def test_highway_identity_at_negative_gate(rng):
+    h = Highway(input_shape=(6,))
+    params = h.build(rng, (None, 6))
+    # force the transform gate closed: output ≈ input
+    params["b_t"] = jnp.full((6,), -20.0)
+    x = np.random.default_rng(4).normal(size=(8, 6)).astype(np.float32)
+    y = h.call(params, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), x, atol=1e-4)
+
+
+def test_masking_zeroes_masked_timesteps():
+    ml = Masking(mask_value=0.0)
+    x = np.ones((2, 3, 4), np.float32)
+    x[0, 1] = 0.0  # fully-masked timestep
+    y = np.asarray(ml.call({}, jnp.asarray(x)))
+    np.testing.assert_array_equal(y[0, 1], np.zeros(4))
+    np.testing.assert_array_equal(y[0, 0], np.ones(4))
+
+
+def test_gaussian_noise_train_vs_eval(rng):
+    g = GaussianNoise(0.5)
+    x = jnp.ones((4, 5))
+    assert np.allclose(np.asarray(g.call({}, x, training=False)), 1.0)
+    noisy = np.asarray(g.call({}, x, training=True, rng=rng))
+    assert not np.allclose(noisy, 1.0)
+    assert noisy.shape == (4, 5)
+
+
+def test_gaussian_dropout_train_vs_eval(rng):
+    g = GaussianDropout(0.3)
+    x = jnp.ones((4, 5))
+    assert np.allclose(np.asarray(g.call({}, x, training=False)), 1.0)
+    out = np.asarray(g.call({}, x, training=True, rng=rng))
+    assert not np.allclose(out, 1.0)
+    # multiplicative noise has mean 1: sample mean should be near 1
+    assert abs(out.mean() - 1.0) < 0.5
+
+
+def test_sparse_embedding_combiners(rng):
+    for combiner, expect_fn in [
+        ("sum", lambda e: e[1] + e[3]),
+        ("mean", lambda e: (e[1] + e[3]) / 2.0),
+        ("sqrtn", lambda e: (e[1] + e[3]) / np.sqrt(2.0)),
+    ]:
+        se = SparseEmbedding(5, 4, combiner=combiner)
+        params = se.build(rng, (None, 5))
+        table = np.asarray(params["embeddings"])
+        x = np.zeros((1, 5), np.float32)
+        x[0, 1] = x[0, 3] = 1.0
+        y = np.asarray(se.call(params, jnp.asarray(x)))
+        np.testing.assert_allclose(y[0], expect_fn(table), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_word_embedding_frozen_and_trainable(rng):
+    weights = np.random.default_rng(5).normal(size=(10, 3)).astype(np.float32)
+    ids = jnp.asarray([[1, 2], [3, 4]])
+
+    frozen = WordEmbedding(weights, trainable=False)
+    p = frozen.build(rng, (None, 2))
+    s = frozen.initial_state((None, 2))
+    assert p == {}  # no trainable params when frozen
+    y, _ = frozen.apply(p, s, ids)
+    np.testing.assert_allclose(np.asarray(y), weights[np.asarray(ids)],
+                               rtol=1e-6)
+
+    trainable = WordEmbedding(weights, trainable=True)
+    p = trainable.build(rng, (None, 2))
+    assert "embeddings" in p
+
+
+def test_narrow_and_select(rng):
+    x = jnp.asarray(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    n = Narrow(dim=1, offset=1, length=2)
+    assert np.asarray(n.call({}, x)).shape == (2, 2, 4)
+    np.testing.assert_array_equal(np.asarray(n.call({}, x)),
+                                  np.asarray(x)[:, 1:3])
+    s = Select(dim=2, index=3)
+    np.testing.assert_array_equal(np.asarray(s.call({}, x)),
+                                  np.asarray(x)[:, :, 3])

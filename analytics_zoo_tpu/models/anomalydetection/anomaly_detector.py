@@ -1,0 +1,87 @@
+"""AnomalyDetector — LSTM time-series anomaly detection, parity with
+``models/anomalydetection/AnomalyDetector.scala:40,65`` (pyzoo
+``models/anomalydetection/anomaly_detector.py:30``).
+
+Stacked return-sequence LSTMs + dropouts, final LSTM + Dense(1) regressor;
+anomalies = the top-N absolute prediction errors (``detectAnomalies``).
+``unroll`` converts a 1-D/2-D series into (windows, unroll_length, features)
+training tensors, the ``FeatureLabelIndex`` role.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...pipeline.api.keras.engine import Sequential
+from ...pipeline.api.keras.layers import LSTM, Dense, Dropout
+from ..common.zoo_model import ZooModel, register_model
+
+
+@register_model
+class AnomalyDetector(ZooModel):
+    """``AnomalyDetector(featureShape, hiddenLayers, dropouts)``."""
+
+    def __init__(self, feature_shape: Sequence[int],
+                 hidden_layers: Sequence[int] = (8, 32, 15),
+                 dropouts: Sequence[float] = (0.2, 0.2, 0.2),
+                 name: Optional[str] = None):
+        if len(hidden_layers) != len(dropouts):
+            raise ValueError("hidden_layers and dropouts must align")
+        self.feature_shape = tuple(int(d) for d in feature_shape)
+        self.hidden_layers = tuple(int(h) for h in hidden_layers)
+        self.dropouts = tuple(float(d) for d in dropouts)
+        super().__init__(name=name)
+
+    def build_model(self) -> Sequential:
+        m = Sequential()
+        first = True
+        # all but the last hidden layer return sequences
+        for units, drop in zip(self.hidden_layers[:-1], self.dropouts[:-1]):
+            m.add(LSTM(units, return_sequences=True,
+                       **({"input_shape": self.feature_shape} if first else {})))
+            m.add(Dropout(drop))
+            first = False
+        m.add(LSTM(self.hidden_layers[-1], return_sequences=False,
+                   **({"input_shape": self.feature_shape} if first else {})))
+        m.add(Dropout(self.dropouts[-1]))
+        m.add(Dense(1))
+        return m
+
+    def get_config(self) -> Dict[str, Any]:
+        return {"feature_shape": list(self.feature_shape),
+                "hidden_layers": list(self.hidden_layers),
+                "dropouts": list(self.dropouts)}
+
+
+def unroll(data: np.ndarray, unroll_length: int,
+           predict_step: int = 1) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Windowize a series — ``AnomalyDetector.unroll`` / ``FeatureLabelIndex``:
+    returns (features (N, unroll_length, D), labels (N,), indices (N,)).
+    The label is the first feature dimension ``predict_step`` after the
+    window, i.e. next-value prediction."""
+    data = np.asarray(data, np.float32)
+    if data.ndim == 1:
+        data = data[:, None]
+    n = data.shape[0] - unroll_length - predict_step + 1
+    if n <= 0:
+        raise ValueError("series too short for the requested unroll_length")
+    idx = np.arange(unroll_length)[None, :] + np.arange(n)[:, None]
+    x = data[idx]
+    y = data[np.arange(n) + unroll_length + predict_step - 1, 0]
+    return x, y, np.arange(n)
+
+
+def detect_anomalies(y_truth: np.ndarray, y_predict: np.ndarray,
+                     anomaly_size: int = 5) -> np.ndarray:
+    """``detectAnomalies``: rank |truth - prediction|; the ``anomaly_size``
+    most distant points are anomalies. Returns a float array shaped like
+    ``y_truth`` holding the anomalous truth values and NaN elsewhere."""
+    t = np.asarray(y_truth, np.float32).reshape(-1)
+    p = np.asarray(y_predict, np.float32).reshape(-1)
+    dist = np.abs(t - p)
+    thresh_idx = np.argsort(-dist)[:anomaly_size]
+    out = np.full_like(t, np.nan)
+    out[thresh_idx] = t[thresh_idx]
+    return out

@@ -1,0 +1,87 @@
+"""SessionRecommender — session-based GRU recommender, parity with
+``models/recommendation/SessionRecommender.scala:45``.
+
+Topology (identical): session item ids (B, session_length) → Embedding →
+stacked GRUs (last one return_sequences=False) → Dense(item_count); with
+``include_history``: purchase-history ids → Embedding → sum over time → MLP
+→ Dense(item_count); merged by sum; softmax output over item_count classes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...pipeline.api.keras.engine import Input, Lambda, Model, unique_name
+from ...pipeline.api.keras.layers import (GRU, Activation, Dense, Embedding,
+                                          Merge)
+from ..common.zoo_model import register_model
+from .neural_cf import Recommender
+
+
+@register_model
+class SessionRecommender(Recommender):
+    """``SessionRecommender(itemCount, itemEmbed, rnnHiddenLayers,
+    sessionLength, includeHistory, mlpHiddenLayers, historyLength)``."""
+
+    def __init__(self, item_count: int, item_embed: int = 100,
+                 rnn_hidden_layers: Sequence[int] = (40, 20),
+                 session_length: int = 10, include_history: bool = False,
+                 mlp_hidden_layers: Sequence[int] = (40, 20),
+                 history_length: int = 0, name: Optional[str] = None):
+        if include_history and history_length <= 0:
+            raise ValueError("include_history requires history_length > 0")
+        self.item_count = int(item_count)
+        self.item_embed = int(item_embed)
+        self.rnn_hidden_layers = tuple(int(h) for h in rnn_hidden_layers)
+        self.session_length = int(session_length)
+        self.include_history = bool(include_history)
+        self.mlp_hidden_layers = tuple(int(h) for h in mlp_hidden_layers)
+        self.history_length = int(history_length)
+        super().__init__(name=name)
+
+    def build_model(self) -> Model:
+        inp_rnn = Input(shape=(self.session_length,))
+        h = Embedding(self.item_count + 1, self.item_embed,
+                      init="normal")(inp_rnn)
+        for units in self.rnn_hidden_layers[:-1]:
+            h = GRU(units, return_sequences=True)(h)
+        h = GRU(self.rnn_hidden_layers[-1], return_sequences=False)(h)
+        rnn_logits = Dense(self.item_count)(h)
+
+        if not self.include_history:
+            out = Activation("softmax")(rnn_logits)
+            return Model(inp_rnn, out)
+
+        inp_mlp = Input(shape=(self.history_length,))
+        his = Embedding(self.item_count + 1, self.item_embed)(inp_mlp)
+        pooled = Lambda(lambda e: jnp.sum(e, axis=1),
+                        name=unique_name("histsum_"))(his)
+        m = pooled
+        for units in self.mlp_hidden_layers:
+            m = Dense(units, activation="relu")(m)
+        mlp_logits = Dense(self.item_count)(m)
+        merged = Merge(mode="sum")([rnn_logits, mlp_logits])
+        out = Activation("softmax")(merged)
+        return Model([inp_rnn, inp_mlp], out)
+
+    def recommend_for_session(self, sessions: np.ndarray, max_items: int = 5,
+                              zero_based_label: bool = True,
+                              batch_size: int = 1024
+                              ) -> List[List[Tuple[int, float]]]:
+        """``recommendForSession``: top-k (item, probability) per session."""
+        probs = self.predict(sessions, batch_size=batch_size)
+        top = np.argsort(-probs, axis=1)[:, :max_items]
+        base = 0 if zero_based_label else 1
+        return [[(int(i) + base, float(p[i])) for i in row]
+                for row, p in zip(top, probs)]
+
+    def get_config(self) -> Dict[str, Any]:
+        return {"item_count": self.item_count, "item_embed": self.item_embed,
+                "rnn_hidden_layers": list(self.rnn_hidden_layers),
+                "session_length": self.session_length,
+                "include_history": self.include_history,
+                "mlp_hidden_layers": list(self.mlp_hidden_layers),
+                "history_length": self.history_length}

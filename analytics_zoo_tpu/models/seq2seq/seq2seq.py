@@ -1,0 +1,206 @@
+"""Seq2seq — generic encoder/decoder sequence model, parity with
+``models/seq2seq/Seq2seq.scala:50`` + ``RNNEncoder.scala`` /
+``RNNDecoder.scala`` / ``Bridge.scala:38`` (pyzoo ``models/seq2seq/seq2seq.py:42-158``).
+
+Structure (same as the reference graph):
+  encoder: stacked LSTM/GRU over (B, Te, D_in), final states collected
+  bridge:  passthrough | dense | densenonlinear over the concatenated states
+  decoder: stacked LSTM/GRU over (B, Td, D_dec), layer i initialized from the
+           bridged encoder layer-i states (teacher forcing during training)
+  generator: optional Dense head applied per timestep
+
+``infer`` runs the greedy feedback loop of ``Seq2seq.infer``
+(``Seq2seq.scala:112+``): start sign in, one timestep at a time, outputs fed
+back as the next decoder input, early stop on ``stop_sign``. Each step is one
+jitted decoder call; the Python loop is host-side control, as the reference's.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...pipeline.api.keras.engine import Layer, compute_dtype, param_dtype
+from ...pipeline.api.keras.layers import GRU, LSTM, Dense
+from ...pipeline.api.keras.layers.core import get_activation
+from ..common.zoo_model import ZooModel, register_model
+
+
+class _Seq2seqNet(Layer):
+    """The wired encoder/bridge/decoder/generator as one functional Layer."""
+
+    def __init__(self, spec: "Seq2seq", **kwargs):
+        super().__init__(**kwargs)
+        self.spec = spec
+        cell_cls = LSTM if spec.rnn_type == "lstm" else GRU
+        self.encoder_cells = [
+            cell_cls(spec.hidden_size, return_sequences=True,
+                     name=f"{self.name}_enc{i}")
+            for i in range(spec.num_layers)]
+        self.decoder_cells = [
+            cell_cls(spec.hidden_size, return_sequences=True,
+                     name=f"{self.name}_dec{i}")
+            for i in range(spec.num_layers)]
+        self.generator = (Dense(spec.generator_dim,
+                                activation=spec.generator_activation,
+                                name=f"{self.name}_gen")
+                          if spec.generator_dim else None)
+        # states per layer: LSTM carries (h, c), GRU carries h
+        self.state_num = 2 if spec.rnn_type == "lstm" else 1
+
+    @property
+    def input_shape(self):
+        s = self.spec
+        return [(None, None, s.input_dim), (None, None, s.decoder_input_dim)]
+
+    def build(self, rng, input_shape=None):
+        s = self.spec
+        shapes = input_shape or self.input_shape
+        enc_shape, dec_shape = shapes
+        keys = jax.random.split(rng, 2 * s.num_layers + 2)
+        p: Dict[str, Any] = {}
+        shape = enc_shape
+        for i, cell in enumerate(self.encoder_cells):
+            p[cell.name] = cell.build(keys[i], shape)
+            shape = (shape[0], shape[1], s.hidden_size)
+        shape = dec_shape
+        for i, cell in enumerate(self.decoder_cells):
+            p[cell.name] = cell.build(keys[s.num_layers + i], shape)
+            shape = (shape[0], shape[1], s.hidden_size)
+        if s.bridge in ("dense", "densenonlinear"):
+            # Bridge.scala:38: one Dense over the flattened states
+            dim = s.hidden_size * self.state_num * s.num_layers
+            p["bridge"] = {"W": jax.random.normal(
+                keys[-2], (dim, dim), param_dtype()) * (dim ** -0.5)}
+        if self.generator is not None:
+            p[self.generator.name] = self.generator.build(
+                keys[-1], (None, None, s.hidden_size))
+        return p
+
+    # ---- pieces reused by call() and infer() ------------------------------
+    def encode(self, params, enc_x) -> List:
+        h = enc_x
+        carries = []
+        for cell in self.encoder_cells:
+            h, carry = cell.run(params[cell.name], h)
+            carries.append(carry)
+        return carries
+
+    def apply_bridge(self, params, carries: List) -> List:
+        s = self.spec
+        if s.bridge == "passthrough":
+            return carries
+        flat_parts = []
+        for carry in carries:
+            parts = carry if isinstance(carry, tuple) else (carry,)
+            flat_parts.extend(parts)
+        flat = jnp.concatenate(flat_parts, axis=-1)
+        out = flat @ params["bridge"]["W"].astype(flat.dtype)
+        if s.bridge == "densenonlinear":
+            out = jnp.tanh(out)
+        splits = jnp.split(out, self.state_num * s.num_layers, axis=-1)
+        new_carries = []
+        for i in range(s.num_layers):
+            chunk = splits[i * self.state_num:(i + 1) * self.state_num]
+            new_carries.append(tuple(chunk) if self.state_num == 2 else chunk[0])
+        return new_carries
+
+    def decode(self, params, dec_x, carries: List) -> Tuple[Any, List]:
+        h = dec_x
+        new_carries = []
+        for cell, carry in zip(self.decoder_cells, carries):
+            h, c = cell.run(params[cell.name], h, carry0=carry)
+            new_carries.append(c)
+        if self.generator is not None:
+            h = self.generator.call(params[self.generator.name], h)
+        return h, new_carries
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not isinstance(x, (list, tuple)) or len(x) != 2:
+            raise ValueError(f"{self.name}: Seq2seq expects "
+                             f"[encoder_input, decoder_input]")
+        enc_x, dec_x = x
+        carries = self.apply_bridge(params, self.encode(params, enc_x))
+        out, _ = self.decode(params, dec_x, carries)
+        return out
+
+
+@register_model
+class Seq2seq(ZooModel):
+    """``Seq2seq(encoder, decoder, inputShape, outputShape, bridge,
+    generator)`` — configured by type instead of layer objects."""
+
+    def __init__(self, rnn_type: str = "lstm", num_layers: int = 1,
+                 hidden_size: int = 64, input_dim: int = 32,
+                 decoder_input_dim: Optional[int] = None,
+                 bridge: str = "passthrough",
+                 generator_dim: Optional[int] = None,
+                 generator_activation: Optional[str] = None,
+                 name: Optional[str] = None):
+        if rnn_type not in ("lstm", "gru"):
+            raise ValueError(f"rnn_type must be lstm|gru, got {rnn_type!r}")
+        if bridge not in ("passthrough", "dense", "densenonlinear"):
+            raise ValueError(f"bridge must be passthrough|dense|densenonlinear,"
+                             f" got {bridge!r}")
+        self.rnn_type = rnn_type
+        self.num_layers = int(num_layers)
+        self.hidden_size = int(hidden_size)
+        self.input_dim = int(input_dim)
+        self.decoder_input_dim = int(decoder_input_dim
+                                     if decoder_input_dim is not None
+                                     else input_dim)
+        self.bridge = bridge
+        self.generator_dim = generator_dim
+        self.generator_activation = generator_activation
+        super().__init__(name=name)
+
+    def build_model(self) -> _Seq2seqNet:
+        return _Seq2seqNet(self, name=self.name + "_net")
+
+    def infer(self, input: np.ndarray, start_sign: np.ndarray,
+              max_seq_len: int = 30,
+              stop_sign: Optional[np.ndarray] = None) -> np.ndarray:
+        """Greedy generation (``Seq2seq.scala:112``): feed outputs back as the
+        next decoder input. Requires the generator (or hidden) output dim to
+        equal ``decoder_input_dim``."""
+        if self.params is None:
+            raise RuntimeError("no weights; fit() or init_weights() first")
+        net: _Seq2seqNet = self.model
+        params = self.params
+        enc_x = jnp.asarray(np.asarray(input, np.float32))
+        if enc_x.ndim == 2:
+            enc_x = enc_x[None]
+        cur = jnp.asarray(np.asarray(start_sign, np.float32))
+        if cur.ndim == 1:
+            cur = cur[None, None]  # (1, 1, D)
+        elif cur.ndim == 2:
+            cur = cur[:, None]
+
+        @jax.jit
+        def enc_fn(p, e):
+            return net.apply_bridge(p, net.encode(p, e))
+
+        @jax.jit
+        def step_fn(p, c, carries):
+            return net.decode(p, c, carries)
+
+        carries = enc_fn(params, enc_x)
+        outs = []
+        for _ in range(max_seq_len):
+            y, carries = step_fn(params, cur, carries)
+            outs.append(np.asarray(y[:, 0]))
+            if stop_sign is not None and np.allclose(
+                    outs[-1], np.asarray(stop_sign, np.float32), atol=1e-4):
+                break
+            cur = y
+        return np.stack(outs, axis=1)
+
+    def get_config(self) -> Dict[str, Any]:
+        return {"rnn_type": self.rnn_type, "num_layers": self.num_layers,
+                "hidden_size": self.hidden_size, "input_dim": self.input_dim,
+                "decoder_input_dim": self.decoder_input_dim,
+                "bridge": self.bridge, "generator_dim": self.generator_dim,
+                "generator_activation": self.generator_activation}

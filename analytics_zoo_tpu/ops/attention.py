@@ -1,0 +1,64 @@
+"""Attention core ops — the compute kernel behind TransformerLayer/BERT
+(reference: ``pipeline/api/keras/layers/TransformerLayer.scala:56``,
+``BERT.scala:66``, pyzoo ``layers/self_attention.py``).
+
+Kept separate from the layer classes so the same interface can be served by
+(a) this fused XLA softmax-attention, (b) a Pallas flash-attention kernel, or
+(c) ring attention over the ``seq`` mesh axis (``parallel/ring_attention``) —
+swap happens at the layer level without touching model code.
+
+Logits/softmax run in float32 regardless of compute dtype (bfloat16 QKV is
+fine into the MXU; accumulating attention weights in bf16 is not).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          mask: Optional[jax.Array] = None,
+                          causal: bool = False,
+                          dropout_rate: float = 0.0,
+                          dropout_rng: Optional[jax.Array] = None,
+                          ) -> jax.Array:
+    """Multi-head scaled dot-product attention.
+
+    q, k, v: (B, n_head, T, d_head); ``mask``: broadcastable to
+    (B, n_head, Tq, Tk), 1.0 = attend / 0.0 = hide. Returns (B, n_head, T, d_head).
+    """
+    d_head = q.shape[-1]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits / jnp.sqrt(jnp.asarray(d_head, jnp.float32))
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), jnp.bool_), k=tk - tq)
+        logits = jnp.where(cm[None, None], logits, NEG_INF)
+    if mask is not None:
+        logits = logits + (1.0 - mask.astype(jnp.float32)) * NEG_INF
+    weights = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    weights.shape)
+        weights = jnp.where(keep, weights / (1.0 - dropout_rate), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(v.dtype)
+
+
+def split_heads(x: jax.Array, n_head: int) -> jax.Array:
+    """(B, T, H) → (B, n_head, T, H/n_head)."""
+    b, t, h = x.shape
+    return x.reshape(b, t, n_head, h // n_head).transpose(0, 2, 1, 3)
+
+
+def merge_heads(x: jax.Array) -> jax.Array:
+    """(B, n_head, T, d) → (B, T, n_head*d)."""
+    b, nh, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, nh * d)

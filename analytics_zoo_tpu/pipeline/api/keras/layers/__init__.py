@@ -5,3 +5,15 @@ from .core import (Activation, Dense, Dropout, Flatten, Reshape, Permute,  # noq
                    TimeDistributed, Highway, SparseDense, get_activation)
 from .embeddings import Embedding, SparseEmbedding, WordEmbedding  # noqa: F401
 from .normalization import BatchNormalization, LayerNorm, L2Normalize  # noqa: F401
+from .convolution import (AtrousConvolution1D, AtrousConvolution2D,  # noqa: F401
+                          Convolution1D, Convolution2D, Cropping1D,
+                          Cropping2D, Deconvolution2D, LocallyConnected1D,
+                          SeparableConvolution2D, UpSampling1D, UpSampling2D,
+                          ZeroPadding1D, ZeroPadding2D)
+from .pooling import (AveragePooling1D, AveragePooling2D,  # noqa: F401
+                      GlobalAveragePooling1D, GlobalAveragePooling2D,
+                      GlobalMaxPooling1D, GlobalMaxPooling2D, MaxPooling1D,
+                      MaxPooling2D)
+from .recurrent import GRU, LSTM, Bidirectional, SimpleRNN  # noqa: F401
+from .self_attention import (BERT, MultiHeadSelfAttention,  # noqa: F401
+                             TransformerBlock, TransformerLayer)

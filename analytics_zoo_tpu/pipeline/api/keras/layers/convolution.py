@@ -1,0 +1,348 @@
+"""Convolution layers — parity with the reference's Keras-1 conv family
+(``pipeline/api/keras/layers/``: Convolution1D.scala, Convolution2D.scala,
+AtrousConvolution1D/2D.scala, SeparableConvolution2D.scala,
+Deconvolution2D.scala, ZeroPadding*.scala, Cropping*.scala, UpSampling*.scala).
+
+TPU-native design: all convs run channels-last (NHWC/NWC) through
+``lax.conv_general_dilated`` so XLA tiles them straight onto the MXU — the
+reference's default NCHW (``dim_ordering="th"``) is a CPU/MKL layout and is
+deliberately not carried over. Accumulation is float32 regardless of the
+compute dtype (bfloat16 inputs keep full MXU rate).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..engine import Layer, compute_dtype, get_initializer, param_dtype
+from .core import get_activation
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def _padding(border_mode: str):
+    if border_mode not in ("valid", "same"):
+        raise ValueError(f"border_mode must be 'valid' or 'same', got {border_mode!r}")
+    return border_mode.upper()
+
+
+class Convolution1D(Layer):
+    """``Convolution1D(nb_filter, filter_length, activation, border_mode,
+    subsample_length)`` — Convolution1D.scala. Input (B, T, C) → (B, T', F)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 init: str = "glorot_uniform", activation=None,
+                 border_mode: str = "valid", subsample_length: int = 1,
+                 dilation_rate: int = 1, bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.init = init
+        self.activation = get_activation(activation)
+        self.border_mode = border_mode
+        self.subsample_length = subsample_length
+        self.dilation_rate = dilation_rate
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        p = {"W": get_initializer(self.init)(
+            rng, (self.filter_length, in_ch, self.nb_filter), param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        y = lax.conv_general_dilated(
+            x.astype(cd), params["W"].astype(cd),
+            window_strides=(self.subsample_length,),
+            padding=_padding(self.border_mode),
+            rhs_dilation=(self.dilation_rate,),
+            dimension_numbers=("NWC", "WIO", "NWC"),
+            preferred_element_type=jnp.float32).astype(cd)
+        if self.bias:
+            y = y + params["b"].astype(cd)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+
+class AtrousConvolution1D(Convolution1D):
+    """``AtrousConvolution1D.scala`` — dilated 1D conv."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 atrous_rate: int = 1, **kwargs):
+        super().__init__(nb_filter, filter_length,
+                         dilation_rate=atrous_rate, **kwargs)
+
+
+class Convolution2D(Layer):
+    """``Convolution2D(nb_filter, nb_row, nb_col, activation, border_mode,
+    subsample)`` — Convolution2D.scala. Input (B, H, W, C) → (B, H', W', F).
+    (Channels-last; the reference's NCHW maps to NHWC on TPU.)"""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 init: str = "glorot_uniform", activation=None,
+                 border_mode: str = "valid",
+                 subsample: Tuple[int, int] = (1, 1),
+                 dilation: Tuple[int, int] = (1, 1), bias: bool = True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.init = init
+        self.activation = get_activation(activation)
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample)
+        self.dilation = _pair(dilation)
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        p = {"W": get_initializer(self.init)(
+            rng, (self.nb_row, self.nb_col, in_ch, self.nb_filter),
+            param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        y = lax.conv_general_dilated(
+            x.astype(cd), params["W"].astype(cd),
+            window_strides=self.subsample,
+            padding=_padding(self.border_mode),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32).astype(cd)
+        if self.bias:
+            y = y + params["b"].astype(cd)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+
+class AtrousConvolution2D(Convolution2D):
+    """``AtrousConvolution2D.scala`` — dilated 2D conv."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 atrous_rate: Tuple[int, int] = (1, 1), **kwargs):
+        super().__init__(nb_filter, nb_row, nb_col, dilation=atrous_rate,
+                         **kwargs)
+
+
+class SeparableConvolution2D(Layer):
+    """``SeparableConvolution2D.scala`` — depthwise conv (per-channel,
+    ``feature_group_count``) followed by a 1x1 pointwise conv."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 init: str = "glorot_uniform", activation=None,
+                 border_mode: str = "valid",
+                 subsample: Tuple[int, int] = (1, 1),
+                 depth_multiplier: int = 1, bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.nb_row, self.nb_col = nb_row, nb_col
+        self.init = init
+        self.activation = get_activation(activation)
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample)
+        self.depth_multiplier = depth_multiplier
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        ini = get_initializer(self.init)
+        p = {"depthwise": ini(k1, (self.nb_row, self.nb_col, 1,
+                                   in_ch * self.depth_multiplier),
+                              param_dtype()),
+             "pointwise": ini(k2, (1, 1, in_ch * self.depth_multiplier,
+                                   self.nb_filter), param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        in_ch = x.shape[-1]
+        y = lax.conv_general_dilated(
+            x.astype(cd), params["depthwise"].astype(cd),
+            window_strides=self.subsample,
+            padding=_padding(self.border_mode),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=in_ch,
+            preferred_element_type=jnp.float32).astype(cd)
+        y = lax.conv_general_dilated(
+            y, params["pointwise"].astype(cd), window_strides=(1, 1),
+            padding="VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32).astype(cd)
+        if self.bias:
+            y = y + params["b"].astype(cd)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+
+class Deconvolution2D(Layer):
+    """``Deconvolution2D.scala`` — transposed conv (stride-upsampling)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 init: str = "glorot_uniform", activation=None,
+                 subsample: Tuple[int, int] = (1, 1), bias: bool = True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.nb_row, self.nb_col = nb_row, nb_col
+        self.init = init
+        self.activation = get_activation(activation)
+        self.subsample = _pair(subsample)
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        p = {"W": get_initializer(self.init)(
+            rng, (self.nb_row, self.nb_col, in_ch, self.nb_filter),
+            param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        y = lax.conv_transpose(
+            x.astype(cd), params["W"].astype(cd),
+            strides=self.subsample, padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32).astype(cd)
+        if self.bias:
+            y = y + params["b"].astype(cd)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+
+class ZeroPadding1D(Layer):
+    """``ZeroPadding1D.scala`` — pad the time axis."""
+
+    def __init__(self, padding: Union[int, Tuple[int, int]] = 1, **kwargs):
+        super().__init__(**kwargs)
+        self.padding = _pair(padding)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.pad(x, ((0, 0), self.padding, (0, 0)))
+
+
+class ZeroPadding2D(Layer):
+    """``ZeroPadding2D.scala`` — pad height/width."""
+
+    def __init__(self, padding: Tuple[int, int] = (1, 1), **kwargs):
+        super().__init__(**kwargs)
+        self.padding = _pair(padding)
+
+    def call(self, params, x, *, training=False, rng=None):
+        ph, pw = self.padding
+        return jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+
+
+class Cropping1D(Layer):
+    """``Cropping1D.scala``."""
+
+    def __init__(self, cropping: Tuple[int, int] = (1, 1), **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = _pair(cropping)
+
+    def call(self, params, x, *, training=False, rng=None):
+        a, b = self.cropping
+        return x[:, a:x.shape[1] - b, :]
+
+
+class Cropping2D(Layer):
+    """``Cropping2D.scala``."""
+
+    def __init__(self, cropping=((0, 0), (0, 0)), **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = (tuple(cropping[0]), tuple(cropping[1]))
+
+    def call(self, params, x, *, training=False, rng=None):
+        (t, b), (l, r) = self.cropping
+        return x[:, t:x.shape[1] - b, l:x.shape[2] - r, :]
+
+
+class UpSampling1D(Layer):
+    """``UpSampling1D.scala`` — repeat timesteps."""
+
+    def __init__(self, length: int = 2, **kwargs):
+        super().__init__(**kwargs)
+        self.length = length
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.repeat(x, self.length, axis=1)
+
+
+class UpSampling2D(Layer):
+    """``UpSampling2D.scala`` — nearest-neighbour spatial upsampling."""
+
+    def __init__(self, size: Tuple[int, int] = (2, 2), **kwargs):
+        super().__init__(**kwargs)
+        self.size = _pair(size)
+
+    def call(self, params, x, *, training=False, rng=None):
+        y = jnp.repeat(x, self.size[0], axis=1)
+        return jnp.repeat(y, self.size[1], axis=2)
+
+
+class LocallyConnected1D(Layer):
+    """``LocallyConnected1D.scala`` — unshared conv: one filter per output
+    position. Implemented as a batched matmul over unfolded patches (MXU-
+    friendly einsum, no Python loop)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 init: str = "glorot_uniform", activation=None,
+                 subsample_length: int = 1, bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.init = init
+        self.activation = get_activation(activation)
+        self.subsample_length = subsample_length
+        self.bias = bias
+
+    def _out_len(self, t: int) -> int:
+        return (t - self.filter_length) // self.subsample_length + 1
+
+    def build(self, rng, input_shape):
+        t, c = input_shape[1], input_shape[2]
+        out_t = self._out_len(t)
+        p = {"W": get_initializer(self.init)(
+            rng, (out_t, self.filter_length * c, self.nb_filter),
+            param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((out_t, self.nb_filter), param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        out_t = self._out_len(x.shape[1])
+        # unfold patches: (B, out_t, filter_length * C)
+        idx = (jnp.arange(out_t)[:, None] * self.subsample_length
+               + jnp.arange(self.filter_length)[None, :])
+        patches = x[:, idx, :].reshape(x.shape[0], out_t, -1)
+        y = jnp.einsum("btk,tkf->btf", patches.astype(cd),
+                       params["W"].astype(cd),
+                       preferred_element_type=jnp.float32).astype(cd)
+        if self.bias:
+            y = y + params["b"].astype(cd)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y

@@ -24,7 +24,9 @@ ACTIVATIONS: Dict[str, Callable] = {
     "relu6": jax.nn.relu6,
     "tanh": jnp.tanh,
     "sigmoid": jax.nn.sigmoid,
-    "hard_sigmoid": jax.nn.hard_sigmoid,
+    # Keras-1/BigDL hard_sigmoid is clip(0.2x+0.5, 0, 1) — NOT jax.nn's
+    # relu6(x+3)/6 variant; the reference's RNN defaults depend on this
+    "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
     "softmax": jax.nn.softmax,
     "log_softmax": jax.nn.log_softmax,
     "softplus": jax.nn.softplus,

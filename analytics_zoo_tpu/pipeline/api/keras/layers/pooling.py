@@ -1,0 +1,119 @@
+"""Pooling layers — parity with the reference's Keras-1 pooling family
+(``pipeline/api/keras/layers/``: MaxPooling1D/2D/3D.scala,
+AveragePooling1D/2D/3D.scala, GlobalMaxPooling*.scala,
+GlobalAveragePooling*.scala).
+
+All channels-last; windows run through ``lax.reduce_window`` which XLA fuses
+with neighbouring elementwise ops. Average pooling under ``same`` padding
+divides by the true window population (edge windows are smaller), matching
+Keras/TF semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..engine import Layer
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def _pool(x, init, op, window, strides, padding):
+    dims = (1,) + tuple(window) + (1,)
+    strd = (1,) + tuple(strides) + (1,)
+    return lax.reduce_window(x, init, op, dims, strd, padding)
+
+
+class MaxPooling1D(Layer):
+    """``MaxPooling1D(pool_length, stride, border_mode)``."""
+
+    def __init__(self, pool_length: int = 2, stride: Optional[int] = None,
+                 border_mode: str = "valid", **kwargs):
+        super().__init__(**kwargs)
+        self.pool_length = pool_length
+        self.stride = stride if stride is not None else pool_length
+        self.border_mode = border_mode.upper()
+
+    def call(self, params, x, *, training=False, rng=None):
+        return _pool(x, -jnp.inf, lax.max, (self.pool_length,),
+                     (self.stride,), self.border_mode)
+
+
+class AveragePooling1D(Layer):
+    """``AveragePooling1D(pool_length, stride, border_mode)``."""
+
+    def __init__(self, pool_length: int = 2, stride: Optional[int] = None,
+                 border_mode: str = "valid", **kwargs):
+        super().__init__(**kwargs)
+        self.pool_length = pool_length
+        self.stride = stride if stride is not None else pool_length
+        self.border_mode = border_mode.upper()
+
+    def call(self, params, x, *, training=False, rng=None):
+        s = _pool(x.astype(jnp.float32), 0.0, lax.add, (self.pool_length,),
+                  (self.stride,), self.border_mode)
+        n = _pool(jnp.ones_like(x, jnp.float32), 0.0, lax.add,
+                  (self.pool_length,), (self.stride,), self.border_mode)
+        return (s / n).astype(x.dtype)
+
+
+class MaxPooling2D(Layer):
+    """``MaxPooling2D(pool_size, strides, border_mode)`` — channels-last."""
+
+    def __init__(self, pool_size: Tuple[int, int] = (2, 2),
+                 strides: Optional[Tuple[int, int]] = None,
+                 border_mode: str = "valid", **kwargs):
+        super().__init__(**kwargs)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        self.border_mode = border_mode.upper()
+
+    def call(self, params, x, *, training=False, rng=None):
+        return _pool(x, -jnp.inf, lax.max, self.pool_size, self.strides,
+                     self.border_mode)
+
+
+class AveragePooling2D(Layer):
+    """``AveragePooling2D(pool_size, strides, border_mode)``."""
+
+    def __init__(self, pool_size: Tuple[int, int] = (2, 2),
+                 strides: Optional[Tuple[int, int]] = None,
+                 border_mode: str = "valid", **kwargs):
+        super().__init__(**kwargs)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        self.border_mode = border_mode.upper()
+
+    def call(self, params, x, *, training=False, rng=None):
+        s = _pool(x.astype(jnp.float32), 0.0, lax.add, self.pool_size,
+                  self.strides, self.border_mode)
+        n = _pool(jnp.ones_like(x, jnp.float32), 0.0, lax.add, self.pool_size,
+                  self.strides, self.border_mode)
+        return (s / n).astype(x.dtype)
+
+
+class GlobalMaxPooling1D(Layer):
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.max(x, axis=1)
+
+
+class GlobalAveragePooling1D(Layer):
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.mean(x, axis=1)
+
+
+class GlobalMaxPooling2D(Layer):
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.max(x, axis=(1, 2))
+
+
+class GlobalAveragePooling2D(Layer):
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.mean(x, axis=(1, 2))

@@ -1,0 +1,186 @@
+"""Recurrent layers — parity with the reference's Keras-1 RNN family
+(``pipeline/api/keras/layers/``: SimpleRNN.scala, LSTM.scala, GRU.scala,
+Bidirectional.scala; BigDL ``Recurrent`` containers underneath).
+
+TPU-native design: the time loop is ONE ``lax.scan`` over the sequence axis —
+a single compiled loop whose per-step body is a fused (B, D) x (D, 4U) matmul
+on the MXU. The input projection ``x @ W`` for all timesteps is hoisted out of
+the scan as one big (B*T, D) x (D, 4U) matmul, so the recurrent loop only
+carries the (U, 4U) recurrence — the standard XLA RNN recipe, unlike the
+reference's per-timestep BigDL cell graph.
+
+Weight layout follows Keras-1 exactly (gate order i, f, c, o for LSTM;
+z, r, h for GRU; reset gate applied BEFORE the recurrent matmul), so golden
+tests can compare against independent oracles.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..engine import Layer, compute_dtype, get_initializer, param_dtype
+from .core import get_activation
+
+
+class _RecurrentBase(Layer):
+    """Shared plumbing: shapes, scan driver, return_sequences/go_backwards."""
+
+    def __init__(self, output_dim: int, activation="tanh",
+                 init: str = "glorot_uniform", inner_init: str = "orthogonal",
+                 return_sequences: bool = False, go_backwards: bool = False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.output_dim = output_dim
+        self.activation = get_activation(activation)
+        self.init = init
+        self.inner_init = inner_init
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    # subclasses define: n_gates, step(params, carry, zx) -> (carry, h)
+    n_gates = 1
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        u = self.output_dim
+        k1, k2 = jax.random.split(rng)
+        return {
+            "W": get_initializer(self.init)(k1, (d, self.n_gates * u),
+                                            param_dtype()),
+            "U": get_initializer(self.inner_init)(k2, (u, self.n_gates * u),
+                                                  param_dtype()),
+            "b": jnp.zeros((self.n_gates * u,), param_dtype()),
+        }
+
+    def initial_carry(self, batch: int, dtype):
+        return jnp.zeros((batch, self.output_dim), dtype)
+
+    def run(self, params, x, carry0=None):
+        """Full scan: returns (hidden sequence (B, T, U), final carry).
+        ``carry0`` lets a decoder start from bridged encoder states
+        (``Seq2seq.scala`` / ``RNNDecoder.scala``)."""
+        cd = compute_dtype()
+        x = x.astype(cd)
+        b, t, _ = x.shape
+        if self.go_backwards:
+            x = x[:, ::-1, :]
+        # hoist the input projection out of the loop: one (B*T, D) matmul
+        zx = (jnp.einsum("btd,dk->btk", x, params["W"].astype(cd),
+                         preferred_element_type=jnp.float32)
+              + params["b"].astype(jnp.float32))
+        zx = jnp.swapaxes(zx, 0, 1)  # (T, B, n_gates*U) scan over time
+        if carry0 is None:
+            carry0 = self.initial_carry(b, jnp.float32)
+        U = params["U"].astype(cd)
+
+        def body(carry, z_t):
+            return self.step(U, carry, z_t)
+
+        final_carry, hs = lax.scan(body, carry0, zx)
+        hs = jnp.swapaxes(hs, 0, 1).astype(cd)  # (B, T, U)
+        return hs, final_carry
+
+    def call(self, params, x, *, training=False, rng=None):
+        hs, _ = self.run(params, x)
+        if self.return_sequences:
+            return hs[:, ::-1, :] if self.go_backwards else hs
+        return hs[:, -1, :]
+
+
+class SimpleRNN(_RecurrentBase):
+    """``SimpleRNN.scala`` — h_t = act(x_t W + h_{t-1} U + b)."""
+
+    n_gates = 1
+
+    def step(self, U, h, z_t):
+        h = self.activation(z_t + h @ U)
+        return h, h
+
+
+class LSTM(_RecurrentBase):
+    """``LSTM.scala`` — Keras-1 gates (i, f, c, o):
+    i = σ(z_i), f = σ(z_f), c = f*c' + i*tanh(z_c), o = σ(z_o),
+    h = o * act(c), where z = x W + h' U + b."""
+
+    n_gates = 4
+
+    def __init__(self, output_dim: int, activation="tanh",
+                 inner_activation="hard_sigmoid", **kwargs):
+        super().__init__(output_dim, activation=activation, **kwargs)
+        self.inner_activation = get_activation(inner_activation)
+
+    def initial_carry(self, batch: int, dtype):
+        z = jnp.zeros((batch, self.output_dim), dtype)
+        return (z, z)  # (h, c)
+
+    def step(self, U, carry, z_t):
+        h_prev, c_prev = carry
+        u = self.output_dim
+        z = z_t + h_prev @ U
+        i = self.inner_activation(z[:, :u])
+        f = self.inner_activation(z[:, u:2 * u])
+        g = jnp.tanh(z[:, 2 * u:3 * u])
+        o = self.inner_activation(z[:, 3 * u:])
+        c = f * c_prev + i * g
+        h = o * self.activation(c)
+        return (h, c), h
+
+
+class GRU(_RecurrentBase):
+    """``GRU.scala`` — Keras-1 gates (z, r, h), reset BEFORE the recurrent
+    matmul: hh = act(x W_h + (r*h') U_h + b_h); h = z*h' + (1-z)*hh."""
+
+    n_gates = 3
+
+    def __init__(self, output_dim: int, activation="tanh",
+                 inner_activation="hard_sigmoid", **kwargs):
+        super().__init__(output_dim, activation=activation, **kwargs)
+        self.inner_activation = get_activation(inner_activation)
+
+    def step(self, U, h_prev, z_t):
+        u = self.output_dim
+        rec = h_prev @ U[:, :2 * u]
+        z = self.inner_activation(z_t[:, :u] + rec[:, :u])
+        r = self.inner_activation(z_t[:, u:2 * u] + rec[:, u:])
+        hh = self.activation(z_t[:, 2 * u:] + (r * h_prev) @ U[:, 2 * u:])
+        h = z * h_prev + (1.0 - z) * hh
+        return h, h
+
+
+class Bidirectional(Layer):
+    """``Bidirectional.scala`` — run a recurrent layer forward and (a fresh
+    copy) backward, merging outputs (concat/sum/mul/ave)."""
+
+    def __init__(self, layer: _RecurrentBase, merge_mode: str = "concat",
+                 **kwargs):
+        super().__init__(**kwargs)
+        import copy
+        self.forward = layer
+        self.backward = copy.copy(layer)
+        self.backward._auto_name = False
+        self.backward.name = layer.name + "_bwd"
+        self.backward.go_backwards = not layer.go_backwards
+        self.merge_mode = merge_mode
+
+    def build(self, rng, input_shape):
+        k1, k2 = jax.random.split(rng)
+        return {"forward": self.forward.build(k1, input_shape),
+                "backward": self.backward.build(k2, input_shape)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        yf = self.forward.call(params["forward"], x, training=training, rng=rng)
+        yb = self.backward.call(params["backward"], x, training=training, rng=rng)
+        m = self.merge_mode
+        if m == "concat":
+            return jnp.concatenate([yf, yb], axis=-1)
+        if m == "sum":
+            return yf + yb
+        if m == "mul":
+            return yf * yb
+        if m == "ave":
+            return (yf + yb) / 2.0
+        raise ValueError(f"unknown merge_mode {m!r}")

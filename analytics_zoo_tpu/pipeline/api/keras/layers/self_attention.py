@@ -1,0 +1,260 @@
+"""Transformer layers — parity with the reference's attention stack
+(``pipeline/api/keras/layers/TransformerLayer.scala:56``, ``BERT.scala:66``,
+pyzoo ``pipeline/api/keras/layers/self_attention.py``).
+
+* ``MultiHeadSelfAttention`` — fused QKV projection (one (B*T, H) x (H, 3H)
+  matmul onto the MXU) + the swappable attention core in
+  ``ops/attention.py``.
+* ``TransformerBlock`` — post-LN residual block (attention → add&norm →
+  gelu FFN → add&norm), the layout both the reference's GPT-style
+  TransformerLayer and BERT use.
+* ``TransformerLayer`` — word+position embeddings + N causal blocks
+  (``bidirectional=False`` ≙ the reference's maskAttention GPT mode).
+* ``BERT`` — word+position+token-type embeddings, N bidirectional blocks with
+  an attention mask input, plus the tanh pooler over [CLS].
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.attention import (dot_product_attention,
+                                             merge_heads, split_heads)
+from ..engine import Layer, compute_dtype, get_initializer, param_dtype
+from .normalization import LayerNorm
+
+
+def _dense_params(rng, d_in, d_out, init="glorot_uniform"):
+    return {"W": get_initializer(init)(rng, (d_in, d_out), param_dtype()),
+            "b": jnp.zeros((d_out,), param_dtype())}
+
+
+def _dense(p, x, cd):
+    y = jnp.einsum("...d,dk->...k", x.astype(cd), p["W"].astype(cd),
+                   preferred_element_type=jnp.float32).astype(cd)
+    return y + p["b"].astype(cd)
+
+
+def _dropout(x, rate, rng, training):
+    if not training or rate <= 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+class MultiHeadSelfAttention(Layer):
+    """Fused-QKV multi-head self-attention. Input (B, T, H) (optionally with a
+    (B, 1, 1, T) keep-mask) → (B, T, H)."""
+
+    def __init__(self, hidden_size: int, n_head: int, causal: bool = False,
+                 attn_drop: float = 0.0, out_drop: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        if hidden_size % n_head != 0:
+            raise ValueError(f"hidden_size {hidden_size} not divisible by "
+                             f"n_head {n_head}")
+        self.hidden_size = hidden_size
+        self.n_head = n_head
+        self.causal = causal
+        self.attn_drop = attn_drop
+        self.out_drop = out_drop
+
+    def build(self, rng, input_shape):
+        k1, k2 = jax.random.split(rng)
+        return {"qkv": _dense_params(k1, self.hidden_size, 3 * self.hidden_size),
+                "proj": _dense_params(k2, self.hidden_size, self.hidden_size)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        mask = None
+        if isinstance(x, (list, tuple)):
+            x, mask = x
+        cd = compute_dtype()
+        qkv = _dense(params["qkv"], x, cd)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        r1 = r2 = None
+        if rng is not None:
+            r1, r2 = jax.random.split(rng)
+        out = dot_product_attention(
+            split_heads(q, self.n_head), split_heads(k, self.n_head),
+            split_heads(v, self.n_head), mask=mask, causal=self.causal,
+            dropout_rate=self.attn_drop if training else 0.0, dropout_rng=r1)
+        out = _dense(params["proj"], merge_heads(out), cd)
+        return _dropout(out, self.out_drop, r2, training)
+
+
+class TransformerBlock(Layer):
+    """Post-LN residual block: x = LN1(x + Attn(x)); x = LN2(x + FFN(x)).
+    FFN = gelu (``TransformerLayer.scala`` uses gelu, as does BERT)."""
+
+    def __init__(self, hidden_size: int, n_head: int,
+                 intermediate_size: Optional[int] = None,
+                 causal: bool = False, hidden_drop: float = 0.0,
+                 attn_drop: float = 0.0, epsilon: float = 1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.hidden_drop = hidden_drop
+        self.attn = MultiHeadSelfAttention(
+            hidden_size, n_head, causal=causal, attn_drop=attn_drop,
+            out_drop=hidden_drop, name=(kwargs.get("name") or "tb") + "_attn")
+        self.ln1 = LayerNorm(epsilon=epsilon)
+        self.ln2 = LayerNorm(epsilon=epsilon)
+
+    def build(self, rng, input_shape):
+        shape = input_shape[0] if isinstance(input_shape, list) else input_shape
+        k1, k2, k3, k4, k5 = jax.random.split(rng, 5)
+        return {
+            "attn": self.attn.build(k1, shape),
+            "ln1": self.ln1.build(k2, shape),
+            "fc": _dense_params(k3, self.hidden_size, self.intermediate_size),
+            "out": _dense_params(k4, self.intermediate_size, self.hidden_size),
+            "ln2": self.ln2.build(k5, shape),
+        }
+
+    def call(self, params, x, *, training=False, rng=None):
+        mask = None
+        if isinstance(x, (list, tuple)):
+            x, mask = x
+        r1 = r2 = None
+        if rng is not None:
+            r1, r2 = jax.random.split(rng)
+        cd = compute_dtype()
+        a = self.attn.call(params["attn"], [x, mask] if mask is not None else x,
+                           training=training, rng=r1)
+        x = self.ln1.call(params["ln1"], x + a)
+        h = jax.nn.gelu(_dense(params["fc"], x, cd))
+        h = _dropout(_dense(params["out"], h, cd), self.hidden_drop, r2,
+                     training)
+        return self.ln2.call(params["ln2"], x + h)
+
+
+class TransformerLayer(Layer):
+    """GPT-style decoder stack — ``TransformerLayer.scala:56`` /
+    pyzoo ``self_attention.py``. Input int ids (B, T) → hidden states
+    (B, T, H). ``bidirectional=False`` applies the causal mask (the
+    reference's ``maskAttention``)."""
+
+    def __init__(self, vocab: int, seq_len: int, n_block: int = 12,
+                 hidden_size: int = 768, n_head: int = 12,
+                 hidden_drop: float = 0.1, attn_drop: float = 0.1,
+                 embedding_drop: float = 0.1, bidirectional: bool = False,
+                 initializer_range: float = 0.02, **kwargs):
+        super().__init__(**kwargs)
+        self.vocab = vocab
+        self.seq_len = seq_len
+        self.n_block = n_block
+        self.hidden_size = hidden_size
+        self.embedding_drop = embedding_drop
+        self.initializer_range = initializer_range
+        self.blocks = [
+            TransformerBlock(hidden_size, n_head, causal=not bidirectional,
+                             hidden_drop=hidden_drop, attn_drop=attn_drop,
+                             name=f"{self.name}_block{i}")
+            for i in range(n_block)
+        ]
+
+    def build(self, rng, input_shape):
+        keys = jax.random.split(rng, self.n_block + 2)
+        std = self.initializer_range
+        p: Dict[str, Any] = {
+            "wte": jax.random.normal(keys[0], (self.vocab, self.hidden_size),
+                                     param_dtype()) * std,
+            "wpe": jax.random.normal(keys[1], (self.seq_len, self.hidden_size),
+                                     param_dtype()) * std,
+        }
+        h_shape = (input_shape[0], input_shape[1], self.hidden_size)
+        for i, blk in enumerate(self.blocks):
+            p[f"block{i}"] = blk.build(keys[i + 2], h_shape)
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        ids = x.astype(jnp.int32)
+        t = ids.shape[1]
+        h = (jnp.take(params["wte"], ids, axis=0)
+             + params["wpe"][None, :t, :]).astype(compute_dtype())
+        r = rng
+        if rng is not None:
+            r, re = jax.random.split(rng)
+            h = _dropout(h, self.embedding_drop, re, training)
+        for i, blk in enumerate(self.blocks):
+            br = jax.random.fold_in(r, i) if r is not None else None
+            h = blk.call(params[f"block{i}"], h, training=training, rng=br)
+        return h
+
+
+class BERT(Layer):
+    """BERT encoder — ``BERT.scala:66``. Input
+    ``[token_ids, token_type_ids, position_ids, attention_mask]`` (mask is
+    (B, 1, 1, T), 1.0 = attend) → ``[sequence_output, pooled_output]``."""
+
+    def __init__(self, vocab: int = 40990, hidden_size: int = 768,
+                 n_block: int = 12, n_head: int = 12, seq_len: int = 512,
+                 intermediate_size: int = 3072, hidden_drop: float = 0.1,
+                 attn_drop: float = 0.1, initializer_range: float = 0.02,
+                 type_vocab: int = 2, **kwargs):
+        super().__init__(**kwargs)
+        self.vocab = vocab
+        self.hidden_size = hidden_size
+        self.n_block = n_block
+        self.seq_len = seq_len
+        self.type_vocab = type_vocab
+        self.hidden_drop = hidden_drop
+        self.initializer_range = initializer_range
+        self.emb_ln = LayerNorm(epsilon=1e-12)
+        self.blocks = [
+            TransformerBlock(hidden_size, n_head,
+                             intermediate_size=intermediate_size,
+                             causal=False, hidden_drop=hidden_drop,
+                             attn_drop=attn_drop, epsilon=1e-12,
+                             name=f"{self.name}_block{i}")
+            for i in range(n_block)
+        ]
+
+    def build(self, rng, input_shape):
+        shapes = input_shape if isinstance(input_shape, list) else [input_shape]
+        b, t = shapes[0][0], shapes[0][1]
+        keys = jax.random.split(rng, self.n_block + 5)
+        std = self.initializer_range
+        p: Dict[str, Any] = {
+            "word": jax.random.normal(keys[0], (self.vocab, self.hidden_size),
+                                      param_dtype()) * std,
+            "position": jax.random.normal(
+                keys[1], (self.seq_len, self.hidden_size), param_dtype()) * std,
+            "token_type": jax.random.normal(
+                keys[2], (self.type_vocab, self.hidden_size),
+                param_dtype()) * std,
+            "emb_ln": self.emb_ln.build(keys[3], (b, t, self.hidden_size)),
+            "pooler": _dense_params(keys[4], self.hidden_size,
+                                    self.hidden_size),
+        }
+        for i, blk in enumerate(self.blocks):
+            p[f"block{i}"] = blk.build(keys[i + 5] if self.n_block else keys[4],
+                                       (b, t, self.hidden_size))
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not isinstance(x, (list, tuple)) or len(x) != 4:
+            raise ValueError(
+                f"{self.name}: BERT expects [token_ids, token_type_ids, "
+                f"position_ids, attention_mask]")
+        ids, token_type, pos, mask = x
+        cd = compute_dtype()
+        h = (jnp.take(params["word"], ids.astype(jnp.int32), axis=0)
+             + jnp.take(params["position"], pos.astype(jnp.int32), axis=0)
+             + jnp.take(params["token_type"], token_type.astype(jnp.int32),
+                        axis=0))
+        h = self.emb_ln.call(params["emb_ln"], h).astype(cd)
+        r = rng
+        if rng is not None:
+            r, re = jax.random.split(rng)
+            h = _dropout(h, self.hidden_drop, re, training)
+        if mask is not None and mask.ndim == 2:  # (B, T) → (B, 1, 1, T)
+            mask = mask[:, None, None, :]
+        for i, blk in enumerate(self.blocks):
+            br = jax.random.fold_in(r, i) if r is not None else None
+            h = blk.call(params[f"block{i}"], [h, mask], training=training,
+                         rng=br)
+        pooled = jnp.tanh(_dense(params["pooler"], h[:, 0, :], cd))
+        return [h, pooled]

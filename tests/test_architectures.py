@@ -1,0 +1,114 @@
+"""End-to-end architecture tests on the 8-device CPU mesh: a conv net, an
+LSTM classifier, and a BERT-small classifier train and learn — the round-2
+milestones from the build plan (SURVEY §7 step 6)."""
+
+import numpy as np
+
+from analytics_zoo_tpu.common import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model, Sequential
+from analytics_zoo_tpu.pipeline.api.keras.engine import Lambda
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    BERT, GRU, LSTM, Convolution2D, Dense, Flatten, GlobalAveragePooling1D,
+    MaxPooling2D, TransformerLayer)
+
+
+def test_convnet_trains():
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    n = 256
+    # class = which quadrant holds the bright blob
+    y = rng.integers(0, 4, n).astype(np.int32)
+    x = rng.normal(0, 0.1, (n, 8, 8, 1)).astype(np.float32)
+    for i in range(n):
+        r, c = divmod(int(y[i]), 2)
+        x[i, r * 4:(r + 1) * 4, c * 4:(c + 1) * 4, 0] += 1.0
+    m = Sequential([
+        Convolution2D(8, 3, 3, activation="relu", border_mode="same",
+                      input_shape=(8, 8, 1)),
+        MaxPooling2D((2, 2)),
+        Flatten(),
+        Dense(4, activation="softmax"),
+    ])
+    m.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=0.01)
+    m.fit(x, y, batch_size=32, nb_epoch=10)
+    assert m.evaluate(x, y, batch_size=32)["accuracy"] > 0.9
+
+
+def test_lstm_classifier_trains():
+    init_zoo_context()
+    rng = np.random.default_rng(1)
+    n, t, d = 256, 10, 4
+    x = rng.normal(size=(n, t, d)).astype(np.float32)
+    # label depends on the sign of the sum of the LAST timestep
+    y = (x[:, -1, :].sum(axis=1) > 0).astype(np.float32)[:, None]
+    m = Sequential([
+        LSTM(16, input_shape=(t, d)),
+        Dense(1, activation="sigmoid"),
+    ])
+    m.compile(optimizer="adam", loss="bce", metrics=["accuracy"], lr=0.01)
+    m.fit(x, y, batch_size=32, nb_epoch=15)
+    assert m.evaluate(x, y, batch_size=32)["accuracy"] > 0.9
+
+
+def test_gru_sequence_output_feeds_pooling():
+    init_zoo_context()
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(64, 6, 3)).astype(np.float32)
+    y = (x.mean(axis=(1, 2)) > 0).astype(np.float32)[:, None]
+    m = Sequential([
+        GRU(8, return_sequences=True, input_shape=(6, 3)),
+        GlobalAveragePooling1D(),
+        Dense(1, activation="sigmoid"),
+    ])
+    m.compile(optimizer="adam", loss="bce", lr=0.02)
+    h = m.fit(x, y, batch_size=32, nb_epoch=10)
+    assert h["loss"][-1] < h["loss"][0]
+
+
+def _bert_inputs(n, t, vocab, seed=3):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, vocab, (n, t)).astype(np.int32)
+    token_type = np.zeros((n, t), np.int32)
+    pos = np.broadcast_to(np.arange(t, dtype=np.int32), (n, t)).copy()
+    mask = np.ones((n, 1, 1, t), np.float32)
+    return ids, token_type, pos, mask
+
+
+def test_bert_small_classifier_trains():
+    init_zoo_context()
+    n, t, vocab = 128, 12, 50
+    ids, token_type, pos, mask = _bert_inputs(n, t, vocab)
+    # learnable: label = parity of the first token id
+    y = (ids[:, 0] % 2).astype(np.int32)
+
+    i1, i2, i3 = Input(shape=(t,)), Input(shape=(t,)), Input(shape=(t,))
+    i4 = Input(shape=(1, 1, t))
+    bert = BERT(vocab=vocab, hidden_size=32, n_block=2, n_head=2, seq_len=t,
+                intermediate_size=64, hidden_drop=0.0, attn_drop=0.0)
+    seq_and_pooled = bert([i1, i2, i3, i4])
+    pooled = Lambda(lambda seq, pooled: pooled, name="take_pooled")(seq_and_pooled)
+    out = Dense(2, activation="softmax")(pooled)
+    m = Model(input=[i1, i2, i3, i4], output=out)
+    m.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=3e-3)
+    h = m.fit([ids, token_type, pos, mask], y, batch_size=32, nb_epoch=12)
+    assert h["loss"][-1] < 0.7 * h["loss"][0]
+    res = m.evaluate([ids, token_type, pos, mask], y, batch_size=32)
+    assert res["accuracy"] > 0.8
+
+
+def test_transformer_layer_in_graph():
+    init_zoo_context()
+    n, t, vocab = 96, 8, 40
+    rng = np.random.default_rng(4)
+    ids = rng.integers(0, vocab, (n, t)).astype(np.int32)
+    y = (ids[:, 0] >= vocab // 2).astype(np.float32)[:, None]
+    m = Sequential([
+        TransformerLayer(vocab=vocab, seq_len=t, n_block=1, hidden_size=16,
+                         n_head=2, hidden_drop=0.0, attn_drop=0.0,
+                         embedding_drop=0.0, input_shape=(t,)),
+        GlobalAveragePooling1D(),
+        Dense(1, activation="sigmoid"),
+    ])
+    m.compile(optimizer="adam", loss="bce", lr=5e-3)
+    h = m.fit(ids, y, batch_size=32, nb_epoch=10)
+    assert h["loss"][-1] < h["loss"][0]

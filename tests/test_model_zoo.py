@@ -1,0 +1,269 @@
+"""Model-zoo tests: WideAndDeep, AnomalyDetector, TextClassifier, KNRM,
+Seq2seq, SessionRecommender — each trains end-to-end on the sharded CPU mesh
+and round-trips through save/load (the reference's per-model specs +
+``ZooModel`` discipline)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import init_zoo_context
+from analytics_zoo_tpu.models.anomalydetection import (AnomalyDetector,
+                                                       detect_anomalies,
+                                                       unroll)
+from analytics_zoo_tpu.models.common import load_model
+from analytics_zoo_tpu.models.recommendation import (ColumnFeatureInfo,
+                                                     SessionRecommender,
+                                                     WideAndDeep)
+from analytics_zoo_tpu.models.seq2seq import Seq2seq
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+from analytics_zoo_tpu.models.textmatching import KNRM
+
+
+# ---------------------------------------------------------------------------
+# WideAndDeep
+# ---------------------------------------------------------------------------
+
+def _census_like(n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    table = {
+        "gender": rng.integers(0, 2, n),
+        "occupation": rng.integers(0, 10, n),
+        "gender_x_occupation": None,  # crossed below
+        "education": rng.integers(0, 5, n),
+        "age_bucket": rng.integers(0, 8, n),
+        "hours": rng.normal(size=n).astype(np.float32),
+    }
+    table["gender_x_occupation"] = table["gender"] * 10 + table["occupation"]
+    # learnable target: depends on occupation and education
+    label = ((table["occupation"] + table["education"]) % 2).astype(np.int32)
+    info = ColumnFeatureInfo(
+        wide_base_cols=["gender", "occupation"], wide_base_dims=[2, 10],
+        wide_cross_cols=["gender_x_occupation"], wide_cross_dims=[20],
+        indicator_cols=["education"], indicator_dims=[5],
+        embed_cols=["occupation", "age_bucket"], embed_in_dims=[10, 8],
+        embed_out_dims=[8, 8],
+        continuous_cols=["hours"])
+    return table, label, info
+
+
+@pytest.mark.parametrize("model_type", ["wide", "deep", "wide_n_deep"])
+def test_wide_and_deep_variants_train(model_type):
+    init_zoo_context()
+    table, label, info = _census_like()
+    m = WideAndDeep(model_type=model_type, num_classes=2, column_info=info,
+                    hidden_layers=(16, 8))
+    x = info.input_arrays(table, model_type)
+    m.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=0.01)
+    h = m.fit(x if len(x) > 1 else x[0], label, batch_size=64, nb_epoch=12)
+    assert h["loss"][-1] < h["loss"][0]
+    if model_type != "wide":  # wide-alone can't express the xor-ish target
+        res = m.evaluate(x if len(x) > 1 else x[0], label, batch_size=64)
+        assert res["accuracy"] > 0.8
+
+
+def test_wide_and_deep_save_load(tmp_path):
+    init_zoo_context()
+    table, label, info = _census_like(n=128)
+    m = WideAndDeep(model_type="wide_n_deep", num_classes=2, column_info=info,
+                    hidden_layers=(8,))
+    x = info.input_arrays(table, "wide_n_deep")
+    m.compile(optimizer="adam", loss="scce", lr=0.01)
+    m.fit(x, label, batch_size=32, nb_epoch=2)
+    before = m.predict(x)
+    path = str(tmp_path / "wnd.npz")
+    m.save(path)
+    m2 = load_model(path)
+    np.testing.assert_allclose(m2.predict(x), before, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# AnomalyDetector
+# ---------------------------------------------------------------------------
+
+def test_anomaly_detector_end_to_end():
+    init_zoo_context()
+    t = np.arange(600, dtype=np.float32)
+    series = np.sin(t * 0.1)
+    series[400] = 5.0  # planted anomaly
+    x, y, idx = unroll(series, unroll_length=10)
+    assert x.shape == (590, 10, 1) and y.shape == (590,)
+    m = AnomalyDetector(feature_shape=(10, 1), hidden_layers=(8, 8),
+                        dropouts=(0.0, 0.0))
+    m.compile(optimizer="adam", loss="mse", lr=0.01)
+    h = m.fit(x, y[:, None], batch_size=64, nb_epoch=8)
+    assert h["loss"][-1] < h["loss"][0]
+    pred = m.predict(x).reshape(-1)
+    anomalies = detect_anomalies(y, pred, anomaly_size=3)
+    # the planted spike must rank among the top-3 distances
+    spike_window = np.where(np.abs(y - 5.0) < 1e-6)[0]
+    assert np.isfinite(anomalies[spike_window]).any()
+
+
+def test_anomaly_detector_save_load(tmp_path):
+    init_zoo_context()
+    x = np.random.default_rng(0).normal(size=(64, 6, 2)).astype(np.float32)
+    y = x[:, -1, :1]
+    m = AnomalyDetector(feature_shape=(6, 2), hidden_layers=(4,),
+                        dropouts=(0.0,))
+    m.compile(optimizer="adam", loss="mse", lr=0.01)
+    m.fit(x, y, batch_size=32, nb_epoch=2)
+    before = m.predict(x)
+    path = str(tmp_path / "ad.npz")
+    m.save(path)
+    np.testing.assert_allclose(load_model(path).predict(x), before,
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# TextClassifier
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("encoder", ["cnn", "lstm", "gru"])
+def test_text_classifier_trains(encoder):
+    init_zoo_context()
+    rng = np.random.default_rng(1)
+    n, t, vocab = 192, 20, 60
+    ids = rng.integers(1, vocab, (n, t)).astype(np.int32)
+    # class = whether "keyword" token 7 appears in the sequence
+    y = (ids == 7).any(axis=1).astype(np.int32)
+    m = TextClassifier(class_num=2, token_length=16, sequence_length=t,
+                       encoder=encoder, encoder_output_dim=16,
+                       vocab_size=vocab)
+    m.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=0.01)
+    h = m.fit(ids, y, batch_size=32, nb_epoch=10)
+    assert h["loss"][-1] < h["loss"][0]
+    if encoder == "cnn":
+        assert m.evaluate(ids, y, batch_size=32)["accuracy"] > 0.8
+
+
+def test_text_classifier_pretrained_embedding_frozen():
+    init_zoo_context()
+    vocab, dim, t = 30, 8, 10
+    weights = np.random.default_rng(2).normal(size=(vocab, dim)).astype(np.float32)
+    m = TextClassifier(class_num=2, token_length=dim, sequence_length=t,
+                       encoder="cnn", encoder_output_dim=8,
+                       embedding_weights=weights)
+    m.init_weights()
+    # frozen embedding: its table lives in net_state, not params
+    flat_names = str(sorted(m.params.keys()))
+    assert "wordembedding" not in flat_names or m.params.get(
+        [k for k in m.params if "wordembedding" in k][0]) == {}
+
+
+# ---------------------------------------------------------------------------
+# KNRM
+# ---------------------------------------------------------------------------
+
+def test_knrm_classification_trains():
+    init_zoo_context()
+    rng = np.random.default_rng(3)
+    n, t1, t2, vocab = 192, 5, 8, 40
+    q = rng.integers(1, vocab, (n, t1))
+    # positive pairs share tokens with the query; negatives are disjoint
+    y = rng.integers(0, 2, n).astype(np.float32)
+    d = rng.integers(1, vocab, (n, t2))
+    d[y == 1, :t1] = q[y == 1]
+    x = np.concatenate([q, d], axis=1).astype(np.int32)
+    m = KNRM(t1, t2, vocab_size=vocab, embed_size=12, kernel_num=11,
+             target_mode="classification")
+    m.compile(optimizer="adam", loss="bce", metrics=["accuracy"], lr=0.01)
+    h = m.fit(x, y[:, None], batch_size=32, nb_epoch=12)
+    assert h["loss"][-1] < h["loss"][0]
+    assert m.evaluate(x, y[:, None], batch_size=32)["accuracy"] > 0.8
+
+
+def test_knrm_ranking_mode_and_save_load(tmp_path):
+    init_zoo_context()
+    rng = np.random.default_rng(4)
+    x = rng.integers(1, 30, (64, 10)).astype(np.int32)
+    m = KNRM(4, 6, vocab_size=30, embed_size=8, kernel_num=5)
+    m.init_weights()
+    scores = m.predict(x)
+    assert scores.shape == (64, 1)
+    path = str(tmp_path / "knrm.npz")
+    m.save(path)
+    np.testing.assert_allclose(load_model(path).predict(x), scores,
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Seq2seq
+# ---------------------------------------------------------------------------
+
+def test_seq2seq_trains_copy_task():
+    init_zoo_context()
+    rng = np.random.default_rng(5)
+    n, te, td, d = 256, 6, 6, 4
+    enc = rng.normal(size=(n, te, d)).astype(np.float32)
+    # task: reproduce the encoder sequence (teacher-forced shift)
+    dec_in = np.concatenate([np.zeros((n, 1, d), np.float32),
+                             enc[:, :-1]], axis=1)
+    target = enc
+    m = Seq2seq(rnn_type="lstm", num_layers=1, hidden_size=32, input_dim=d,
+                bridge="dense", generator_dim=d)
+    m.compile(optimizer="adam", loss="mse", lr=0.01)
+    h = m.fit([enc, dec_in], target, batch_size=32, nb_epoch=15)
+    assert h["loss"][-1] < 0.5 * h["loss"][0]
+
+
+def test_seq2seq_infer_shapes():
+    init_zoo_context()
+    d = 3
+    m = Seq2seq(rnn_type="gru", num_layers=2, hidden_size=8, input_dim=d,
+                bridge="densenonlinear", generator_dim=d)
+    m.init_weights()
+    out = m.infer(np.zeros((4, 5, d), np.float32),
+                  start_sign=np.zeros((4, d), np.float32), max_seq_len=7)
+    assert out.shape == (4, 7, d)
+
+
+def test_seq2seq_save_load(tmp_path):
+    init_zoo_context()
+    d = 3
+    m = Seq2seq(rnn_type="lstm", num_layers=1, hidden_size=8, input_dim=d,
+                generator_dim=d)
+    m.init_weights()
+    enc = np.random.default_rng(6).normal(size=(8, 5, d)).astype(np.float32)
+    dec = np.zeros_like(enc)
+    before = m.predict([enc, dec])
+    path = str(tmp_path / "s2s.npz")
+    m.save(path)
+    np.testing.assert_allclose(load_model(path).predict([enc, dec]), before,
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# SessionRecommender
+# ---------------------------------------------------------------------------
+
+def test_session_recommender_trains_and_recommends():
+    init_zoo_context()
+    rng = np.random.default_rng(7)
+    n, sess_len, items = 256, 6, 30
+    x = rng.integers(1, items + 1, (n, sess_len)).astype(np.int32)
+    # next item = last item in session (strong learnable signal), 0-based label
+    y = (x[:, -1] - 1).astype(np.int32)
+    m = SessionRecommender(item_count=items, item_embed=12,
+                           rnn_hidden_layers=(16,), session_length=sess_len)
+    m.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=0.01)
+    h = m.fit(x, y, batch_size=32, nb_epoch=15)
+    assert h["loss"][-1] < h["loss"][0]
+    recs = m.recommend_for_session(x[:4], max_items=3)
+    assert len(recs) == 4 and len(recs[0]) == 3
+    assert all(0 <= item < items for item, _ in recs[0])
+
+
+def test_session_recommender_with_history():
+    init_zoo_context()
+    rng = np.random.default_rng(8)
+    n, sess_len, hist_len, items = 128, 5, 7, 20
+    xs = rng.integers(1, items + 1, (n, sess_len)).astype(np.int32)
+    xh = rng.integers(1, items + 1, (n, hist_len)).astype(np.int32)
+    y = (xs[:, -1] - 1).astype(np.int32)
+    m = SessionRecommender(item_count=items, item_embed=8,
+                           rnn_hidden_layers=(8,), session_length=sess_len,
+                           include_history=True, mlp_hidden_layers=(8,),
+                           history_length=hist_len)
+    m.compile(optimizer="adam", loss="scce", lr=0.01)
+    h = m.fit([xs, xh], y, batch_size=32, nb_epoch=3)
+    assert np.isfinite(h["loss"][-1])

@@ -36,6 +36,8 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.seed": 0,
     "zoo.matmul.precision": "default",   # default | high | highest
     "zoo.compute.dtype": "float32",      # float32 | bfloat16
+    "zoo.train.scan_steps": 1,           # optimizer steps fused per dispatch (lax.scan)
+    "zoo.train.device_cache": False,     # HBM-resident dataset, 1 dispatch/epoch
     "zoo.failure.retry_times": 5,        # ≅ bigdl.failure.retryTimes (Topology.scala:1172)
     "zoo.failure.retry_window_sec": 3600,
     "zoo.checkpoint.keep": 3,

@@ -185,12 +185,17 @@ class _ThreadedIterator:
 
 
 def prefetch_to_device(it: Iterator, mesh=None, *, buffer_size: int = 2,
-                       threaded: bool = True) -> Iterator:
+                       threaded: bool = True, sharding=None) -> Iterator:
     """Double-buffered device transfer: keep ``buffer_size`` batches already
     dispatched to the devices while the current one computes. ``device_put``
     is async in JAX, so this pipeline hides both host batch assembly (via the
-    background thread) and PCIe/DMA transfer behind the previous step."""
-    sharding = mesh_lib.batch_sharding(mesh)
+    background thread) and PCIe/DMA transfer behind the previous step.
+
+    ``sharding`` overrides the default leading-dim data sharding — used by the
+    multi-step scan path, whose chunks are ``(K, batch, ...)`` and shard the
+    *second* axis."""
+    if sharding is None:
+        sharding = mesh_lib.batch_sharding(mesh)
 
     def put(item):
         return jax.tree.map(

@@ -108,3 +108,10 @@ def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     per worker, ``Topology.scala:1118-1120``)."""
     mesh = mesh or global_mesh()
     return NamedSharding(mesh, P())
+
+
+def stacked_batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Sharding for a stacked chunk of K minibatches ``(K, batch, ...)``:
+    the scan axis stays replicated, the batch axis splits over data."""
+    mesh = mesh or global_mesh()
+    return NamedSharding(mesh, P(None, DATA_AXIS))

@@ -1,0 +1,5 @@
+"""Inference runtime (``pipeline/inference`` of the reference, L8)."""
+
+from .inference_model import InferenceModel
+
+__all__ = ["InferenceModel"]

@@ -1,0 +1,253 @@
+"""InferenceModel — the TPU-native inference runtime, parity with the
+reference's multi-backend ``InferenceModel``
+(``pipeline/inference/InferenceModel.scala:30-67,622-656``):
+
+* ``concurrent_num``-deep **replica queue**: the reference clones the model
+  ``concurrentNum`` times into a ``LinkedBlockingQueue`` so concurrent callers
+  each hold one replica (``InferenceModel.scala:67``). Here params are
+  immutable jax arrays and the compiled predict fn is pure, so replicas share
+  weights; the queue holds permits that bound in-flight predictions and make
+  ``predict`` safely callable from many threads (serving threads, ``L9``).
+* **multi-format load**: the reference loads BigDL/Caffe/TF/Torch/OpenVINO
+  (``InferenceModel.scala:80-450``); the TPU-native formats are the ZooModel
+  one-file ``.npz`` (``load(path)``), a training checkpoint directory
+  (``load_checkpoint``), or an in-memory ``KerasNet`` (``from_keras``).
+* **precision paths**: fp32, bf16 (MXU native), and **int8 weight-only
+  quantization** with per-channel scales — the AQT-style replacement for the
+  reference's OpenVINO int8 calibration path
+  (``InferenceModel.scala:350-450``, ``OpenVinoInferenceSupportive.scala``);
+  int8 weights stay int8 in HBM (4x smaller, bandwidth-bound layers speed
+  up) and are dequantized inside the fused XLA program.
+* **batch bucketing**: inputs are padded to the next power-of-two batch so
+  arbitrary request sizes reuse a small set of compiled programs instead of
+  recompiling per shape (XLA static-shape discipline).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.common.zoo_model import load_model
+from ...parallel import mesh as mesh_lib
+from ..api.keras.engine import KerasNet
+from ...utils.checkpoint import CheckpointManager
+
+__all__ = ["InferenceModel"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+# ---------------------------------------------------------------------------
+# int8 weight-only quantization (AQT-style)
+# ---------------------------------------------------------------------------
+
+_QUANT_MIN_SIZE = 512  # leaves smaller than this stay float (biases, scalars)
+
+
+def quantize_int8(params) -> Tuple[Any, Any]:
+    """Split a float param tree into (int8-or-float tree, scale-or-None tree).
+
+    Per-channel symmetric quantization over the last axis: for a Dense kernel
+    ``(in, out)`` each output column gets its own scale — the same granularity
+    OpenVINO's calibration uses for FC layers. Small leaves (biases, norms)
+    are kept in float; their footprint is negligible and quantizing them
+    costs accuracy for nothing."""
+
+    def q(leaf):
+        a = np.asarray(jax.device_get(leaf))
+        if a.dtype.kind != "f" or a.size < _QUANT_MIN_SIZE or a.ndim < 1:
+            return a, None
+        axes = tuple(range(a.ndim - 1)) if a.ndim > 1 else (0,)
+        amax = np.max(np.abs(a), axis=axes, keepdims=True)
+        scale = (amax / 127.0).astype(np.float32)
+        scale = np.where(scale == 0, 1.0, scale)
+        qa = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+        return qa, np.squeeze(scale, axis=axes) if a.ndim > 1 else scale
+
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    qs, scales = zip(*(q(l) for l in flat)) if flat else ((), ())
+    return (jax.tree_util.tree_unflatten(treedef, list(qs)),
+            jax.tree_util.tree_unflatten(treedef, list(scales)))
+
+
+def dequantize_int8(q_tree, scale_tree, dtype=jnp.float32):
+    """Inverse of :func:`quantize_int8`, run INSIDE the jitted predict so the
+    int8 leaves are what lives in HBM."""
+
+    def dq(q, s):
+        if s is None:
+            return q.astype(dtype) if q.dtype.kind == "f" else q
+        return q.astype(dtype) * jnp.asarray(s, dtype)
+
+    return jax.tree.map(dq, q_tree, scale_tree,
+                        is_leaf=lambda x: x is None or not isinstance(
+                            x, (dict, list, tuple)))
+
+
+# ---------------------------------------------------------------------------
+# InferenceModel
+# ---------------------------------------------------------------------------
+
+class InferenceModel:
+    """Replica-queue batched inference runtime.
+
+    >>> im = InferenceModel(concurrent_num=4)
+    >>> im.load("/path/model.npz", dtype="bfloat16")
+    >>> probs = im.predict(x)                       # thread-safe
+    """
+
+    def __init__(self, concurrent_num: int = 1, *,
+                 max_batch_size: int = 4096):
+        if concurrent_num < 1:
+            raise ValueError("concurrent_num must be >= 1")
+        self.concurrent_num = int(concurrent_num)
+        self.max_batch_size = int(max_batch_size)
+        self.mesh = mesh_lib.global_mesh()
+        self._permits: "queue.Queue[int]" = queue.Queue()
+        for i in range(self.concurrent_num):
+            self._permits.put(i)
+        self._model: Optional[KerasNet] = None
+        self._params = None
+        self._net_state = None
+        self._scales = None          # int8 path only
+        self._dtype = jnp.float32
+        self._predict_fns: Dict[int, Any] = {}   # padded batch -> compiled fn
+        self._compile_lock = threading.Lock()
+
+    # ---- loaders (InferenceModel.scala:80-450 family) ---------------------
+    def load(self, path: str, *, dtype: str = "float32",
+             quantize: Optional[str] = None) -> "InferenceModel":
+        """Load a ZooModel one-file ``.npz`` (``doLoadBigDL`` role)."""
+        return self.from_keras(load_model(path), dtype=dtype, quantize=quantize)
+
+    def load_checkpoint(self, model: KerasNet, ckpt_dir: str, *,
+                        dtype: str = "float32",
+                        quantize: Optional[str] = None) -> "InferenceModel":
+        """Load the newest training snapshot from ``ckpt_dir`` into
+        ``model``'s architecture (``doLoadTF(checkpoint)`` role)."""
+        if model.params is None:
+            model.init_weights()
+        mgr = CheckpointManager(ckpt_dir)
+        step = mgr.latest()
+        if step is None:
+            raise FileNotFoundError(f"no snapshot in {ckpt_dir}")
+        trees, _ = mgr.restore(step, {"params": model.params,
+                                      "net_state": model.net_state})
+        model.params = trees["params"]
+        model.net_state = trees["net_state"]
+        return self.from_keras(model, dtype=dtype, quantize=quantize)
+
+    def from_keras(self, model: KerasNet, *, dtype: str = "float32",
+                   quantize: Optional[str] = None) -> "InferenceModel":
+        """Wrap an in-memory KerasNet/ZooModel (weights already present)."""
+        if model.params is None:
+            model.init_weights()
+        self._model = model
+        self._dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                       "bf16": jnp.bfloat16}[dtype]
+        params, net_state = model.params, model.net_state
+        if quantize is None:
+            cast = (lambda a: a.astype(self._dtype)
+                    if hasattr(a, "dtype") and a.dtype == jnp.float32
+                    and self._dtype != jnp.float32 else a)
+            self._params = jax.tree.map(cast, params)
+            self._scales = None
+        elif quantize == "int8":
+            self._params, self._scales = quantize_int8(params)
+        else:
+            raise ValueError(f"unknown quantize mode {quantize!r}; "
+                             "use None or 'int8'")
+        self._net_state = net_state
+        self._predict_fns.clear()
+        return self
+
+    # ---- predict (InferenceModel.scala:622-656) ---------------------------
+    def _build_predict(self, padded: int):
+        model, dtype, scales = self._model, self._dtype, self._scales
+
+        def run(params, net_state, x):
+            if scales is not None:
+                params = dequantize_int8(params, scales, dtype)
+            if dtype != jnp.float32:
+                x = jax.tree.map(
+                    lambda a: a.astype(dtype) if a.dtype.kind == "f" else a, x)
+            yp, _ = model.apply(params, net_state, x, training=False, rng=None)
+            return jax.tree.map(lambda a: a.astype(jnp.float32)
+                                if a.dtype == jnp.bfloat16 else a, yp)
+
+        return jax.jit(run)
+
+    def _predict_fn(self, padded: int):
+        fn = self._predict_fns.get(padded)
+        if fn is None:
+            with self._compile_lock:
+                fn = self._predict_fns.get(padded)
+                if fn is None:
+                    fn = self._build_predict(padded)
+                    self._predict_fns[padded] = fn
+        return fn
+
+    def predict(self, x, batch_size: Optional[int] = None):
+        """Batched predict. Blocks while all ``concurrent_num`` replicas are
+        busy (the reference blocks on the replica queue,
+        ``InferenceModel.scala:622-656``). Thread-safe."""
+        if self._model is None:
+            raise RuntimeError("no model loaded; call load()/from_keras() first")
+        xs = [np.asarray(a) for a in _as_list(x)]
+        n = xs[0].shape[0]
+        dp = mesh_lib.data_parallel_size(self.mesh)
+        cap = min(self.max_batch_size, max(_next_pow2(n), dp))
+        permit = self._permits.get()
+        try:
+            outs = []
+            for i in range(0, n, cap):
+                chunk = [a[i:i + cap] for a in xs]
+                m = chunk[0].shape[0]
+                padded = max(_next_pow2(m), dp)
+                if m != padded:
+                    chunk = [np.concatenate(
+                        [a, np.repeat(a[-1:], padded - m, axis=0)], axis=0)
+                        for a in chunk]
+                sharding = mesh_lib.batch_sharding(self.mesh)
+                chunk_d = [jax.device_put(jnp.asarray(a), sharding)
+                           for a in chunk]
+                fn = self._predict_fn(padded)
+                yp = fn(self._params, self._net_state,
+                        chunk_d if len(chunk_d) > 1 else chunk_d[0])
+                outs.append(jax.tree.map(lambda a: np.asarray(
+                    jax.device_get(a))[:m], yp))
+            return jax.tree.map(lambda *ys: np.concatenate(ys, axis=0), *outs)
+        finally:
+            self._permits.put(permit)
+
+    def predict_classes(self, x, zero_based: bool = True):
+        probs = self.predict(x)
+        if probs.ndim > 1 and probs.shape[-1] > 1:
+            cls = np.argmax(probs, axis=-1)
+        else:
+            cls = (np.asarray(probs).reshape(-1) > 0.5).astype(np.int32)
+        return cls if zero_based else cls + 1
+
+    # ---- introspection ----------------------------------------------------
+    def memory_bytes(self) -> int:
+        """Weight footprint in HBM — shows the int8 4x reduction."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self._params):
+            total += int(np.prod(np.shape(leaf))) * np.dtype(
+                np.asarray(jax.device_get(leaf)).dtype).itemsize
+        return total

@@ -1,0 +1,147 @@
+"""Inference-runtime tests — replica-queue concurrency, multi-format load,
+bf16/int8 precision paths (counterpart of the reference's
+``pipeline/inference`` suites, ``InferenceModel.scala:30-67,622-656``)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+from analytics_zoo_tpu.pipeline.inference.inference_model import quantize_int8
+
+
+def _trained_mlp(seed=0, n=512, d=16, classes=4):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    m = Sequential([Dense(64, activation="relu", input_shape=(d,)),
+                    Dense(classes, activation="softmax")])
+    m.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=0.01)
+    m.fit(x, y, batch_size=64, nb_epoch=10)
+    return m, x, y
+
+
+def test_from_keras_predict_parity():
+    init_zoo_context()
+    m, x, y = _trained_mlp()
+    im = InferenceModel().from_keras(m)
+    np.testing.assert_allclose(im.predict(x[:100]),
+                               m.predict(x[:100], batch_size=128),
+                               rtol=1e-5, atol=1e-6)
+    cls = im.predict_classes(x[:100])
+    assert (cls == y[:100]).mean() > 0.9
+
+
+def test_load_zoo_npz(tmp_path):
+    init_zoo_context()
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    rng = np.random.default_rng(0)
+    x = np.stack([rng.integers(1, 50, 256), rng.integers(1, 40, 256)],
+                 axis=1).astype(np.int32)
+    y = rng.integers(0, 3, 256).astype(np.int32)
+    ncf = NeuralCF(50, 40, 3, user_embed=8, item_embed=8,
+                   hidden_layers=(16, 8), mf_embed=8)
+    ncf.compile(optimizer="adam", loss="scce", lr=0.01)
+    ncf.fit(x, y, batch_size=64, nb_epoch=2)
+    path = ncf.save(str(tmp_path / "ncf.npz"))
+    im = InferenceModel().load(path)
+    np.testing.assert_allclose(im.predict(x[:64]),
+                               ncf.predict(x[:64], batch_size=64),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_load_checkpoint(tmp_path):
+    init_zoo_context()
+    m, x, _ = _trained_mlp()
+    ck = str(tmp_path / "ck")
+    m.set_checkpoint(ck)
+    m.fit(x, np.argmax(m.predict(x, batch_size=128), -1).astype(np.int32),
+          batch_size=64, nb_epoch=1)
+
+    fresh = Sequential([Dense(64, activation="relu", input_shape=(16,)),
+                        Dense(4, activation="softmax")])
+    im = InferenceModel().load_checkpoint(fresh, ck)
+    np.testing.assert_allclose(im.predict(x[:50]),
+                               m.predict(x[:50], batch_size=64),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bfloat16_path_close():
+    init_zoo_context()
+    m, x, _ = _trained_mlp()
+    base = InferenceModel().from_keras(m).predict(x[:128])
+    bf = InferenceModel().from_keras(m, dtype="bfloat16").predict(x[:128])
+    assert bf.dtype == np.float32  # outputs upcast
+    assert np.argmax(bf, -1).tolist() == pytest.approx(
+        np.argmax(base, -1).tolist())
+
+
+def test_int8_quantization_memory_and_accuracy():
+    init_zoo_context()
+    m, x, y = _trained_mlp(n=1024)
+    fp = InferenceModel().from_keras(m)
+    q8 = InferenceModel().from_keras(m, quantize="int8")
+    # the two Dense kernels dominate; int8 must shrink footprint >2x overall
+    assert q8.memory_bytes() < fp.memory_bytes() / 2
+    pf, pq = fp.predict(x), q8.predict(x)
+    agree = (np.argmax(pf, -1) == np.argmax(pq, -1)).mean()
+    assert agree > 0.99, agree
+    acc = (q8.predict_classes(x) == y).mean()
+    assert acc > 0.9
+
+
+def test_quantize_int8_roundtrip_error_bounded():
+    rng = np.random.default_rng(3)
+    w = {"k": rng.normal(0, 0.1, (64, 32)).astype(np.float32),
+         "b": rng.normal(0, 0.1, (32,)).astype(np.float32)}
+    q, s = quantize_int8(w)
+    assert q["k"].dtype == np.int8
+    assert s["b"] is None  # small leaf stays float
+    deq = q["k"].astype(np.float32) * s["k"]
+    assert np.max(np.abs(deq - w["k"])) <= np.max(np.abs(w["k"])) / 127 + 1e-7
+
+
+def test_concurrent_callers():
+    init_zoo_context()
+    m, x, _ = _trained_mlp()
+    im = InferenceModel(concurrent_num=3)
+    im.from_keras(m)
+    expected = im.predict(x[:64])
+    results, errors = [None] * 8, []
+
+    def worker(i):
+        try:
+            results[i] = im.predict(x[:64])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for r in results:
+        np.testing.assert_allclose(r, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_ragged_and_chunked_batches():
+    init_zoo_context()
+    m, x, _ = _trained_mlp()
+    im = InferenceModel(max_batch_size=64).from_keras(m)
+    # 130 rows -> chunks of 64+64+2, tail padded to pow2 then trimmed
+    out = im.predict(x[:130])
+    assert out.shape[0] == 130
+    np.testing.assert_allclose(out, m.predict(x[:130], batch_size=64),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predict_before_load_raises():
+    init_zoo_context()
+    with pytest.raises(RuntimeError):
+        InferenceModel().predict(np.zeros((4, 2), np.float32))

@@ -1,0 +1,5 @@
+"""Estimator facade (``pipeline/estimator`` of the reference, L4)."""
+
+from .estimator import Estimator
+
+__all__ = ["Estimator"]

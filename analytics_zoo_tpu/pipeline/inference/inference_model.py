@@ -26,7 +26,6 @@ reference's multi-backend ``InferenceModel``
 from __future__ import annotations
 
 import queue
-import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -126,8 +125,7 @@ class InferenceModel:
         self._net_state = None
         self._scales = None          # int8 path only
         self._dtype = jnp.float32
-        self._predict_fns: Dict[int, Any] = {}   # padded batch -> compiled fn
-        self._compile_lock = threading.Lock()
+        self._predict = None         # shape-polymorphic jitted fn
 
     # ---- loaders (InferenceModel.scala:80-450 family) ---------------------
     def load(self, path: str, *, dtype: str = "float32",
@@ -173,11 +171,6 @@ class InferenceModel:
             raise ValueError(f"unknown quantize mode {quantize!r}; "
                              "use None or 'int8'")
         self._net_state = net_state
-        self._predict_fns.clear()
-        return self
-
-    # ---- predict (InferenceModel.scala:622-656) ---------------------------
-    def _build_predict(self, padded: int):
         model, dtype, scales = self._model, self._dtype, self._scales
 
         def run(params, net_state, x):
@@ -190,18 +183,13 @@ class InferenceModel:
             return jax.tree.map(lambda a: a.astype(jnp.float32)
                                 if a.dtype == jnp.bfloat16 else a, yp)
 
-        return jax.jit(run)
+        # one shape-polymorphic jitted fn; jax.jit caches one executable per
+        # padded batch size (bounded by the power-of-two bucketing below) and
+        # is itself thread-safe
+        self._predict = jax.jit(run)
+        return self
 
-    def _predict_fn(self, padded: int):
-        fn = self._predict_fns.get(padded)
-        if fn is None:
-            with self._compile_lock:
-                fn = self._predict_fns.get(padded)
-                if fn is None:
-                    fn = self._build_predict(padded)
-                    self._predict_fns[padded] = fn
-        return fn
-
+    # ---- predict (InferenceModel.scala:622-656) ---------------------------
     def predict(self, x, batch_size: Optional[int] = None):
         """Batched predict. Blocks while all ``concurrent_num`` replicas are
         busy (the reference blocks on the replica queue,
@@ -210,8 +198,13 @@ class InferenceModel:
             raise RuntimeError("no model loaded; call load()/from_keras() first")
         xs = [np.asarray(a) for a in _as_list(x)]
         n = xs[0].shape[0]
+        if n == 0:
+            raise ValueError("predict called with an empty batch")
         dp = mesh_lib.data_parallel_size(self.mesh)
-        cap = min(self.max_batch_size, max(_next_pow2(n), dp))
+        # the chunk cap is a power of two <= max_batch_size so padded chunks
+        # never exceed the user's HBM bound
+        cap = max(_next_pow2(self.max_batch_size + 1) // 2, dp)
+        cap = min(cap, max(_next_pow2(n), dp))
         permit = self._permits.get()
         try:
             outs = []
@@ -226,9 +219,8 @@ class InferenceModel:
                 sharding = mesh_lib.batch_sharding(self.mesh)
                 chunk_d = [jax.device_put(jnp.asarray(a), sharding)
                            for a in chunk]
-                fn = self._predict_fn(padded)
-                yp = fn(self._params, self._net_state,
-                        chunk_d if len(chunk_d) > 1 else chunk_d[0])
+                yp = self._predict(self._params, self._net_state,
+                                   chunk_d if len(chunk_d) > 1 else chunk_d[0])
                 outs.append(jax.tree.map(lambda a: np.asarray(
                     jax.device_get(a))[:m], yp))
             return jax.tree.map(lambda *ys: np.concatenate(ys, axis=0), *outs)
@@ -245,9 +237,7 @@ class InferenceModel:
 
     # ---- introspection ----------------------------------------------------
     def memory_bytes(self) -> int:
-        """Weight footprint in HBM — shows the int8 4x reduction."""
-        total = 0
-        for leaf in jax.tree_util.tree_leaves(self._params):
-            total += int(np.prod(np.shape(leaf))) * np.dtype(
-                np.asarray(jax.device_get(leaf)).dtype).itemsize
-        return total
+        """Weight footprint in HBM — shows the int8 4x reduction. Reads only
+        dtype/shape metadata (no device transfer)."""
+        return sum(int(np.prod(np.shape(leaf))) * np.dtype(leaf.dtype).itemsize
+                   for leaf in jax.tree_util.tree_leaves(self._params))

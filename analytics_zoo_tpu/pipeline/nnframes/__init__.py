@@ -1,0 +1,6 @@
+"""NNFrames — columnar-table ML pipeline (``pipeline/nnframes`` of the
+reference, L6)."""
+
+from .nn_estimator import NNClassifier, NNClassifierModel, NNEstimator, NNModel
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel"]

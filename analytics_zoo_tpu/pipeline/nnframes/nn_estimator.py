@@ -1,0 +1,197 @@
+"""NNFrames — the DataFrame-pipeline adapter, parity with the reference's
+``pipeline/nnframes/NNEstimator.scala`` / ``NNClassifier.scala``.
+
+The reference plugs BigDL training into Spark ML Pipelines:
+``NNEstimator.fit(df)`` converts DataFrame rows to Samples via
+``Preprocessing`` chains (``NNEstimator.scala:385-412``), trains through
+``InternalDistriOptimizer`` (``:414-479``), and returns an ``NNModel``
+transformer that appends a prediction column (``Predictor.scala:136-208``).
+
+TPU-native re-design: the "DataFrame" is a **columnar table** — a plain dict
+of column-name → numpy array (arrow-style), the natural host-side format for
+feeding device-resident batches. The estimator/transformer contract
+(`fit(table) -> NNModel`, `NNModel.transform(table) -> table + prediction`)
+and the param surface (feature/label cols, batch size, max epoch, caching)
+are kept.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ...common.triggers import Trigger
+from ...feature.feature_set import FeatureSet
+from ..api.keras.engine import KerasNet
+from ..estimator.estimator import Estimator
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel"]
+
+Table = Dict[str, np.ndarray]
+
+
+def _assemble(table: Table, cols: Sequence[str]) -> np.ndarray:
+    """VectorAssembler role: concatenate columns into one float feature
+    matrix. Scalar columns become width-1; array columns keep their width
+    (``NNEstimator.scala:385-403`` unwraps ML vectors the same way)."""
+    parts = []
+    for c in cols:
+        if c not in table:
+            raise KeyError(f"column {c!r} not in table; have {sorted(table)}")
+        a = np.asarray(table[c])
+        parts.append(a[:, None] if a.ndim == 1 else a.reshape(a.shape[0], -1))
+    return np.concatenate(parts, axis=1).astype(np.float32)
+
+
+class NNEstimator:
+    """``NNEstimator(model, criterion, samplePreprocessing)``
+    (``NNEstimator.scala:160-209``). ``feature_preprocessing`` maps the
+    table to the model's input array(s) — pass a callable for multi-input
+    models (e.g. ``ColumnFeatureInfo.input_arrays``); by default the
+    ``features_col`` columns are assembled into one float matrix."""
+
+    def __init__(self, model: KerasNet, criterion: Any = "mse",
+                 feature_preprocessing: Optional[Callable[[Table], Any]] = None):
+        self.model = model
+        self.criterion = criterion
+        self.feature_preprocessing = feature_preprocessing
+        self.features_col: List[str] = ["features"]
+        self.label_col = "label"
+        self.prediction_col = "prediction"
+        self.batch_size = 32
+        self.max_epoch = 1
+        self.optim_method: Any = "adam"
+        self.end_trigger: Optional[Trigger] = None
+        self.checkpoint_trigger: Optional[Trigger] = None
+        self.model_dir: Optional[str] = None
+        self.label_dtype = np.float32
+
+    # ---- Spark-ML-style param setters (NNEstimator.scala param surface) ---
+    def set_features_col(self, *cols: str) -> "NNEstimator":
+        self.features_col = list(cols)
+        return self
+
+    def set_label_col(self, col: str) -> "NNEstimator":
+        self.label_col = col
+        return self
+
+    def set_prediction_col(self, col: str) -> "NNEstimator":
+        self.prediction_col = col
+        return self
+
+    def set_batch_size(self, bs: int) -> "NNEstimator":
+        self.batch_size = int(bs)
+        return self
+
+    def set_max_epoch(self, n: int) -> "NNEstimator":
+        self.max_epoch = int(n)
+        return self
+
+    def set_optim_method(self, opt: Any) -> "NNEstimator":
+        self.optim_method = opt
+        return self
+
+    def set_end_when(self, trigger: Trigger) -> "NNEstimator":
+        self.end_trigger = trigger
+        return self
+
+    def set_checkpoint(self, path: str,
+                       trigger: Optional[Trigger] = None) -> "NNEstimator":
+        """``setCheckpoint`` (``NNEstimator.scala:131-140``)."""
+        self.model_dir = path
+        self.checkpoint_trigger = trigger
+        return self
+
+    # ---- fit (NNEstimator.scala:414-479) ----------------------------------
+    def _features(self, table: Table):
+        if self.feature_preprocessing is not None:
+            return self.feature_preprocessing(table)
+        return _assemble(table, self.features_col)
+
+    def _label(self, table: Table) -> np.ndarray:
+        if self.label_col not in table:
+            raise KeyError(f"label column {self.label_col!r} not in table")
+        y = np.asarray(table[self.label_col])
+        y = y.astype(self.label_dtype)
+        return y[:, None] if y.ndim == 1 and self.label_dtype == np.float32 else y
+
+    def fit(self, table: Table, validation_table: Optional[Table] = None,
+            ) -> "NNModel":
+        x = self._features(table)
+        y = self._label(table)
+        fs = FeatureSet.array(x, y)
+        est = Estimator(self.model, optim_methods=self.optim_method,
+                        model_dir=self.model_dir)
+        val = None
+        if validation_table is not None:
+            val = FeatureSet.array(self._features(validation_table),
+                                   self._label(validation_table))
+        est.train(fs, self.criterion, batch_size=self.batch_size,
+                  nb_epoch=self.max_epoch, end_trigger=self.end_trigger,
+                  checkpoint_trigger=self.checkpoint_trigger,
+                  validation_set=val)
+        return self._wrap_model()
+
+    def _wrap_model(self) -> "NNModel":
+        return NNModel(self.model,
+                       feature_preprocessing=self.feature_preprocessing,
+                       features_col=self.features_col,
+                       prediction_col=self.prediction_col,
+                       batch_size=self.batch_size)
+
+
+class NNModel:
+    """Transformer: appends ``prediction_col`` to the table
+    (``NNModel.transform`` → ``Predictor.scala:136-208``)."""
+
+    def __init__(self, model: KerasNet, *,
+                 feature_preprocessing: Optional[Callable] = None,
+                 features_col: Sequence[str] = ("features",),
+                 prediction_col: str = "prediction",
+                 batch_size: int = 32):
+        self.model = model
+        self.feature_preprocessing = feature_preprocessing
+        self.features_col = list(features_col)
+        self.prediction_col = prediction_col
+        self.batch_size = batch_size
+
+    def _features(self, table: Table):
+        if self.feature_preprocessing is not None:
+            return self.feature_preprocessing(table)
+        return _assemble(table, self.features_col)
+
+    def transform(self, table: Table) -> Table:
+        preds = self.model.predict(self._features(table),
+                                   batch_size=self.batch_size)
+        out = dict(table)
+        out[self.prediction_col] = self._postprocess(np.asarray(preds))
+        return out
+
+    def _postprocess(self, preds: np.ndarray) -> np.ndarray:
+        return preds
+
+
+class NNClassifier(NNEstimator):
+    """``NNClassifier`` (``NNClassifier.scala``): integer labels, argmax
+    predictions."""
+
+    def __init__(self, model: KerasNet,
+                 criterion: Any = "sparse_categorical_crossentropy",
+                 feature_preprocessing: Optional[Callable] = None):
+        super().__init__(model, criterion, feature_preprocessing)
+        self.label_dtype = np.int32
+
+    def _wrap_model(self) -> "NNClassifierModel":
+        return NNClassifierModel(
+            self.model, feature_preprocessing=self.feature_preprocessing,
+            features_col=self.features_col,
+            prediction_col=self.prediction_col, batch_size=self.batch_size)
+
+
+class NNClassifierModel(NNModel):
+    def _postprocess(self, preds: np.ndarray) -> np.ndarray:
+        if preds.ndim > 1 and preds.shape[-1] > 1:
+            return np.argmax(preds, axis=-1).astype(np.int32)
+        return (preds.reshape(-1) > 0.5).astype(np.int32)

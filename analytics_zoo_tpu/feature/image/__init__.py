@@ -1,0 +1,13 @@
+"""Image pipeline (``feature/image`` of the reference, L2)."""
+
+from .image_set import ImageSet, LocalImageSet
+from .transforms import (Brightness, CenterCrop, ChannelNormalize,
+                         ChannelOrder, HFlip, ImageProcessing,
+                         ImageSetToSample, MatToTensor, PixelNormalizer,
+                         RandomCrop, Resize)
+
+__all__ = [
+    "ImageSet", "LocalImageSet", "ImageProcessing", "Resize", "CenterCrop",
+    "RandomCrop", "HFlip", "Brightness", "ChannelNormalize", "ChannelOrder",
+    "PixelNormalizer", "MatToTensor", "ImageSetToSample",
+]

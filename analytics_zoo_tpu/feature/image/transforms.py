@@ -1,0 +1,224 @@
+"""Image preprocessing transformers — parity with the reference's
+``feature/image/*.scala`` OpenCV-JNI transformer files (Resize, CenterCrop,
+RandomCrop, Flip, Brightness, ChannelNormalize, ChannelOrder, MatToTensor...),
+re-designed host-side for the TPU infeed:
+
+* transforms are **vectorized numpy** wherever shapes allow (a batch
+  ``(N, H, W, C)`` processes in one call — the role Spark's per-partition
+  parallelism plays for the reference's per-record OpenCV ops), falling back
+  to per-image application for ragged inputs;
+* they compose with the same ``>>`` combinator as every other
+  ``Preprocessing`` (``feature/common/Preprocessing.scala``);
+* the output of a chain is a dense float32 NHWC batch ready for
+  ``device_put`` (channels-last is the TPU-native layout; the reference's
+  NCHW ``MatToTensor`` is an MKL layout choice).
+
+Each class cites its reference counterpart file.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..common import Preprocessing
+
+__all__ = [
+    "ImageProcessing", "Resize", "CenterCrop", "RandomCrop", "HFlip",
+    "Brightness", "ChannelNormalize", "ChannelOrder", "PixelNormalizer",
+    "MatToTensor", "ImageSetToSample",
+]
+
+
+class ImageProcessing(Preprocessing):
+    """Base: applies per-image (H, W, C) or batched (N, H, W, C).
+    Counterpart of ``feature/image/ImageProcessing.scala``."""
+
+    def apply(self, data):
+        if isinstance(data, (list, tuple)):
+            return [self.apply_one(np.asarray(im)) for im in data]
+        data = np.asarray(data)
+        if data.ndim == 4:
+            return self.apply_batch(data)
+        return self.apply_one(data)
+
+    def apply_one(self, im: np.ndarray) -> np.ndarray:
+        raise NotImplementedError(type(self).__name__)
+
+    def apply_batch(self, batch: np.ndarray) -> np.ndarray:
+        out = [self.apply_one(im) for im in batch]
+        return np.stack(out) if out else batch
+
+
+class Resize(ImageProcessing):
+    """``Resize.scala`` — bilinear resize to (height, width) via PIL."""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.h, self.w = int(resize_h), int(resize_w)
+
+    def apply_one(self, im):
+        from PIL import Image
+        arr = im
+        squeeze = arr.ndim == 3 and arr.shape[-1] == 1
+        if squeeze:
+            arr = arr[..., 0]
+        dtype = arr.dtype
+        if dtype != np.uint8:
+            # PIL resizes float per-channel via mode F; round-trip per channel
+            chans = [np.asarray(Image.fromarray(
+                arr[..., c].astype(np.float32), mode="F").resize(
+                    (self.w, self.h), Image.BILINEAR))
+                for c in range(arr.shape[-1])] if arr.ndim == 3 else [
+                np.asarray(Image.fromarray(arr.astype(np.float32), mode="F")
+                           .resize((self.w, self.h), Image.BILINEAR))]
+            out = np.stack(chans, axis=-1) if arr.ndim == 3 else chans[0]
+            out = out.astype(dtype)
+        else:
+            out = np.asarray(Image.fromarray(arr).resize((self.w, self.h),
+                                                         Image.BILINEAR))
+        if squeeze:
+            out = out[..., None]
+        return out
+
+
+class CenterCrop(ImageProcessing):
+    """``CenterCrop.scala``."""
+
+    def __init__(self, crop_h: int, crop_w: int):
+        self.h, self.w = int(crop_h), int(crop_w)
+
+    def _box(self, H, W):
+        if H < self.h or W < self.w:
+            raise ValueError(f"image {H}x{W} smaller than crop "
+                             f"{self.h}x{self.w}")
+        y = (H - self.h) // 2
+        x = (W - self.w) // 2
+        return y, x
+
+    def apply_one(self, im):
+        y, x = self._box(im.shape[0], im.shape[1])
+        return im[y:y + self.h, x:x + self.w]
+
+    def apply_batch(self, batch):
+        y, x = self._box(batch.shape[1], batch.shape[2])
+        return batch[:, y:y + self.h, x:x + self.w]
+
+
+class RandomCrop(ImageProcessing):
+    """``RandomCrop.scala`` — train-time augmentation."""
+
+    def __init__(self, crop_h: int, crop_w: int, seed: Optional[int] = None):
+        self.h, self.w = int(crop_h), int(crop_w)
+        self._rng = np.random.default_rng(seed)
+
+    def apply_one(self, im):
+        H, W = im.shape[0], im.shape[1]
+        if H < self.h or W < self.w:
+            raise ValueError(f"image {H}x{W} smaller than crop "
+                             f"{self.h}x{self.w}")
+        y = int(self._rng.integers(0, H - self.h + 1))
+        x = int(self._rng.integers(0, W - self.w + 1))
+        return im[y:y + self.h, x:x + self.w]
+
+
+class HFlip(ImageProcessing):
+    """``Flip.scala`` (horizontal) with probability ``p``."""
+
+    def __init__(self, p: float = 0.5, seed: Optional[int] = None):
+        self.p = float(p)
+        self._rng = np.random.default_rng(seed)
+
+    def apply_one(self, im):
+        return im[:, ::-1] if self._rng.random() < self.p else im
+
+    def apply_batch(self, batch):
+        flip = self._rng.random(batch.shape[0]) < self.p
+        out = batch.copy()
+        out[flip] = out[flip, :, ::-1]
+        return out
+
+
+class Brightness(ImageProcessing):
+    """``Brightness.scala`` — add a uniform delta in [delta_low, delta_high]
+    (operates in float; clips uint8 range)."""
+
+    def __init__(self, delta_low: float = -32.0, delta_high: float = 32.0,
+                 seed: Optional[int] = None):
+        self.lo, self.hi = float(delta_low), float(delta_high)
+        self._rng = np.random.default_rng(seed)
+
+    def apply_one(self, im):
+        delta = self._rng.uniform(self.lo, self.hi)
+        out = im.astype(np.float32) + delta
+        if im.dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out.astype(im.dtype)
+
+
+class ChannelOrder(ImageProcessing):
+    """``ChannelOrder.scala`` — swap RGB<->BGR."""
+
+    def apply_one(self, im):
+        return im[..., ::-1]
+
+    def apply_batch(self, batch):
+        return batch[..., ::-1]
+
+
+class ChannelNormalize(ImageProcessing):
+    """``ChannelNormalize.scala`` — per-channel (x - mean) / std, output
+    float32."""
+
+    def __init__(self, mean: Sequence[float], std: Sequence[float] = (1., 1., 1.)):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def apply_one(self, im):
+        return (im.astype(np.float32) - self.mean) / self.std
+
+    def apply_batch(self, batch):
+        return (batch.astype(np.float32) - self.mean) / self.std
+
+
+class PixelNormalizer(ImageProcessing):
+    """``PixelNormalizer.scala`` — subtract a full per-pixel mean image."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def apply_one(self, im):
+        return im.astype(np.float32) - self.means
+
+    def apply_batch(self, batch):
+        return batch.astype(np.float32) - self.means
+
+
+class MatToTensor(ImageProcessing):
+    """``MatToTensor.scala`` — finalize to float32. The reference emits NCHW
+    for MKL; TPU keeps NHWC (channels-last feeds conv kernels directly)."""
+
+    def __init__(self, scale: float = 1.0):
+        self.scale = float(scale)
+
+    def apply_one(self, im):
+        return im.astype(np.float32) * self.scale
+
+    def apply_batch(self, batch):
+        return batch.astype(np.float32) * self.scale
+
+
+class ImageSetToSample(Preprocessing):
+    """``ImageSetToSample.scala`` — stack a (possibly per-image) pipeline
+    output into one dense NHWC float batch (all images must agree on shape
+    by this point)."""
+
+    def apply(self, data):
+        if isinstance(data, np.ndarray) and data.ndim == 4:
+            return data.astype(np.float32)
+        ims = [np.asarray(im, np.float32) for im in data]
+        shapes = {im.shape for im in ims}
+        if len(shapes) != 1:
+            raise ValueError(f"cannot stack ragged images {sorted(shapes)}; "
+                             "Resize/Crop to a common size first")
+        return np.stack(ims)

@@ -1,0 +1,5 @@
+"""Text pipeline (``feature/text`` of the reference, L2)."""
+
+from .text_set import TextFeature, TextSet
+
+__all__ = ["TextFeature", "TextSet"]

@@ -1,0 +1,6 @@
+"""Vision model zoo (``models/image`` of the reference, L5)."""
+
+from .common.image_model import ImageModel
+from .imageclassification.image_classifier import ImageClassifier, inception_v1
+
+__all__ = ["ImageModel", "ImageClassifier", "inception_v1"]

@@ -1,0 +1,3 @@
+from .image_model import ImageModel
+
+__all__ = ["ImageModel"]

@@ -431,9 +431,22 @@ class TrainingLoop:
         # is a no-op alias and step 1 would delete the model's weights
         params = jax.device_put(_clone_tree(model.params), repl)
         net_state = jax.device_put(_clone_tree(model.net_state), repl)
-        opt_state = (_clone_tree(model.opt_state)
-                     if model.opt_state is not None
-                     else self.optimizer.init(params))
+        fresh_opt_state = self.optimizer.init(params)
+        if model.opt_state is not None:
+            # reuse stored optimizer state only when it structurally matches
+            # the CURRENT optimizer — a clipping/optimizer change between
+            # train calls (Estimator.scala:75-100) alters the optax state
+            # tree, and feeding the old one would corrupt the update
+            same = (jax.tree_util.tree_structure(model.opt_state)
+                    == jax.tree_util.tree_structure(fresh_opt_state))
+            if same:
+                opt_state = _clone_tree(model.opt_state)
+            else:
+                log.warning("optimizer structure changed since the last fit; "
+                            "resetting optimizer state")
+                opt_state = fresh_opt_state
+        else:
+            opt_state = fresh_opt_state
         opt_state = jax.device_put(opt_state, repl)
 
         # resume: if a checkpoint directory is configured and holds a snapshot
@@ -775,12 +788,9 @@ def _predict(self: KerasNet, x, batch_size: int = 32, distributed: bool = True):
 
 def _predict_classes(self: KerasNet, x, batch_size: int = 32, zero_based: bool = True):
     """``predictClass`` (``Predictor.scala:210``)."""
+    from ....utils.prediction import probs_to_classes
     probs = self.predict(x, batch_size=batch_size)
-    if probs.ndim > 1 and probs.shape[-1] > 1:
-        cls = np.argmax(probs, axis=-1)
-    else:
-        cls = (np.asarray(probs).reshape(-1) > 0.5).astype(np.int32)
-    return cls if zero_based else cls + 1
+    return probs_to_classes(probs, zero_based=zero_based)
 
 
 # state attributes

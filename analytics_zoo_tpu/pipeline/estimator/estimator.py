@@ -48,6 +48,7 @@ class Estimator:
         self._clip_norm: Optional[float] = None
         self._loop: Optional[TrainingLoop] = None
         self._loop_key = None  # (criterion, validation_methods) the loop was built for
+        self._last_criterion: Any = None
 
     # ---- clipping (Estimator.scala:75-100) --------------------------------
     def set_constant_gradient_clipping(self, min_v: float, max_v: float):
@@ -110,9 +111,15 @@ class Estimator:
             raise TypeError("train expects a FeatureSet; build one with "
                             "FeatureSet.array(...)")
         self._get_loop(criterion, validation_methods)
+        self._last_criterion = criterion
         if self.model_dir is not None:
             self.model.set_checkpoint(self.model_dir,
                                       trigger=checkpoint_trigger)
+        elif checkpoint_trigger is not None:
+            import logging
+            logging.getLogger("analytics_zoo_tpu.estimator").warning(
+                "checkpoint_trigger given but Estimator has no model_dir — "
+                "no snapshots will be written and a failure cannot resume")
         val = None
         if validation_set is not None:
             val = (validation_set.x, validation_set.y)
@@ -122,8 +129,13 @@ class Estimator:
 
     def evaluate(self, validation_set: FeatureSet,
                  validation_methods: Optional[Sequence[Any]] = None, *,
-                 criterion: Any = "mse",
+                 criterion: Any = None,
                  batch_size: int = 32) -> Dict[str, float]:
+        """``criterion`` defaults to whatever ``train`` last used, so the
+        reported loss matches the trained objective."""
+        if criterion is None:
+            criterion = (self._last_criterion
+                         if self._last_criterion is not None else "mse")
         loop = self._get_loop(criterion, validation_methods)
         return loop.evaluate(validation_set.x, validation_set.y,
                              batch_size=batch_size)

@@ -228,12 +228,8 @@ class InferenceModel:
             self._permits.put(permit)
 
     def predict_classes(self, x, zero_based: bool = True):
-        probs = self.predict(x)
-        if probs.ndim > 1 and probs.shape[-1] > 1:
-            cls = np.argmax(probs, axis=-1)
-        else:
-            cls = (np.asarray(probs).reshape(-1) > 0.5).astype(np.int32)
-        return cls if zero_based else cls + 1
+        from ...utils.prediction import probs_to_classes
+        return probs_to_classes(self.predict(x), zero_based=zero_based)
 
     # ---- introspection ----------------------------------------------------
     def memory_bytes(self) -> int:

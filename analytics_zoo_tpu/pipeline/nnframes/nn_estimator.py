@@ -17,7 +17,6 @@ are kept.
 
 from __future__ import annotations
 
-import copy
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -192,6 +191,5 @@ class NNClassifier(NNEstimator):
 
 class NNClassifierModel(NNModel):
     def _postprocess(self, preds: np.ndarray) -> np.ndarray:
-        if preds.ndim > 1 and preds.shape[-1] > 1:
-            return np.argmax(preds, axis=-1).astype(np.int32)
-        return (preds.reshape(-1) > 0.5).astype(np.int32)
+        from ...utils.prediction import probs_to_classes
+        return probs_to_classes(preds)

@@ -158,3 +158,17 @@ def test_nnestimator_missing_column_raises():
     with pytest.raises(KeyError):
         nne.fit({"features": np.zeros((4, 2), np.float32),
                  "label": np.zeros(4, np.float32)})
+
+
+def test_clipping_change_between_trains_resets_opt_state():
+    """Changing clipping between train calls alters the optax state tree;
+    the engine must detect the mismatch and reset instead of corrupting."""
+    init_zoo_context()
+    import optax
+    x, y = _mlp_data()
+    est = Estimator(_mlp(), optim_methods=optax.adam(0.01))
+    h1 = est.train(FeatureSet.array(x, y), "scce", batch_size=64, nb_epoch=3)
+    est.set_gradient_clipping_by_l2_norm(1.0)
+    h2 = est.train(FeatureSet.array(x, y), "scce", batch_size=64, nb_epoch=3)
+    assert np.isfinite(h2["loss"][-1])
+    assert h2["loss"][-1] < h1["loss"][0]

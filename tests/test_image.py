@@ -1,0 +1,188 @@
+"""Image pipeline + ImageClassifier tests — parity config #3
+(dogs-vs-cats-shaped transfer learning) and the transformer semantics
+(counterparts of the reference's ``feature/image`` specs and
+``examples/inception``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import init_zoo_context
+from analytics_zoo_tpu.feature import FeatureSet
+from analytics_zoo_tpu.feature.image import (Brightness, CenterCrop,
+                                             ChannelNormalize, ChannelOrder,
+                                             HFlip, ImageSet, MatToTensor,
+                                             RandomCrop, Resize)
+from analytics_zoo_tpu.models.image import ImageClassifier
+
+
+def _striped(n, cls, size=32, seed=0):
+    """Class 0: vertical stripes, class 1: horizontal, class 2: flat."""
+    rng = np.random.default_rng(seed + cls)
+    ims = np.zeros((n, size, size, 3), np.uint8)
+    for i in range(n):
+        base = rng.integers(40, 80)
+        if cls == 0:
+            ims[i, :, ::4] = base + 100
+        elif cls == 1:
+            ims[i, ::4, :] = base + 100
+        ims[i] += rng.integers(0, 20, (size, size, 3)).astype(np.uint8)
+    return ims
+
+
+def _dataset(n_per=40, size=32, classes=3):
+    xs = np.concatenate([_striped(n_per, c, size) for c in range(classes)])
+    ys = np.repeat(np.arange(classes), n_per).astype(np.int32)
+    return xs, ys
+
+
+# ---- transforms -----------------------------------------------------------
+
+def test_resize_center_crop_shapes():
+    im = np.arange(40 * 50 * 3, dtype=np.uint8).reshape(40, 50, 3)
+    out = Resize(32, 36)(im)
+    assert out.shape == (32, 36, 3)
+    out = CenterCrop(20, 24)(im)
+    assert out.shape == (20, 24, 3)
+    np.testing.assert_array_equal(out, im[10:30, 13:37])
+    batch = np.stack([im, im])
+    assert CenterCrop(20, 24)(batch).shape == (2, 20, 24, 3)
+
+
+def test_random_crop_and_flip_deterministic_seed():
+    im = np.random.default_rng(0).integers(0, 255, (16, 16, 3)).astype(np.uint8)
+    a = RandomCrop(8, 8, seed=1)(im)
+    b = RandomCrop(8, 8, seed=1)(im)
+    np.testing.assert_array_equal(a, b)
+    flipped = HFlip(p=1.0)(im)
+    np.testing.assert_array_equal(flipped, im[:, ::-1])
+    batch = np.stack([im] * 4)
+    assert HFlip(p=1.0)(batch).shape == batch.shape
+
+
+def test_channel_normalize_and_order():
+    im = np.full((4, 4, 3), 100, np.uint8)
+    out = ChannelNormalize(mean=(100, 50, 0), std=(1, 2, 4))(im)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out[0, 0], [0.0, 25.0, 25.0])
+    rgb = np.zeros((2, 2, 3), np.uint8)
+    rgb[..., 0] = 255
+    bgr = ChannelOrder()(rgb)
+    assert bgr[0, 0, 2] == 255 and bgr[0, 0, 0] == 0
+
+
+def test_brightness_clips_uint8():
+    im = np.full((4, 4, 3), 250, np.uint8)
+    out = Brightness(delta_low=30, delta_high=30)(im)
+    assert out.dtype == np.uint8
+    assert out.max() == 255
+
+
+def test_pipeline_chain_on_ragged_images():
+    """Per-image path: ragged inputs -> Resize unifies -> dense batch."""
+    rng = np.random.default_rng(0)
+    ims = [rng.integers(0, 255, (rng.integers(30, 60), rng.integers(30, 60), 3)
+                        ).astype(np.uint8) for _ in range(6)]
+    chain = (Resize(24, 24) >> HFlip(p=0.5, seed=0)
+             >> ChannelNormalize((127.5,) * 3, (127.5,) * 3) >> MatToTensor())
+    iset = ImageSet.from_arrays(ims).transform(chain)
+    x = iset.to_array()
+    assert x.shape == (6, 24, 24, 3) and x.dtype == np.float32
+    assert abs(float(x.mean())) < 1.0  # roughly centered
+
+
+def test_image_set_read_with_labels(tmp_path):
+    """ImageSet.read on the per-class-subdirectory convention
+    (ImageSet.scala:236)."""
+    from PIL import Image
+    for cls, n in (("cat", 3), ("dog", 2)):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(n):
+            arr = np.random.default_rng(i).integers(
+                0, 255, (20, 20, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.png")
+    iset = ImageSet.read(str(tmp_path), with_label=True)
+    assert len(iset) == 5
+    assert iset.label_map == {"cat": 0, "dog": 1}
+    assert iset.labels.tolist() == [0, 0, 0, 1, 1]
+    fs = iset.to_feature_set()
+    assert len(fs) == 5
+
+
+# ---- ImageClassifier ------------------------------------------------------
+
+def test_simple_cnn_trains_on_stripes():
+    init_zoo_context()
+    import optax
+    x, y = _dataset()
+    m = ImageClassifier("simple-cnn", num_classes=3, input_shape=(32, 32, 3),
+                        dropout=0.1)
+    chain = ChannelNormalize((127.5,) * 3, (127.5,) * 3)
+    xs = chain(x)
+    m.compile(optimizer=optax.adam(0.01), loss="scce", metrics=["accuracy"])
+    h = m.fit(xs, y, batch_size=24, nb_epoch=15)
+    assert h["loss"][-1] < h["loss"][0]
+    assert m.evaluate(xs, y, batch_size=24)["accuracy"] > 0.85
+
+
+def test_transfer_learning_frozen_backbone():
+    """Parity config #3 shape: pretrain, re-head, fine-tune with the backbone
+    frozen via per-submodule optimizers; backbone must not move."""
+    init_zoo_context()
+    import jax
+    import optax
+    from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+    x, y = _dataset()
+    xs = ChannelNormalize((127.5,) * 3, (127.5,) * 3)(x)
+    pre = ImageClassifier("simple-cnn", num_classes=3,
+                          input_shape=(32, 32, 3), dropout=0.1)
+    pre.compile(optimizer=optax.adam(0.01), loss="scce")
+    pre.fit(xs, y, batch_size=24, nb_epoch=8)
+
+    # new 2-class task: stripes (0/1) vs flat (2)
+    y2 = (y == 2).astype(np.int32)
+    ft = pre.new_head(num_classes=2)
+    backbone_before = jax.device_get(
+        {k: v for k, v in ft.params.items() if k.startswith("backbone_")})
+    est = Estimator(ft, optim_methods={"backbone": optax.sgd(0.0),
+                                       "head": optax.adam(0.01)})
+    est.train(FeatureSet.array(xs, y2), "scce", batch_size=24, nb_epoch=10)
+    backbone_after = jax.device_get(
+        {k: v for k, v in ft.params.items() if k.startswith("backbone_")})
+    for a, b in zip(jax.tree_util.tree_leaves(backbone_before),
+                    jax.tree_util.tree_leaves(backbone_after)):
+        np.testing.assert_array_equal(a, b)
+    acc = (ft.predict_classes(xs, batch_size=24) == y2).mean()
+    assert acc > 0.85
+
+
+def test_inception_v1_forward_and_save_load(tmp_path):
+    """Full GoogLeNet graph: forward shape + zoo save/load round-trip."""
+    init_zoo_context()
+    m = ImageClassifier("inception-v1", num_classes=7,
+                        input_shape=(64, 64, 3))
+    m.init_weights()
+    x = np.random.default_rng(0).normal(size=(8, 64, 64, 3)).astype(np.float32)
+    p = m.predict(x, batch_size=8)
+    assert p.shape == (8, 7)
+    np.testing.assert_allclose(p.sum(-1), np.ones(8), rtol=1e-4)
+    path = m.save(str(tmp_path / "inc.npz"))
+    from analytics_zoo_tpu.models.common.zoo_model import load_model
+    m2 = load_model(path)
+    np.testing.assert_allclose(m2.predict(x, batch_size=8), p,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predict_image_set_with_attached_preprocessing():
+    init_zoo_context()
+    x, y = _dataset(n_per=8)
+    m = ImageClassifier("simple-cnn", num_classes=3, input_shape=(24, 24, 3))
+    m.init_weights()
+    m.set_preprocessing(Resize(24, 24)
+                        >> ChannelNormalize((127.5,) * 3, (127.5,) * 3))
+    cls = m.predict_classes_image_set(ImageSet.from_arrays(x, y),
+                                      batch_size=8)
+    assert cls.shape == (24,)

@@ -34,6 +34,11 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.mesh.seq": 1,
     "zoo.mesh.expert": 1,
     "zoo.seed": 0,
+    # multi-host (DCN) bring-up — the reference's Spark executor topology
+    # becomes the JAX multi-process runtime; empty coordinator = single host
+    "zoo.distributed.coordinator": "",   # "host:port" of process 0
+    "zoo.distributed.num_processes": 1,
+    "zoo.distributed.process_id": 0,
     "zoo.matmul.precision": "default",   # default | high | highest
     "zoo.compute.dtype": "float32",      # float32 | bfloat16
     "zoo.train.scan_steps": 1,           # optimizer steps fused per dispatch (lax.scan)
@@ -162,6 +167,33 @@ class ZooContext:
 
 
 _context: Optional[ZooContext] = None
+_distributed_initialized = False
+
+
+def _maybe_init_distributed(conf: Mapping[str, Any]) -> None:
+    """Multi-host bring-up over DCN: ``jax.distributed.initialize`` when a
+    coordinator is configured (``zoo.distributed.*`` conf /
+    ``ZOO_TPU_DISTRIBUTED_COORDINATOR`` env). Single-process runs skip this
+    entirely — the analogue of the reference running Spark ``local[N]``
+    without a cluster manager (``DistriEstimatorSpec.scala:118``)."""
+    global _distributed_initialized
+    coordinator = str(conf.get("zoo.distributed.coordinator") or "").strip()
+    if not coordinator or _distributed_initialized:
+        return
+    from jax._src import xla_bridge
+    if getattr(xla_bridge, "_backends", {}):
+        raise RuntimeError(
+            "zoo.distributed.coordinator is set but JAX backends are already "
+            "initialized — init_zoo_context(...) with the coordinator must "
+            "run before any jax.devices()/computation in this process")
+    num_processes = int(conf.get("zoo.distributed.num_processes", 1))
+    process_id = int(conf.get("zoo.distributed.process_id", 0))
+    log.info("initializing JAX multi-host runtime: coordinator=%s "
+             "process %d/%d", coordinator, process_id, num_processes)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _distributed_initialized = True
 
 
 def init_zoo_context(
@@ -193,6 +225,8 @@ def init_zoo_context(
         merged[_canonical_key(k)] = v
 
     logging.basicConfig(level=merged.get("zoo.log.level", "INFO"))
+
+    _maybe_init_distributed(merged)
 
     precision = merged.get("zoo.matmul.precision", "default")
     if precision != "default":

@@ -64,6 +64,9 @@ class ZooModel(KerasNet):
     def call(self, params, x, *, training=False, rng=None):
         return self.model.call(params, x, training=training, rng=rng)
 
+    def param_sharding(self, params):
+        return self.model.param_sharding(params)
+
     # ---- save / load (ZooModel.scala:38-154) ------------------------------
     def save(self, path: str, over_write: bool = True) -> str:
         """``saveModel(path, overWrite)``: one .npz with config + weights."""

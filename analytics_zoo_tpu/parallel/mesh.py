@@ -115,3 +115,44 @@ def stacked_batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     the scan axis stays replicated, the batch axis splits over data."""
     mesh = mesh or global_mesh()
     return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
+def param_shardings(model, params, mesh: Optional[Mesh] = None):
+    """Per-leaf NamedSharding tree for a model's params: layers declare
+    PartitionSpecs over the ``model`` axis via ``Layer.param_sharding``
+    (Dense/Embedding shard; everything else replicates). On a mesh without
+    tensor parallelism everything replicates — the pure-DP fast path."""
+    import jax
+
+    mesh = mesh or global_mesh()
+    repl = replicated_sharding(mesh)
+    if mesh.shape[MODEL_AXIS] == 1 or not hasattr(model, "param_sharding"):
+        return jax.tree.map(lambda _: repl, params)
+    spec_tree = model.param_sharding(params)
+    fallbacks: list = []
+
+    def to_sharding(path, spec, leaf):
+        if spec is None:
+            return repl
+        # a dim that doesn't divide by its axis size can't shard — fall back
+        # to replicated for that leaf (e.g. a 3-class head under model=2)
+        shape = np.shape(leaf)
+        for i, ax in enumerate(spec):
+            if ax is None:
+                continue
+            if i >= len(shape) or shape[i] % mesh.shape[ax] != 0:
+                fallbacks.append((jax.tree_util.keystr(path), shape, spec))
+                return repl
+        return NamedSharding(mesh, spec)
+
+    out = jax.tree_util.tree_map_with_path(
+        to_sharding, spec_tree, params,
+        is_leaf=lambda s: s is None or isinstance(s, P))
+    if fallbacks:
+        import logging
+        logging.getLogger("analytics_zoo_tpu.mesh").warning(
+            "%d param leaf/leaves replicated instead of model-sharded "
+            "(dim not divisible by axis size): %s", len(fallbacks),
+            "; ".join(f"{p} shape={s} spec={sp}" for p, s, sp in
+                      fallbacks[:5]) + (" ..." if len(fallbacks) > 5 else ""))
+    return out

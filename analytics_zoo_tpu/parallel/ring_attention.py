@@ -1,0 +1,104 @@
+"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+
+The reference has NO long-context mechanism (SURVEY §5: sequences are padded
+to one core's memory, attention is plain full self-attention inside
+``TransformerLayer.scala``/``BERT.scala:66``), so this is greenfield TPU
+design: the sequence dim is sharded over the ``seq`` axis, each device holds
+its Q/K/V block, and K/V blocks rotate around the ring via ``ppermute`` while
+a numerically-stable online softmax accumulates output blocks — attention
+memory per device is O(T/seq_shards * T_block) and the ppermute rides ICI
+(the blockwise/ring attention construction of Liu et al., re-derived for
+``shard_map``).
+
+Math (flash-style streaming softmax, all in float32): for each incoming K/V
+block, s = q·k/sqrt(d); m' = max(m, max_allowed(s)); o = o*exp(m-m') +
+exp(s-m')·v (masked entries contribute 0); l likewise; final out = o/l.
+Fully-masked blocks leave (o, m, l) untouched by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+__all__ = ["ring_attention", "ring_self_attention"]
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
+                   causal: bool = False) -> jax.Array:
+    """Blockwise ring attention INSIDE a ``shard_map`` over ``axis_name``.
+
+    q, k, v: local blocks (B, H, T_local, D) — the sequence dim is sharded
+    over ``axis_name``. Returns the local output block (B, H, T_local, D).
+    ``causal`` masks with GLOBAL positions (block i attends to block j<=i,
+    and within the diagonal block the usual triangular mask).
+    """
+    n_shards = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, t_local, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    qf = q.astype(jnp.float32)
+
+    q_pos = my_idx * t_local + jnp.arange(t_local)          # global q rows
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        src = (my_idx - i) % n_shards                       # block owner
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
+        s = s * scale
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            allowed = q_pos[:, None] >= k_pos[None, :]      # (Tq, Tk)
+            allowed = allowed[None, None]
+        else:
+            allowed = jnp.ones((1, 1, t_local, t_local), jnp.bool_)
+        s_masked = jnp.where(allowed, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s_masked, axis=-1, keepdims=True))
+        # exp(-inf - finite) = 0 handles both masked entries and the
+        # not-yet-seen-anything m = -inf state; guard the all-masked case
+        # where m_new is still -inf (exp(nan) otherwise)
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.where(allowed, jnp.exp(s - m_safe), 0.0)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                  v_blk.astype(jnp.float32))
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o, m_new, l, k_blk, v_blk), None
+
+    o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    m0 = jnp.full((b, h, t_local, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
+    (o, _, l, _, _), _ = jax.lax.scan(step, (o0, m0, l0, k, v),
+                                      jnp.arange(n_shards))
+    out = o / jnp.where(l == 0.0, 1.0, l)
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        mesh: Optional[Mesh] = None,
+                        causal: bool = False) -> jax.Array:
+    """Entry point on GLOBAL arrays: q/k/v (B, H, T, D) with T sharded over
+    the ``seq`` axis (and batch over ``data``); runs the ring under
+    ``shard_map``. T must divide evenly by the seq-axis size."""
+    mesh = mesh or mesh_lib.global_mesh()
+    n_seq = mesh.shape[mesh_lib.SEQ_AXIS]
+    t = q.shape[2]
+    if t % max(n_seq, 1) != 0:
+        raise ValueError(f"sequence length {t} not divisible by seq axis "
+                         f"size {n_seq}")
+    spec = P(mesh_lib.DATA_AXIS, None, mesh_lib.SEQ_AXIS, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=mesh_lib.SEQ_AXIS,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

@@ -145,6 +145,14 @@ class Layer:
     def get_config(self) -> Dict[str, Any]:
         return {}
 
+    # ---- tensor-parallel sharding rules (SURVEY §2.4 TP — greenfield) -----
+    def param_sharding(self, params):
+        """PartitionSpec tree matching this layer's ``params``; ``None``
+        leaves mean replicated. Layers whose weights shard over the ``model``
+        mesh axis (Dense, Embedding) override this; everything else stays
+        replicated — GSPMD propagates activation shardings from here."""
+        return jax.tree.map(lambda _: None, params)
+
     # ---- shape inference --------------------------------------------------
     def output_shape_for(self, params, state, input_shape):
         """Infer output shape via abstract evaluation (no FLOPs)."""
@@ -397,6 +405,10 @@ class Sequential(KerasNet):
         y, _ = self.apply(params, {}, x, training=training, rng=rng)
         return y
 
+    def param_sharding(self, params):
+        return {l.name: l.param_sharding(params[l.name])
+                for l in self.layers if l.name in params}
+
 
 class Model(KerasNet):
     """Graph container — parity with ``Model`` (``Topology.scala:602``) and
@@ -524,3 +536,10 @@ class Model(KerasNet):
         by_name = {n.name: n for n in self._topo}
         outs = [Variable(by_name[o]) for o in outputs]
         return Model(self.inputs, outs if len(outs) > 1 else outs[0])
+
+    def param_sharding(self, params):
+        out = {}
+        for n in self._topo:
+            if n.name in params and n.name not in out:
+                out[n.name] = n.layer.param_sharding(params[n.name])
+        return out

@@ -81,6 +81,16 @@ class Dense(Layer):
             params["b"] = jnp.zeros((self.output_dim,), param_dtype())
         return params
 
+    def param_sharding(self, params):
+        """Column-parallel TP: the kernel's output dim splits over the
+        ``model`` axis (Megatron-style); GSPMD propagates the resulting
+        feature sharding through the activation graph."""
+        from jax.sharding import PartitionSpec as P
+        spec = {"W": P(None, "model")}
+        if "b" in params:
+            spec["b"] = P("model")
+        return spec
+
     def call(self, params, x, *, training=False, rng=None):
         cd = compute_dtype()
         y = jnp.matmul(x.astype(cd), params["W"].astype(cd),

@@ -38,6 +38,12 @@ class Embedding(Layer):
             rng, (self.input_dim, self.output_dim), param_dtype())
         return {"embeddings": w}
 
+    def param_sharding(self, params):
+        """Shard the embedding dim over ``model`` (the gather stays local to
+        each shard; rows are never split)."""
+        from jax.sharding import PartitionSpec as P
+        return {"embeddings": P(None, "model")}
+
     def call(self, params, x, *, training=False, rng=None):
         ids = x.astype(jnp.int32)
         return jnp.take(params["embeddings"], ids, axis=0)

@@ -426,28 +426,49 @@ class TrainingLoop:
             self.build_train_step()
 
         repl = mesh_lib.replicated_sharding(self.mesh)
+        # params: replicated under pure DP; sharded over the model axis when
+        # the mesh has one (layers declare the specs — SURVEY §2.4 TP)
+        psh = mesh_lib.param_shardings(model, model.params, self.mesh)
         # clone: the donated train step must own its buffers exclusively —
         # without the copy, device_put of an already-replicated model.params
         # is a no-op alias and step 1 would delete the model's weights
-        params = jax.device_put(_clone_tree(model.params), repl)
+        params = jax.device_put(_clone_tree(model.params), psh)
         net_state = jax.device_put(_clone_tree(model.net_state), repl)
-        fresh_opt_state = self.optimizer.init(params)
+        # init from the sharded params => optimizer moments inherit the
+        # param shardings (zeros_like keeps sharding)
+        # structure of the CURRENT optimizer's state, with zero allocation
+        fresh_struct = jax.tree_util.tree_structure(
+            jax.eval_shape(self.optimizer.init, params))
         if model.opt_state is not None:
             # reuse stored optimizer state only when it structurally matches
             # the CURRENT optimizer — a clipping/optimizer change between
             # train calls (Estimator.scala:75-100) alters the optax state
             # tree, and feeding the old one would corrupt the update
             same = (jax.tree_util.tree_structure(model.opt_state)
-                    == jax.tree_util.tree_structure(fresh_opt_state))
+                    == fresh_struct)
             if same:
                 opt_state = _clone_tree(model.opt_state)
+                try:
+                    # param-shaped leaves (adam moments) follow the param
+                    # shardings; counters and the like replicate
+                    opt_state = optax.tree_map_params(
+                        self.optimizer,
+                        lambda s, sh: jax.device_put(s, sh), opt_state, psh,
+                        transform_non_params=lambda s: jax.device_put(s, repl))
+                except (ValueError, TypeError) as e:
+                    # structure quirks of custom/wrapped optimizers: fall
+                    # back to replicated moments — correct but, under TP,
+                    # resharded every step; say so
+                    log.warning("could not apply param shardings to the "
+                                "optimizer state (%s); moments stay "
+                                "replicated", e)
+                    opt_state = jax.device_put(opt_state, repl)
             else:
                 log.warning("optimizer structure changed since the last fit; "
                             "resetting optimizer state")
-                opt_state = fresh_opt_state
+                opt_state = self.optimizer.init(params)
         else:
-            opt_state = fresh_opt_state
-        opt_state = jax.device_put(opt_state, repl)
+            opt_state = self.optimizer.init(params)
 
         # resume: if a checkpoint directory is configured and holds a snapshot
         # newer than this model's progress, restore it (process-death resume)

@@ -1,0 +1,126 @@
+"""Parallelism tests beyond pure DP — tensor-parallel param sharding over the
+``model`` axis (dp-vs-tp numerical equality), ring attention over the ``seq``
+axis vs full attention, and multi-host bring-up gating (SURVEY §2.4, §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import init_zoo_context
+from analytics_zoo_tpu.common.context import reset_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, Embedding, Flatten
+
+
+def _data(n=256, d=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def _mlp():
+    return Sequential([Dense(32, activation="relu", input_shape=(8,)),
+                       Dense(4, activation="softmax")])
+
+
+def test_dp_vs_tp_numerical_equality():
+    """data=8 vs data=4 x model=2 must train to (near-)identical results:
+    sharding is a layout choice, not a math change."""
+    import optax
+    x, y = _data()
+
+    init_zoo_context()  # data=8
+    m_dp = _mlp()
+    m_dp.compile(optimizer=optax.adam(0.01), loss="scce")
+    h_dp = m_dp.fit(x, y, batch_size=64, nb_epoch=5)
+    p_dp = m_dp.predict(x, batch_size=64)
+
+    reset_zoo_context()
+    init_zoo_context(mesh_model=2)  # data=4, model=2
+    m_tp = _mlp()
+    m_tp.compile(optimizer=optax.adam(0.01), loss="scce")
+    h_tp = m_tp.fit(x, y, batch_size=64, nb_epoch=5)
+    p_tp = m_tp.predict(x, batch_size=64)
+
+    np.testing.assert_allclose(h_dp["loss"], h_tp["loss"], rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(p_dp, p_tp, rtol=1e-3, atol=1e-4)
+
+
+def test_tp_params_actually_sharded():
+    """The Dense kernel must really live split over the model axis (not a
+    decorative spec): check the committed sharding of the trained params."""
+    import optax
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+    init_zoo_context(mesh_model=2)
+    x, y = _data()
+    m = _mlp()
+    m.compile(optimizer=optax.adam(0.01), loss="scce")
+    m.fit(x, y, batch_size=64, nb_epoch=1)
+    w = m.params["dense_0"]["W"]
+    assert isinstance(w, jax.Array)
+    spec = w.sharding.spec
+    assert "model" in str(spec), f"kernel not model-sharded: {spec}"
+
+
+def test_embedding_model_sharded_ncf():
+    """The NeuralCF docstring's sharding claim (VERDICT r2 weak #8): under a
+    model axis the embedding tables shard and training still converges."""
+    import optax
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+    init_zoo_context(mesh_model=2)
+    rng = np.random.default_rng(0)
+    x = np.stack([rng.integers(1, 50, 256), rng.integers(1, 40, 256)],
+                 axis=1).astype(np.int32)
+    y = rng.integers(0, 3, 256).astype(np.int32)
+    m = NeuralCF(50, 40, 3, user_embed=8, item_embed=8, hidden_layers=(16, 8),
+                 mf_embed=8)
+    m.compile(optimizer=optax.adam(0.01), loss="scce")
+    h = m.fit(x, y, batch_size=64, nb_epoch=3)
+    assert np.isfinite(h["loss"][-1])
+    sharded = [str(l.sharding.spec) for l in jax.tree_util.tree_leaves(m.params)
+               if hasattr(l, "sharding") and "model" in str(l.sharding.spec)]
+    assert sharded, "no param leaf is model-sharded"
+
+
+def test_ring_attention_matches_full():
+    from analytics_zoo_tpu.ops.attention import dot_product_attention
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+    from analytics_zoo_tpu.parallel.ring_attention import ring_self_attention
+
+    init_zoo_context(mesh_data=2, mesh_seq=4)
+    mesh = mesh_lib.global_mesh()
+    rng = np.random.default_rng(0)
+    q, k, v = (rng.normal(size=(2, 2, 16, 8)).astype(np.float32)
+               for _ in range(3))
+    for causal in (False, True):
+        ring = ring_self_attention(jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v), mesh=mesh, causal=causal)
+        full = dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), causal=causal)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_rejects_ragged_seq():
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+    from analytics_zoo_tpu.parallel.ring_attention import ring_self_attention
+
+    init_zoo_context(mesh_data=2, mesh_seq=4)
+    q = jnp.zeros((2, 2, 10, 8))  # 10 % 4 != 0
+    with pytest.raises(ValueError):
+        ring_self_attention(q, q, q, mesh=mesh_lib.global_mesh())
+
+
+def test_multihost_bringup_skipped_single_process():
+    """Empty coordinator => no jax.distributed.initialize call (which would
+    hang); context still comes up."""
+    ctx = init_zoo_context()
+    assert ctx.process_count == 1
+    from analytics_zoo_tpu.common import context as ctx_mod
+    assert not ctx_mod._distributed_initialized

@@ -47,6 +47,12 @@ class ZooModel(KerasNet):
     def get_config(self) -> Dict[str, Any]:
         raise NotImplementedError(type(self).__name__)
 
+    def extra_arrays(self) -> Dict[str, np.ndarray]:
+        """Constructor kwargs that are ndarrays (e.g. pretrained embedding
+        tables) — too big for the JSON config, so they ride in the .npz as
+        ``x_<kwarg>`` entries and are passed back to ``__init__`` on load."""
+        return {}
+
     # ---- Layer protocol: delegate to the inner graph ----------------------
     @property
     def input_shape(self):
@@ -71,6 +77,8 @@ class ZooModel(KerasNet):
     def save(self, path: str, over_write: bool = True) -> str:
         """``saveModel(path, overWrite)``: one .npz with config + weights."""
         import os
+        if not path.endswith(".npz"):
+            path += ".npz"  # np.savez appends it anyway; normalize up front
         if os.path.exists(path) and not over_write:
             raise FileExistsError(f"{path} exists and over_write=False")
         if self.params is None:
@@ -81,8 +89,23 @@ class ZooModel(KerasNet):
                   for i, l in enumerate(p_leaves)}
         arrays.update({f"s_{i}": np.asarray(jax.device_get(l))
                        for i, l in enumerate(s_leaves)})
+        # constructor arrays that are bit-identical to a saved weight leaf
+        # (e.g. a frozen WordEmbedding table in net_state) are stored once,
+        # as a named reference, so a 480MB GloVe table doesn't ride twice
+        extra_refs: Dict[str, str] = {}
+        for k, v in self.extra_arrays().items():
+            v = np.asarray(v)
+            ref = next((name for name, a in arrays.items()
+                        if a.shape == v.shape and a.dtype == v.dtype
+                        and np.array_equal(a, v)), None)
+            if ref is not None:
+                extra_refs[k] = ref
+            else:
+                arrays[f"x_{k}"] = v
+                extra_refs[k] = f"x_{k}"
         header = json.dumps({"class": type(self).__name__,
                              "config": self.get_config(),
+                             "extra": extra_refs,
                              "n_params": len(p_leaves),
                              "n_state": len(s_leaves)})
         np.savez(path, __zoo_header__=np.frombuffer(
@@ -107,15 +130,19 @@ class ZooModel(KerasNet):
 def load_model(path: str) -> ZooModel:
     """``ZooModel.loadModel`` (``ZooModel.scala:119-154``): rebuild from the
     registered class + config, then install saved weights."""
+    if not path.endswith(".npz"):
+        path += ".npz"
     with np.load(path) as data:
         header = json.loads(bytes(data["__zoo_header__"]).decode("utf-8"))
         p_loaded = [data[f"p_{i}"] for i in range(header["n_params"])]
         s_loaded = [data[f"s_{i}"] for i in range(header["n_state"])]
+        extras = {k: data[ref]
+                  for k, ref in header.get("extra", {}).items()}
     cls = _REGISTRY.get(header["class"])
     if cls is None:
         raise ValueError(f"unknown model class {header['class']!r}; "
                          f"registered: {sorted(_REGISTRY)}")
-    model = cls(**header["config"])
+    model = cls(**header["config"], **extras)
     model.init_weights(rng=get_zoo_context().rng())
     _, p_def = jax.tree_util.tree_flatten(model.params)
     _, s_def = jax.tree_util.tree_flatten(model.net_state)

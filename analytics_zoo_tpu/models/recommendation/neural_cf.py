@@ -40,10 +40,15 @@ class Recommender(ZooModel):
         items = np.asarray(candidate_items).reshape(-1)
         pairs = np.stack([np.full_like(items, user_id), items], axis=1)
         probs = self.predict(pairs, batch_size=batch_size)
-        # rank by the probability of the highest class (rating), as the
-        # reference ranks by predicted class score
-        scores = probs[:, -1] if probs.ndim > 1 else probs
-        top = np.argsort(-scores)[:max_items]
+        if probs.ndim > 1:
+            # Recommender.scala:55,92-96 sorts by (predicted class desc,
+            # probability of that class desc): a confidently-rated-5 item
+            # outranks any rated-4 item regardless of probability mass.
+            cls = np.argmax(probs, axis=1)
+            p_cls = probs[np.arange(len(cls)), cls]
+            top = np.lexsort((-p_cls, -cls))[:max_items]
+        else:
+            top = np.argsort(-probs)[:max_items]
         return items[top]
 
 

@@ -179,24 +179,43 @@ class Seq2seq(ZooModel):
         elif cur.ndim == 2:
             cur = cur[:, None]
 
-        @jax.jit
-        def enc_fn(p, e):
-            return net.apply_bridge(p, net.encode(p, e))
-
-        @jax.jit
-        def step_fn(p, c, carries):
-            return net.decode(p, c, carries)
-
+        enc_fn, step_fn = self._infer_fns()
         carries = enc_fn(params, enc_x)
+        stop = (np.asarray(stop_sign, np.float32)
+                if stop_sign is not None else None)
+        done = np.zeros(enc_x.shape[0], bool)  # per-sequence finished flags
+        frozen = None
         outs = []
         for _ in range(max_seq_len):
             y, carries = step_fn(params, cur, carries)
-            outs.append(np.asarray(y[:, 0]))
-            if stop_sign is not None and np.allclose(
-                    outs[-1], np.asarray(stop_sign, np.float32), atol=1e-4):
-                break
-            cur = y
+            step_out = np.asarray(y[:, 0])
+            if frozen is not None:
+                step_out = np.where(done[:, None], frozen, step_out)
+            outs.append(step_out)
+            if stop is not None:
+                done |= np.isclose(step_out, stop, atol=1e-4).all(axis=-1)
+                frozen = step_out
+                if done.all():
+                    break
+            cur = jnp.asarray(step_out[:, None])
         return np.stack(outs, axis=1)
+
+    def _infer_fns(self):
+        """Jitted encode/decode-step closures, built once per model instance
+        (re-jitting per ``infer`` call would recompile for every request)."""
+        if getattr(self, "_cached_infer_fns", None) is None:
+            net: _Seq2seqNet = self.model
+
+            @jax.jit
+            def enc_fn(p, e):
+                return net.apply_bridge(p, net.encode(p, e))
+
+            @jax.jit
+            def step_fn(p, c, carries):
+                return net.decode(p, c, carries)
+
+            self._cached_infer_fns = (enc_fn, step_fn)
+        return self._cached_infer_fns
 
     def get_config(self) -> Dict[str, Any]:
         return {"rnn_type": self.rnn_type, "num_layers": self.num_layers,

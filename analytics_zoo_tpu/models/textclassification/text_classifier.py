@@ -76,14 +76,9 @@ class TextClassifier(ZooModel):
                "encoder_output_dim": self.encoder_output_dim}
         if self.vocab_size is not None:
             cfg["vocab_size"] = self.vocab_size
-        # pretrained embedding weights travel with the saved params (they are
-        # net_state for frozen WordEmbedding), so the config omits them; a
-        # loaded model needs them re-supplied only to rebuild from scratch
         return cfg
 
-    def save(self, path: str, over_write: bool = True) -> str:
+    def extra_arrays(self):
         if self.embedding_weights is not None:
-            raise NotImplementedError(
-                "save/load of GloVe-initialized TextClassifier lands with the "
-                "serialization sweep; use vocab_size models for now")
-        return super().save(path, over_write=over_write)
+            return {"embedding_weights": self.embedding_weights}
+        return {}

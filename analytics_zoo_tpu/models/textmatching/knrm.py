@@ -104,9 +104,7 @@ class KNRM(ZooModel):
                 "exact_sigma": self.exact_sigma,
                 "target_mode": self.target_mode}
 
-    def save(self, path: str, over_write: bool = True) -> str:
-        if self.embed_weights is not None and not self.train_embed:
-            raise NotImplementedError(
-                "save/load of frozen-GloVe KNRM lands with the serialization "
-                "sweep; use trainable embeddings for now")
-        return super().save(path, over_write=over_write)
+    def extra_arrays(self):
+        if self.embed_weights is not None:
+            return {"embed_weights": self.embed_weights}
+        return {}

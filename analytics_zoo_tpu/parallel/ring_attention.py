@@ -48,8 +48,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
     q_pos = my_idx * t_local + jnp.arange(t_local)          # global q rows
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
-    def step(carry, i):
-        o, m, l, k_blk, v_blk = carry
+    def accumulate(o, m, l, k_blk, v_blk, i):
         src = (my_idx - i) % n_shards                       # block owner
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
         s = s * scale
@@ -70,15 +69,23 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p,
                                   v_blk.astype(jnp.float32))
+        return o, m_new, l
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        o, m, l = accumulate(o, m, l, k_blk, v_blk, i)
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (o, m_new, l, k_blk, v_blk), None
+        return (o, m, l, k_blk, v_blk), None
 
     o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
     m0 = jnp.full((b, h, t_local, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
-    (o, _, l, _, _), _ = jax.lax.scan(step, (o0, m0, l0, k, v),
-                                      jnp.arange(n_shards))
+    # scan rotates K/V after each accumulation; the LAST block is folded in
+    # outside the scan so the ring doesn't pay one final discarded ppermute
+    (o, m, l, k_last, v_last), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(n_shards - 1))
+    o, _, l = accumulate(o, m, l, k_last, v_last, n_shards - 1)
     out = o / jnp.where(l == 0.0, 1.0, l)
     return out.astype(q.dtype)
 

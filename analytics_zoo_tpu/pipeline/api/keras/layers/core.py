@@ -86,9 +86,10 @@ class Dense(Layer):
         ``model`` axis (Megatron-style); GSPMD propagates the resulting
         feature sharding through the activation graph."""
         from jax.sharding import PartitionSpec as P
-        spec = {"W": P(None, "model")}
+        from .....parallel.mesh import MODEL_AXIS
+        spec = {"W": P(None, MODEL_AXIS)}
         if "b" in params:
-            spec["b"] = P("model")
+            spec["b"] = P(MODEL_AXIS)
         return spec
 
     def call(self, params, x, *, training=False, rng=None):

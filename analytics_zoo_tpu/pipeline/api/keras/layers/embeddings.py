@@ -42,7 +42,8 @@ class Embedding(Layer):
         """Shard the embedding dim over ``model`` (the gather stays local to
         each shard; rows are never split)."""
         from jax.sharding import PartitionSpec as P
-        return {"embeddings": P(None, "model")}
+        from .....parallel.mesh import MODEL_AXIS
+        return {"embeddings": P(None, MODEL_AXIS)}
 
     def call(self, params, x, *, training=False, rng=None):
         ids = x.astype(jnp.int32)

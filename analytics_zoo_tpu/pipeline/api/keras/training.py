@@ -269,17 +269,34 @@ class TrainingLoop:
         model, loss_fn, metrics = self.model, self.loss, self.metrics
         pe_loss = objectives.per_example_loss(loss_fn)
 
+        def _update(m):
+            """User Metric classes may predate the mask argument; detect the
+            two-arg signature once (outside jit) and shim it."""
+            try:
+                import inspect
+                n = len(inspect.signature(m.update).parameters)
+            except (TypeError, ValueError):
+                n = 3
+            if n >= 3:
+                return m.update
+            return lambda y, yp, mask: m.update(y, yp)
+
+        updates = [(m.name, _update(m)) for m in metrics]
+
         def step(params, net_state, x, y, mask):
             yp, _ = model.apply(params, net_state, x, training=False, rng=None)
-            stats = {m.name: m.update(y, yp, mask) for m in metrics}
+            stats = {name: upd(y, yp, mask) for name, upd in updates}
             if pe_loss is not None:
                 stats["loss"] = {"sum": jnp.sum(pe_loss(y, yp) * mask),
                                  "count": jnp.sum(mask)}
             else:
                 # cross-batch losses (rank_hinge, custom callables) have no
-                # per-example form; fall back to whole-batch statistics
-                stats["loss"] = {"sum": loss_fn(y, yp) * _first_dim(x),
-                                 "count": jnp.asarray(_first_dim(x), jnp.float32)}
+                # per-example form; the whole-batch loss (which unavoidably
+                # includes repeated-pad rows — for rank_hinge an odd real tail
+                # also misaligns the assumed (pos, neg) pairing of pad rows)
+                # is weighted by the real-row count so pads don't inflate it.
+                stats["loss"] = {"sum": loss_fn(y, yp) * jnp.sum(mask),
+                                 "count": jnp.sum(mask)}
             return stats
 
         self._eval_step = jax.jit(step)
@@ -434,9 +451,7 @@ class TrainingLoop:
         # is a no-op alias and step 1 would delete the model's weights
         params = jax.device_put(_clone_tree(model.params), psh)
         net_state = jax.device_put(_clone_tree(model.net_state), repl)
-        # init from the sharded params => optimizer moments inherit the
-        # param shardings (zeros_like keeps sharding)
-        # structure of the CURRENT optimizer's state, with zero allocation
+        # eval_shape: the CURRENT optimizer's state structure, zero allocation
         fresh_struct = jax.tree_util.tree_structure(
             jax.eval_shape(self.optimizer.init, params))
         if model.opt_state is not None:

@@ -150,6 +150,40 @@ def test_text_classifier_pretrained_embedding_frozen():
         [k for k in m.params if "wordembedding" in k][0]) == {}
 
 
+def test_text_classifier_frozen_embedding_save_load(tmp_path):
+    """Frozen-GloVe path round-trips: the pretrained table rides in the .npz
+    as an x_ extra array and is passed back to __init__ on load."""
+    init_zoo_context()
+    vocab, dim, t = 30, 8, 10
+    rng = np.random.default_rng(5)
+    weights = rng.normal(size=(vocab, dim)).astype(np.float32)
+    m = TextClassifier(class_num=2, token_length=dim, sequence_length=t,
+                       encoder="cnn", encoder_output_dim=8,
+                       embedding_weights=weights)
+    m.init_weights()
+    x = rng.integers(1, vocab, (16, t)).astype(np.int32)
+    before = m.predict(x)
+    path = m.save(str(tmp_path / "tc_frozen"))  # no .npz suffix on purpose
+    assert path.endswith(".npz")
+    m2 = load_model(path)
+    assert m2.embedding_weights is not None
+    np.testing.assert_allclose(m2.predict(x), before, rtol=1e-5, atol=1e-6)
+
+
+def test_knrm_frozen_embedding_save_load(tmp_path):
+    init_zoo_context()
+    rng = np.random.default_rng(6)
+    weights = rng.normal(size=(30, 8)).astype(np.float32)
+    m = KNRM(4, 6, vocab_size=30, embed_size=8, kernel_num=5,
+             embed_weights=weights, train_embed=False)
+    m.init_weights()
+    x = rng.integers(1, 30, (32, 10)).astype(np.int32)
+    before = m.predict(x)
+    path = m.save(str(tmp_path / "knrm_frozen.npz"))
+    np.testing.assert_allclose(load_model(path).predict(x), before,
+                               rtol=1e-5, atol=1e-6)
+
+
 # ---------------------------------------------------------------------------
 # KNRM
 # ---------------------------------------------------------------------------

@@ -105,6 +105,9 @@ class KNRM(ZooModel):
                 "target_mode": self.target_mode}
 
     def extra_arrays(self):
-        if self.embed_weights is not None:
+        # only the FROZEN path needs the constructor table back at load time;
+        # a trainable table lives in (and is restored from) the p_ leaves,
+        # and after training it no longer dedups against the original
+        if self.embed_weights is not None and not self.train_embed:
             return {"embed_weights": self.embed_weights}
         return {}

@@ -70,16 +70,19 @@ def adam_weight_decay(lr: float = 1e-4, warmup_portion: float = -1.0,
                       ) -> optax.GradientTransformation:
     """BERT AdamW — ``optimizers/AdamWeightDecay.scala``: linear warmup over
     ``warmup_portion * total`` steps then linear decay to 0."""
-    if total > 0 and warmup_portion >= 0:
-        warm = int(total * warmup_portion)
-        sched = optax.schedules.join_schedules(
-            [optax.schedules.linear_schedule(0.0, lr, max(warm, 1)),
-             optax.schedules.linear_schedule(lr, 0.0, max(total - warm, 1))],
-            [max(warm, 1)])
-    else:
-        sched = lr
+    sched = _warmup_linear_decay(lr, warmup_portion, total)
     return optax.adamw(sched, b1=beta_1, b2=beta_2, eps=epsilon,
                        weight_decay=weight_decay)
+
+
+def _warmup_linear_decay(lr: float, warmup_portion: float, total: int):
+    if total > 0 and warmup_portion >= 0:
+        warm = max(int(total * warmup_portion), 1)
+        return optax.schedules.join_schedules(
+            [optax.schedules.linear_schedule(0.0, lr, warm),
+             optax.schedules.linear_schedule(lr, 0.0, max(total - warm, 1))],
+            [warm])
+    return lr
 
 
 def rmsprop(lr: float = 0.001, rho: float = 0.9, epsilon: float = 1e-8, **kw):
@@ -120,6 +123,28 @@ def get_optimizer(opt: Union[str, optax.GradientTransformation],
             raise ValueError(f"unknown optimizer {opt!r}")
         return OPTIMIZERS[opt](**kwargs)
     raise TypeError(f"bad optimizer spec: {opt!r}")
+
+
+def resolve_lr(opt: Union[str, optax.GradientTransformation], **kwargs):
+    """The EFFECTIVE learning rate of a ``compile()`` spec — a float or a
+    ``step -> lr`` schedule, resolved the same way the named constructor
+    does (signature default + decay/schedule kwargs). Feeds the TensorBoard
+    ``LearningRate`` scalar; None for pre-built optax objects (their inner
+    schedule isn't introspectable)."""
+    if not isinstance(opt, str) or opt not in OPTIMIZERS:
+        return None
+    import inspect
+    lr_param = inspect.signature(OPTIMIZERS[opt]).parameters.get("lr")
+    lr = kwargs.get("lr", lr_param.default if lr_param else None)
+    if opt in ("adamw", "adam_weight_decay"):
+        return _warmup_linear_decay(lr, kwargs.get("warmup_portion", -1.0),
+                                    kwargs.get("total", -1))
+    if opt in ("sgd", "adam"):
+        kw = {k: v for k, v in kwargs.items()
+              if k not in ("lr", "schedule", "decay")}
+        return make_schedule(lr, schedule=kwargs.get("schedule"),
+                             decay=kwargs.get("decay", 0.0), **kw)
+    return lr
 
 
 # ---------------------------------------------------------------------------

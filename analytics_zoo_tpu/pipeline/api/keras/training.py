@@ -639,6 +639,33 @@ class TrainingLoop:
                 for k, v in val.items():
                     history.setdefault("val_" + k, []).append(v)
                 record.update({"val_" + k: v for k, v in val.items()})
+            tb = getattr(model, "_train_summary", None)
+            if tb is not None:
+                # one Loss point per optimizer step (the reference's
+                # per-iteration granularity), written at epoch end so no
+                # device sync lands inside the dispatch pipeline
+                loss_vec = (np.concatenate(
+                    [np.atleast_1d(np.asarray(l)) for l in losses])
+                    if losses else np.zeros(0))
+                start_it = loop_state.iteration - len(loss_vec)
+                for j, lv in enumerate(loss_vec):
+                    tb.add_scalar("Loss", float(lv), start_it + j + 1)
+                tb.add_scalar("Throughput", record["throughput"],
+                              loop_state.iteration)
+                lr = getattr(model, "_lr", None)
+                if callable(lr):
+                    tb.add_scalar("LearningRate",
+                                  float(lr(loop_state.iteration)),
+                                  loop_state.iteration)
+                elif isinstance(lr, (int, float)):
+                    tb.add_scalar("LearningRate", float(lr),
+                                  loop_state.iteration)
+                tb.writer.flush()
+            vtb = getattr(model, "_val_summary", None)
+            if vtb is not None and val is not None:
+                for k, v in val.items():
+                    vtb.add_scalar(k, float(v), loop_state.iteration)
+                vtb.writer.flush()
             log.info("Epoch %d%s: loss=%.6f (%.1f ex/s)%s", epoch,
                      "" if completed else " (stopped mid-epoch)", epoch_loss,
                      record["throughput"],
@@ -755,6 +782,8 @@ def _compile(self: KerasNet, optimizer="adam", loss="mse", metrics=None,
     ms = [metrics_lib.get_metric(m) for m in (metrics or [])]
     self._compiled = CompiledSpec(opt, loss_fn, ms)
     self._loop = TrainingLoop(self, opt, loss_fn, ms)
+    # effective lr (constant or schedule) for the LearningRate summary
+    self._lr = optim_lib.resolve_lr(optimizer, **opt_kwargs)
     return self
 
 
@@ -784,6 +813,36 @@ def _set_checkpoint(self: KerasNet, path: str, trigger: Optional[Trigger] = None
     fires (default: every epoch, ``Topology.scala:1161-1168``)."""
     self._checkpoint = {"path": path, "trigger": trigger, "keep": keep}
     return self
+
+
+def _set_tensorboard(self: KerasNet, log_dir: str, app_name: str):
+    """``setTensorBoard(logDir, appName)`` (``Topology.scala:204-216``):
+    write train scalars (Loss per iteration, Throughput, LearningRate) to
+    ``<log_dir>/<app_name>/train`` and validation metrics to
+    ``.../validation`` as TensorBoard event files."""
+    from ....utils.tensorboard import TrainSummary, ValidationSummary
+    for attr in ("_train_summary", "_val_summary"):
+        old = getattr(self, attr, None)
+        if old is not None:  # redirecting: release the previous file handle
+            old.close()
+    self._train_summary = TrainSummary(log_dir, app_name)
+    self._val_summary = ValidationSummary(log_dir, app_name)
+    return self
+
+
+def _get_train_summary(self: KerasNet, tag: str = "Loss") -> np.ndarray:
+    """``getTrainSummary(tag)`` (``Topology.scala:222-229``): (n, 3) rows of
+    ``[iteration, value, wall_time]``."""
+    if getattr(self, "_train_summary", None) is None:
+        raise RuntimeError("call set_tensorboard() before reading summaries")
+    return self._train_summary.read_scalar(tag)
+
+
+def _get_validation_summary(self: KerasNet, tag: str) -> np.ndarray:
+    """``getValidationSummary(tag)`` (``Topology.scala:231-236``)."""
+    if getattr(self, "_val_summary", None) is None:
+        raise RuntimeError("call set_tensorboard() before reading summaries")
+    return self._val_summary.read_scalar(tag)
 
 
 def _fit(self: KerasNet, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
@@ -837,10 +896,16 @@ KerasNet.finished_epochs = 0
 KerasNet.finished_iterations = 0
 KerasNet._loop = None
 KerasNet._checkpoint = None
+KerasNet._train_summary = None
+KerasNet._val_summary = None
+KerasNet._lr = None
 
 KerasNet.compile = _compile
 KerasNet.init_weights = _init_weights
 KerasNet.set_checkpoint = _set_checkpoint
+KerasNet.set_tensorboard = _set_tensorboard
+KerasNet.get_train_summary = _get_train_summary
+KerasNet.get_validation_summary = _get_validation_summary
 KerasNet.fit = _fit
 KerasNet.evaluate = _evaluate
 KerasNet.predict = _predict

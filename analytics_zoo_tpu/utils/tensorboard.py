@@ -1,0 +1,275 @@
+"""TensorBoard event-file writer/reader — parity with the reference's
+``zoo/common/tensorboard/FileWriter.scala`` + ``EventWriter.scala`` (which
+wrap TF's Java proto classes) and the ``setTensorBoard`` / ``getTrainSummary``
+/ ``getValidationSummary`` surface of ``keras/engine/Topology.scala:204-236``.
+
+Re-designed dependency-free: TensorBoard's on-disk format is just a TFRecord
+stream of serialized ``tensorflow.Event`` protos, and the two messages we need
+(Event{wall_time, step, file_version | summary{value{tag, simple_value}}})
+are small enough to encode by hand — so this module writes bytes directly:
+
+* TFRecord framing: ``uint64 len | masked_crc32c(len) | data |
+  masked_crc32c(data)`` with the Castagnoli CRC and TF's mask rotation.
+* Proto wire format: field tags ``(num << 3) | wire_type`` with varint (0),
+  64-bit (1), length-delimited (2), 32-bit (5) payloads.
+
+The reader side parses the same framing back (verifying both CRCs), which is
+what ``get_train_summary`` uses — and doubles as proof the files are
+TensorBoard-readable.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["EventFileWriter", "TrainSummary", "ValidationSummary",
+           "read_scalars"]
+
+
+# ---------------------------------------------------------------------------
+# crc32c (Castagnoli, table-driven) + TF's masking
+# ---------------------------------------------------------------------------
+
+def _make_crc32c_table() -> List[int]:
+    poly = 0x82F63B78  # reversed Castagnoli polynomial
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ (poly if crc & 1 else 0)
+        table.append(crc)
+    return table
+
+
+_CRC_TABLE = _make_crc32c_table()
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# minimal proto encoding (event.proto / summary.proto subset)
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _field_double(num: int, value: float) -> bytes:
+    return _varint((num << 3) | 1) + struct.pack("<d", value)
+
+
+def _field_float(num: int, value: float) -> bytes:
+    return _varint((num << 3) | 5) + struct.pack("<f", value)
+
+
+def _field_varint(num: int, value: int) -> bytes:
+    return _varint(num << 3) + _varint(value & 0xFFFFFFFFFFFFFFFF)
+
+
+def _scalar_event(wall_time: float, step: int, tag: str,
+                  value: float) -> bytes:
+    # Summary.Value{ tag=1, simple_value=2 } inside Summary{ value=1 }
+    sv = _field_bytes(1, tag.encode("utf-8")) + _field_float(2, float(value))
+    summary = _field_bytes(1, sv)
+    # Event{ wall_time=1, step=2, summary=5 }
+    return (_field_double(1, wall_time) + _field_varint(2, int(step))
+            + _field_bytes(5, summary))
+
+
+def _version_event(wall_time: float) -> bytes:
+    # Event{ wall_time=1, file_version=3 }
+    return _field_double(1, wall_time) + _field_bytes(3, b"brain.Event:2")
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+
+class EventFileWriter:
+    """Appends TFRecord-framed Event protos to one
+    ``events.out.tfevents.<ts>.<host>`` file (``EventWriter.scala``
+    equivalent; thread-safe, explicit ``flush``)."""
+
+    def __init__(self, log_dir: str):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+        self.path = os.path.join(log_dir, fname)
+        self._f = open(self.path, "ab")
+        self._lock = threading.Lock()
+        self._write(_version_event(time.time()))
+
+    def _write(self, event: bytes) -> None:
+        header = struct.pack("<Q", len(event))
+        rec = (header + struct.pack("<I", _masked_crc(header))
+               + event + struct.pack("<I", _masked_crc(event)))
+        with self._lock:
+            self._f.write(rec)
+
+    def add_scalar(self, tag: str, value: float, step: int,
+                   wall_time: Optional[float] = None) -> None:
+        self._write(_scalar_event(wall_time if wall_time is not None
+                                  else time.time(), step, tag, value))
+
+    def flush(self) -> None:
+        with self._lock:
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.flush()
+            self._f.close()
+
+
+# ---------------------------------------------------------------------------
+# reader (used by get_train_summary / get_validation_summary)
+# ---------------------------------------------------------------------------
+
+def _read_records(path: str) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            if hcrc != _masked_crc(header):
+                raise IOError(f"corrupt record header in {path}")
+            data = f.read(length)
+            (dcrc,) = struct.unpack("<I", f.read(4))
+            if dcrc != _masked_crc(data):
+                raise IOError(f"corrupt record payload in {path}")
+            yield data
+
+
+def _parse_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = result = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+
+
+def _parse_fields(buf: bytes) -> Iterator[Tuple[int, int, bytes]]:
+    """Yield (field_num, wire_type, payload_bytes) triples."""
+    i = 0
+    while i < len(buf):
+        key, i = _parse_varint(buf, i)
+        num, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _parse_varint(buf, i)
+            yield num, wt, _varint(v)
+        elif wt == 1:
+            yield num, wt, buf[i:i + 8]
+            i += 8
+        elif wt == 2:
+            ln, i = _parse_varint(buf, i)
+            yield num, wt, buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            yield num, wt, buf[i:i + 4]
+            i += 4
+        else:
+            raise IOError(f"unsupported wire type {wt}")
+
+
+def read_scalars(log_dir: str, tag: Optional[str] = None
+                 ) -> List[Tuple[int, float, float, str]]:
+    """All scalar points under ``log_dir`` as ``(step, value, wall_time,
+    tag)``, sorted by step — the ``readScalar`` analogue."""
+    points = []
+    for fname in sorted(os.listdir(log_dir)):
+        if "tfevents" not in fname:
+            continue
+        for rec in _read_records(os.path.join(log_dir, fname)):
+            wall, step, summary = 0.0, 0, None
+            for num, wt, payload in _parse_fields(rec):
+                if num == 1 and wt == 1:
+                    (wall,) = struct.unpack("<d", payload)
+                elif num == 2 and wt == 0:
+                    step, _ = _parse_varint(payload, 0)
+                elif num == 5 and wt == 2:
+                    summary = payload
+            if summary is None:
+                continue
+            for num, wt, val in _parse_fields(summary):
+                if num != 1 or wt != 2:
+                    continue
+                vtag, simple = "", None
+                for n2, w2, p2 in _parse_fields(val):
+                    if n2 == 1 and w2 == 2:
+                        vtag = p2.decode("utf-8")
+                    elif n2 == 2 and w2 == 5:
+                        (simple,) = struct.unpack("<f", p2)
+                if simple is not None and (tag is None or vtag == tag):
+                    points.append((step, simple, wall, vtag))
+    points.sort(key=lambda p: (p[0], p[2]))
+    return points
+
+
+# ---------------------------------------------------------------------------
+# TrainSummary / ValidationSummary (Topology.scala:204-236 surface)
+# ---------------------------------------------------------------------------
+
+class _Summary:
+    sub_dir = ""
+
+    def __init__(self, log_dir: str, app_name: str):
+        self.log_dir = os.path.join(log_dir, app_name, self.sub_dir)
+        self.writer = EventFileWriter(self.log_dir)
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self.writer.add_scalar(tag, value, step)
+
+    def read_scalar(self, tag: str) -> np.ndarray:
+        """(n, 3) array of ``[step, value, wall_time]`` rows for ``tag``."""
+        self.writer.flush()
+        pts = read_scalars(self.log_dir, tag)
+        if not pts:
+            return np.zeros((0, 3), np.float64)
+        return np.asarray([[s, v, w] for s, v, w, _ in pts], np.float64)
+
+    def close(self) -> None:
+        self.writer.close()
+
+
+class TrainSummary(_Summary):
+    """Per-iteration Loss/Throughput (+ LearningRate when known) scalars,
+    written by ``fit`` when ``set_tensorboard`` is configured."""
+    sub_dir = "train"
+
+
+class ValidationSummary(_Summary):
+    """Per-epoch validation metrics, tagged by metric name."""
+    sub_dir = "validation"

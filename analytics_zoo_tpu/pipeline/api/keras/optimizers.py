@@ -102,6 +102,41 @@ def adamax(lr: float = 0.002, beta_1: float = 0.9, beta_2: float = 0.999,
     return optax.adamax(lr, b1=beta_1, b2=beta_2, eps=epsilon)
 
 
+# Each constructor carries its own lr_resolver — the function that reports
+# the EFFECTIVE lr (constant or step->lr schedule) the ctor would build from
+# the same kwargs. Co-located so a signature/schedule change can't silently
+# desynchronize the TensorBoard LearningRate curve from the real training lr.
+
+def _signature_lr(fn, kwargs):
+    import inspect
+    p = inspect.signature(fn).parameters.get("lr")
+    return kwargs.get("lr", p.default if p is not None else None)
+
+
+def _schedule_resolver(fn):
+    def resolve(**kw):
+        extra = {k: v for k, v in kw.items()
+                 if k not in ("lr", "schedule", "decay")}
+        return make_schedule(_signature_lr(fn, kw),
+                             schedule=kw.get("schedule"),
+                             decay=kw.get("decay", 0.0), **extra)
+    return resolve
+
+
+def _constant_resolver(fn):
+    return lambda **kw: _signature_lr(fn, kw)
+
+
+sgd.lr_resolver = _schedule_resolver(sgd)
+adam.lr_resolver = _schedule_resolver(adam)
+adam_weight_decay.lr_resolver = lambda **kw: _warmup_linear_decay(
+    _signature_lr(adam_weight_decay, kw),
+    kw.get("warmup_portion", -1.0), kw.get("total", -1))
+rmsprop.lr_resolver = _constant_resolver(rmsprop)
+adagrad.lr_resolver = _constant_resolver(adagrad)
+adadelta.lr_resolver = _constant_resolver(adadelta)
+adamax.lr_resolver = _constant_resolver(adamax)
+
 OPTIMIZERS: Dict[str, Callable[..., optax.GradientTransformation]] = {
     "sgd": sgd,
     "adam": adam,
@@ -127,24 +162,14 @@ def get_optimizer(opt: Union[str, optax.GradientTransformation],
 
 def resolve_lr(opt: Union[str, optax.GradientTransformation], **kwargs):
     """The EFFECTIVE learning rate of a ``compile()`` spec — a float or a
-    ``step -> lr`` schedule, resolved the same way the named constructor
-    does (signature default + decay/schedule kwargs). Feeds the TensorBoard
-    ``LearningRate`` scalar; None for pre-built optax objects (their inner
-    schedule isn't introspectable)."""
+    ``step -> lr`` schedule, via the ``lr_resolver`` registered next to each
+    constructor. Feeds the TensorBoard ``LearningRate`` scalar; None for
+    pre-built optax objects (their inner schedule isn't introspectable)."""
     if not isinstance(opt, str) or opt not in OPTIMIZERS:
         return None
-    import inspect
-    lr_param = inspect.signature(OPTIMIZERS[opt]).parameters.get("lr")
-    lr = kwargs.get("lr", lr_param.default if lr_param else None)
-    if opt in ("adamw", "adam_weight_decay"):
-        return _warmup_linear_decay(lr, kwargs.get("warmup_portion", -1.0),
-                                    kwargs.get("total", -1))
-    if opt in ("sgd", "adam"):
-        kw = {k: v for k, v in kwargs.items()
-              if k not in ("lr", "schedule", "decay")}
-        return make_schedule(lr, schedule=kwargs.get("schedule"),
-                             decay=kwargs.get("decay", 0.0), **kw)
-    return lr
+    ctor = OPTIMIZERS[opt]
+    resolver = getattr(ctor, "lr_resolver", None) or _constant_resolver(ctor)
+    return resolver(**kwargs)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,6 @@
+"""Cluster Serving — L9 of the layer map (SURVEY §1): stream-in/stream-out
+model serving with batching and backpressure (``serving/ClusterServing.scala``)."""
+
+from .backend import LocalBackend, QueueFullError, default_backend  # noqa: F401
+from .client import InputQueue, OutputQueue, ServingError  # noqa: F401
+from .server import ClusterServing  # noqa: F401

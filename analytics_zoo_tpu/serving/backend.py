@@ -1,0 +1,184 @@
+"""Serving queue backends — the transport under Cluster Serving.
+
+The reference couples serving to a Redis instance: producers ``XADD`` to an
+input stream, the serving job consumes, and results land as ``result:<uri>``
+hashes (``serving/ClusterServing.scala:103-134``; client
+``pyzoo/zoo/serving/client.py:58-142``). Here the same stream/result contract
+is an interface with two implementations:
+
+* ``LocalBackend`` — in-process, thread-safe, bounded; the default for tests
+  and single-host serving (no external service needed on a TPU VM).
+* ``RedisBackend`` — the wire-compatible option when a ``redis`` client is
+  installed; same xadd/xread/result surface against a real server.
+
+Backpressure is explicit: a bounded input stream makes ``xadd`` block (up to
+a timeout) instead of the reference's used_memory-threshold polling.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["LocalBackend", "RedisBackend", "QueueFullError",
+           "default_backend"]
+
+
+class QueueFullError(RuntimeError):
+    """Input stream at capacity and the enqueue timeout elapsed."""
+
+
+_DEFAULT: Optional["LocalBackend"] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_backend() -> "LocalBackend":
+    """The process-wide LocalBackend that default-constructed InputQueue /
+    OutputQueue / ClusterServing share — so the no-args client API actually
+    communicates (mirroring the reference, where 'default' means the one
+    local Redis)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = LocalBackend()
+        return _DEFAULT
+
+
+class LocalBackend:
+    """In-process stream + result store with Redis-stream-like semantics."""
+
+    def __init__(self, maxlen: int = 10000):
+        self.maxlen = maxlen
+        self._streams: Dict[str, List[Tuple[str, dict]]] = {}
+        self._results: Dict[str, dict] = {}
+        self._lock = threading.Condition()
+        self._seq = itertools.count()
+
+    # -- stream ------------------------------------------------------------
+    def xadd(self, stream: str, fields: dict,
+             timeout: Optional[float] = None) -> str:
+        """Append; blocks while the stream holds ``maxlen`` unread entries."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            entries = self._streams.setdefault(stream, [])
+            while len(entries) >= self.maxlen:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise QueueFullError(
+                        f"stream {stream!r} full ({self.maxlen}); inference "
+                        f"is not keeping up — dequeue or raise maxlen")
+                self._lock.wait(remaining)
+            entry_id = f"{int(time.time() * 1000)}-{next(self._seq)}"
+            entries.append((entry_id, dict(fields)))
+            self._lock.notify_all()
+            return entry_id
+
+    def xread(self, stream: str, count: int,
+              block_ms: int = 100) -> List[Tuple[str, dict]]:
+        """Pop up to ``count`` entries, waiting up to ``block_ms`` for the
+        first (consume-on-read: the serving loop is the only consumer group)."""
+        deadline = time.monotonic() + block_ms / 1000.0
+        with self._lock:
+            entries = self._streams.setdefault(stream, [])
+            while not entries:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._lock.wait(remaining)
+            out = entries[:count]
+            del entries[:count]
+            self._lock.notify_all()  # wake blocked producers
+            return out
+
+    def stream_len(self, stream: str) -> int:
+        with self._lock:
+            return len(self._streams.get(stream, []))
+
+    # -- results -----------------------------------------------------------
+    def set_result(self, uri: str, fields: dict) -> None:
+        with self._lock:
+            self._results[uri] = dict(fields)
+            self._lock.notify_all()
+
+    def pop_result(self, uri: str,
+                   timeout: Optional[float] = None) -> Optional[dict]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while uri not in self._results:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._lock.wait(remaining)
+            return self._results.pop(uri)
+
+    def pop_all_results(self) -> Dict[str, dict]:
+        with self._lock:
+            out, self._results = self._results, {}
+            return out
+
+
+class RedisBackend:
+    """Same contract against a real Redis (requires the ``redis`` package);
+    keys match the reference: input stream entries + ``result:<uri>`` hashes."""
+
+    def __init__(self, host: str = "localhost", port: int = 6379,
+                 maxlen: int = 10000):
+        import redis  # gated: not part of the baked environment
+        self._r = redis.Redis(host=host, port=port)
+        self.maxlen = maxlen
+        self._last_id: Dict[str, str] = {}
+
+    def xadd(self, stream: str, fields: dict,
+             timeout: Optional[float] = None) -> str:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._r.xlen(stream) >= self.maxlen:
+            if deadline is not None and time.monotonic() > deadline:
+                raise QueueFullError(f"stream {stream!r} full ({self.maxlen})")
+            time.sleep(0.01)
+        return self._r.xadd(stream, fields).decode()
+
+    def xread(self, stream: str, count: int,
+              block_ms: int = 100) -> List[Tuple[str, dict]]:
+        last = self._last_id.get(stream, "0")
+        resp = self._r.xread({stream: last}, count=count, block=block_ms)
+        out = []
+        for _, entries in resp or []:
+            for eid, fields in entries:
+                eid = eid.decode()
+                out.append((eid, {k.decode(): v.decode()
+                                  for k, v in fields.items()}))
+                self._last_id[stream] = eid
+                self._r.xdel(stream, eid)
+        return out
+
+    def stream_len(self, stream: str) -> int:
+        return int(self._r.xlen(stream))
+
+    def set_result(self, uri: str, fields: dict) -> None:
+        self._r.hset(f"result:{uri}", mapping=fields)
+
+    def pop_result(self, uri: str,
+                   timeout: Optional[float] = None) -> Optional[dict]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        key = f"result:{uri}"
+        while True:
+            vals = self._r.hgetall(key)
+            if vals:
+                self._r.delete(key)
+                return {k.decode(): v.decode() for k, v in vals.items()}
+            if deadline is not None and time.monotonic() > deadline:
+                return None
+            time.sleep(0.01)
+
+    def pop_all_results(self) -> Dict[str, dict]:
+        out = {}
+        for key in self._r.keys("result:*"):
+            uri = key.decode().split(":", 1)[1]
+            res = self.pop_result(uri, timeout=0)
+            if res is not None:
+                out[uri] = res
+        return out

@@ -1,0 +1,83 @@
+"""Cluster Serving client — ``InputQueue`` / ``OutputQueue`` parity with
+``pyzoo/zoo/serving/client.py:58-142``, ndarray-native instead of
+image-file-native: payloads are base64-wrapped ``.npy`` bytes (dtype+shape
+self-describing), so any tensor model serves, not just jpeg classifiers.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+from typing import Dict, Optional
+
+import numpy as np
+
+from .backend import LocalBackend, default_backend
+
+INPUT_STREAM = "tensor_stream"
+
+__all__ = ["InputQueue", "OutputQueue", "ServingError", "encode_array",
+           "decode_array"]
+
+
+class ServingError(RuntimeError):
+    """The server wrote an error record for this uri (failed inference or
+    undecodable request payload)."""
+
+
+def encode_array(arr: np.ndarray) -> str:
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def decode_array(payload: str) -> np.ndarray:
+    return np.load(io.BytesIO(base64.b64decode(payload)),
+                   allow_pickle=False)
+
+
+class InputQueue:
+    """Producer side: ``enqueue(uri, tensor)``. Blocks (up to ``timeout``)
+    when the stream is at capacity — the backpressure the reference
+    implements by polling Redis used_memory against a threshold."""
+
+    def __init__(self, backend: Optional[LocalBackend] = None,
+                 stream: str = INPUT_STREAM, timeout: float = 30.0):
+        self.backend = backend if backend is not None else default_backend()
+        self.stream = stream
+        self.timeout = timeout
+
+    def enqueue(self, uri: str, data: np.ndarray) -> str:
+        return self.backend.xadd(
+            self.stream, {"uri": uri, "data": encode_array(np.asarray(data))},
+            timeout=self.timeout)
+
+
+class OutputQueue:
+    """Consumer side: ``query(uri)`` one result (raises ``ServingError`` if
+    the server recorded a failure for that uri), ``dequeue()`` everything
+    successful (failures land in ``last_errors``, they never crash the
+    drain or lose other clients' results)."""
+
+    def __init__(self, backend: Optional[LocalBackend] = None):
+        self.backend = backend if backend is not None else default_backend()
+        self.last_errors: Dict[str, str] = {}
+
+    def query(self, uri: str, timeout: Optional[float] = None
+              ) -> Optional[np.ndarray]:
+        res = self.backend.pop_result(uri, timeout=timeout)
+        if res is None:
+            return None
+        if "value" not in res:
+            raise ServingError(f"{uri}: {res.get('error', 'unknown error')}")
+        return decode_array(res["value"])
+
+    def dequeue(self) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        self.last_errors = {}
+        for uri, res in self.backend.pop_all_results().items():
+            if "value" in res:
+                out[uri] = decode_array(res["value"])
+            else:
+                self.last_errors[uri] = res.get("error", "unknown error")
+        return out

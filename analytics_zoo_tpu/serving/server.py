@@ -1,0 +1,118 @@
+"""Cluster Serving — the serving loop, parity with
+``serving/ClusterServing.scala:103-134,243-289`` re-designed for a TPU chip:
+
+* the reference runs a Spark-streaming micro-batch per trigger; here one
+  background thread drains the input stream and pushes through a jitted
+  ``InferenceModel`` (replica-queue concurrency inside),
+* requests are batched up to ``batch_size`` per dispatch — padding to a
+  fixed shape inside ``InferenceModel.predict`` keeps ONE compiled program
+  regardless of how many requests arrived (dynamic batch sizes would
+  recompile per unique size),
+* backpressure comes from the bounded stream (``LocalBackend.xadd`` blocks),
+  replacing the reference's Redis-memory watermark polling.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .backend import LocalBackend, default_backend
+from .client import INPUT_STREAM, decode_array, encode_array
+
+log = logging.getLogger("analytics_zoo_tpu.serving")
+
+__all__ = ["ClusterServing"]
+
+
+class ClusterServing:
+    """Owns the serve loop: xread → batched predict → result writes."""
+
+    def __init__(self, model, backend: Optional[LocalBackend] = None,
+                 batch_size: int = 32, stream: str = INPUT_STREAM,
+                 block_ms: int = 50):
+        self.model = model          # InferenceModel (or any .predict(x))
+        self.backend = backend if backend is not None else default_backend()
+        self.batch_size = int(batch_size)
+        self.stream = stream
+        self.block_ms = int(block_ms)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.served = 0             # records processed (visible for tests/ops)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ClusterServing":
+        if self._thread is not None:
+            raise RuntimeError("serving already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="cluster-serving")
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the loop; with ``drain`` first wait for the stream to empty."""
+        if self._thread is None:
+            return
+        if drain:
+            import time
+            deadline = time.monotonic() + timeout
+            while (self.backend.stream_len(self.stream) > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            # keep the handle: a discarded live thread would let a second
+            # start() race two consumers on the same stream
+            raise TimeoutError(
+                f"serve loop still running after {timeout}s (model dispatch "
+                f"in flight?); call stop() again to re-join")
+        self._thread = None
+
+    # -- the loop -----------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            entries = self.backend.xread(self.stream, self.batch_size,
+                                         block_ms=self.block_ms)
+            if not entries:
+                continue
+            uris, tensors = [], []
+            for _, fields in entries:
+                try:
+                    tensors.append(decode_array(fields["data"]))
+                    uris.append(fields["uri"])
+                except Exception:
+                    # write an addressable error so the producer's query()
+                    # fails fast instead of blocking out its full timeout
+                    log.exception("undecodable record (uri=%r)",
+                                  fields.get("uri"))
+                    if fields.get("uri"):
+                        self.backend.set_result(
+                            fields["uri"], {"error": "undecodable payload"})
+            if not uris:
+                continue
+            try:
+                batch = np.stack(tensors)
+            except ValueError:
+                # ragged shapes can't batch: serve one by one
+                for uri, t in zip(uris, tensors):
+                    self._predict_and_store([uri], t[None])
+                continue
+            self._predict_and_store(uris, batch)
+
+    def _predict_and_store(self, uris, batch) -> None:
+        try:
+            preds = np.asarray(self.model.predict(batch))
+        except Exception:
+            log.exception("inference failed for %d records; writing errors",
+                          len(uris))
+            for uri in uris:
+                self.backend.set_result(uri, {"error": "inference failed"})
+            return
+        for i, uri in enumerate(uris):
+            self.backend.set_result(uri, {"value": encode_array(preds[i])})
+        self.served += len(uris)

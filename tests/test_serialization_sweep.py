@@ -1,0 +1,204 @@
+"""Serialization sweep — every exported layer round-trips through
+save → fresh rebuild → load → bit-identical output, the ``SerializerSpec``
+discipline (``zoo/src/test/.../serializer/SerializerSpec.scala``, SURVEY §4):
+the reference auto-enumerates every layer class and fails the build if one
+isn't serialization-tested.
+
+Here "serialize" means what every persistence path in this framework does
+(ZooModel .npz, CheckpointManager): flatten params+state to leaves in
+deterministic tree order, write, rebuild the SAME topology fresh (different
+rng), install leaves by order, and require identical outputs. Catches
+leaf-order nondeterminism, build/init asymmetries, and state handling bugs.
+"""
+
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as L
+
+B = 2  # batch
+
+
+def _input_for(kind, shape, rng):
+    if kind == "int":
+        return rng.integers(0, 7, (B,) + shape).astype(np.int32)
+    return rng.normal(size=(B,) + shape).astype(np.float32)
+
+
+# (factory, input_shape(s) sans batch, input kind) — one per exported layer
+CASES = {
+    "Dense": (lambda: L.Dense(5), (4,), "float"),
+    "Dense_act": (lambda: L.Dense(5, activation="relu", bias=False), (4,), "float"),
+    "SparseDense": (lambda: L.SparseDense(5), (4,), "float"),
+    "Activation": (lambda: L.Activation("tanh"), (4,), "float"),
+    "Dropout": (lambda: L.Dropout(0.3), (4,), "float"),
+    "Flatten": (lambda: L.Flatten(), (3, 4), "float"),
+    "Reshape": (lambda: L.Reshape((4, 3)), (3, 4), "float"),
+    "Permute": (lambda: L.Permute((2, 1)), (3, 4), "float"),
+    "RepeatVector": (lambda: L.RepeatVector(3), (4,), "float"),
+    "Select": (lambda: L.Select(1, 2), (5, 4), "float"),
+    "Squeeze": (lambda: L.Squeeze(2), (3, 1), "float"),
+    "ExpandDim": (lambda: L.ExpandDim(1), (3,), "float"),
+    "Narrow": (lambda: L.Narrow(1, 1, 2), (5, 4), "float"),
+    "Masking": (lambda: L.Masking(0.0), (3, 4), "float"),
+    "GaussianNoise": (lambda: L.GaussianNoise(0.1), (4,), "float"),
+    "GaussianDropout": (lambda: L.GaussianDropout(0.1), (4,), "float"),
+    "TimeDistributed": (lambda: L.TimeDistributed(L.Dense(5)), (3, 4), "float"),
+    "Highway": (lambda: L.Highway(), (4,), "float"),
+    "Embedding": (lambda: L.Embedding(7, 6), (3,), "int"),
+    # multi-hot bag over the vocab (not id list): input width = vocab size
+    "SparseEmbedding": (lambda: L.SparseEmbedding(7, 6), (7,), "float"),
+    "WordEmbedding": (lambda: L.WordEmbedding(
+        np.arange(42, dtype=np.float32).reshape(7, 6)), (3,), "int"),
+    "WordEmbedding_trainable": (lambda: L.WordEmbedding(
+        np.arange(42, dtype=np.float32).reshape(7, 6), trainable=True),
+        (3,), "int"),
+    "BatchNormalization": (lambda: L.BatchNormalization(), (4,), "float"),
+    "LayerNorm": (lambda: L.LayerNorm(), (4,), "float"),
+    "L2Normalize": (lambda: L.L2Normalize(), (4,), "float"),
+    "Convolution1D": (lambda: L.Convolution1D(5, 3), (8, 4), "float"),
+    "Convolution2D": (lambda: L.Convolution2D(5, 3, 3), (8, 8, 3), "float"),
+    "AtrousConvolution1D": (lambda: L.AtrousConvolution1D(5, 3, atrous_rate=2),
+                            (10, 4), "float"),
+    "AtrousConvolution2D": (lambda: L.AtrousConvolution2D(
+        5, 3, 3, atrous_rate=(2, 2)), (10, 10, 3), "float"),
+    "SeparableConvolution2D": (lambda: L.SeparableConvolution2D(6, 3, 3),
+                               (8, 8, 3), "float"),
+    "Deconvolution2D": (lambda: L.Deconvolution2D(5, 3, 3), (6, 6, 3), "float"),
+    "LocallyConnected1D": (lambda: L.LocallyConnected1D(5, 3), (8, 4), "float"),
+    "Cropping1D": (lambda: L.Cropping1D((1, 1)), (8, 4), "float"),
+    "Cropping2D": (lambda: L.Cropping2D(((1, 1), (1, 1))), (8, 8, 3), "float"),
+    "UpSampling1D": (lambda: L.UpSampling1D(2), (4, 3), "float"),
+    "UpSampling2D": (lambda: L.UpSampling2D((2, 2)), (4, 4, 3), "float"),
+    "ZeroPadding1D": (lambda: L.ZeroPadding1D(1), (4, 3), "float"),
+    "ZeroPadding2D": (lambda: L.ZeroPadding2D((1, 1)), (4, 4, 3), "float"),
+    "MaxPooling1D": (lambda: L.MaxPooling1D(2), (8, 3), "float"),
+    "MaxPooling2D": (lambda: L.MaxPooling2D((2, 2)), (8, 8, 3), "float"),
+    "AveragePooling1D": (lambda: L.AveragePooling1D(2), (8, 3), "float"),
+    "AveragePooling2D": (lambda: L.AveragePooling2D((2, 2)), (8, 8, 3), "float"),
+    "GlobalMaxPooling1D": (lambda: L.GlobalMaxPooling1D(), (8, 3), "float"),
+    "GlobalMaxPooling2D": (lambda: L.GlobalMaxPooling2D(), (4, 4, 3), "float"),
+    "GlobalAveragePooling1D": (lambda: L.GlobalAveragePooling1D(), (8, 3), "float"),
+    "GlobalAveragePooling2D": (lambda: L.GlobalAveragePooling2D(),
+                               (4, 4, 3), "float"),
+    "SimpleRNN": (lambda: L.SimpleRNN(5), (6, 4), "float"),
+    "LSTM": (lambda: L.LSTM(5, return_sequences=True), (6, 4), "float"),
+    "GRU": (lambda: L.GRU(5), (6, 4), "float"),
+    "Bidirectional": (lambda: L.Bidirectional(L.LSTM(5, return_sequences=True)),
+                      (6, 4), "float"),
+    "MultiHeadSelfAttention": (lambda: L.MultiHeadSelfAttention(8, 2),
+                               (6, 8), "float"),
+    "TransformerBlock": (lambda: L.TransformerBlock(8, 2), (6, 8), "float"),
+    "TransformerLayer": (lambda: L.TransformerLayer(
+        vocab=7, seq_len=6, n_block=2, hidden_size=8, n_head=2), (6,), "int"),
+}
+
+
+def _roundtrip(factory, shape, kind):
+    data_rng = np.random.default_rng(0)
+    x = _input_for(kind, shape, data_rng)
+    xs = jax.numpy.asarray(x)
+    in_shape = (None,) + shape
+
+    l1 = factory()
+    p1 = l1.build(jax.random.key(0), in_shape)
+    s1 = l1.initial_state(in_shape)
+    y1, _ = l1.apply(p1, s1, xs, training=False, rng=None)
+
+    # persist exactly as ZooModel/CheckpointManager do: leaves in tree order
+    leaves = [np.asarray(v) for v in jax.tree_util.tree_leaves((p1, s1))]
+    with tempfile.NamedTemporaryFile(suffix=".npz") as f:
+        np.savez(f.name, **{f"l_{i}": a for i, a in enumerate(leaves)})
+        with np.load(f.name) as data:
+            loaded = [data[f"l_{i}"] for i in range(len(leaves))]
+
+    l2 = factory()  # fresh instance, DIFFERENT init rng
+    p2 = l2.build(jax.random.key(999), in_shape)
+    s2 = l2.initial_state(in_shape)
+    _, treedef = jax.tree_util.tree_flatten((p2, s2))
+    fresh = jax.tree_util.tree_leaves((p2, s2))
+    assert len(fresh) == len(loaded), \
+        f"leaf count changed across rebuild: {len(fresh)} vs {len(loaded)}"
+    for i, (a, b) in enumerate(zip(loaded, fresh)):
+        assert np.shape(a) == np.shape(b), \
+            f"leaf {i} shape {np.shape(a)} vs rebuilt {np.shape(b)}"
+    p2, s2 = jax.tree_util.tree_unflatten(treedef, loaded)
+    y2, _ = l2.apply(p2, s2, xs, training=False, rng=None)
+
+    for a, b in zip(jax.tree_util.tree_leaves(y1),
+                    jax.tree_util.tree_leaves(y2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_layer_roundtrip(name):
+    factory, shape, kind = CASES[name]
+    _roundtrip(factory, shape, kind)
+
+
+def test_sweep_covers_every_exported_layer():
+    """The reference's SerializerSpec fails when a new layer lacks coverage —
+    enforce the same: every public layer class must appear in CASES."""
+    import inspect
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+    exempt = {
+        "Input", "InputLayer", "Lambda",  # graph plumbing, not serializable
+        "Merge",                           # covered by test_merge_roundtrip
+        "BERT",                            # covered by test_bert_roundtrip
+        "Layer",
+    }
+    covered = {case[0]().__class__.__name__ for case in CASES.values()}
+    for name in dir(L):
+        obj = getattr(L, name)
+        if (inspect.isclass(obj) and issubclass(obj, Layer)
+                and name not in exempt):
+            assert obj.__name__ in covered, \
+                f"layer {name} missing from the serialization sweep"
+
+
+def test_merge_roundtrip():
+    rng = np.random.default_rng(1)
+    xs = [jax.numpy.asarray(rng.normal(size=(B, 4)).astype(np.float32))
+          for _ in range(2)]
+    shapes = [(None, 4), (None, 4)]
+    for mode in ("sum", "concat", "mul", "max", "ave"):
+        l1 = L.Merge(mode=mode)
+        p1 = l1.build(jax.random.key(0), shapes)
+        s1 = l1.initial_state(shapes)
+        y1, _ = l1.apply(p1, s1, xs, training=False, rng=None)
+        l2 = L.Merge(mode=mode)
+        p2 = l2.build(jax.random.key(9), shapes)
+        s2 = l2.initial_state(shapes)
+        y2, _ = l2.apply(p2, s2, xs, training=False, rng=None)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+def test_bert_roundtrip():
+    t = 6
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, 7, (B, t)).astype(np.int32)
+    seg = np.zeros((B, t), np.int32)
+    pos = np.tile(np.arange(t, dtype=np.int32), (B, 1))
+    mask = np.ones((B, t), np.float32)
+    x = [jax.numpy.asarray(a) for a in (ids, seg, pos, mask)]
+    shapes = [(None, t)] * 4
+
+    def factory():
+        return L.BERT(vocab=7, hidden_size=8, n_block=2, n_head=2, seq_len=t,
+                      intermediate_size=16)
+
+    l1 = factory()
+    p1 = l1.build(jax.random.key(0), shapes)
+    y1, _ = l1.apply(p1, {}, x, training=False, rng=None)
+    leaves = [np.asarray(v) for v in jax.tree_util.tree_leaves(p1)]
+    l2 = factory()
+    p2 = l2.build(jax.random.key(7), shapes)
+    _, treedef = jax.tree_util.tree_flatten(p2)
+    p2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    y2, _ = l2.apply(p2, {}, x, training=False, rng=None)
+    for a, b in zip(jax.tree_util.tree_leaves(y1),
+                    jax.tree_util.tree_leaves(y2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,144 @@
+"""Cluster Serving: enqueue → batched predict → dequeue round-trip,
+backpressure, concurrent producers, error records."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                       LocalBackend, OutputQueue,
+                                       QueueFullError)
+from analytics_zoo_tpu.serving.client import decode_array, encode_array
+
+
+def _toy_model():
+    init_zoo_context()
+    m = Sequential()
+    m.add(Dense(4, input_shape=(6,), activation="relu"))
+    m.add(Dense(3, activation="softmax"))
+    m.init_weights()
+    return m
+
+
+def test_array_codec_roundtrip():
+    for arr in (np.arange(12, dtype=np.float32).reshape(3, 4),
+                np.array([1, 2, 3], np.int64),
+                np.random.default_rng(0).normal(size=(2, 5, 5))):
+        out = decode_array(encode_array(arr))
+        assert out.dtype == arr.dtype
+        np.testing.assert_array_equal(out, arr)
+
+
+def test_serve_round_trip_matches_direct_predict():
+    model = _toy_model()
+    im = InferenceModel().from_keras(model)
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=8).start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+
+    rng = np.random.default_rng(1)
+    xs = {f"req-{i}": rng.normal(size=(6,)).astype(np.float32)
+          for i in range(20)}
+    for uri, x in xs.items():
+        inq.enqueue(uri, x)
+    results = {uri: outq.query(uri, timeout=30.0) for uri in xs}
+    serving.stop()
+
+    direct = np.asarray(im.predict(np.stack(list(xs.values()))))
+    for i, uri in enumerate(xs):
+        assert results[uri] is not None, f"no result for {uri}"
+        np.testing.assert_allclose(results[uri], direct[i],
+                                   rtol=1e-5, atol=1e-6)
+    assert serving.served == 20
+
+
+def test_concurrent_producers():
+    model = _toy_model()
+    im = InferenceModel().from_keras(model)
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4).start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    rng = np.random.default_rng(2)
+    data = {f"t{t}-{i}": rng.normal(size=(6,)).astype(np.float32)
+            for t in range(4) for i in range(10)}
+
+    def produce(t):
+        for i in range(10):
+            inq.enqueue(f"t{t}-{i}", data[f"t{t}-{i}"])
+
+    threads = [threading.Thread(target=produce, args=(t,)) for t in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    got = {uri: outq.query(uri, timeout=30.0) for uri in data}
+    serving.stop()
+    assert all(v is not None and v.shape == (3,) for v in got.values())
+
+
+def test_backpressure_blocks_then_errors():
+    backend = LocalBackend(maxlen=2)
+    inq = InputQueue(backend, timeout=0.2)  # no consumer running
+    inq.enqueue("a", np.zeros(3, np.float32))
+    inq.enqueue("b", np.zeros(3, np.float32))
+    with pytest.raises(QueueFullError):
+        inq.enqueue("c", np.zeros(3, np.float32))
+    # a consumer draining one entry unblocks the producer
+    def drain():
+        backend.xread("tensor_stream", 1, block_ms=5000)
+    t = threading.Thread(target=drain)
+    t.start()
+    inq2 = InputQueue(backend, timeout=10.0)
+    inq2.enqueue("c", np.zeros(3, np.float32))  # must not raise now
+    t.join()
+
+
+def test_undecodable_and_failing_records():
+    from analytics_zoo_tpu.serving import ServingError
+
+    class BoomModel:
+        def predict(self, x):
+            raise RuntimeError("boom")
+
+    backend = LocalBackend()
+    serving = ClusterServing(BoomModel(), backend=backend,
+                             batch_size=2).start()
+    backend.xadd("tensor_stream", {"uri": "bad", "data": "!!notb64!!"})
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    inq.enqueue("x1", np.zeros(3, np.float32))
+    # failed inference surfaces as ServingError, not a hang or KeyError
+    with pytest.raises(ServingError):
+        outq.query("x1", timeout=10.0)
+    # undecodable payloads get an addressable error record too
+    with pytest.raises(ServingError):
+        outq.query("bad", timeout=10.0)
+    serving.stop()
+
+
+def test_dequeue_survives_error_records():
+    backend = LocalBackend()
+    backend.set_result("ok", {"value": encode_array(np.ones(2, np.float32))})
+    backend.set_result("failed", {"error": "inference failed"})
+    outq = OutputQueue(backend)
+    got = outq.dequeue()
+    assert list(got) == ["ok"]
+    np.testing.assert_array_equal(got["ok"], np.ones(2, np.float32))
+    assert outq.last_errors == {"failed": "inference failed"}
+
+
+def test_default_backend_is_shared():
+    """Default-constructed client + server must talk to each other."""
+    model = _toy_model()
+    im = InferenceModel().from_keras(model)
+    serving = ClusterServing(im, batch_size=4).start()
+    inq, outq = InputQueue(), OutputQueue()
+    x = np.random.default_rng(3).normal(size=(6,)).astype(np.float32)
+    inq.enqueue("shared", x)
+    res = outq.query("shared", timeout=30.0)
+    serving.stop()
+    assert res is not None and res.shape == (3,)

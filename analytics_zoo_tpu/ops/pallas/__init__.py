@@ -1,0 +1,8 @@
+"""L0 — Pallas TPU kernels (SURVEY §2.3). The compute-critical ops the XLA
+autofusion can't produce: blockwise flash attention (O(block^2) VMEM instead
+of an HBM (T,T) score matrix) and fused int8 weight-only dequant-matmul.
+Kernels auto-select interpreter mode off-TPU so the same code paths test on
+the CPU mesh."""
+
+from .flash_attention import flash_attention  # noqa: F401
+from .quantized import int8_matmul  # noqa: F401

@@ -1,0 +1,17 @@
+"""Shared helpers for the pallas kernel package."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pad_to_multiple(x: jax.Array, axis: int, mult: int) -> jax.Array:
+    """Zero-pad ``axis`` up to the next multiple of ``mult`` (no-op when
+    already aligned)."""
+    rem = (-x.shape[axis]) % mult
+    if rem == 0:
+        return x
+    cfg = [(0, 0)] * x.ndim
+    cfg[axis] = (0, rem)
+    return jnp.pad(x, cfg)

@@ -1,0 +1,184 @@
+"""Flash attention — the L0 Pallas TPU kernel behind the attention stack
+(SURVEY §2.3; the reference has no custom kernels at all — its attention is
+whole-matrix softmax inside ``TransformerLayer.scala:56``/``BERT.scala:66``,
+materializing the (T, T) score matrix in HBM).
+
+Design: grid (batch*head, q-blocks, k-blocks) with the k dimension innermost —
+TPU pallas runs the grid sequentially, so the online-softmax carry (acc/m/l)
+lives in VMEM scratch across the k steps of one q block: initialized at
+``ki == 0``, folded per k block, written out at the last k block. VMEM per
+cell is O(block_q·D + block_k·D) — K/V stream block-by-block, never the whole
+sequence — and both matmuls (QK^T, PV) hit the MXU at tile-aligned sizes.
+Causal cells predicate away k blocks strictly right of the diagonal.
+
+Causal masking is BOTTOM-RIGHT aligned like the XLA oracle
+(``ops/attention.py:41``): query i attends keys ``j <= i + (t_kv - t_q)``.
+Rows with no visible key (t_q > t_kv tails) return zeros — the one spot the
+oracle differs (its -1e9 fill degrades to uniform weights there).
+
+Backward runs as XLA recompute (``jax.custom_vjp`` whose bwd re-derives the
+probabilities like the checkpointed form) — the classic flash trade: don't
+store the (T, T) weights, re-make them.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import pad_to_multiple
+
+__all__ = ["flash_attention"]
+
+_LANES = 128  # scratch lane width (TPU min tile last dim)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                scale: float, block_q: int, block_k: int, t_q: int,
+                t_kv: int, causal: bool):
+    """Grid cell (bh, qi, ki). q (1, block_q, D); k/v (1, block_k, D);
+    o (1, block_q, D); scratch acc (block_q, D), m/l (block_q, LANES)."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    offset = t_kv - t_q  # bottom-right causal alignment
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: the first row of this q block sees keys up to
+    # qi*block_q + offset; the last row up to (qi+1)*block_q - 1 + offset.
+    # Blocks fully beyond the latter contribute nothing — skip their math.
+    needed = True
+    if causal:
+        needed = ki * block_k <= (qi + 1) * block_q - 1 + offset
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        ok = k_pos < t_kv                              # kv padding mask
+        if causal:
+            ok = ok & (k_pos <= q_pos + offset)
+        s = jnp.where(ok, s, -jnp.inf)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.where(ok, jnp.exp(s - m_safe), 0.0)
+        corr = jnp.where(jnp.isneginf(m_prev), 0.0, jnp.exp(m_prev - m_safe))
+        l_ref[:, :1] = l_ref[:, :1] * corr + jnp.sum(p, axis=-1,
+                                                     keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, :1] = m_new
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+                    ).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+               interpret: bool):
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[2]
+    scale = 1.0 / float(d) ** 0.5
+    block_q = min(block_q, max(t_q, 1))
+    block_k = min(block_k, max(t_kv, 1))
+
+    qr = pad_to_multiple(q.reshape(b * h, t_q, d), 1, block_q)
+    kr = pad_to_multiple(k.reshape(b * h, t_kv, d), 1, block_k)
+    vr = pad_to_multiple(v.reshape(b * h, t_kv, d), 1, block_k)
+    n_q = qr.shape[1] // block_q
+    n_k = kr.shape[1] // block_k
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
+                               block_k=block_k, t_q=t_q, t_kv=t_kv,
+                               causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),       # acc
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out[:, :t_q, :].reshape(b, h, t_q, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, block_q: int = 256,
+                    block_k: int = 256,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Blockwise-softmax attention: q/k/v (B, H, T, D) → (B, H, Tq, D).
+
+    Numerically equivalent to ``ops.attention.dot_product_attention`` (minus
+    dropout/mask arguments — those paths stay on the XLA op). ``interpret``
+    defaults to auto: compiled on TPU, interpreter elsewhere (tests).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    """Recompute-form backward: differentiate the reference attention math
+    (no (T,T) tensor was saved by the forward; XLA re-materializes it here,
+    which is the standard flash-attention memory/compute trade)."""
+    q, k, v = res
+
+    def ref(q, k, v):
+        d = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32)
+        s = s / jnp.sqrt(jnp.asarray(d, jnp.float32))
+        if causal:
+            tq, tk = s.shape[-2], s.shape[-1]
+            cm = jnp.tril(jnp.ones((tq, tk), jnp.bool_), k=tk - tq)
+            s = jnp.where(cm[None, None], s, -1e9)
+        w = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+        return out.astype(v.dtype)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -66,6 +66,18 @@ class MultiHeadSelfAttention(Layer):
         return {"qkv": _dense_params(k1, self.hidden_size, 3 * self.hidden_size),
                 "proj": _dense_params(k2, self.hidden_size, self.hidden_size)}
 
+    def _use_flash(self, mask, drop) -> bool:
+        """The pallas flash kernel covers the mask-free, dropout-free case;
+        opt in via ``zoo.pallas.attention`` (attention masks and in-kernel
+        dropout stay on the XLA op)."""
+        if mask is not None or drop > 0.0:
+            return False
+        from .....common.context import get_zoo_context
+        try:
+            return bool(get_zoo_context().get("zoo.pallas.attention", False))
+        except Exception:
+            return False
+
     def call(self, params, x, *, training=False, rng=None):
         mask = None
         if isinstance(x, (list, tuple)):
@@ -76,10 +88,15 @@ class MultiHeadSelfAttention(Layer):
         r1 = r2 = None
         if rng is not None:
             r1, r2 = jax.random.split(rng)
-        out = dot_product_attention(
-            split_heads(q, self.n_head), split_heads(k, self.n_head),
-            split_heads(v, self.n_head), mask=mask, causal=self.causal,
-            dropout_rate=self.attn_drop if training else 0.0, dropout_rng=r1)
+        qh, kh, vh = (split_heads(a, self.n_head) for a in (q, k, v))
+        drop = self.attn_drop if training else 0.0
+        if self._use_flash(mask, drop):
+            from .....ops.pallas import flash_attention
+            out = flash_attention(qh, kh, vh, self.causal)
+        else:
+            out = dot_product_attention(qh, kh, vh, mask=mask,
+                                        causal=self.causal,
+                                        dropout_rate=drop, dropout_rng=r1)
         out = _dense(params["proj"], merge_heads(out), cd)
         return _dropout(out, self.out_drop, r2, training)
 

@@ -1,0 +1,127 @@
+"""L0 Pallas kernels vs their XLA oracles (interpret mode on the CPU mesh;
+the same code compiles on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.ops.attention import dot_product_attention
+from analytics_zoo_tpu.ops.pallas import flash_attention, int8_matmul
+
+RTOL, ATOL = 2e-4, 2e-5
+
+
+def _qkv(b, h, tq, tk, d, seed=0):
+    rng = np.random.default_rng(seed)
+    q = rng.normal(size=(b, h, tq, d)).astype(np.float32)
+    k = rng.normal(size=(b, h, tk, d)).astype(np.float32)
+    v = rng.normal(size=(b, h, tk, d)).astype(np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_xla_multiblock(causal):
+    # several q and k blocks, t NOT a multiple of the block size
+    q, k, v = _qkv(2, 3, 50, 50, 8)
+    out = flash_attention(q, k, v, causal, 16, 16)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_flash_single_block_and_tiny():
+    q, k, v = _qkv(1, 1, 3, 5, 4, seed=1)
+    out = flash_attention(q, k, v, False, 128, 128)
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_cross_attention_lengths(causal):
+    """tq != tkv, incl. the bottom-right-aligned causal convention."""
+    q, k, v = _qkv(1, 2, 7, 33, 8, seed=2)
+    out = flash_attention(q, k, v, causal, 4, 8)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_flash_bf16():
+    q, k, v = _qkv(1, 2, 32, 32, 8, seed=3)
+    qb, kb, vb = (a.astype(jnp.bfloat16) for a in (q, k, v))
+    out = flash_attention(qb, kb, vb, True, 16, 16)
+    assert out.dtype == jnp.bfloat16
+    ref = dot_product_attention(qb, kb, vb, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match_xla(causal):
+    q, k, v = _qkv(1, 2, 24, 24, 4, seed=4)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal, 8, 8) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_flash_rejects_nothing_when_t_one():
+    q, k, v = _qkv(1, 1, 1, 1, 4, seed=5)
+    out = flash_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(v),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_attention_layer_flash_optin_matches_xla_path():
+    """zoo.pallas.attention=True routes MultiHeadSelfAttention through the
+    flash kernel with identical results."""
+    from analytics_zoo_tpu.common.context import (init_zoo_context,
+                                                  reset_zoo_context)
+    from analytics_zoo_tpu.pipeline.api.keras.layers import \
+        MultiHeadSelfAttention
+
+    x = jnp.asarray(np.random.default_rng(7).normal(size=(2, 12, 16)),
+                    jnp.float32)
+    layer = MultiHeadSelfAttention(16, 4, causal=True)
+    params = layer.build(jax.random.key(0), (None, 12, 16))
+
+    reset_zoo_context()
+    init_zoo_context()
+    y_xla = np.asarray(layer.call(params, x))
+    reset_zoo_context()
+    init_zoo_context(conf={"zoo.pallas.attention": True})
+    y_flash = np.asarray(layer.call(params, x))
+    reset_zoo_context()
+    np.testing.assert_allclose(y_flash, y_xla, rtol=RTOL, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# int8 weight-only matmul
+# ---------------------------------------------------------------------------
+
+def test_int8_matmul_matches_dequant():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(37, 19)).astype(np.float32)
+    w = rng.integers(-127, 128, (19, 29)).astype(np.int8)
+    s = rng.uniform(0.01, 0.1, 29).astype(np.float32)
+    out = int8_matmul(jnp.asarray(x), jnp.asarray(w), jnp.asarray(s),
+                      block_m=16, block_n=8)
+    ref = x @ (w.astype(np.float32) * s[None, :])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-4)
+
+
+def test_int8_matmul_shape_check():
+    with pytest.raises(ValueError):
+        int8_matmul(jnp.zeros((4, 3)), jnp.zeros((5, 2), jnp.int8),
+                    jnp.zeros(2))

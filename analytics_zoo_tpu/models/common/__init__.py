@@ -1,1 +1,3 @@
+from .ranker import (RankerMixin, hit_rate,  # noqa: F401
+                     mean_average_precision, ndcg)
 from .zoo_model import ZooModel, load_model, register_model  # noqa: F401

@@ -20,10 +20,11 @@ import numpy as np
 
 from ...pipeline.api.keras.engine import Input, Model
 from ...pipeline.api.keras.layers import Dense, Embedding, Merge, Select
+from ..common.ranker import RankerMixin
 from ..common.zoo_model import ZooModel, register_model
 
 
-class Recommender(ZooModel):
+class Recommender(RankerMixin, ZooModel):
     """Base recommender — ``models/recommendation/Recommender.scala``:
     convenience prediction APIs over (user, item) pairs."""
 

@@ -21,11 +21,12 @@ import numpy as np
 
 from ...pipeline.api.keras.engine import Input, Lambda, Model, unique_name
 from ...pipeline.api.keras.layers import Dense, Embedding, WordEmbedding
+from ..common.ranker import RankerMixin
 from ..common.zoo_model import ZooModel, register_model
 
 
 @register_model
-class KNRM(ZooModel):
+class KNRM(RankerMixin, ZooModel):
     """``KNRM(text1Length, text2Length, vocabSize, embedSize, kernelNum,
     sigma, exactSigma, targetMode)``."""
 

@@ -175,6 +175,13 @@ def _vjp_bwd(causal, block_q, block_k, interpret, res, g):
         w = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v,
                          preferred_element_type=jnp.float32)
+        if causal:
+            # match the kernel exactly: rows with NO visible key (t_q > t_kv
+            # tails) are zero in the forward, so they must be constants here
+            # too — the -1e9 fill alone would leak uniform-weight gradients
+            has_key = (jnp.arange(s.shape[-2])
+                       + (s.shape[-1] - s.shape[-2])) >= 0
+            out = out * has_key[None, None, :, None].astype(out.dtype)
         return out.astype(v.dtype)
 
     _, vjp = jax.vjp(ref, q, k, v)

@@ -1,0 +1,183 @@
+"""Autograd op library + CustomLoss — parity with
+``pipeline/api/autograd/math.scala:32-365`` and ``CustomLoss.scala``.
+
+The reference builds BigDL graph nodes per op; here each op is a ``Lambda``
+graph node over the package's ``Variable`` handles, so an autograd expression
+IS a Keras graph — it jits, shards, and serializes like any model. Ops accept
+``Variable`` or plain constants (broadcast like the reference's scalars).
+
+``CustomLoss`` turns an autograd expression over (y_true, y_pred) into a loss
+callable usable directly in ``compile(loss=...)`` — the jitted train step
+traces straight through it (no py4j round-trip analogue to pay).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .keras.engine import Input, Lambda, Model, Variable, unique_name
+
+__all__ = ["abs", "sum", "clip", "square", "sqrt", "maximum", "mean", "log",
+           "epsilon", "exp", "pow", "softsign", "softplus", "stack",
+           "expand_dims", "contiguous", "mm", "l2_normalize", "batch_dot",
+           "erf", "CustomLoss"]
+
+def _unary(v: Variable, fn: Callable, name: str) -> Variable:
+    return Lambda(fn, name=unique_name(name + "_"))(v)
+
+
+def _binary(a, b, fn: Callable, name: str) -> Variable:
+    if isinstance(a, Variable) and isinstance(b, Variable):
+        return Lambda(fn, name=unique_name(name + "_"))([a, b])
+    if isinstance(a, Variable):
+        return Lambda(lambda x: fn(x, b), name=unique_name(name + "_"))(a)
+    return Lambda(lambda x: fn(a, x), name=unique_name(name + "_"))(b)
+
+
+def abs(v):  # noqa: A001 — mirrors the reference's op name
+    return _unary(v, jnp.abs, "abs")
+
+
+def sum(v, axis: int = 0, keep_dims: bool = False):  # noqa: A001
+    return _unary(v, lambda a: jnp.sum(a, axis=axis, keepdims=keep_dims),
+                  "sum")
+
+
+def mean(v, axis: int = 0, keep_dims: bool = False):
+    return _unary(v, lambda a: jnp.mean(a, axis=axis, keepdims=keep_dims),
+                  "mean")
+
+
+def clip(v, min: float, max: float):  # noqa: A002
+    return _unary(v, lambda a: jnp.clip(a, min, max), "clip")
+
+
+def square(v):
+    return _unary(v, jnp.square, "square")
+
+
+def sqrt(v):
+    return _unary(v, jnp.sqrt, "sqrt")
+
+
+def log(v):
+    return _unary(v, jnp.log, "log")
+
+
+def exp(v):
+    return _unary(v, jnp.exp, "exp")
+
+
+def erf(v):
+    return _unary(v, jax.scipy.special.erf, "erf")
+
+
+def softsign(v):
+    return _unary(v, lambda a: a / (1.0 + jnp.abs(a)), "softsign")
+
+
+def softplus(v):
+    return _unary(v, jax.nn.softplus, "softplus")
+
+
+def maximum(a, b):
+    return _binary(a, b, jnp.maximum, "maximum")
+
+
+def pow(v, a: float):  # noqa: A001
+    return _unary(v, lambda x: jnp.power(x, a), "pow")
+
+
+def epsilon() -> float:
+    """``AutoGrad.epsilon`` — the fuzz constant."""
+    return 1e-7
+
+
+def stack(inputs: Sequence[Variable], axis: int = 1) -> Variable:
+    """``stack(inputs, axis)`` — join along a NEW axis (reference default
+    axis=1, after batch)."""
+    return Lambda(lambda *xs: jnp.stack(xs, axis=axis),
+                  name=unique_name("stack_"))(list(inputs))
+
+
+def expand_dims(v, axis: int):
+    return _unary(v, lambda a: jnp.expand_dims(a, axis=axis), "expanddims")
+
+
+def contiguous(v):
+    """Layout no-op (XLA owns layout); kept for API parity."""
+    return _unary(v, lambda a: a, "contiguous")
+
+
+def mm(x, y, axes: Optional[Tuple[int, int]] = None):
+    """``mm(x, y, axes)`` — batched matmul contracting ``axes``
+    (``math.scala`` mm; default contracts x's last with y's first non-batch)."""
+    if axes is None:
+        return _binary(
+            x, y, lambda a, b: jnp.matmul(
+                a, b, preferred_element_type=jnp.float32).astype(a.dtype),
+            "mm")
+
+    def f(a, b):
+        return jnp.tensordot(a, b, axes=(axes[0], axes[1]),
+                             preferred_element_type=jnp.float32).astype(a.dtype)
+    return _binary(x, y, f, "mm")
+
+
+def batch_dot(x, y, axes: Tuple[int, int] = (2, 2), normalize: bool = False):
+    """``batchDot(x, y, axes, normalize)`` — per-sample contraction (the
+    KNRM translation-matrix op); ``normalize`` l2-normalizes along the
+    contracted axes first (cosine similarity)."""
+
+    def f(a, b):
+        if normalize:
+            a = a / jnp.maximum(jnp.linalg.norm(a, axis=axes[0],
+                                                keepdims=True), 1e-12)
+            b = b / jnp.maximum(jnp.linalg.norm(b, axis=axes[1],
+                                                keepdims=True), 1e-12)
+        # axes count the batch dim (reference convention); contract
+        # per-sample via vmap'd tensordot
+        td = lambda aa, bb: jnp.tensordot(  # noqa: E731
+            aa, bb, axes=((axes[0] - 1,), (axes[1] - 1,)),
+            preferred_element_type=jnp.float32)
+        return jax.vmap(td)(a, b).astype(a.dtype)
+
+    return _binary(x, y, f, "batchdot")
+
+
+def l2_normalize(v, axis: int):
+    return _unary(
+        v, lambda a: a / jnp.maximum(jnp.linalg.norm(a, axis=axis,
+                                                     keepdims=True), 1e-12),
+        "l2normalize")
+
+
+class CustomLoss:
+    """``CustomLoss.scala`` — a loss defined as an autograd expression.
+
+    >>> def rmse(y_true, y_pred):
+    ...     return A.sqrt(A.mean(A.square(y_true - y_pred), axis=1))
+    >>> model.compile(optimizer="adam", loss=CustomLoss(rmse, (1,)))
+
+    ``loss_fn(y_true, y_pred)`` receives Variables of shape
+    ``(batch,) + y_shape`` and returns a per-sample (or scalar) Variable;
+    the final loss is its mean.
+    """
+
+    def __init__(self, loss_fn: Callable[[Variable, Variable], Variable],
+                 y_pred_shape: Tuple[int, ...],
+                 y_true_shape: Optional[Tuple[int, ...]] = None):
+        yt = Input(shape=tuple(y_true_shape or y_pred_shape))
+        yp = Input(shape=tuple(y_pred_shape))
+        out = loss_fn(yt, yp)
+        if not isinstance(out, Variable):
+            raise TypeError("loss_fn must return an autograd Variable")
+        self._graph = Model([yt, yp], out)
+        self._params = self._graph.build(jax.random.key(0), None)
+
+    def __call__(self, y_true, y_pred):
+        y = self._graph.call(self._params, [y_true, y_pred])
+        return jnp.mean(y)

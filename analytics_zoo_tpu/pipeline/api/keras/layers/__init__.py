@@ -8,12 +8,26 @@ from .normalization import BatchNormalization, LayerNorm, L2Normalize  # noqa: F
 from .convolution import (AtrousConvolution1D, AtrousConvolution2D,  # noqa: F401
                           Convolution1D, Convolution2D, Cropping1D,
                           Cropping2D, Deconvolution2D, LocallyConnected1D,
-                          SeparableConvolution2D, UpSampling1D, UpSampling2D,
+                          SeparableConvolution2D, ShareConvolution2D,
+                          UpSampling1D, UpSampling2D,
                           ZeroPadding1D, ZeroPadding2D)
-from .pooling import (AveragePooling1D, AveragePooling2D,  # noqa: F401
+from .convolution3d import (ConvLSTM2D, Convolution3D, Cropping3D, LRN2D,  # noqa: F401
+                            LocallyConnected2D, MaxoutDense,
+                            SpatialDropout1D, SpatialDropout2D,
+                            SpatialDropout3D, UpSampling3D, ZeroPadding3D)
+from .pooling import (AveragePooling1D, AveragePooling2D, AveragePooling3D,  # noqa: F401
                       GlobalAveragePooling1D, GlobalAveragePooling2D,
-                      GlobalMaxPooling1D, GlobalMaxPooling2D, MaxPooling1D,
-                      MaxPooling2D)
+                      GlobalAveragePooling3D, GlobalMaxPooling1D,
+                      GlobalMaxPooling2D, GlobalMaxPooling3D, MaxPooling1D,
+                      MaxPooling2D, MaxPooling3D)
+from .advanced_activations import (ELU, BinaryThreshold, HardShrink,  # noqa: F401
+                                   HardTanh, LeakyReLU, PReLU, RReLU, SReLU,
+                                   SoftShrink, Softmax, Threshold,
+                                   ThresholdedReLU)
+from .elementwise import (AddConstant, CAdd, CMul, Exp, Expand,  # noqa: F401
+                          GaussianSampler, Log, Max, Mul, MulConstant,
+                          Negative, Power, ResizeBilinear, Scale, Sqrt,
+                          Square)
 from .recurrent import GRU, LSTM, Bidirectional, SimpleRNN  # noqa: F401
 from .self_attention import (BERT, MultiHeadSelfAttention,  # noqa: F401
                              TransformerBlock, TransformerLayer)

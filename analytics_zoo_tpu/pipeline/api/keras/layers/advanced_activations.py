@@ -1,0 +1,169 @@
+"""Advanced activation layers — parity with the reference's
+``keras/layers/{LeakyReLU,ELU,PReLU,SReLU,ThresholdedReLU,RReLU,Softmax,
+HardTanh,HardShrink,SoftShrink,Threshold,BinaryThreshold}.scala`` (all thin
+wrappers over BigDL nn modules there; here each is a direct VPU-friendly
+elementwise expression XLA fuses into neighbours).
+
+Learnable ones (PReLU, SReLU) carry per-channel parameters like the
+reference's defaults.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import Layer, param_dtype
+
+__all__ = ["LeakyReLU", "ELU", "PReLU", "SReLU", "ThresholdedReLU", "RReLU",
+           "Softmax", "HardTanh", "HardShrink", "SoftShrink", "Threshold",
+           "BinaryThreshold"]
+
+
+class LeakyReLU(Layer):
+    """``LeakyReLU(alpha)``: x if x > 0 else alpha*x."""
+
+    def __init__(self, alpha: float = 0.01, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = float(alpha)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x > 0, x, self.alpha * x)
+
+
+class ELU(Layer):
+    """``ELU(alpha)``: x if x > 0 else alpha*(exp(x)-1)."""
+
+    def __init__(self, alpha: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = float(alpha)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x > 0, x, self.alpha * jnp.expm1(x))
+
+
+class PReLU(Layer):
+    """``PReLU.scala`` — learnable per-channel negative slope (init 0.25)."""
+
+    def build(self, rng, input_shape):
+        ch = input_shape[-1]
+        return {"alpha": jnp.full((ch,), 0.25, param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        a = params["alpha"].astype(x.dtype)
+        return jnp.where(x > 0, x, a * x)
+
+
+class SReLU(Layer):
+    """``SReLU.scala`` — s-shaped ReLU with 4 learnable per-channel params:
+    y = t_r + a_r(x - t_r) for x >= t_r; x in between; t_l + a_l(x - t_l)
+    for x <= t_l."""
+
+    def build(self, rng, input_shape):
+        ch = input_shape[-1]
+        z = jnp.zeros((ch,), param_dtype())
+        return {"t_left": z, "a_left": z,
+                "t_right": jnp.ones((ch,), param_dtype()),
+                "a_right": jnp.ones((ch,), param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        tl = params["t_left"].astype(x.dtype)
+        al = params["a_left"].astype(x.dtype)
+        tr = params["t_right"].astype(x.dtype)
+        ar = params["a_right"].astype(x.dtype)
+        y = jnp.where(x >= tr, tr + ar * (x - tr), x)
+        return jnp.where(x <= tl, tl + al * (x - tl), y)
+
+
+class ThresholdedReLU(Layer):
+    """``ThresholdedReLU(theta)``: x if x > theta else 0."""
+
+    def __init__(self, theta: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.theta = float(theta)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x > self.theta, x, jnp.zeros_like(x))
+
+
+class RReLU(Layer):
+    """``RReLU(lower, upper)`` — randomized leaky: training samples the
+    negative slope ~ U(lower, upper) per element; inference uses the mean."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.lower, self.upper = float(lower), float(upper)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if training and rng is not None:
+            a = jax.random.uniform(rng, x.shape, x.dtype, self.lower,
+                                   self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, a * x)
+
+
+class Softmax(Layer):
+    """``Softmax.scala`` as a standalone layer (last axis)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class HardTanh(Layer):
+    """``HardTanh(min_value, max_value)``: clip."""
+
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.min_value, self.max_value = float(min_value), float(max_value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class HardShrink(Layer):
+    """``HardShrink(value)``: x if |x| > value else 0."""
+
+    def __init__(self, value: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.value = float(value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(jnp.abs(x) > self.value, x, jnp.zeros_like(x))
+
+
+class SoftShrink(Layer):
+    """``SoftShrink(value)``: x -/+ value outside the band, 0 inside."""
+
+    def __init__(self, value: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.value = float(value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x > self.value, x - self.value,
+                         jnp.where(x < -self.value, x + self.value,
+                                   jnp.zeros_like(x)))
+
+
+class Threshold(Layer):
+    """``Threshold(th, v)``: x if x > th else v."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.th, self.v = float(th), float(v)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x > self.th, x, jnp.full_like(x, self.v))
+
+
+class BinaryThreshold(Layer):
+    """``BinaryThreshold(th)``: 1 where x > th else 0."""
+
+    def __init__(self, th: float = 1e-6, **kwargs):
+        super().__init__(**kwargs)
+        self.th = float(th)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return (x > self.th).astype(x.dtype)

@@ -346,3 +346,22 @@ class LocallyConnected1D(Layer):
         if self.activation is not None:
             y = self.activation(y)
         return y
+
+
+class ShareConvolution2D(Convolution2D):
+    """``ShareConvolution2D.scala`` — the reference variant whose weight
+    buffers are shared across replicas (a JVM memory concern); functionally a
+    ``Convolution2D`` with explicit pad_h/pad_w, which is all that survives
+    the functional re-design (params are immutable pytrees — sharing is the
+    default, XLA donates/aliases buffers)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 pad_h: int = 0, pad_w: int = 0, **kwargs):
+        super().__init__(nb_filter, nb_row, nb_col, **kwargs)
+        self.pad_h, self.pad_w = int(pad_h), int(pad_w)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if self.pad_h or self.pad_w:
+            x = jnp.pad(x, ((0, 0), (self.pad_h, self.pad_h),
+                            (self.pad_w, self.pad_w), (0, 0)))
+        return super().call(params, x, training=training, rng=rng)

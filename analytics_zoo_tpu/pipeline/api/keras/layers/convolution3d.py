@@ -1,0 +1,295 @@
+"""3D convolution family + the structured-conv extras — parity with the
+reference's ``keras/layers/{Convolution3D,ConvLSTM2D,ZeroPadding3D,
+Cropping3D,UpSampling3D,SpatialDropout1D/2D/3D,LocallyConnected2D,
+ShareConvolution2D,MaxoutDense,LRN2D}.scala``.
+
+All channels-last (the reference's NCDHW maps to NDHWC on TPU: depth/height/
+width become spatial dims of one ``conv_general_dilated``, which XLA tiles
+onto the MXU like any conv).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..engine import Layer, compute_dtype, get_initializer, param_dtype
+from ._shapes import triple as _triple
+from .core import get_activation
+
+
+def _padding3(mode: str):
+    return mode.upper() if isinstance(mode, str) else mode
+
+
+class Convolution3D(Layer):
+    """``Convolution3D(nb_filter, kernel_dim1..3)`` — input (B, D, H, W, C)."""
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, init: str = "glorot_uniform",
+                 activation=None, border_mode: str = "valid",
+                 subsample: Tuple[int, int, int] = (1, 1, 1),
+                 bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.kernel = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.init = init
+        self.activation = get_activation(activation)
+        self.border_mode = border_mode
+        self.subsample = _triple(subsample)
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        p = {"W": get_initializer(self.init)(
+            rng, self.kernel + (in_ch, self.nb_filter), param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        y = lax.conv_general_dilated(
+            x.astype(cd), params["W"].astype(cd),
+            window_strides=self.subsample,
+            padding=_padding3(self.border_mode),
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            preferred_element_type=jnp.float32).astype(cd)
+        if self.bias:
+            y = y + params["b"].astype(cd)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+
+class ZeroPadding3D(Layer):
+    def __init__(self, padding: Tuple[int, int, int] = (1, 1, 1), **kwargs):
+        super().__init__(**kwargs)
+        self.padding = _triple(padding)
+
+    def call(self, params, x, *, training=False, rng=None):
+        p = self.padding
+        return jnp.pad(x, ((0, 0), (p[0], p[0]), (p[1], p[1]),
+                           (p[2], p[2]), (0, 0)))
+
+
+class Cropping3D(Layer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = tuple((int(a), int(b)) for a, b in cropping)
+
+    def call(self, params, x, *, training=False, rng=None):
+        (d0, d1), (h0, h1), (w0, w1) = self.cropping
+        return x[:, d0:x.shape[1] - d1, h0:x.shape[2] - h1,
+                 w0:x.shape[3] - w1, :]
+
+
+class UpSampling3D(Layer):
+    def __init__(self, size: Tuple[int, int, int] = (2, 2, 2), **kwargs):
+        super().__init__(**kwargs)
+        self.size = _triple(size)
+
+    def call(self, params, x, *, training=False, rng=None):
+        for axis, r in zip((1, 2, 3), self.size):
+            x = jnp.repeat(x, r, axis=axis)
+        return x
+
+
+class _SpatialDropoutBase(Layer):
+    """Drop whole channels: the mask broadcasts over all spatial dims."""
+    ndim_spatial = 1
+
+    def __init__(self, p: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training or rng is None or self.p <= 0.0:
+            return x
+        mask_shape = (x.shape[0],) + (1,) * self.ndim_spatial + (x.shape[-1],)
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, mask_shape)
+        return jnp.where(keep, x / (1.0 - self.p), jnp.zeros_like(x))
+
+
+class SpatialDropout1D(_SpatialDropoutBase):
+    ndim_spatial = 1
+
+
+class SpatialDropout2D(_SpatialDropoutBase):
+    ndim_spatial = 2
+
+
+class SpatialDropout3D(_SpatialDropoutBase):
+    ndim_spatial = 3
+
+
+class ConvLSTM2D(Layer):
+    """``ConvLSTM2D(nb_filter, nb_kernel)`` — LSTM whose gates are 'same'
+    2D convs. Input (B, T, H, W, C) → (B, H, W, F) or the full sequence
+    (B, T, H, W, F) with ``return_sequences``. The time loop is a
+    ``lax.scan`` (one compiled step body, like the package's LSTM/GRU)."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int,
+                 init: str = "glorot_uniform",
+                 inner_activation="hard_sigmoid", activation="tanh",
+                 return_sequences: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.nb_kernel = int(nb_kernel)
+        self.init = init
+        self.inner_activation = get_activation(inner_activation)
+        self.activation = get_activation(activation)
+        self.return_sequences = return_sequences
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        k = self.nb_kernel
+        kx, kh = jax.random.split(rng)
+        return {
+            "Wx": get_initializer(self.init)(
+                kx, (k, k, in_ch, 4 * self.nb_filter), param_dtype()),
+            "Wh": get_initializer(self.init)(
+                kh, (k, k, self.nb_filter, 4 * self.nb_filter), param_dtype()),
+            "b": jnp.zeros((4 * self.nb_filter,), param_dtype()),
+        }
+
+    def call(self, params, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        b, t, h, w, _ = x.shape
+        f = self.nb_filter
+
+        def conv(inp, kern):
+            return lax.conv_general_dilated(
+                inp, kern, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32).astype(cd)
+
+        wx = params["Wx"].astype(cd)
+        wh = params["Wh"].astype(cd)
+        bias = params["b"].astype(cd)
+
+        def step(carry, x_t):
+            h_prev, c_prev = carry
+            z = conv(x_t, wx) + conv(h_prev, wh) + bias
+            i, fgate, g, o = jnp.split(z, 4, axis=-1)
+            i = self.inner_activation(i)
+            fgate = self.inner_activation(fgate)
+            o = self.inner_activation(o)
+            c = fgate * c_prev + i * self.activation(g)
+            h_new = o * self.activation(c)
+            return (h_new, c), h_new
+
+        h0 = jnp.zeros((b, h, w, f), cd)
+        xs = jnp.moveaxis(x.astype(cd), 1, 0)          # (T, B, H, W, C)
+        (h_last, _), hs = lax.scan(step, (h0, h0), xs)
+        if self.return_sequences:
+            return jnp.moveaxis(hs, 0, 1)              # (B, T, H, W, F)
+        return h_last
+
+
+class LocallyConnected2D(Layer):
+    """``LocallyConnected2D.scala`` — conv with UNSHARED weights per output
+    position: patches are extracted once, then one einsum against the
+    (H'·W', k·k·C, F) weight tensor (a single batched MXU contraction)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample: Tuple[int, int] = (1, 1),
+                 bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.nb_row, self.nb_col = int(nb_row), int(nb_col)
+        self.activation = get_activation(activation)
+        self.subsample = (int(subsample[0]), int(subsample[1])) \
+            if isinstance(subsample, (tuple, list)) else (int(subsample),) * 2
+        self.bias = bias
+
+    def _out_hw(self, h, w):
+        oh = (h - self.nb_row) // self.subsample[0] + 1
+        ow = (w - self.nb_col) // self.subsample[1] + 1
+        return oh, ow
+
+    def build(self, rng, input_shape):
+        _, h, w, c = input_shape
+        oh, ow = self._out_hw(h, w)
+        patch = self.nb_row * self.nb_col * c
+        p = {"W": get_initializer("glorot_uniform")(
+            rng, (oh * ow, patch, self.nb_filter), param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((oh, ow, self.nb_filter), param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        _, h, w, c = x.shape
+        oh, ow = self._out_hw(h, w)
+        patches = lax.conv_general_dilated_patches(
+            x.astype(cd), (self.nb_row, self.nb_col), self.subsample,
+            "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # conv_general_dilated_patches yields features ordered (C, kh, kw);
+        # reorder to (kh, kw, C) to match the W layout
+        patches = patches.reshape(x.shape[0], oh, ow, c,
+                                  self.nb_row * self.nb_col)
+        patches = jnp.moveaxis(patches, 3, -1)
+        patches = patches.reshape(x.shape[0], oh * ow, -1)
+        y = jnp.einsum("bpk,pkf->bpf", patches, params["W"].astype(cd),
+                       preferred_element_type=jnp.float32).astype(cd)
+        y = y.reshape(x.shape[0], oh, ow, self.nb_filter)
+        if self.bias:
+            y = y + params["b"].astype(cd)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+
+class MaxoutDense(Layer):
+    """``MaxoutDense(output_dim, nb_feature)`` — max over nb_feature linear
+    pieces."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.output_dim = int(output_dim)
+        self.nb_feature = int(nb_feature)
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        in_dim = input_shape[-1]
+        p = {"W": get_initializer("glorot_uniform")(
+            rng, (in_dim, self.nb_feature * self.output_dim), param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_feature * self.output_dim,),
+                               param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        y = jnp.matmul(x.astype(cd), params["W"].astype(cd),
+                       preferred_element_type=jnp.float32).astype(cd)
+        if self.bias:
+            y = y + params["b"].astype(cd)
+        y = y.reshape(x.shape[:-1] + (self.nb_feature, self.output_dim))
+        return jnp.max(y, axis=-2)
+
+
+class LRN2D(Layer):
+    """``LRN2D(alpha, k, beta, n)`` — cross-channel local response norm:
+    x / (k + alpha/n * sum_{window n} x^2) ** beta (channels-last)."""
+
+    def __init__(self, alpha: float = 1e-4, k: float = 1.0,
+                 beta: float = 0.75, n: int = 5, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha, self.k, self.beta, self.n = (float(alpha), float(k),
+                                                 float(beta), int(n))
+
+    def call(self, params, x, *, training=False, rng=None):
+        half = self.n // 2
+        sq = jnp.square(x.astype(jnp.float32))
+        # sliding channel-window sum via padded cumulative trick
+        pad = jnp.pad(sq, ((0, 0),) * (x.ndim - 1) + ((half, half),))
+        win = sum(lax.slice_in_dim(pad, i, i + x.shape[-1], axis=-1)
+                  for i in range(self.n))
+        denom = jnp.power(self.k + self.alpha / self.n * win, self.beta)
+        return (x.astype(jnp.float32) / denom).astype(x.dtype)

@@ -1,0 +1,179 @@
+"""Elementwise / tensor-op layers — parity with the reference's
+``keras/layers/{AddConstant,MulConstant,Negative,Power,Exp,Log,Sqrt,Square,
+Mul,CAdd,CMul,Scale,Max,Expand,GaussianSampler,ResizeBilinear}.scala``.
+Dim conventions follow the package's Select/Squeeze style: 0 = batch,
+negatives allowed.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import Layer, param_dtype
+
+__all__ = ["AddConstant", "MulConstant", "Negative", "Power", "Exp", "Log",
+           "Sqrt", "Square", "Mul", "CAdd", "CMul", "Scale", "Max",
+           "Expand", "GaussianSampler", "ResizeBilinear"]
+
+
+class AddConstant(Layer):
+    def __init__(self, constant: float, **kwargs):
+        super().__init__(**kwargs)
+        self.constant = float(constant)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x + self.constant
+
+
+class MulConstant(Layer):
+    def __init__(self, constant: float, **kwargs):
+        super().__init__(**kwargs)
+        self.constant = float(constant)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * self.constant
+
+
+class Negative(Layer):
+    def call(self, params, x, *, training=False, rng=None):
+        return -x
+
+
+class Power(Layer):
+    """``Power(power, scale, shift)``: (shift + scale * x) ** power."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.power, self.scale, self.shift = (float(power), float(scale),
+                                              float(shift))
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Exp(Layer):
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.exp(x)
+
+
+class Log(Layer):
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.log(x)
+
+
+class Sqrt(Layer):
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.sqrt(x)
+
+
+class Square(Layer):
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.square(x)
+
+
+class Mul(Layer):
+    """``Mul.scala`` — ONE learnable scalar multiplier."""
+
+    def build(self, rng, input_shape):
+        return {"w": jnp.ones((1,), param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * params["w"].astype(x.dtype)
+
+
+class CAdd(Layer):
+    """``CAdd(size)`` — learnable bias of ``size``, broadcast-added."""
+
+    def __init__(self, size: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape):
+        return {"bias": jnp.zeros(self.size, param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x + params["bias"].astype(x.dtype)
+
+
+class CMul(Layer):
+    """``CMul(size)`` — learnable scale of ``size``, broadcast-multiplied."""
+
+    def __init__(self, size: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size, param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * params["weight"].astype(x.dtype)
+
+
+class Scale(Layer):
+    """``Scale(size)`` — CMul then CAdd (affine per broadcastable block)."""
+
+    def __init__(self, size: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size, param_dtype()),
+                "bias": jnp.zeros(self.size, param_dtype())}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return (x * params["weight"].astype(x.dtype)
+                + params["bias"].astype(x.dtype))
+
+
+class Max(Layer):
+    """``Max(dim)`` — max-reduce one axis (0 = batch, like Select)."""
+
+    def __init__(self, dim: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.max(x, axis=self.dim)
+
+
+class Expand(Layer):
+    """``Expand`` — broadcast singleton dims up to ``shape`` (sans batch)."""
+
+    def __init__(self, shape: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.shape = tuple(int(s) for s in shape)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.broadcast_to(x, (x.shape[0],) + self.shape)
+
+
+class GaussianSampler(Layer):
+    """``GaussianSampler.scala`` — the VAE reparameterization: input
+    ``[mean, log_var]`` → mean + exp(log_var/2) * eps. Deterministic (mean)
+    when no rng is supplied (inference)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        mean, log_var = x
+        if rng is None:
+            return mean
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(0.5 * log_var) * eps
+
+
+class ResizeBilinear(Layer):
+    """``ResizeBilinear(output_height, output_width)`` — channels-last
+    bilinear resize (``jax.image.resize``, align_corners=False semantics)."""
+
+    def __init__(self, output_height: int, output_width: int, **kwargs):
+        super().__init__(**kwargs)
+        self.output_height = int(output_height)
+        self.output_width = int(output_width)
+
+    def call(self, params, x, *, training=False, rng=None):
+        b, _, _, c = x.shape
+        return jax.image.resize(
+            x, (b, self.output_height, self.output_width, c), "bilinear")

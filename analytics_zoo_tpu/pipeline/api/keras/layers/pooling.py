@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..engine import Layer
+from ._shapes import triple as _triple
 
 
 def _pair(v) -> Tuple[int, int]:
@@ -117,3 +118,50 @@ class GlobalMaxPooling2D(Layer):
 class GlobalAveragePooling2D(Layer):
     def call(self, params, x, *, training=False, rng=None):
         return jnp.mean(x, axis=(1, 2))
+
+
+class MaxPooling3D(Layer):
+    """``MaxPooling3D(pool_size, strides, border_mode)`` — (B, D, H, W, C)."""
+
+    def __init__(self, pool_size: Tuple[int, int, int] = (2, 2, 2),
+                 strides: Optional[Tuple[int, int, int]] = None,
+                 border_mode: str = "valid", **kwargs):
+        super().__init__(**kwargs)
+        self.pool_size = _triple(pool_size)
+        self.strides = (_triple(strides) if strides is not None
+                        else self.pool_size)
+        self.border_mode = border_mode.upper()
+
+    def call(self, params, x, *, training=False, rng=None):
+        return _pool(x, -jnp.inf, lax.max, self.pool_size, self.strides,
+                     self.border_mode)
+
+
+class AveragePooling3D(Layer):
+    """``AveragePooling3D(pool_size, strides, border_mode)``."""
+
+    def __init__(self, pool_size: Tuple[int, int, int] = (2, 2, 2),
+                 strides: Optional[Tuple[int, int, int]] = None,
+                 border_mode: str = "valid", **kwargs):
+        super().__init__(**kwargs)
+        self.pool_size = _triple(pool_size)
+        self.strides = (_triple(strides) if strides is not None
+                        else self.pool_size)
+        self.border_mode = border_mode.upper()
+
+    def call(self, params, x, *, training=False, rng=None):
+        s = _pool(x.astype(jnp.float32), 0.0, lax.add, self.pool_size,
+                  self.strides, self.border_mode)
+        n = _pool(jnp.ones_like(x, jnp.float32), 0.0, lax.add,
+                  self.pool_size, self.strides, self.border_mode)
+        return (s / n).astype(x.dtype)
+
+
+class GlobalMaxPooling3D(Layer):
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.max(x, axis=(1, 2, 3))
+
+
+class GlobalAveragePooling3D(Layer):
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.mean(x, axis=(1, 2, 3))

@@ -1,0 +1,12 @@
+"""Keras-2 style API — parity with ``pyzoo/zoo/pipeline/api/keras2`` (the
+reference maintains a second layer namespace with Keras-2 argument
+conventions: ``units``/``filters``/``kernel_size``/``rate``/``padding``/
+``use_bias``/``kernel_initializer`` instead of Keras-1's ``output_dim``/
+``nb_filter``/``p``/``border_mode``/``init``).
+
+Here every keras2 symbol is a thin constructor adapter over the SAME layer
+classes as ``api.keras.layers`` — one graph engine, two argument dialects —
+so keras2-built models train, shard, and serialize identically.
+"""
+
+from . import layers  # noqa: F401

@@ -1,0 +1,357 @@
+"""Keras-2 argument-dialect constructors (see package docstring).
+
+Each function returns a configured layer from ``api.keras.layers`` — the
+keras2 namespace adds NO new layer semantics, exactly like the reference
+(its keras2 classes call the same BigDL modules with renamed args,
+``pyzoo/zoo/pipeline/api/keras2/layers/core.py:26-160``).
+"""
+
+from __future__ import annotations
+
+from ..keras import layers as K1
+from ..keras.engine import Input, Model, Sequential  # noqa: F401 (re-export)
+
+__all__ = [
+    "Input", "Model", "Sequential",
+    "Dense", "Activation", "Dropout", "Flatten", "Reshape", "Permute",
+    "RepeatVector", "Masking", "Embedding",
+    "Conv1D", "Conv2D", "Conv3D", "SeparableConv2D", "Conv2DTranspose",
+    "LocallyConnected1D", "LocallyConnected2D",
+    "Cropping1D", "Cropping2D", "Cropping3D",
+    "UpSampling1D", "UpSampling2D", "UpSampling3D",
+    "ZeroPadding1D", "ZeroPadding2D", "ZeroPadding3D",
+    "MaxPooling1D", "MaxPooling2D", "MaxPooling3D",
+    "AveragePooling1D", "AveragePooling2D", "AveragePooling3D",
+    "GlobalMaxPooling1D", "GlobalMaxPooling2D", "GlobalMaxPooling3D",
+    "GlobalAveragePooling1D", "GlobalAveragePooling2D",
+    "GlobalAveragePooling3D",
+    "BatchNormalization", "LayerNormalization",
+    "LSTM", "GRU", "SimpleRNN", "Bidirectional", "TimeDistributed",
+    "LeakyReLU", "ELU", "PReLU", "ThresholdedReLU", "Softmax",
+    "GaussianNoise", "GaussianDropout",
+    "SpatialDropout1D", "SpatialDropout2D", "SpatialDropout3D",
+    "add", "multiply", "average", "maximum", "concatenate", "dot",
+]
+
+
+from ..keras.layers._shapes import pair as _pair, triple as _triple  # noqa: E402
+
+
+# --- core ------------------------------------------------------------------
+
+def Dense(units, activation=None, use_bias=True,
+          kernel_initializer="glorot_uniform", input_dim=None,
+          input_shape=None, **kwargs):
+    if input_dim is not None:
+        input_shape = (input_dim,)
+    return K1.Dense(units, init=kernel_initializer, activation=activation,
+                    bias=use_bias, input_shape=input_shape, **kwargs)
+
+
+def Activation(activation, **kwargs):
+    return K1.Activation(activation, **kwargs)
+
+
+def Dropout(rate, **kwargs):
+    return K1.Dropout(rate, **kwargs)
+
+
+def Flatten(**kwargs):
+    return K1.Flatten(**kwargs)
+
+
+def Reshape(target_shape, **kwargs):
+    return K1.Reshape(target_shape, **kwargs)
+
+
+def Permute(dims, **kwargs):
+    return K1.Permute(dims, **kwargs)
+
+
+def RepeatVector(n, **kwargs):
+    return K1.RepeatVector(n, **kwargs)
+
+
+def Masking(mask_value=0.0, **kwargs):
+    return K1.Masking(mask_value, **kwargs)
+
+
+def Embedding(input_dim, output_dim, input_length=None, **kwargs):
+    if input_length is not None:
+        kwargs.setdefault("input_shape", (input_length,))
+    return K1.Embedding(input_dim, output_dim, **kwargs)
+
+
+# --- convolution -----------------------------------------------------------
+
+def Conv1D(filters, kernel_size, strides=1, padding="valid",
+           dilation_rate=1, activation=None, use_bias=True,
+           kernel_initializer="glorot_uniform", **kwargs):
+    return K1.Convolution1D(filters, kernel_size, init=kernel_initializer,
+                            activation=activation, border_mode=padding,
+                            subsample_length=strides,
+                            dilation_rate=dilation_rate, bias=use_bias,
+                            **kwargs)
+
+
+def Conv2D(filters, kernel_size, strides=(1, 1), padding="valid",
+           dilation_rate=(1, 1), activation=None, use_bias=True,
+           kernel_initializer="glorot_uniform", **kwargs):
+    kh, kw = _pair(kernel_size)
+    return K1.Convolution2D(filters, kh, kw, init=kernel_initializer,
+                            activation=activation, border_mode=padding,
+                            subsample=_pair(strides),
+                            dilation=_pair(dilation_rate), bias=use_bias,
+                            **kwargs)
+
+
+def Conv3D(filters, kernel_size, strides=(1, 1, 1), padding="valid",
+           activation=None, use_bias=True,
+           kernel_initializer="glorot_uniform", **kwargs):
+    k1, k2, k3 = _triple(kernel_size)
+    return K1.Convolution3D(filters, k1, k2, k3, init=kernel_initializer,
+                            activation=activation, border_mode=padding,
+                            subsample=_triple(strides), bias=use_bias,
+                            **kwargs)
+
+
+def SeparableConv2D(filters, kernel_size, strides=(1, 1), padding="valid",
+                    depth_multiplier=1, activation=None, use_bias=True,
+                    **kwargs):
+    kh, kw = _pair(kernel_size)
+    return K1.SeparableConvolution2D(filters, kh, kw, activation=activation,
+                                     border_mode=padding,
+                                     subsample=_pair(strides),
+                                     depth_multiplier=depth_multiplier,
+                                     bias=use_bias, **kwargs)
+
+
+def Conv2DTranspose(filters, kernel_size, strides=(1, 1), padding="valid",
+                    activation=None, use_bias=True, **kwargs):
+    if padding != "valid":
+        raise ValueError("Conv2DTranspose supports only padding='valid' "
+                         "(like the reference's Deconvolution2D)")
+    kh, kw = _pair(kernel_size)
+    return K1.Deconvolution2D(filters, kh, kw, activation=activation,
+                              subsample=_pair(strides), bias=use_bias,
+                              **kwargs)
+
+
+def LocallyConnected1D(filters, kernel_size, activation=None, use_bias=True,
+                       **kwargs):
+    return K1.LocallyConnected1D(filters, kernel_size, activation=activation,
+                                 bias=use_bias, **kwargs)
+
+
+def LocallyConnected2D(filters, kernel_size, strides=(1, 1), activation=None,
+                       use_bias=True, **kwargs):
+    kh, kw = _pair(kernel_size)
+    return K1.LocallyConnected2D(filters, kh, kw, activation=activation,
+                                 subsample=_pair(strides), bias=use_bias,
+                                 **kwargs)
+
+
+def Cropping1D(cropping=(1, 1), **kwargs):
+    return K1.Cropping1D(cropping, **kwargs)
+
+
+def Cropping2D(cropping=((0, 0), (0, 0)), **kwargs):
+    return K1.Cropping2D(cropping, **kwargs)
+
+
+def Cropping3D(cropping=((1, 1), (1, 1), (1, 1)), **kwargs):
+    return K1.Cropping3D(cropping, **kwargs)
+
+
+def UpSampling1D(size=2, **kwargs):
+    return K1.UpSampling1D(size, **kwargs)
+
+
+def UpSampling2D(size=(2, 2), **kwargs):
+    return K1.UpSampling2D(_pair(size), **kwargs)
+
+
+def UpSampling3D(size=(2, 2, 2), **kwargs):
+    return K1.UpSampling3D(_triple(size), **kwargs)
+
+
+def ZeroPadding1D(padding=1, **kwargs):
+    return K1.ZeroPadding1D(padding, **kwargs)
+
+
+def ZeroPadding2D(padding=(1, 1), **kwargs):
+    return K1.ZeroPadding2D(_pair(padding), **kwargs)
+
+
+def ZeroPadding3D(padding=(1, 1, 1), **kwargs):
+    return K1.ZeroPadding3D(_triple(padding), **kwargs)
+
+
+# --- pooling ---------------------------------------------------------------
+
+def MaxPooling1D(pool_size=2, strides=None, padding="valid", **kwargs):
+    return K1.MaxPooling1D(pool_size, strides, border_mode=padding, **kwargs)
+
+
+def MaxPooling2D(pool_size=(2, 2), strides=None, padding="valid", **kwargs):
+    return K1.MaxPooling2D(_pair(pool_size),
+                           _pair(strides) if strides is not None else None,
+                           border_mode=padding, **kwargs)
+
+
+def MaxPooling3D(pool_size=(2, 2, 2), strides=None, padding="valid",
+                 **kwargs):
+    return K1.MaxPooling3D(_triple(pool_size),
+                           _triple(strides) if strides is not None else None,
+                           border_mode=padding, **kwargs)
+
+
+def AveragePooling1D(pool_size=2, strides=None, padding="valid", **kwargs):
+    return K1.AveragePooling1D(pool_size, strides, border_mode=padding,
+                               **kwargs)
+
+
+def AveragePooling2D(pool_size=(2, 2), strides=None, padding="valid",
+                     **kwargs):
+    return K1.AveragePooling2D(_pair(pool_size),
+                               _pair(strides) if strides is not None else None,
+                               border_mode=padding, **kwargs)
+
+
+def AveragePooling3D(pool_size=(2, 2, 2), strides=None, padding="valid",
+                     **kwargs):
+    return K1.AveragePooling3D(
+        _triple(pool_size),
+        _triple(strides) if strides is not None else None,
+        border_mode=padding, **kwargs)
+
+
+def GlobalMaxPooling1D(**kwargs):
+    return K1.GlobalMaxPooling1D(**kwargs)
+
+
+def GlobalMaxPooling2D(**kwargs):
+    return K1.GlobalMaxPooling2D(**kwargs)
+
+
+def GlobalMaxPooling3D(**kwargs):
+    return K1.GlobalMaxPooling3D(**kwargs)
+
+
+def GlobalAveragePooling1D(**kwargs):
+    return K1.GlobalAveragePooling1D(**kwargs)
+
+
+def GlobalAveragePooling2D(**kwargs):
+    return K1.GlobalAveragePooling2D(**kwargs)
+
+
+def GlobalAveragePooling3D(**kwargs):
+    return K1.GlobalAveragePooling3D(**kwargs)
+
+
+# --- normalization ---------------------------------------------------------
+
+def BatchNormalization(momentum=0.99, epsilon=1e-3, **kwargs):
+    return K1.BatchNormalization(epsilon=epsilon, momentum=momentum, **kwargs)
+
+
+def LayerNormalization(epsilon=1e-5, **kwargs):
+    return K1.LayerNorm(epsilon=epsilon, **kwargs)
+
+
+# --- recurrent -------------------------------------------------------------
+
+def LSTM(units, activation="tanh", recurrent_activation="hard_sigmoid",
+         return_sequences=False, **kwargs):
+    return K1.LSTM(units, activation=activation,
+                   inner_activation=recurrent_activation,
+                   return_sequences=return_sequences, **kwargs)
+
+
+def GRU(units, activation="tanh", recurrent_activation="hard_sigmoid",
+        return_sequences=False, **kwargs):
+    return K1.GRU(units, activation=activation,
+                  inner_activation=recurrent_activation,
+                  return_sequences=return_sequences, **kwargs)
+
+
+def SimpleRNN(units, activation="tanh", return_sequences=False, **kwargs):
+    return K1.SimpleRNN(units, activation=activation,
+                        return_sequences=return_sequences, **kwargs)
+
+
+def Bidirectional(layer, merge_mode="concat", **kwargs):
+    return K1.Bidirectional(layer, merge_mode=merge_mode, **kwargs)
+
+
+def TimeDistributed(layer, **kwargs):
+    return K1.TimeDistributed(layer, **kwargs)
+
+
+# --- activations / noise ---------------------------------------------------
+
+def LeakyReLU(alpha=0.3, **kwargs):
+    return K1.LeakyReLU(alpha, **kwargs)
+
+
+def ELU(alpha=1.0, **kwargs):
+    return K1.ELU(alpha, **kwargs)
+
+
+def PReLU(**kwargs):
+    return K1.PReLU(**kwargs)
+
+
+def ThresholdedReLU(theta=1.0, **kwargs):
+    return K1.ThresholdedReLU(theta, **kwargs)
+
+
+def Softmax(**kwargs):
+    return K1.Softmax(**kwargs)
+
+
+def GaussianNoise(stddev, **kwargs):
+    return K1.GaussianNoise(stddev, **kwargs)
+
+
+def GaussianDropout(rate, **kwargs):
+    return K1.GaussianDropout(rate, **kwargs)
+
+
+def SpatialDropout1D(rate=0.5, **kwargs):
+    return K1.SpatialDropout1D(rate, **kwargs)
+
+
+def SpatialDropout2D(rate=0.5, **kwargs):
+    return K1.SpatialDropout2D(rate, **kwargs)
+
+
+def SpatialDropout3D(rate=0.5, **kwargs):
+    return K1.SpatialDropout3D(rate, **kwargs)
+
+
+# --- functional merges -----------------------------------------------------
+
+def add(inputs, **kwargs):
+    return K1.merge(inputs, mode="sum", **kwargs)
+
+
+def multiply(inputs, **kwargs):
+    return K1.merge(inputs, mode="mul", **kwargs)
+
+
+def average(inputs, **kwargs):
+    return K1.merge(inputs, mode="ave", **kwargs)
+
+
+def maximum(inputs, **kwargs):
+    return K1.merge(inputs, mode="max", **kwargs)
+
+
+def concatenate(inputs, axis=-1, **kwargs):
+    return K1.merge(inputs, mode="concat", concat_axis=axis, **kwargs)
+
+
+def dot(inputs, **kwargs):
+    return K1.merge(inputs, mode="dot", **kwargs)

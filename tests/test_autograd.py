@@ -1,0 +1,122 @@
+"""Autograd op library + CustomLoss (math.scala:32-365, CustomLoss.scala)."""
+
+import jax
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu.pipeline.api.autograd as A
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras.engine import (Input, Model,
+                                                         Sequential)
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+
+def _run(expr_fn, *arrays):
+    """Build a graph from Input shapes of the arrays, run it on them."""
+    init_zoo_context()
+    import jax.numpy as jnp
+    ins = [Input(shape=a.shape[1:]) for a in arrays]
+    out = expr_fn(*ins)
+    m = Model(ins if len(ins) > 1 else ins[0], out)
+    p = m.build(jax.random.key(0), None)
+    xs = [jnp.asarray(a) for a in arrays]
+    return np.asarray(m.call(p, xs if len(xs) > 1 else xs[0]))
+
+
+def test_unary_ops_match_numpy():
+    x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+    cases = [
+        (lambda v: A.abs(v), np.abs(x)),
+        (lambda v: A.square(v), np.square(x)),
+        (lambda v: A.exp(v), np.exp(x)),
+        (lambda v: A.clip(v, -0.5, 0.5), np.clip(x, -0.5, 0.5)),
+        (lambda v: A.sum(v, axis=1), x.sum(axis=1)),
+        (lambda v: A.mean(v, axis=1, keep_dims=True), x.mean(1, keepdims=True)),
+        (lambda v: A.softsign(v), x / (1 + np.abs(x))),
+        (lambda v: A.softplus(v), np.log1p(np.exp(x))),
+        (lambda v: A.pow(v, 3.0), np.power(x, 3.0)),
+        (lambda v: A.expand_dims(v, 1), x[:, None, :]),
+    ]
+    for fn, want in cases:
+        np.testing.assert_allclose(_run(fn, x), want, rtol=1e-5, atol=1e-5)
+
+
+def test_erf_and_sqrt():
+    from scipy.special import erf as np_erf  # scipy ships with the env
+    x = np.random.default_rng(1).uniform(0.1, 2.0, (3, 4)).astype(np.float32)
+    np.testing.assert_allclose(_run(A.sqrt, x), np.sqrt(x), rtol=1e-5)
+    np.testing.assert_allclose(_run(A.erf, x), np_erf(x), rtol=1e-4, atol=1e-5)
+
+
+def test_binary_and_operator_composition():
+    r = np.random.default_rng(2)
+    a = r.normal(size=(3, 4)).astype(np.float32)
+    b = r.normal(size=(3, 4)).astype(np.float32)
+    got = _run(lambda x, y: A.maximum(x, y) + x * 2.0 - y / 2.0, a, b)
+    np.testing.assert_allclose(got, np.maximum(a, b) + a * 2 - b / 2,
+                               rtol=1e-5, atol=1e-5)
+    got = _run(lambda x: A.maximum(x, 0.0), a)  # const arm
+    np.testing.assert_allclose(got, np.maximum(a, 0), rtol=1e-5)
+
+
+def test_mm_batch_dot_l2_normalize():
+    r = np.random.default_rng(3)
+    q = r.normal(size=(2, 4, 5)).astype(np.float32)
+    d = r.normal(size=(2, 6, 5)).astype(np.float32)
+    got = _run(lambda x, y: A.batch_dot(x, y, axes=(2, 2)), q, d)
+    np.testing.assert_allclose(got, np.einsum("bqe,bde->bqd", q, d),
+                               rtol=1e-4, atol=1e-4)
+    qa = q / np.linalg.norm(q, axis=2, keepdims=True)
+    da = d / np.linalg.norm(d, axis=2, keepdims=True)
+    got = _run(lambda x, y: A.batch_dot(x, y, axes=(2, 2), normalize=True),
+               q, d)
+    np.testing.assert_allclose(got, np.einsum("bqe,bde->bqd", qa, da),
+                               rtol=1e-4, atol=1e-4)
+    got = _run(lambda x: A.l2_normalize(x, axis=2), q)
+    np.testing.assert_allclose(got, qa, rtol=1e-5, atol=1e-5)
+    m1 = r.normal(size=(2, 3, 4)).astype(np.float32)
+    m2 = r.normal(size=(2, 4, 5)).astype(np.float32)
+    np.testing.assert_allclose(_run(A.mm, m1, m2), m1 @ m2,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_stack():
+    r = np.random.default_rng(4)
+    a = r.normal(size=(2, 3)).astype(np.float32)
+    b = r.normal(size=(2, 3)).astype(np.float32)
+    got = _run(lambda x, y: A.stack([x, y], axis=1), a, b)
+    np.testing.assert_allclose(got, np.stack([a, b], axis=1), rtol=1e-6)
+
+
+def test_custom_loss_values():
+    loss = A.CustomLoss(
+        lambda yt, yp: A.sqrt(A.mean(A.square(yt - yp), axis=1)), (3,))
+    import jax.numpy as jnp
+    yt = jnp.asarray(np.zeros((2, 3), np.float32))
+    yp = jnp.asarray(np.array([[1, 2, 3], [4, 5, 6]], np.float32))
+    want = np.sqrt((np.array([[1, 2, 3], [4, 5, 6.]]) ** 2).mean(1)).mean()
+    np.testing.assert_allclose(float(loss(yt, yp)), want, rtol=1e-5)
+
+
+def test_custom_loss_trains_a_model():
+    """compile(loss=CustomLoss(...)) goes through the whole jitted stack."""
+    init_zoo_context()
+    r = np.random.default_rng(5)
+    x = r.normal(size=(256, 6)).astype(np.float32)
+    w = r.normal(size=(6, 1)).astype(np.float32)
+    y = x @ w
+
+    m = Sequential()
+    m.add(Dense(1, input_shape=(6,)))
+    mae = A.CustomLoss(lambda yt, yp: A.mean(A.abs(yt - yp), axis=1), (1,))
+    m.compile(optimizer="adam", loss=mae, lr=0.05)
+    h = m.fit(x, y, batch_size=64, nb_epoch=15)
+    assert h["loss"][-1] < 0.25 * h["loss"][0], h["loss"]
+    # evaluate routes the custom callable through the fallback loss path
+    stats = m.evaluate(x, y, batch_size=64)
+    assert np.isfinite(stats["loss"])
+
+
+def test_custom_loss_rejects_non_variable():
+    with pytest.raises(TypeError):
+        A.CustomLoss(lambda yt, yp: 3.0, (1,))

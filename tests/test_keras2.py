@@ -1,0 +1,110 @@
+"""Keras-2 dialect adapters: every constructor builds, and keras2-built
+models equal their keras1 twins numerically (same engine underneath)."""
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras import layers as K1
+from analytics_zoo_tpu.pipeline.api.keras2 import layers as K2
+
+
+def test_every_keras2_constructor_builds():
+    specs = {
+        "Dense": (lambda: K2.Dense(4, activation="relu"), (5,)),
+        "Activation": (lambda: K2.Activation("tanh"), (5,)),
+        "Dropout": (lambda: K2.Dropout(0.3), (5,)),
+        "Flatten": (lambda: K2.Flatten(), (3, 4)),
+        "Reshape": (lambda: K2.Reshape((4, 3)), (3, 4)),
+        "Permute": (lambda: K2.Permute((2, 1)), (3, 4)),
+        "RepeatVector": (lambda: K2.RepeatVector(2), (4,)),
+        "Masking": (lambda: K2.Masking(), (3, 4)),
+        "Embedding": (lambda: K2.Embedding(7, 6), (3,)),
+        "Conv1D": (lambda: K2.Conv1D(4, 3, padding="same"), (8, 3)),
+        "Conv2D": (lambda: K2.Conv2D(4, 3, strides=2), (8, 8, 3)),
+        "Conv3D": (lambda: K2.Conv3D(4, 2), (4, 4, 4, 2)),
+        "SeparableConv2D": (lambda: K2.SeparableConv2D(4, 3), (8, 8, 3)),
+        "Conv2DTranspose": (lambda: K2.Conv2DTranspose(4, 3), (5, 5, 2)),
+        "LocallyConnected1D": (lambda: K2.LocallyConnected1D(4, 3), (8, 3)),
+        "LocallyConnected2D": (lambda: K2.LocallyConnected2D(4, 3), (6, 6, 2)),
+        "Cropping2D": (lambda: K2.Cropping2D(((1, 1), (1, 1))), (6, 6, 2)),
+        "UpSampling2D": (lambda: K2.UpSampling2D(), (3, 3, 2)),
+        "ZeroPadding2D": (lambda: K2.ZeroPadding2D(), (3, 3, 2)),
+        "MaxPooling2D": (lambda: K2.MaxPooling2D(), (6, 6, 2)),
+        "AveragePooling3D": (lambda: K2.AveragePooling3D(), (4, 4, 4, 2)),
+        "GlobalMaxPooling2D": (lambda: K2.GlobalMaxPooling2D(), (4, 4, 2)),
+        "GlobalAveragePooling1D": (lambda: K2.GlobalAveragePooling1D(),
+                                   (6, 3)),
+        "BatchNormalization": (lambda: K2.BatchNormalization(momentum=0.9),
+                               (5,)),
+        "LayerNormalization": (lambda: K2.LayerNormalization(), (5,)),
+        "LSTM": (lambda: K2.LSTM(4), (6, 3)),
+        "GRU": (lambda: K2.GRU(4, return_sequences=True), (6, 3)),
+        "SimpleRNN": (lambda: K2.SimpleRNN(4), (6, 3)),
+        "Bidirectional": (lambda: K2.Bidirectional(K2.LSTM(4)), (6, 3)),
+        "TimeDistributed": (lambda: K2.TimeDistributed(K2.Dense(4)), (6, 3)),
+        "LeakyReLU": (lambda: K2.LeakyReLU(), (5,)),
+        "ELU": (lambda: K2.ELU(), (5,)),
+        "PReLU": (lambda: K2.PReLU(), (5,)),
+        "ThresholdedReLU": (lambda: K2.ThresholdedReLU(), (5,)),
+        "Softmax": (lambda: K2.Softmax(), (5,)),
+        "GaussianNoise": (lambda: K2.GaussianNoise(0.1), (5,)),
+        "GaussianDropout": (lambda: K2.GaussianDropout(0.1), (5,)),
+        "SpatialDropout2D": (lambda: K2.SpatialDropout2D(0.3), (4, 4, 2)),
+    }
+    rng = np.random.default_rng(0)
+    for name, (factory, shape) in specs.items():
+        layer = factory()
+        params = layer.build(jax.random.key(0), (None,) + shape)
+        state = layer.initial_state((None,) + shape)
+        kind = "int" if name == "Embedding" else "float"
+        x = (rng.integers(0, 7, (2,) + shape).astype(np.int32) if kind == "int"
+             else rng.normal(size=(2,) + shape).astype(np.float32))
+        y, _ = layer.apply(params, state, jax.numpy.asarray(x),
+                           training=False, rng=None)
+        assert np.isfinite(np.asarray(
+            jax.tree_util.tree_leaves(y)[0], np.float32)).all(), name
+
+
+def test_keras2_model_equals_keras1_twin():
+    init_zoo_context()
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(8, 10)).astype(np.float32)
+
+    m2 = K2.Sequential()
+    m2.add(K2.Dense(16, activation="relu", input_shape=(10,)))
+    m2.add(K2.Dropout(0.1))
+    m2.add(K2.Dense(3))
+    m2.add(K2.Softmax())
+    m2.init_weights(rng=jax.random.key(42))
+
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    m1 = Sequential()
+    m1.add(K1.Dense(16, activation="relu", input_shape=(10,)))
+    m1.add(K1.Dropout(0.1))
+    m1.add(K1.Dense(3))
+    m1.add(K1.Softmax())
+    m1.init_weights(rng=jax.random.key(42))
+
+    np.testing.assert_allclose(np.asarray(m2.predict(x)),
+                               np.asarray(m1.predict(x)),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_keras2_functional_merge_trains():
+    init_zoo_context()
+    rng = np.random.default_rng(2)
+    a = rng.normal(size=(128, 4)).astype(np.float32)
+    b = rng.normal(size=(128, 4)).astype(np.float32)
+    y = ((a.sum(1) + b.sum(1)) > 0).astype(np.int32)
+
+    xa = K2.Input(shape=(4,))
+    xb = K2.Input(shape=(4,))
+    h = K2.concatenate([K2.Dense(8, activation="relu")(xa),
+                        K2.Dense(8, activation="relu")(xb)])
+    out = K2.Dense(2, activation="softmax")(h)
+    m = K2.Model([xa, xb], out)
+    m.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=0.02)
+    h_ = m.fit([a, b], y, batch_size=32, nb_epoch=8)
+    assert h_["loss"][-1] < h_["loss"][0]
+    assert m.evaluate([a, b], y, batch_size=32)["accuracy"] > 0.85

@@ -1,0 +1,161 @@
+"""Golden tests for the extended layer set (3D conv family, advanced
+activations, structured extras) vs torch/numpy oracles — the KerasBaseSpec
+discipline continued from test_golden_layers.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as L
+
+RTOL, ATOL = 1e-4, 1e-4
+
+
+@pytest.fixture
+def rng():
+    return jax.random.key(7)
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+def test_conv3d_matches_torch(rng):
+    x = np.random.default_rng(0).normal(size=(2, 6, 7, 8, 3)).astype(np.float32)
+    conv = L.Convolution3D(4, 2, 3, 3)
+    params = conv.build(rng, (None, 6, 7, 8, 3))
+    y = _np(conv.call(params, jnp.asarray(x)))
+    # DHWIO → OIDHW; NDHWC → NCDHW
+    w = _np(params["W"]).transpose(4, 3, 0, 1, 2)
+    yt = F.conv3d(torch.tensor(x.transpose(0, 4, 1, 2, 3)), torch.tensor(w),
+                  torch.tensor(_np(params["b"])))
+    np.testing.assert_allclose(y, yt.numpy().transpose(0, 2, 3, 4, 1),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_maxpool3d_matches_torch():
+    x = np.random.default_rng(1).normal(size=(2, 6, 6, 6, 3)).astype(np.float32)
+    y = _np(L.MaxPooling3D((2, 2, 2)).call({}, jnp.asarray(x)))
+    yt = F.max_pool3d(torch.tensor(x.transpose(0, 4, 1, 2, 3)), 2)
+    np.testing.assert_allclose(y, yt.numpy().transpose(0, 2, 3, 4, 1),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_lrn2d_matches_torch():
+    x = np.random.default_rng(2).normal(size=(2, 5, 5, 8)).astype(np.float32)
+    lrn = L.LRN2D(alpha=1e-3, k=2.0, beta=0.75, n=5)
+    y = _np(lrn.call({}, jnp.asarray(x)))
+    yt = F.local_response_norm(torch.tensor(x.transpose(0, 3, 1, 2)),
+                               size=5, alpha=1e-3, beta=0.75, k=2.0)
+    np.testing.assert_allclose(y, yt.numpy().transpose(0, 2, 3, 1),
+                               rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize("layer,tfn", [
+    (L.LeakyReLU(0.1), lambda t: F.leaky_relu(t, 0.1)),
+    (L.ELU(1.0), F.elu),
+    (L.HardShrink(0.5), lambda t: F.hardshrink(t, 0.5)),
+    (L.SoftShrink(0.5), lambda t: F.softshrink(t, 0.5)),
+    (L.HardTanh(), F.hardtanh),
+    (L.Softmax(), lambda t: F.softmax(t, dim=-1)),
+])
+def test_activations_match_torch(layer, tfn):
+    x = np.random.default_rng(3).normal(size=(4, 9)).astype(np.float32)
+    y = _np(layer.call({}, jnp.asarray(x)))
+    np.testing.assert_allclose(y, tfn(torch.tensor(x)).numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_prelu_matches_torch(rng):
+    x = np.random.default_rng(4).normal(size=(4, 6)).astype(np.float32)
+    prelu = L.PReLU()
+    params = prelu.build(rng, (None, 6))
+    y = _np(prelu.call(params, jnp.asarray(x)))
+    yt = F.prelu(torch.tensor(x), torch.tensor(_np(params["alpha"])))
+    np.testing.assert_allclose(y, yt.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_locally_connected_2d_matches_loop(rng):
+    x = np.random.default_rng(5).normal(size=(2, 5, 5, 2)).astype(np.float32)
+    lc = L.LocallyConnected2D(3, 2, 2)
+    params = lc.build(rng, (None, 5, 5, 2))
+    y = _np(lc.call(params, jnp.asarray(x)))
+    w = _np(params["W"]).reshape(4, 4, 2, 2, 2, 3)  # (oh, ow, kh, kw, c, f)
+    b = _np(params["b"])
+    want = np.zeros((2, 4, 4, 3), np.float32)
+    for i in range(4):
+        for j in range(4):
+            patch = x[:, i:i + 2, j:j + 2, :]          # (B, kh, kw, c)
+            want[:, i, j, :] = np.einsum("bklc,klcf->bf", patch, w[i, j]) + b[i, j]
+    np.testing.assert_allclose(y, want, rtol=RTOL, atol=1e-3)
+
+
+def test_maxout_dense_matches_manual(rng):
+    x = np.random.default_rng(6).normal(size=(3, 5)).astype(np.float32)
+    mo = L.MaxoutDense(4, nb_feature=3)
+    params = mo.build(rng, (None, 5))
+    y = _np(mo.call(params, jnp.asarray(x)))
+    z = x @ _np(params["W"]) + _np(params["b"])
+    want = z.reshape(3, 3, 4).max(axis=1)
+    np.testing.assert_allclose(y, want, rtol=RTOL, atol=ATOL)
+
+
+def test_conv_lstm_2d_shapes_and_training():
+    """ConvLSTM2D learns a trivial spatio-temporal task end-to-end."""
+    from analytics_zoo_tpu.common.context import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+
+    init_zoo_context()
+    rng = np.random.default_rng(7)
+    # class 1 = brightness increases over time
+    n, t, h, w = 96, 4, 6, 6
+    base = rng.normal(size=(n, t, h, w, 1)).astype(np.float32)
+    ramp = np.linspace(0, 1.5, t, dtype=np.float32)[None, :, None, None, None]
+    y = rng.integers(0, 2, n).astype(np.int32)
+    x = base + np.where(y[:, None, None, None, None] == 1, ramp, 0.0)
+
+    m = Sequential()
+    m.add(L.ConvLSTM2D(4, 3, input_shape=(t, h, w, 1)))
+    m.add(L.GlobalAveragePooling2D())
+    m.add(L.Dense(2, activation="softmax"))
+    m.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=0.01)
+    hist = m.fit(x, y, batch_size=32, nb_epoch=8)
+    assert hist["loss"][-1] < hist["loss"][0]
+    assert m.evaluate(x, y, batch_size=32)["accuracy"] > 0.8
+
+    seq = L.ConvLSTM2D(3, 3, return_sequences=True)
+    p = seq.build(jax.random.key(0), (None, t, h, w, 1))
+    out = seq.call(p, jnp.asarray(x[:2]))
+    assert out.shape == (2, t, h, w, 3)
+
+
+def test_rrelu_train_vs_eval():
+    x = jnp.asarray(np.full((2, 8), -1.0, np.float32))
+    l = L.RReLU(0.1, 0.3)
+    y_eval = _np(l.call({}, x))
+    np.testing.assert_allclose(y_eval, -0.2 * np.ones((2, 8)), rtol=1e-6)
+    y_train = _np(l.call({}, x, training=True, rng=jax.random.key(0)))
+    assert (y_train <= -0.1 + 1e-6).all() and (y_train >= -0.3 - 1e-6).all()
+    assert np.std(y_train) > 0  # actually random per element
+
+
+def test_spatial_dropout_drops_whole_channels():
+    x = jnp.ones((4, 10, 3))
+    l = L.SpatialDropout1D(0.5)
+    y = _np(l.call({}, x, training=True, rng=jax.random.key(1)))
+    # every (sample, channel) column is either all zero or all scaled
+    col_is_const = np.all((y == 0) | np.isclose(y, 2.0), axis=1)
+    assert col_is_const.all()
+    y_eval = _np(l.call({}, x, training=False, rng=None))
+    np.testing.assert_array_equal(y_eval, np.ones((4, 10, 3)))
+
+
+def test_share_convolution_pads_explicitly(rng):
+    x = np.random.default_rng(8).normal(size=(1, 5, 5, 2)).astype(np.float32)
+    sc = L.ShareConvolution2D(3, 3, 3, pad_h=1, pad_w=1)
+    params = sc.build(rng, (None, 5, 5, 2))
+    y = sc.call(params, jnp.asarray(x))
+    assert y.shape == (1, 5, 5, 3)  # same-size thanks to explicit pad

@@ -76,6 +76,22 @@ def test_flash_gradients_match_xla(causal):
                                    rtol=1e-3, atol=1e-4)
 
 
+def test_flash_causal_tq_gt_tkv_zero_rows_have_zero_grad():
+    """Forward zeroes query rows with no visible key (t_q > t_kv causal);
+    the backward must treat those rows as constants — no uniform-weight
+    gradient leak from the recompute reference."""
+    q, k, v = _qkv(1, 1, 5, 3, 4, seed=8)
+    out = flash_attention(q, k, v, True, 4, 4)
+    # rows 0..1 see no key (offset = 3 - 5 = -2): exactly zero
+    np.testing.assert_array_equal(np.asarray(out[0, 0, :2]), 0.0)
+
+    def f(v):
+        return jnp.sum(flash_attention(q, k, v, True, 4, 4)[0, 0, 0])
+
+    g = jax.grad(f)(v)
+    np.testing.assert_array_equal(np.asarray(g), 0.0)
+
+
 def test_flash_rejects_nothing_when_t_one():
     q, k, v = _qkv(1, 1, 1, 1, 4, seed=5)
     out = flash_attention(q, k, v, True)

@@ -1,0 +1,67 @@
+"""Ranker metrics (Ranker.scala NDCG/MAP + HitRate) with hand-computed
+oracles, and the mixin surfaced through KNRM/Recommender."""
+
+import numpy as np
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.models.common import (hit_rate,
+                                             mean_average_precision, ndcg)
+from analytics_zoo_tpu.models.textmatching import KNRM
+
+
+def test_ndcg_hand_computed():
+    # labels by predicted rank order: [1, 0, 1] (preds 0.9, 0.8, 0.7)
+    y_pred = np.array([0.9, 0.8, 0.7])
+    y_true = np.array([1.0, 0.0, 1.0])
+    # dcg@3 = 2^1/ln2 + 0 + 2^1/ln4 ; idcg = 2/ln2 + 2/ln3
+    dcg = 2 / np.log(2) + 2 / np.log(4)
+    idcg = 2 / np.log(2) + 2 / np.log(3)
+    np.testing.assert_allclose(ndcg(y_pred, y_true, 3), dcg / idcg, rtol=1e-9)
+    # @1: only first ranked (positive) counts; ideal also 2/ln2 → 1.0
+    np.testing.assert_allclose(ndcg(y_pred, y_true, 1), 1.0)
+    # all-negative group → 0 (reference returns 0 when idcg == 0)
+    assert ndcg(y_pred, np.zeros(3), 5) == 0.0
+
+
+def test_map_hand_computed():
+    # ranked labels: [1, 0, 1, 1] → AP = (1/1 + 2/3 + 3/4) / 3
+    y_pred = np.array([0.9, 0.8, 0.7, 0.6])
+    y_true = np.array([1.0, 0.0, 1.0, 1.0])
+    want = (1.0 + 2 / 3 + 3 / 4) / 3
+    np.testing.assert_allclose(mean_average_precision(y_pred, y_true), want,
+                               rtol=1e-9)
+    assert mean_average_precision(y_pred, np.zeros(4)) == 0.0
+
+
+def test_hit_rate_hand_computed():
+    y_pred = np.array([0.9, 0.8, 0.7, 0.6])
+    y_true = np.array([0.0, 0.0, 1.0, 0.0])
+    assert hit_rate(y_pred, y_true, 2) == 0.0
+    assert hit_rate(y_pred, y_true, 3) == 1.0
+
+
+def test_knrm_ranker_evaluation():
+    """KNRM exposes the Ranker surface; trained model ranks matched docs
+    above mismatched ones → NDCG/MAP/HR beat the random baseline."""
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    n, t1, t2, vocab = 256, 5, 8, 40
+    q = rng.integers(1, vocab, (n, t1))
+    y = rng.integers(0, 2, n).astype(np.float32)
+    d = rng.integers(1, vocab, (n, t2))
+    d[y == 1, :t1] = q[y == 1]  # positives share tokens with the query
+    x = np.concatenate([q, d], axis=1).astype(np.int32)
+
+    m = KNRM(t1, t2, vocab_size=vocab, embed_size=12, kernel_num=11,
+             target_mode="classification")
+    m.compile(optimizer="adam", loss="bce", lr=0.01)
+    m.fit(x, y[:, None], batch_size=32, nb_epoch=10)
+
+    # groups of 16 records each, one "query block" per group
+    groups = [(x[i:i + 16], y[i:i + 16]) for i in range(0, 128, 16)]
+    nd = m.evaluate_ndcg(groups, k=5)
+    mp = m.evaluate_map(groups)
+    hr = m.evaluate_hit_rate(groups, k=3)
+    assert 0.8 < nd <= 1.0, nd
+    assert 0.8 < mp <= 1.0, mp
+    assert hr > 0.8, hr

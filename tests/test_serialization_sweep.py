@@ -25,6 +25,8 @@ B = 2  # batch
 def _input_for(kind, shape, rng):
     if kind == "int":
         return rng.integers(0, 7, (B,) + shape).astype(np.int32)
+    if kind == "float_pos":  # strictly positive (Log/Sqrt domains)
+        return rng.uniform(0.1, 2.0, (B,) + shape).astype(np.float32)
     return rng.normal(size=(B,) + shape).astype(np.float32)
 
 
@@ -84,6 +86,61 @@ CASES = {
     "GlobalAveragePooling1D": (lambda: L.GlobalAveragePooling1D(), (8, 3), "float"),
     "GlobalAveragePooling2D": (lambda: L.GlobalAveragePooling2D(),
                                (4, 4, 3), "float"),
+    # --- advanced activations ---
+    "LeakyReLU": (lambda: L.LeakyReLU(0.1), (4,), "float"),
+    "ELU": (lambda: L.ELU(), (4,), "float"),
+    "PReLU": (lambda: L.PReLU(), (4,), "float"),
+    "SReLU": (lambda: L.SReLU(), (4,), "float"),
+    "ThresholdedReLU": (lambda: L.ThresholdedReLU(0.5), (4,), "float"),
+    "RReLU": (lambda: L.RReLU(), (4,), "float"),
+    "Softmax": (lambda: L.Softmax(), (4,), "float"),
+    "HardTanh": (lambda: L.HardTanh(), (4,), "float"),
+    "HardShrink": (lambda: L.HardShrink(), (4,), "float"),
+    "SoftShrink": (lambda: L.SoftShrink(), (4,), "float"),
+    "Threshold": (lambda: L.Threshold(0.1, -1.0), (4,), "float"),
+    "BinaryThreshold": (lambda: L.BinaryThreshold(), (4,), "float"),
+    # --- elementwise ---
+    "AddConstant": (lambda: L.AddConstant(2.0), (4,), "float"),
+    "MulConstant": (lambda: L.MulConstant(0.5), (4,), "float"),
+    "Negative": (lambda: L.Negative(), (4,), "float"),
+    "Power": (lambda: L.Power(2.0, 1.5, 0.1), (4,), "float"),
+    "Exp": (lambda: L.Exp(), (4,), "float"),
+    "Log": (lambda: L.Log(), (7,), "float_pos"),
+    "Sqrt": (lambda: L.Sqrt(), (7,), "float_pos"),
+    "Square": (lambda: L.Square(), (4,), "float"),
+    "Mul": (lambda: L.Mul(), (4,), "float"),
+    "CAdd": (lambda: L.CAdd((4,)), (4,), "float"),
+    "CMul": (lambda: L.CMul((4,)), (4,), "float"),
+    "Scale": (lambda: L.Scale((4,)), (4,), "float"),
+    "Max": (lambda: L.Max(1), (5, 4), "float"),
+    "Expand": (lambda: L.Expand((3, 4)), (1, 4), "float"),
+    "ResizeBilinear": (lambda: L.ResizeBilinear(6, 8), (4, 4, 3), "float"),
+    # --- 3D family + structured extras ---
+    "Convolution3D": (lambda: L.Convolution3D(4, 2, 2, 2), (5, 6, 6, 3),
+                      "float"),
+    "MaxPooling3D": (lambda: L.MaxPooling3D(), (4, 4, 4, 3), "float"),
+    "AveragePooling3D": (lambda: L.AveragePooling3D(), (4, 4, 4, 3), "float"),
+    "GlobalMaxPooling3D": (lambda: L.GlobalMaxPooling3D(), (4, 4, 4, 3),
+                           "float"),
+    "GlobalAveragePooling3D": (lambda: L.GlobalAveragePooling3D(),
+                               (4, 4, 4, 3), "float"),
+    "ZeroPadding3D": (lambda: L.ZeroPadding3D(), (3, 3, 3, 2), "float"),
+    "Cropping3D": (lambda: L.Cropping3D(), (5, 5, 5, 2), "float"),
+    "UpSampling3D": (lambda: L.UpSampling3D(), (2, 2, 2, 3), "float"),
+    "SpatialDropout1D": (lambda: L.SpatialDropout1D(0.3), (6, 3), "float"),
+    "SpatialDropout2D": (lambda: L.SpatialDropout2D(0.3), (4, 4, 3), "float"),
+    "SpatialDropout3D": (lambda: L.SpatialDropout3D(0.3), (3, 3, 3, 2),
+                         "float"),
+    "ConvLSTM2D": (lambda: L.ConvLSTM2D(4, 3), (3, 5, 5, 2), "float"),
+    "ConvLSTM2D_seq": (lambda: L.ConvLSTM2D(4, 3, return_sequences=True),
+                       (3, 5, 5, 2), "float"),
+    "LocallyConnected2D": (lambda: L.LocallyConnected2D(4, 3, 3),
+                           (6, 6, 2), "float"),
+    "ShareConvolution2D": (lambda: L.ShareConvolution2D(4, 3, 3, pad_h=1,
+                                                        pad_w=1),
+                           (6, 6, 2), "float"),
+    "MaxoutDense": (lambda: L.MaxoutDense(5, nb_feature=3), (4,), "float"),
+    "LRN2D": (lambda: L.LRN2D(), (4, 4, 7), "float"),
     "SimpleRNN": (lambda: L.SimpleRNN(5), (6, 4), "float"),
     "LSTM": (lambda: L.LSTM(5, return_sequences=True), (6, 4), "float"),
     "GRU": (lambda: L.GRU(5), (6, 4), "float"),
@@ -148,6 +205,7 @@ def test_sweep_covers_every_exported_layer():
         "Input", "InputLayer", "Lambda",  # graph plumbing, not serializable
         "Merge",                           # covered by test_merge_roundtrip
         "BERT",                            # covered by test_bert_roundtrip
+        "GaussianSampler",                 # covered by test_gaussian_sampler
         "Layer",
     }
     covered = {case[0]().__class__.__name__ for case in CASES.values()}
@@ -174,6 +232,19 @@ def test_merge_roundtrip():
         s2 = l2.initial_state(shapes)
         y2, _ = l2.apply(p2, s2, xs, training=False, rng=None)
         np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+def test_gaussian_sampler():
+    rng = np.random.default_rng(3)
+    mean = jax.numpy.asarray(rng.normal(size=(B, 4)).astype(np.float32))
+    log_var = jax.numpy.asarray(rng.normal(size=(B, 4)).astype(np.float32))
+    l = L.GaussianSampler()
+    # deterministic (mean) without rng; reparameterized draw with rng
+    out = l.call({}, [mean, log_var])
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(mean))
+    draw = l.call({}, [mean, log_var], rng=jax.random.key(0))
+    assert draw.shape == mean.shape
+    assert not np.allclose(np.asarray(draw), np.asarray(mean))
 
 
 def test_bert_roundtrip():

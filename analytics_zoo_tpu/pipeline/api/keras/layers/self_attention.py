@@ -108,11 +108,13 @@ class TransformerBlock(Layer):
     def __init__(self, hidden_size: int, n_head: int,
                  intermediate_size: Optional[int] = None,
                  causal: bool = False, hidden_drop: float = 0.0,
-                 attn_drop: float = 0.0, epsilon: float = 1e-5, **kwargs):
+                 attn_drop: float = 0.0, epsilon: float = 1e-5,
+                 gelu_approximate: bool = True, **kwargs):
         super().__init__(**kwargs)
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size or 4 * hidden_size
         self.hidden_drop = hidden_drop
+        self.gelu_approximate = gelu_approximate  # BERT parity needs exact
         self.attn = MultiHeadSelfAttention(
             hidden_size, n_head, causal=causal, attn_drop=attn_drop,
             out_drop=hidden_drop, name=(kwargs.get("name") or "tb") + "_attn")
@@ -141,7 +143,8 @@ class TransformerBlock(Layer):
         a = self.attn.call(params["attn"], [x, mask] if mask is not None else x,
                            training=training, rng=r1)
         x = self.ln1.call(params["ln1"], x + a)
-        h = jax.nn.gelu(_dense(params["fc"], x, cd))
+        h = jax.nn.gelu(_dense(params["fc"], x, cd),
+                        approximate=self.gelu_approximate)
         h = _dropout(_dense(params["out"], h, cd), self.hidden_drop, r2,
                      training)
         return self.ln2.call(params["ln2"], x + h)
@@ -225,6 +228,7 @@ class BERT(Layer):
                              intermediate_size=intermediate_size,
                              causal=False, hidden_drop=hidden_drop,
                              attn_drop=attn_drop, epsilon=1e-12,
+                             gelu_approximate=False,  # BERT's erf-form gelu
                              name=f"{self.name}_block{i}")
             for i in range(n_block)
         ]

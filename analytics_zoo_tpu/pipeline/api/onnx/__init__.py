@@ -1,0 +1,8 @@
+"""ONNX model import (SURVEY §2.2; reference
+``pyzoo/zoo/pipeline/api/onnx/onnx_loader.py`` maps ONNX nodes onto BigDL
+modules). Dependency-free: the ``.onnx`` protobuf is parsed with the
+package's own wire-format codec, and the graph executes as a native Layer —
+so an imported ONNX model predicts, fine-tunes, shards, and serializes like
+any other model here."""
+
+from .onnx_loader import OnnxLoader, OnnxNet, load_onnx  # noqa: F401

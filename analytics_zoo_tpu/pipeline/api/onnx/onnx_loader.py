@@ -1,0 +1,472 @@
+"""ONNX loader — parse ``.onnx`` (ModelProto) files with the in-repo proto
+codec and execute the graph as a native JAX ``Layer``.
+
+Scope mirrors the reference loader's op coverage
+(``pyzoo/zoo/pipeline/api/onnx/mapper/*``: Gemm, Conv, BatchNormalization,
+pooling, activations, shape ops): the common inference subset. Initializers
+become the Layer's params, so imported models are immediately fine-tunable
+under the jitted train step. ONNX semantics are executed as-is (NCHW convs
+— XLA retiles layouts for TPU on its own).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....utils.proto import parse_fields, parse_varint
+from ...api.keras.engine import Layer
+
+__all__ = ["OnnxLoader", "OnnxNet", "load_onnx"]
+
+# TensorProto.DataType → numpy
+_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 5: np.int16,
+           6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16,
+           11: np.float64}
+
+
+def _as_int(payload: bytes) -> int:
+    v, _ = parse_varint(payload, 0)
+    return v
+
+
+def _signed(v: int) -> int:
+    """Protobuf int64 varints are two's-complement; fold back to signed."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+# ---------------------------------------------------------------------------
+# proto decoding (onnx.proto3 subset)
+# ---------------------------------------------------------------------------
+
+def _decode_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
+    dims: List[int] = []
+    dtype = np.float32
+    name = ""
+    raw: Optional[bytes] = None
+    floats: List[float] = []
+    int64s: List[int] = []
+    for num, wt, payload in parse_fields(buf):
+        if num == 1:          # dims (packed by proto3 default, or repeated)
+            if wt == 2:
+                i = 0
+                while i < len(payload):
+                    v, i = parse_varint(payload, i)
+                    dims.append(_signed(v))
+            else:
+                dims.append(_signed(_as_int(payload)))
+        elif num == 2:        # data_type
+            dtype = _DTYPES[_as_int(payload)]
+        elif num == 8 and wt == 2:   # name
+            name = payload.decode("utf-8")
+        elif num == 9 and wt == 2:   # raw_data
+            raw = payload
+        elif num == 4:        # float_data (packed or repeated)
+            if wt == 2:
+                floats.extend(struct.unpack(f"<{len(payload) // 4}f", payload))
+            else:
+                floats.append(struct.unpack("<f", payload)[0])
+        elif num == 7:        # int64_data
+            if wt == 2:
+                i = 0
+                while i < len(payload):
+                    v, i = parse_varint(payload, i)
+                    int64s.append(_signed(v))
+            else:
+                int64s.append(_signed(_as_int(payload)))
+    if raw is not None:
+        arr = np.frombuffer(raw, dtype=dtype)
+    elif floats:
+        arr = np.asarray(floats, np.float32)
+    elif int64s:
+        arr = np.asarray(int64s, np.int64)
+    else:
+        arr = np.zeros(0, dtype)
+    return name, arr.reshape(dims) if dims else arr
+
+
+def _decode_attribute(buf: bytes) -> Tuple[str, Any]:
+    name, value = "", None
+    for num, wt, payload in parse_fields(buf):
+        if num == 1 and wt == 2:
+            name = payload.decode("utf-8")
+        elif num == 2:        # f
+            value = struct.unpack("<f", payload)[0]
+        elif num == 3:        # i
+            value = _signed(_as_int(payload))
+        elif num == 4 and wt == 2:  # s
+            value = payload.decode("utf-8", "replace")
+        elif num == 5 and wt == 2:  # t (tensor)
+            value = _decode_tensor(payload)[1]
+        elif num == 7:        # floats (packed or repeated; chunks accumulate)
+            vals = value if isinstance(value, list) else []
+            if wt == 2:
+                vals.extend(struct.unpack(f"<{len(payload) // 4}f", payload))
+            else:
+                vals.append(struct.unpack("<f", payload)[0])
+            value = vals
+        elif num == 8:        # ints (packed or repeated)
+            vals = value if isinstance(value, list) else []
+            if wt == 2:
+                i = 0
+                while i < len(payload):
+                    v, i = parse_varint(payload, i)
+                    vals.append(_signed(v))
+            else:
+                vals.append(_signed(_as_int(payload)))
+            value = vals
+    return name, value
+
+
+def _decode_node(buf: bytes) -> Dict[str, Any]:
+    node = {"inputs": [], "outputs": [], "op": "", "name": "", "attrs": {}}
+    for num, wt, payload in parse_fields(buf):
+        if num == 1 and wt == 2:
+            node["inputs"].append(payload.decode("utf-8"))
+        elif num == 2 and wt == 2:
+            node["outputs"].append(payload.decode("utf-8"))
+        elif num == 3 and wt == 2:
+            node["name"] = payload.decode("utf-8")
+        elif num == 4 and wt == 2:
+            node["op"] = payload.decode("utf-8")
+        elif num == 5 and wt == 2:
+            k, v = _decode_attribute(payload)
+            node["attrs"][k] = v
+    return node
+
+
+def _decode_value_info(buf: bytes) -> str:
+    for num, wt, payload in parse_fields(buf):
+        if num == 1 and wt == 2:
+            return payload.decode("utf-8")
+    return ""
+
+
+def _decode_graph(buf: bytes) -> Dict[str, Any]:
+    g = {"nodes": [], "initializers": {}, "inputs": [], "outputs": []}
+    for num, wt, payload in parse_fields(buf):
+        if num == 1 and wt == 2:
+            g["nodes"].append(_decode_node(payload))
+        elif num == 5 and wt == 2:
+            name, arr = _decode_tensor(payload)
+            g["initializers"][name] = arr
+        elif num == 11 and wt == 2:
+            g["inputs"].append(_decode_value_info(payload))
+        elif num == 12 and wt == 2:
+            g["outputs"].append(_decode_value_info(payload))
+    return g
+
+
+def _decode_opset(buf: bytes) -> Tuple[str, int]:
+    domain, version = "", 0
+    for num, wt, payload in parse_fields(buf):
+        if num == 1 and wt == 2:
+            domain = payload.decode("utf-8")
+        elif num == 2:
+            version = _signed(_as_int(payload))
+    return domain, version
+
+
+def _decode_model(buf: bytes) -> Dict[str, Any]:
+    graph, opset = None, 13
+    for num, wt, payload in parse_fields(buf):
+        if num == 7 and wt == 2:    # ModelProto.graph
+            graph = _decode_graph(payload)
+        elif num == 8 and wt == 2:  # ModelProto.opset_import
+            domain, version = _decode_opset(payload)
+            if domain in ("", "ai.onnx") and version:
+                opset = version
+    if graph is None:
+        raise ValueError("no GraphProto found — not an ONNX ModelProto?")
+    graph["opset"] = opset
+    return graph
+
+
+# ---------------------------------------------------------------------------
+# op execution
+# ---------------------------------------------------------------------------
+
+def _conv_padding(attrs, spatial, in_shape=None, kernel=None, strides=None):
+    auto = attrs.get("auto_pad")
+    if auto == "SAME_UPPER":
+        return "SAME"
+    if auto == "SAME_LOWER":
+        # XLA's "SAME" puts the odd pad at the END (SAME_UPPER); ONNX
+        # SAME_LOWER wants it at the START — compute explicit pairs
+        pads = []
+        for i in range(spatial):
+            size, k = int(in_shape[2 + i]), int(kernel[i])
+            s = int(strides[i]) if strides else 1
+            total = max((-(-size // s) - 1) * s + k - size, 0)
+            pads.append((total - total // 2, total // 2))
+        return pads
+    pads = attrs.get("pads")
+    if not pads:
+        return [(0, 0)] * spatial
+    half = len(pads) // 2
+    return list(zip(pads[:half], pads[half:]))
+
+
+def _pool(x, op, init, attrs):
+    if attrs.get("ceil_mode"):
+        raise NotImplementedError("ceil_mode pooling not supported yet")
+    k = attrs["kernel_shape"]
+    strides = attrs.get("strides", [1] * len(k))
+    pads = _conv_padding(attrs, len(k), x.shape, k, strides)
+    window = (1, 1) + tuple(k)
+    strd = (1, 1) + tuple(strides)
+    pad_cfg = (pads if isinstance(pads, str)
+               else [(0, 0), (0, 0)] + list(pads))
+    return jax.lax.reduce_window(x, init, op, window, strd, pad_cfg)
+
+
+def _run_node(node: Dict[str, Any], vals: Dict[str, Any],
+              training: bool, rng=None, opset: int = 13) -> None:
+    op = node["op"]
+    attrs = node["attrs"]
+    ins = [vals[n] if n else None for n in node["inputs"]]
+    out = node["outputs"][0]
+
+    if op == "Gemm":
+        a, b = ins[0], ins[1]
+        if attrs.get("transA"):
+            a = a.T
+        if attrs.get("transB"):
+            b = b.T
+        y = attrs.get("alpha", 1.0) * jnp.matmul(
+            a, b, preferred_element_type=jnp.float32)
+        if len(ins) > 2 and ins[2] is not None:
+            y = y + attrs.get("beta", 1.0) * ins[2]
+        vals[out] = y
+    elif op == "MatMul":
+        vals[out] = jnp.matmul(ins[0], ins[1],
+                               preferred_element_type=jnp.float32)
+    elif op in ("Add", "Sub", "Mul", "Div", "Pow"):
+        fn = {"Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+              "Div": jnp.divide, "Pow": jnp.power}[op]
+        vals[out] = fn(ins[0], ins[1])
+    elif op == "Relu":
+        vals[out] = jnp.maximum(ins[0], 0)
+    elif op == "LeakyRelu":
+        vals[out] = jnp.where(ins[0] > 0, ins[0],
+                              attrs.get("alpha", 0.01) * ins[0])
+    elif op == "Sigmoid":
+        vals[out] = jax.nn.sigmoid(ins[0])
+    elif op == "Tanh":
+        vals[out] = jnp.tanh(ins[0])
+    elif op == "Erf":
+        vals[out] = jax.scipy.special.erf(ins[0])
+    elif op == "Sqrt":
+        vals[out] = jnp.sqrt(ins[0])
+    elif op == "Softmax":
+        if opset >= 13:
+            vals[out] = jax.nn.softmax(ins[0], axis=attrs.get("axis", -1))
+        else:
+            # opset <13: flatten to 2D at `axis` (default 1), softmax the
+            # trailing block, restore shape
+            ax = attrs.get("axis", 1) % ins[0].ndim
+            shape = ins[0].shape
+            flat = ins[0].reshape(int(np.prod(shape[:ax]) if ax else 1), -1)
+            vals[out] = jax.nn.softmax(flat, axis=-1).reshape(shape)
+    elif op == "Conv":
+        if attrs.get("group", 1) != 1:
+            raise NotImplementedError("grouped Conv not supported yet")
+        spatial = ins[1].ndim - 2  # kernel is (O, I, *spatial) — 1/2/3D
+        if not 1 <= spatial <= 3:
+            raise NotImplementedError(f"Conv with {spatial} spatial dims")
+        strides = attrs.get("strides", [1] * spatial)
+        dil = attrs.get("dilations", [1] * spatial)
+        pads = _conv_padding(attrs, spatial, ins[0].shape,
+                             ins[1].shape[2:], strides)
+        chars = "DHW"[3 - spatial:]
+        vals[out] = jax.lax.conv_general_dilated(
+            ins[0], ins[1], tuple(strides), pads, rhs_dilation=tuple(dil),
+            dimension_numbers=("NC" + chars, "OI" + chars, "NC" + chars),
+            preferred_element_type=jnp.float32)
+        if len(ins) > 2 and ins[2] is not None:
+            vals[out] = vals[out] + ins[2].reshape(1, -1, *([1] * spatial))
+    elif op == "MaxPool":
+        vals[out] = _pool(ins[0], jax.lax.max, -jnp.inf, attrs)
+    elif op == "AveragePool":
+        s = _pool(ins[0], jax.lax.add, 0.0, attrs)
+        if attrs.get("count_include_pad"):
+            # torch AvgPool2d default: padded zeros count in the divisor
+            vals[out] = s / float(np.prod(attrs["kernel_shape"]))
+        else:
+            n = _pool(jnp.ones_like(ins[0]), jax.lax.add, 0.0, attrs)
+            vals[out] = s / n
+    elif op == "GlobalAveragePool":
+        vals[out] = jnp.mean(ins[0], axis=tuple(range(2, ins[0].ndim)),
+                             keepdims=True)
+    elif op == "BatchNormalization":
+        x, gamma, beta, mean, var = ins[:5]
+        eps = attrs.get("epsilon", 1e-5)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        vals[out] = (gamma.reshape(shape) * (x - mean.reshape(shape))
+                     / jnp.sqrt(var.reshape(shape) + eps)
+                     + beta.reshape(shape))
+    elif op == "Flatten":
+        ax = attrs.get("axis", 1)
+        vals[out] = ins[0].reshape(
+            int(np.prod(ins[0].shape[:ax])) if ax else 1, -1)
+    elif op == "Reshape":
+        shape = [int(s) for s in np.asarray(ins[1])]
+        vals[out] = ins[0].reshape(
+            [ins[0].shape[i] if s == 0 else s for i, s in enumerate(shape)])
+    elif op == "Transpose":
+        vals[out] = jnp.transpose(ins[0], attrs.get("perm"))
+    elif op == "Concat":
+        vals[out] = jnp.concatenate(ins, axis=attrs.get("axis", 0))
+    elif op == "Gather":
+        vals[out] = jnp.take(ins[0], ins[1].astype(jnp.int32),
+                             axis=attrs.get("axis", 0))
+    elif op == "Unsqueeze":
+        axes = attrs.get("axes") or [int(a) for a in np.asarray(ins[1])]
+        y = ins[0]
+        for a in sorted(axes):
+            y = jnp.expand_dims(y, a)
+        vals[out] = y
+    elif op == "Squeeze":
+        axes = attrs.get("axes") or ([int(a) for a in np.asarray(ins[1])]
+                                     if len(ins) > 1 and ins[1] is not None
+                                     else None)
+        vals[out] = jnp.squeeze(ins[0],
+                                axis=tuple(axes) if axes else None)
+    elif op == "ReduceMean":
+        # axes: attribute (opset <18) or second input (opset >=18)
+        axes = attrs.get("axes") or ([int(a) for a in np.asarray(ins[1])]
+                                     if len(ins) > 1 and ins[1] is not None
+                                     else None)
+        vals[out] = jnp.mean(ins[0], axis=tuple(axes) if axes else None,
+                             keepdims=bool(attrs.get("keepdims", 1)))
+    elif op == "Clip":
+        lo = ins[1] if len(ins) > 1 and ins[1] is not None else attrs.get("min")
+        hi = ins[2] if len(ins) > 2 and ins[2] is not None else attrs.get("max")
+        vals[out] = jnp.clip(ins[0], lo, hi)
+    elif op == "Identity":
+        vals[out] = ins[0]
+    elif op == "Dropout":
+        ratio = (float(np.asarray(ins[1]))
+                 if len(ins) > 1 and ins[1] is not None
+                 else attrs.get("ratio", 0.5))
+        if training and rng is not None and ratio > 0.0:
+            keep = jax.random.bernoulli(rng, 1.0 - ratio, ins[0].shape)
+            vals[out] = jnp.where(keep, ins[0] / (1.0 - ratio), 0.0)
+        else:
+            vals[out] = ins[0]
+    elif op == "Constant":
+        if "value" in attrs:
+            vals[out] = jnp.asarray(attrs["value"])
+        elif "value_float" in attrs:
+            vals[out] = jnp.asarray(attrs["value_float"], jnp.float32)
+        elif "value_int" in attrs:
+            vals[out] = jnp.asarray(attrs["value_int"], jnp.int64)
+        elif "value_floats" in attrs:
+            vals[out] = jnp.asarray(attrs["value_floats"], jnp.float32)
+        elif "value_ints" in attrs:
+            vals[out] = jnp.asarray(attrs["value_ints"], jnp.int64)
+        else:
+            raise NotImplementedError(
+                f"Constant node {node['name']!r} has none of value/"
+                f"value_float(s)/value_int(s); got {sorted(attrs)}")
+    else:
+        raise NotImplementedError(f"ONNX op {op!r} not supported "
+                                  f"(node {node['name']!r})")
+
+
+# ---------------------------------------------------------------------------
+# the Layer
+# ---------------------------------------------------------------------------
+
+# (op, input position) pairs whose initializer operand is STRUCTURE, not a
+# weight: shape/axes/index vectors, Clip bounds, BN running statistics
+_STRUCTURAL_INPUTS = {("Reshape", 1), ("Unsqueeze", 1), ("Squeeze", 1),
+                      ("ReduceMean", 1),
+                      ("Gather", 1), ("Clip", 1), ("Clip", 2),
+                      ("BatchNormalization", 3), ("BatchNormalization", 4),
+                      ("Dropout", 1)}
+
+
+class OnnxNet(Layer):
+    """An ONNX graph as a Layer: float weight initializers are params
+    (fine-tunable); shape/axes/index/statistic initializers stay host
+    constants so they never hit the optimizer or trace as Tracers."""
+
+    def __init__(self, graph: Dict[str, Any], **kwargs):
+        super().__init__(**kwargs)
+        self.nodes = graph["nodes"]
+        self.output_names = graph["outputs"]
+        self.opset = graph.get("opset", 13)
+        # graph inputs that are NOT initializers are the runtime feeds
+        self.feed_names = [n for n in graph["inputs"]
+                           if n not in graph["initializers"]]
+        # only a node's first output is computed; fail at load (not with a
+        # bare KeyError mid-call) if a secondary output is ever consumed
+        consumed = set(self.output_names)
+        for node in self.nodes:
+            consumed.update(n for n in node["inputs"] if n)
+        for node in self.nodes:
+            for extra in node["outputs"][1:]:
+                if extra and extra in consumed:
+                    raise NotImplementedError(
+                        f"node {node['name']!r} ({node['op']}): secondary "
+                        f"output {extra!r} is consumed, but only the first "
+                        f"output of each node is computed")
+        structural = set()
+        for node in self.nodes:
+            for pos, name in enumerate(node["inputs"]):
+                if (node["op"], pos) in _STRUCTURAL_INPUTS:
+                    structural.add(name)
+        self.consts = {n: np.asarray(a)
+                       for n, a in graph["initializers"].items()
+                       if n in structural
+                       or not np.issubdtype(np.asarray(a).dtype, np.floating)}
+        self._weights: Optional[Dict[str, np.ndarray]] = {
+            n: np.asarray(a) for n, a in graph["initializers"].items()
+            if n not in self.consts}
+        self._built_params: Optional[Dict[str, jnp.ndarray]] = None
+
+    def build(self, rng, input_shape=None):
+        # move (not copy) the imported weights onto the device: the host
+        # numpy copies are released so large models aren't held twice
+        if self._built_params is None:
+            self._built_params = {n: jnp.asarray(a)
+                                  for n, a in self._weights.items()}
+            self._weights = None
+        return self._built_params
+
+    def initial_state(self, input_shape=None):
+        return {}
+
+    def call(self, params, x, *, training=False, rng=None):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        if len(xs) != len(self.feed_names):
+            raise ValueError(f"expected {len(self.feed_names)} inputs "
+                             f"({self.feed_names}), got {len(xs)}")
+        vals: Dict[str, Any] = dict(self.consts)
+        vals.update(params)
+        vals.update(zip(self.feed_names, xs))
+        for i, node in enumerate(self.nodes):
+            node_rng = (jax.random.fold_in(rng, i)
+                        if rng is not None else None)
+            _run_node(node, vals, training, node_rng, self.opset)
+        outs = [vals[n] for n in self.output_names]
+        return outs[0] if len(outs) == 1 else outs
+
+
+class OnnxLoader:
+    """``OnnxLoader.load(path)`` — reference class name parity."""
+
+    @staticmethod
+    def load(path: str) -> OnnxNet:
+        return load_onnx(path)
+
+
+def load_onnx(path: str) -> OnnxNet:
+    with open(path, "rb") as f:
+        graph = _decode_model(f.read())
+    return OnnxNet(graph)

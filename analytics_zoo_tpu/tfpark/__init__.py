@@ -1,0 +1,16 @@
+"""L7 — foreign-model import / TFPark equivalent (SURVEY §1, §2.2).
+
+The reference's TFPark wraps TF sessions and estimators
+(``pyzoo/zoo/tfpark/text/estimator/bert_classifier.py``,
+``bert_estimator.py``); in the single-runtime redesign there is no second
+framework to bridge — "import" means mapping a foreign checkpoint's weights
+onto the native JAX layers. This package ships:
+
+* ``BERTClassifier`` — the BERT fine-tune estimator (config #4 surface):
+  native BERT encoder → pooled output → dropout → classifier head, trained
+  with the ordinary compile/fit stack.
+* ``bert_params_from_torch`` — weight import from a HuggingFace/torch BERT
+  ``state_dict`` (the analogue of TFPark's init_from_checkpoint path).
+"""
+
+from .bert_classifier import BERTClassifier, bert_params_from_torch  # noqa: F401

@@ -29,6 +29,13 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from .proto import (field_bytes as _field_bytes,
+                    field_double as _field_double,
+                    field_float as _field_float,
+                    field_varint as _field_varint,
+                    parse_fields as _parse_fields,
+                    parse_varint as _parse_varint)
+
 __all__ = ["EventFileWriter", "TrainSummary", "ValidationSummary",
            "read_scalars"]
 
@@ -66,34 +73,6 @@ def _masked_crc(data: bytes) -> int:
 # ---------------------------------------------------------------------------
 # minimal proto encoding (event.proto / summary.proto subset)
 # ---------------------------------------------------------------------------
-
-def _varint(n: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        if n:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
-
-
-def _field_bytes(num: int, payload: bytes) -> bytes:
-    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
-
-
-def _field_double(num: int, value: float) -> bytes:
-    return _varint((num << 3) | 1) + struct.pack("<d", value)
-
-
-def _field_float(num: int, value: float) -> bytes:
-    return _varint((num << 3) | 5) + struct.pack("<f", value)
-
-
-def _field_varint(num: int, value: int) -> bytes:
-    return _varint(num << 3) + _varint(value & 0xFFFFFFFFFFFFFFFF)
-
 
 def _scalar_event(wall_time: float, step: int, tag: str,
                   value: float) -> bytes:
@@ -168,40 +147,6 @@ def _read_records(path: str) -> Iterator[bytes]:
             if dcrc != _masked_crc(data):
                 raise IOError(f"corrupt record payload in {path}")
             yield data
-
-
-def _parse_varint(buf: bytes, i: int) -> Tuple[int, int]:
-    shift = result = 0
-    while True:
-        b = buf[i]
-        i += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, i
-        shift += 7
-
-
-def _parse_fields(buf: bytes) -> Iterator[Tuple[int, int, bytes]]:
-    """Yield (field_num, wire_type, payload_bytes) triples."""
-    i = 0
-    while i < len(buf):
-        key, i = _parse_varint(buf, i)
-        num, wt = key >> 3, key & 7
-        if wt == 0:
-            v, i = _parse_varint(buf, i)
-            yield num, wt, _varint(v)
-        elif wt == 1:
-            yield num, wt, buf[i:i + 8]
-            i += 8
-        elif wt == 2:
-            ln, i = _parse_varint(buf, i)
-            yield num, wt, buf[i:i + ln]
-            i += ln
-        elif wt == 5:
-            yield num, wt, buf[i:i + 4]
-            i += 4
-        else:
-            raise IOError(f"unsupported wire type {wt}")
 
 
 def read_scalars(log_dir: str, tag: Optional[str] = None

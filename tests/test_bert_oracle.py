@@ -1,0 +1,101 @@
+"""BERT numerical oracle (VERDICT weak #5): the native BERT layer under
+imported HuggingFace weights must reproduce transformers' BertModel outputs
+— catches gate-order / LN-placement / gelu-form divergences shape checks
+can't. Plus the BERTClassifier fine-tune path (config #4 surface)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras.layers import BERT
+from analytics_zoo_tpu.tfpark import BERTClassifier, bert_params_from_torch
+
+VOCAB, HIDDEN, BLOCKS, HEADS, SEQ, INTER = 99, 32, 2, 4, 16, 64
+
+
+def _tiny_hf_bert():
+    cfg = transformers.BertConfig(
+        vocab_size=VOCAB, hidden_size=HIDDEN, num_hidden_layers=BLOCKS,
+        num_attention_heads=HEADS, intermediate_size=INTER,
+        max_position_embeddings=SEQ, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu")
+    torch.manual_seed(0)
+    return transformers.BertModel(cfg).eval()
+
+
+def _inputs(b=3, t=SEQ, pad_from=None):
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, VOCAB, (b, t)).astype(np.int32)
+    tt = rng.integers(0, 2, (b, t)).astype(np.int32)
+    mask = np.ones((b, t), np.float32)
+    if pad_from is not None:
+        ids[:, pad_from:] = 0
+        mask[:, pad_from:] = 0.0
+    pos = np.tile(np.arange(t, dtype=np.int32), (b, 1))
+    return ids, tt, pos, mask
+
+
+@pytest.mark.parametrize("pad_from", [None, 10])
+def test_bert_matches_transformers(pad_from):
+    init_zoo_context()
+    hf = _tiny_hf_bert()
+    ids, tt, pos, mask = _inputs(pad_from=pad_from)
+
+    ours = BERT(vocab=VOCAB, hidden_size=HIDDEN, n_block=BLOCKS,
+                n_head=HEADS, seq_len=SEQ, intermediate_size=INTER,
+                hidden_drop=0.0, attn_drop=0.0)
+    import jax
+    params = ours.build(jax.random.key(0), [(None, SEQ)] * 4)
+    imported = bert_params_from_torch(hf.state_dict(), BLOCKS)
+    # same tree structure → install by matching keys
+    params = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params),
+        jax.tree_util.tree_leaves(
+            jax.tree.map(lambda x: np.asarray(x, np.float32), imported)))
+    seq_out, pooled = ours.call(params, [ids, tt, pos, mask])
+
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor(ids.astype(np.int64)),
+                 token_type_ids=torch.tensor(tt.astype(np.int64)),
+                 attention_mask=torch.tensor(mask.astype(np.int64)))
+    np.testing.assert_allclose(np.asarray(seq_out),
+                               out.last_hidden_state.numpy(),
+                               rtol=1e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(pooled),
+                               out.pooler_output.numpy(),
+                               rtol=1e-4, atol=2e-4)
+
+
+def test_bert_classifier_finetunes_from_pretrained():
+    init_zoo_context()
+    hf = _tiny_hf_bert()
+    clf = BERTClassifier(num_classes=2, vocab=VOCAB, hidden_size=HIDDEN,
+                         n_block=BLOCKS, n_head=HEADS, seq_len=SEQ,
+                         intermediate_size=INTER, hidden_drop=0.0,
+                         attn_drop=0.0)
+    clf.load_pretrained(hf.state_dict())
+
+    # trivial task: class = whether token 7 appears
+    rng = np.random.default_rng(1)
+    n = 96
+    ids = rng.integers(1, VOCAB, (n, SEQ)).astype(np.int32)
+    y = (ids == 7).any(axis=1).astype(np.int32)
+    x = clf.make_inputs(ids)
+    clf.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=3e-3)
+    h = clf.fit(x, y, batch_size=16, nb_epoch=6)
+    assert h["loss"][-1] < h["loss"][0]
+    assert clf.evaluate(x, y, batch_size=16)["accuracy"] > 0.75
+
+
+def test_import_rejects_wrong_shapes():
+    init_zoo_context()
+    hf = _tiny_hf_bert()
+    clf = BERTClassifier(num_classes=2, vocab=VOCAB, hidden_size=HIDDEN + 32,
+                         n_block=BLOCKS, n_head=HEADS, seq_len=SEQ,
+                         intermediate_size=INTER)
+    with pytest.raises(ValueError):
+        clf.load_pretrained(hf.state_dict())

@@ -1,0 +1,331 @@
+"""ONNX loader vs torch oracle: fixture .onnx files are hand-encoded
+ModelProtos (the env has no onnx package — the loader itself is the point),
+weights come from real torch modules and torch's forward is the oracle."""
+
+import struct
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.onnx import OnnxLoader, load_onnx
+from analytics_zoo_tpu.utils.proto import field_bytes, field_varint, varint
+
+
+# ---------------------------------------------------------------------------
+# minimal ModelProto encoder (test fixture generator)
+# ---------------------------------------------------------------------------
+
+def _tensor(name, arr):
+    arr = np.ascontiguousarray(arr)
+    dt = {np.dtype(np.float32): 1, np.dtype(np.int64): 7}[arr.dtype]
+    buf = b"".join(field_varint(1, d) for d in arr.shape)
+    buf += field_varint(2, dt)
+    buf += field_bytes(8, name.encode())
+    buf += field_bytes(9, arr.tobytes())
+    return buf
+
+
+def _attr_i(name, v):
+    return field_bytes(1, name.encode()) + field_varint(3, v) + \
+        field_varint(20, 2)
+
+
+def _attr_f(name, v):
+    return (field_bytes(1, name.encode())
+            + varint((2 << 3) | 5) + struct.pack("<f", v)
+            + field_varint(20, 1))
+
+
+def _attr_ints(name, vs):
+    buf = field_bytes(1, name.encode())
+    for v in vs:
+        buf += field_varint(8, v)
+    return buf + field_varint(20, 7)
+
+
+def _node(op, inputs, outputs, attrs=()):
+    buf = b"".join(field_bytes(1, i.encode()) for i in inputs)
+    buf += b"".join(field_bytes(2, o.encode()) for o in outputs)
+    buf += field_bytes(4, op.encode())
+    buf += b"".join(field_bytes(5, a) for a in attrs)
+    return buf
+
+
+def _value_info(name):
+    return field_bytes(1, name.encode())
+
+
+def _model(nodes, initializers, inputs, outputs):
+    g = b"".join(field_bytes(1, n) for n in nodes)
+    g += b"".join(field_bytes(5, t) for t in initializers)
+    g += b"".join(field_bytes(11, _value_info(i)) for i in inputs)
+    g += b"".join(field_bytes(12, _value_info(o)) for o in outputs)
+    return field_varint(1, 8) + field_bytes(7, g)  # ir_version + graph
+
+
+def _np(t):
+    return t.detach().cpu().numpy()
+
+
+# ---------------------------------------------------------------------------
+# tests
+# ---------------------------------------------------------------------------
+
+def test_mlp_matches_torch(tmp_path):
+    torch.manual_seed(0)
+    m = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 3))
+    x = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32)
+
+    nodes = [
+        _node("Gemm", ["x", "w1", "b1"], ["h1"], [_attr_i("transB", 1)]),
+        _node("Relu", ["h1"], ["h2"]),
+        _node("Gemm", ["h2", "w2", "b2"], ["h3"], [_attr_i("transB", 1)]),
+        _node("Softmax", ["h3"], ["y"], [_attr_i("axis", 1)]),
+    ]
+    inits = [_tensor("w1", _np(m[0].weight)), _tensor("b1", _np(m[0].bias)),
+             _tensor("w2", _np(m[2].weight)), _tensor("b2", _np(m[2].bias))]
+    path = tmp_path / "mlp.onnx"
+    path.write_bytes(_model(nodes, inits,
+                            ["x", "w1", "b1", "w2", "b2"], ["y"]))
+
+    net = load_onnx(str(path))
+    assert net.feed_names == ["x"]
+    params = net.build(None)
+    got = np.asarray(net.call(params, np.asarray(x)))
+    with torch.no_grad():
+        want = torch.softmax(m(torch.tensor(x)), dim=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_cnn_matches_torch(tmp_path):
+    torch.manual_seed(1)
+    conv = nn.Conv2d(2, 5, 3, stride=1, padding=1)
+    bn = nn.BatchNorm2d(5).eval()
+    bn.running_mean.normal_(); bn.running_var.uniform_(0.5, 2.0)
+    fc = nn.Linear(5 * 4 * 4, 3)
+    x = np.random.default_rng(1).normal(size=(2, 2, 8, 8)).astype(np.float32)
+
+    nodes = [
+        _node("Conv", ["x", "cw", "cb"], ["c1"],
+              [_attr_ints("kernel_shape", [3, 3]),
+               _attr_ints("strides", [1, 1]),
+               _attr_ints("pads", [1, 1, 1, 1])]),
+        _node("BatchNormalization", ["c1", "g", "b", "rm", "rv"], ["c2"],
+              [_attr_f("epsilon", bn.eps)]),
+        _node("Relu", ["c2"], ["c3"]),
+        _node("MaxPool", ["c3"], ["p1"],
+              [_attr_ints("kernel_shape", [2, 2]),
+               _attr_ints("strides", [2, 2])]),
+        _node("Flatten", ["p1"], ["f1"], [_attr_i("axis", 1)]),
+        _node("Gemm", ["f1", "fw", "fb"], ["y"], [_attr_i("transB", 1)]),
+    ]
+    inits = [_tensor("cw", _np(conv.weight)), _tensor("cb", _np(conv.bias)),
+             _tensor("g", _np(bn.weight)), _tensor("b", _np(bn.bias)),
+             _tensor("rm", _np(bn.running_mean)),
+             _tensor("rv", _np(bn.running_var)),
+             _tensor("fw", _np(fc.weight)), _tensor("fb", _np(fc.bias))]
+    path = tmp_path / "cnn.onnx"
+    path.write_bytes(_model(nodes, inits, ["x"], ["y"]))
+
+    net = OnnxLoader.load(str(path))
+    got = np.asarray(net.call(net.build(None), np.asarray(x)))
+    with torch.no_grad():
+        want = fc(torch.flatten(
+            torch.max_pool2d(torch.relu(bn(conv(torch.tensor(x)))), 2),
+            1)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_imported_model_fine_tunes(tmp_path):
+    """Initializers are params: the imported graph trains under fit()."""
+    init_zoo_context()
+    torch.manual_seed(2)
+    m = nn.Sequential(nn.Linear(5, 8), nn.ReLU(), nn.Linear(8, 2))
+    nodes = [
+        _node("Gemm", ["x", "w1", "b1"], ["h1"], [_attr_i("transB", 1)]),
+        _node("Relu", ["h1"], ["h2"]),
+        _node("Gemm", ["h2", "w2", "b2"], ["y"], [_attr_i("transB", 1)]),
+        _node("Softmax", ["y"], ["probs"], [_attr_i("axis", 1)]),
+    ]
+    inits = [_tensor("w1", _np(m[0].weight)), _tensor("b1", _np(m[0].bias)),
+             _tensor("w2", _np(m[2].weight)), _tensor("b2", _np(m[2].bias))]
+    path = tmp_path / "ft.onnx"
+    path.write_bytes(_model(nodes, inits, ["x"], ["probs"]))
+
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    net = load_onnx(str(path))
+    model = Sequential()
+    model.add(net)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(256, 5)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    model.init_weights(sample_input=x)
+    model.compile(optimizer="adam", loss="scce", metrics=["accuracy"],
+                  lr=0.02)
+    h = model.fit(x, y, batch_size=32, nb_epoch=8)
+    assert h["loss"][-1] < h["loss"][0]
+    assert model.evaluate(x, y, batch_size=32)["accuracy"] > 0.9
+
+
+def test_reshape_and_gather_initializers_stay_constants(tmp_path):
+    """Shape vectors and integer index tables must NOT become params: they
+    would crash under jit tracing (np.asarray of a Tracer) and under grad
+    (integer leaves). Model: Gather(embed, idx) → Reshape → Gemm."""
+    init_zoo_context()
+    torch.manual_seed(3)
+    table = np.random.default_rng(3).normal(size=(10, 4)).astype(np.float32)
+    idx = np.array([1, 3, 5], np.int64)
+    w = np.random.default_rng(4).normal(size=(12, 2)).astype(np.float32)
+
+    nodes = [
+        _node("Gather", ["table", "idx"], ["g"], [_attr_i("axis", 0)]),
+        # (3, 4) rows → broadcast-add x then flatten via Reshape initializer
+        _node("Reshape", ["g", "shape"], ["flat"]),
+        _node("Add", ["flat", "x"], ["h"]),
+        _node("MatMul", ["h", "w"], ["y"]),
+    ]
+    inits = [_tensor("table", table), _tensor("idx", idx),
+             _tensor("shape", np.array([1, 12], np.int64)), _tensor("w", w)]
+    path = tmp_path / "gather.onnx"
+    path.write_bytes(_model(nodes, inits, ["x"], ["y"]))
+
+    net = load_onnx(str(path))
+    # structural/int initializers are constants, not params
+    params = net.build(None)
+    assert set(params) == {"table", "w"}
+    assert set(net.consts) == {"idx", "shape"}
+
+    import jax
+    x = np.random.default_rng(5).normal(size=(1, 12)).astype(np.float32)
+    got = np.asarray(jax.jit(
+        lambda p, xx: net.call(p, xx))(params, x))  # traced: must not crash
+    want = (table[idx].reshape(1, 12) + x) @ w
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    # gradients flow through float params only
+    g = jax.grad(lambda p: jax.numpy.sum(net.call(p, x)))(params)
+    assert set(g) == {"table", "w"}
+
+
+def test_packed_dims_and_constant_value_float(tmp_path):
+    """proto3 packs repeated int64 `dims` into one length-delimited field —
+    that's what real exporters emit; and Constant may carry value_float
+    instead of a tensor attribute."""
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t = (field_bytes(1, b"".join(varint(d) for d in w.shape))  # packed dims
+         + field_varint(2, 1) + field_bytes(8, b"w")
+         + field_bytes(9, w.tobytes()))
+    nodes = [
+        _node("Constant", [], ["c"], [_attr_f("value_float", 2.5)]),
+        _node("Mul", ["x", "c"], ["s"]),
+        _node("MatMul", ["s", "w"], ["y"]),
+    ]
+    path = tmp_path / "packed.onnx"
+    path.write_bytes(_model(nodes, [t], ["x"], ["y"]))
+    net = load_onnx(str(path))
+    x = np.random.default_rng(6).normal(size=(2, 3)).astype(np.float32)
+    got = np.asarray(net.call(net.build(None), x))
+    np.testing.assert_allclose(got, (x * 2.5) @ w, rtol=1e-5, atol=1e-5)
+
+
+def test_consumed_secondary_output_fails_at_load(tmp_path):
+    """Only a node's first output is computed; a graph consuming a secondary
+    output (e.g. MaxPool Indices) must fail loudly at load time."""
+    nodes = [
+        _node("MaxPool", ["x"], ["p", "indices"],
+              [_attr_ints("kernel_shape", [2, 2])]),
+        _node("Relu", ["indices"], ["y"]),
+    ]
+    path = tmp_path / "multi_out.onnx"
+    path.write_bytes(_model(nodes, [], ["x"], ["y"]))
+    with pytest.raises(NotImplementedError, match="secondary"):
+        load_onnx(str(path))
+
+
+def test_avgpool_count_include_pad_matches_torch(tmp_path):
+    """torch AvgPool2d default exports count_include_pad=1: padded zeros
+    count in the divisor."""
+    x = np.random.default_rng(7).normal(size=(1, 1, 4, 4)).astype(np.float32)
+    for include in (0, 1):
+        nodes = [_node("AveragePool", ["x"], ["y"],
+                       [_attr_ints("kernel_shape", [2, 2]),
+                        _attr_ints("strides", [2, 2]),
+                        _attr_ints("pads", [1, 1, 1, 1]),
+                        _attr_i("count_include_pad", include)])]
+        path = tmp_path / f"ap{include}.onnx"
+        path.write_bytes(_model(nodes, [], ["x"], ["y"]))
+        net = load_onnx(str(path))
+        got = np.asarray(net.call({}, x))
+        with torch.no_grad():
+            want = torch.nn.functional.avg_pool2d(
+                torch.tensor(x), 2, 2, padding=1,
+                count_include_pad=bool(include)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_conv1d_and_reduce_mean_axes_input(tmp_path):
+    """Conv generalizes to 1D (text CNNs), and opset-18 ReduceMean takes
+    axes as a second input tensor rather than an attribute."""
+    torch.manual_seed(4)
+    conv = nn.Conv1d(2, 3, 3)
+    x = np.random.default_rng(8).normal(size=(2, 2, 9)).astype(np.float32)
+    nodes = [
+        _node("Conv", ["x", "cw", "cb"], ["c"],
+              [_attr_ints("kernel_shape", [3])]),
+        _node("Relu", ["c"], ["r"]),
+        _node("ReduceMean", ["r", "axes"], ["y"], [_attr_i("keepdims", 0)]),
+    ]
+    inits = [_tensor("cw", _np(conv.weight)), _tensor("cb", _np(conv.bias)),
+             _tensor("axes", np.array([2], np.int64))]
+    path = tmp_path / "c1d.onnx"
+    path.write_bytes(_model(nodes, inits, ["x"], ["y"]))
+    net = load_onnx(str(path))
+    params = net.build(None)
+    assert "axes" not in params  # structural, not a weight
+    got = np.asarray(net.call(params, x))
+    with torch.no_grad():
+        want = torch.relu(conv(torch.tensor(x))).mean(dim=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_opset11_softmax_flattens(tmp_path):
+    """opset <13 Softmax has flatten-to-2D semantics with default axis=1."""
+    x = np.random.default_rng(9).normal(size=(2, 3, 4)).astype(np.float32)
+    nodes = [_node("Softmax", ["x"], ["y"])]
+    g = b"".join(field_bytes(1, n) for n in nodes)
+    g += field_bytes(11, _value_info("x")) + field_bytes(12, _value_info("y"))
+    opset = field_varint(2, 11)  # OperatorSetIdProto{version=11}, domain=""
+    path = tmp_path / "sm11.onnx"
+    path.write_bytes(field_varint(1, 6) + field_bytes(7, g)
+                     + field_bytes(8, opset))
+    net = load_onnx(str(path))
+    assert net.opset == 11
+    got = np.asarray(net.call({}, x))
+    with torch.no_grad():
+        want = torch.softmax(torch.tensor(x).reshape(2, 12),
+                             dim=1).reshape(2, 3, 4).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_ceil_mode_pool_is_loud(tmp_path):
+    nodes = [_node("MaxPool", ["x"], ["y"],
+                   [_attr_ints("kernel_shape", [3, 3]),
+                    _attr_i("ceil_mode", 1)])]
+    path = tmp_path / "ceil.onnx"
+    path.write_bytes(_model(nodes, [], ["x"], ["y"]))
+    net = load_onnx(str(path))
+    with pytest.raises(NotImplementedError, match="ceil_mode"):
+        net.call({}, np.zeros((1, 1, 5, 5), np.float32))
+
+
+def test_unsupported_op_is_loud(tmp_path):
+    nodes = [_node("FancyCustomOp", ["x"], ["y"])]
+    path = tmp_path / "bad.onnx"
+    path.write_bytes(_model(nodes, [], ["x"], ["y"]))
+    net = load_onnx(str(path))
+    with pytest.raises(NotImplementedError):
+        net.call({}, np.zeros((1, 2), np.float32))

@@ -1,0 +1,7 @@
+from .bbox import (bbox_iou, decode_boxes, encode_boxes, nms_mask,  # noqa: F401
+                   batched_detection_output)
+from .priors import PriorBox, ssd_priors  # noqa: F401
+from .multibox_loss import MultiBoxLoss  # noqa: F401
+from .ssd import ssd_vgg, ssd_lite  # noqa: F401
+from .object_detector import ObjectDetector, DetectionOutputParam  # noqa: F401
+from .evaluation import MeanAveragePrecision, average_precision  # noqa: F401

@@ -1,0 +1,140 @@
+"""Box utilities — the jittable core under SSD training and detection
+output (parity with ``objectdetection/common/BboxUtil.scala``: IoU, the
+Caffe-SSD center-offset encode/decode with variances, per-class NMS, and
+the decode→NMS→keep-topk detection output of ``Postprocessor.scala``).
+
+TPU-first design: every function is static-shape. NMS is a fixed-size
+suppression mask computed from the full IoU matrix with a ``fori_loop``
+(no dynamic gather/compaction — XLA keeps it on-chip), and the detection
+output is a fixed ``(keep_topk, 6)`` table padded with label ``-1`` rather
+than a ragged per-image list.
+
+Boxes are corner-format ``(x1, y1, x2, y2)``, normalized to [0, 1].
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["bbox_iou", "encode_boxes", "decode_boxes", "nms_mask",
+           "detection_output", "batched_detection_output"]
+
+
+def bbox_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU. a: (N, 4), b: (M, 4) → (N, M)."""
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0.0) * jnp.clip(a[:, 3] - a[:, 1], 0.0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0.0) * jnp.clip(b[:, 3] - b[:, 1], 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _center_form(boxes):
+    wh = boxes[..., 2:] - boxes[..., :2]
+    return (boxes[..., :2] + boxes[..., 2:]) * 0.5, wh
+
+
+def encode_boxes(gt: jnp.ndarray, priors: jnp.ndarray,
+                 variances=(0.1, 0.1, 0.2, 0.2)) -> jnp.ndarray:
+    """Caffe-SSD regression targets (``BboxUtil.encodeBoxes``): center
+    offsets scaled by prior size / variance, log-space sizes."""
+    g_c, g_wh = _center_form(jnp.asarray(gt, jnp.float32))
+    p_c, p_wh = _center_form(jnp.asarray(priors, jnp.float32))
+    v = jnp.asarray(variances, jnp.float32)
+    p_wh = jnp.maximum(p_wh, 1e-8)
+    g_wh = jnp.maximum(g_wh, 1e-8)
+    d_c = (g_c - p_c) / (p_wh * v[:2])
+    d_wh = jnp.log(g_wh / p_wh) / v[2:]
+    return jnp.concatenate([d_c, d_wh], axis=-1)
+
+
+def decode_boxes(loc: jnp.ndarray, priors: jnp.ndarray,
+                 variances=(0.1, 0.1, 0.2, 0.2)) -> jnp.ndarray:
+    """Inverse of :func:`encode_boxes` (``BboxUtil.decodeBoxes``)."""
+    p_c, p_wh = _center_form(jnp.asarray(priors, jnp.float32))
+    v = jnp.asarray(variances, jnp.float32)
+    c = loc[..., :2] * v[:2] * p_wh + p_c
+    wh = jnp.exp(loc[..., 2:] * v[2:]) * p_wh
+    return jnp.concatenate([c - wh * 0.5, c + wh * 0.5], axis=-1)
+
+
+def nms_mask(boxes: jnp.ndarray,
+             iou_threshold: float = 0.45) -> jnp.ndarray:
+    """Greedy NMS as a keep-mask over score-DESCENDING-sorted boxes.
+
+    Returns a bool (N,) mask: True where the box survives. Caller sorts
+    (row order IS the suppression priority); keeping the sort outside makes
+    the suppression loop a pure static-shape scan over the IoU matrix
+    (O(N²) memory — N here is nms_topk, a few hundred, so the matrix is
+    tiny next to the conv activations).
+    """
+    n = boxes.shape[0]
+    iou = bbox_iou(boxes, boxes)
+    idx = jnp.arange(n)
+
+    def body(i, keep):
+        sup = (iou[i] > iou_threshold) & (idx > i) & keep[i]
+        return keep & ~sup
+
+    return jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+
+
+def _top_rows(arr: jnp.ndarray, scores: jnp.ndarray, k: int):
+    """Rows of ``arr`` at the top-k scores (descending), static shape."""
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    return arr[top_idx], top_scores
+
+
+@partial(jax.jit, static_argnames=("num_classes", "nms_topk", "keep_topk",
+                                   "bg_label"))
+def detection_output(loc: jnp.ndarray, conf: jnp.ndarray,
+                     priors: jnp.ndarray, *, num_classes: int,
+                     conf_thresh: float = 0.01, nms_thresh: float = 0.45,
+                     nms_topk: int = 400, keep_topk: int = 200,
+                     bg_label: int = 0,
+                     variances=(0.1, 0.1, 0.2, 0.2)) -> jnp.ndarray:
+    """One image: (n_priors, 4) loc + (n_priors, C) scores → fixed
+    ``(keep_topk, 6)`` detections ``[label, score, x1, y1, x2, y2]`` sorted
+    by score, padded with label -1 (``Postprocessor.scala`` semantics:
+    per-class conf-threshold → per-class NMS → global keep-topk)."""
+    boxes = jnp.clip(decode_boxes(loc, priors, variances), 0.0, 1.0)
+
+    def per_class(c):
+        s = jnp.where(conf[:, c] >= conf_thresh, conf[:, c], 0.0)
+        cand_boxes, cand_scores = _top_rows(boxes, s, min(nms_topk, s.shape[0]))
+        keep = nms_mask(cand_boxes, nms_thresh)
+        return cand_boxes, jnp.where(keep, cand_scores, 0.0)
+
+    classes = jnp.arange(num_classes)
+    all_boxes, all_scores = jax.vmap(per_class)(classes)  # (C, K, 4/[])
+    # background contributes nothing
+    all_scores = jnp.where(classes[:, None] == bg_label, 0.0, all_scores)
+    labels = jnp.broadcast_to(classes[:, None], all_scores.shape)
+    flat_boxes = all_boxes.reshape(-1, 4)
+    flat_scores = all_scores.reshape(-1)
+    flat_labels = labels.reshape(-1)
+    top_scores, top_idx = jax.lax.top_k(flat_scores,
+                                        min(keep_topk, flat_scores.shape[0]))
+    out_label = jnp.where(top_scores > 0,
+                          flat_labels[top_idx].astype(jnp.float32), -1.0)
+    det = jnp.concatenate([out_label[:, None], top_scores[:, None],
+                           flat_boxes[top_idx]], axis=-1)
+    if det.shape[0] < keep_topk:  # pad when total candidates < keep_topk
+        pad = jnp.full((keep_topk - det.shape[0], 6), -1.0)
+        det = jnp.concatenate([det, pad.at[:, 1:].set(0.0)], axis=0)
+    return det
+
+
+def batched_detection_output(loc, conf, priors, **kw) -> jnp.ndarray:
+    """(B, n_priors, 4) + (B, n_priors, C) → (B, keep_topk, 6)."""
+    return jax.vmap(lambda l, c: detection_output(l, c, priors, **kw))(
+        jnp.asarray(loc), jnp.asarray(conf))

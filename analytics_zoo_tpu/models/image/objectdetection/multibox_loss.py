@@ -1,0 +1,106 @@
+"""MultiBoxLoss — SSD training objective (parity with
+``objectdetection/common/loss/MultiBoxLoss.scala``: prior↔gt matching with
+a forced best-prior-per-gt assignment, smooth-L1 localization loss on
+encoded offsets, softmax confidence loss with 3:1 hard negative mining,
+normalized by the positive count).
+
+TPU-first: the whole loss — matching included — is one jittable function
+over fixed shapes. Ground truth arrives as a padded ``(B, max_gt, 5)``
+tensor ``[label, x1, y1, x2, y2]`` with label ``-1`` marking padding (the
+reference instead carries ragged per-image tables through the JVM; padding
++ masking is the XLA-native equivalent). Hard negative mining uses a
+rank-vs-threshold mask instead of sort-and-slice so shapes stay static.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .bbox import bbox_iou, encode_boxes
+
+__all__ = ["MultiBoxLoss", "match_priors"]
+
+
+def match_priors(gt: jnp.ndarray, priors: jnp.ndarray,
+                 iou_threshold: float = 0.5
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One image. gt: (max_gt, 5) padded with label -1; priors (P, 4).
+
+    Returns (matched_gt_idx (P,), positive mask (P,)):
+    * a prior is positive when its best gt IoU > threshold, OR when it is
+      the single best prior for some valid gt (the forced assignment that
+      guarantees every object gets at least one prior);
+    * matched_gt_idx points each prior at its assigned gt row.
+    """
+    valid = gt[:, 0] >= 0  # (G,)
+    iou = bbox_iou(priors, gt[:, 1:5]) * valid[None, :]  # (P, G)
+    best_gt = jnp.argmax(iou, axis=1)                    # (P,)
+    best_gt_iou = jnp.max(iou, axis=1)
+    # forced: for each valid gt g, its argmax prior is matched to g.
+    # Padding rows scatter to an out-of-range index and are dropped.
+    best_prior = jnp.argmax(iou, axis=0)                 # (G,)
+    scatter_to = jnp.where(valid, best_prior, priors.shape[0])
+    forced = jnp.zeros(priors.shape[0], bool).at[scatter_to].set(
+        True, mode="drop")
+    gt_idx = best_gt.at[scatter_to].set(jnp.arange(gt.shape[0]), mode="drop")
+    pos = (best_gt_iou > iou_threshold) | forced
+    return gt_idx, pos
+
+
+def _smooth_l1(x):
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0, 0.5 * x * x, ax - 0.5)
+
+
+class MultiBoxLoss:
+    """Callable loss for ``compile(loss=MultiBoxLoss(...))``. The model
+    output is the concatenated ``(B, P, 4 + num_classes)`` loc‖conf-logits
+    tensor; targets are padded ``(B, max_gt, 5)`` boxes."""
+
+    def __init__(self, num_classes: int, priors: np.ndarray,
+                 iou_threshold: float = 0.5, neg_pos_ratio: float = 3.0,
+                 bg_label: int = 0,
+                 variances=(0.1, 0.1, 0.2, 0.2)):
+        self.num_classes = int(num_classes)
+        self.priors = jnp.asarray(priors, jnp.float32)
+        self.iou_threshold = float(iou_threshold)
+        self.neg_pos_ratio = float(neg_pos_ratio)
+        self.bg_label = int(bg_label)
+        self.variances = tuple(variances)
+        self.__name__ = "multibox_loss"
+
+    def __call__(self, y_true, y_pred):
+        gt = jnp.asarray(y_true, jnp.float32)        # (B, G, 5)
+        loc = y_pred[..., :4]                        # (B, P, 4)
+        logits = y_pred[..., 4:]                     # (B, P, C)
+
+        def one(gt_i, loc_i, logits_i):
+            gt_idx, pos = match_priors(gt_i, self.priors, self.iou_threshold)
+            npos = jnp.sum(pos.astype(jnp.float32))
+
+            # localization: smooth-L1 on encoded offsets, positives only
+            target = encode_boxes(gt_i[gt_idx, 1:5], self.priors,
+                                  self.variances)
+            loc_loss = jnp.sum(_smooth_l1(loc_i - target).sum(-1) * pos)
+
+            # confidence: CE against matched label (bg for negatives)
+            labels = jnp.where(pos, gt_i[gt_idx, 0].astype(jnp.int32),
+                               self.bg_label)
+            logp = jax.nn.log_softmax(logits_i, axis=-1)
+            ce = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+
+            # hard negative mining: top (ratio * npos) negatives by CE
+            neg_ce = jnp.where(pos, -jnp.inf, ce)
+            order = jnp.argsort(-neg_ce)
+            rank = jnp.argsort(order)  # rank[i] = position of prior i
+            n_neg = jnp.minimum(self.neg_pos_ratio * npos,
+                                jnp.sum(~pos).astype(jnp.float32))
+            neg = (rank < n_neg) & ~pos
+            conf_loss = jnp.sum(ce * pos) + jnp.sum(ce * neg)
+            return (loc_loss + conf_loss) / jnp.maximum(npos, 1.0)
+
+        return jnp.mean(jax.vmap(one)(gt, loc, logits))

@@ -1,0 +1,105 @@
+"""ObjectDetector — zoo-model wrapper for SSD detection (parity with
+``objectdetection/ObjectDetector.scala`` + ``Postprocessor.scala``:
+model forward → decode → per-class NMS → keep-topk, plus save/load through
+the ZooModel registry).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ...common.zoo_model import ZooModel, register_model
+from .bbox import batched_detection_output
+from .multibox_loss import MultiBoxLoss
+from .ssd import ssd_lite, ssd_vgg
+
+__all__ = ["ObjectDetector", "DetectionOutputParam"]
+
+
+@dataclass
+class DetectionOutputParam:
+    """``DetectionOutputParam`` (``Postprocessor.scala``) — postprocess
+    knobs."""
+    nms_thresh: float = 0.45
+    nms_topk: int = 400
+    keep_topk: int = 200
+    conf_thresh: float = 0.01
+    bg_label: int = 0
+
+
+_TOPOLOGIES = {"ssd-vgg16-300": ssd_vgg, "ssd-lite": ssd_lite}
+
+
+@register_model
+class ObjectDetector(ZooModel):
+    """``ObjectDetector(model_name, num_classes)``. Class 0 is background
+    (``bgLabel=0``, ``SSD.scala``). ``detect`` returns a fixed
+    ``(B, keep_topk, 6)`` table ``[label, score, x1, y1, x2, y2]`` with
+    label ``-1`` padding."""
+
+    def __init__(self, model_name: str = "ssd-lite", num_classes: int = 21,
+                 resolution: Optional[int] = None,
+                 post_param: Optional[DetectionOutputParam] = None,
+                 name: Optional[str] = None):
+        if model_name not in _TOPOLOGIES:
+            raise ValueError(f"unknown topology {model_name!r}; "
+                             f"available: {sorted(_TOPOLOGIES)}")
+        self.model_name = model_name
+        self.num_classes = int(num_classes)
+        self.resolution = int(resolution) if resolution else (
+            300 if model_name == "ssd-vgg16-300" else 64)
+        self.post_param = post_param or DetectionOutputParam()
+        self.priors: Optional[np.ndarray] = None
+        super().__init__(name=name)
+
+    def build_model(self):
+        net, priors = _TOPOLOGIES[self.model_name](
+            num_classes=self.num_classes, resolution=self.resolution)
+        self.priors = priors
+        return net
+
+    def get_config(self) -> Dict[str, Any]:
+        p = self.post_param
+        return {"model_name": self.model_name,
+                "num_classes": self.num_classes,
+                "resolution": self.resolution,
+                "post_param": {"nms_thresh": p.nms_thresh,
+                               "nms_topk": p.nms_topk,
+                               "keep_topk": p.keep_topk,
+                               "conf_thresh": p.conf_thresh,
+                               "bg_label": p.bg_label}}
+
+    @classmethod
+    def from_config(cls, config: Dict[str, Any]) -> "ObjectDetector":
+        cfg = dict(config)
+        pp = cfg.pop("post_param", None)
+        if pp is not None:
+            cfg["post_param"] = DetectionOutputParam(**pp)
+        return cls(**cfg)
+
+    def multibox_loss(self, **kw) -> MultiBoxLoss:
+        """The matching loss bound to this model's priors — pass to
+        ``compile(loss=...)``."""
+        if self.priors is None:  # build_model always ran in __init__
+            raise RuntimeError("model priors missing — build_model() did "
+                               "not run")
+        return MultiBoxLoss(self.num_classes, self.priors,
+                            bg_label=self.post_param.bg_label, **kw)
+
+    def detect(self, images: np.ndarray, batch_size: int = 32,
+               conf_thresh: Optional[float] = None) -> np.ndarray:
+        """Images (B, H, W, 3) → detections (B, keep_topk, 6)."""
+        raw = np.asarray(self.predict(images, batch_size=batch_size))
+        loc, conf = raw[..., :4], raw[..., 4:]
+        import jax
+        probs = np.asarray(jax.nn.softmax(conf, axis=-1))
+        p = self.post_param
+        return np.asarray(batched_detection_output(
+            loc, probs, self.priors, num_classes=self.num_classes,
+            conf_thresh=(p.conf_thresh if conf_thresh is None
+                         else conf_thresh),
+            nms_thresh=p.nms_thresh, nms_topk=p.nms_topk,
+            keep_topk=p.keep_topk, bg_label=p.bg_label))

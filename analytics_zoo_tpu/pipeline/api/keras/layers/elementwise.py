@@ -114,14 +114,19 @@ class CMul(Layer):
 
 
 class Scale(Layer):
-    """``Scale(size)`` — CMul then CAdd (affine per broadcastable block)."""
+    """``Scale(size)`` — CMul then CAdd (affine per broadcastable block).
+    ``init_weight`` sets the initial multiplier (e.g. SSD's conv4_3 norm
+    scale starts at 20)."""
 
-    def __init__(self, size: Sequence[int], **kwargs):
+    def __init__(self, size: Sequence[int], init_weight: float = 1.0,
+                 **kwargs):
         super().__init__(**kwargs)
         self.size = tuple(int(s) for s in size)
+        self.init_weight = float(init_weight)
 
     def build(self, rng, input_shape):
-        return {"weight": jnp.ones(self.size, param_dtype()),
+        return {"weight": jnp.full(self.size, self.init_weight,
+                                   param_dtype()),
                 "bias": jnp.zeros(self.size, param_dtype())}
 
     def call(self, params, x, *, training=False, rng=None):

@@ -1,0 +1,248 @@
+"""Object detection: bbox ops vs numpy/torch oracles, NMS vs a naive
+reference, MultiBoxLoss matching semantics, VOC mAP on hand cases, and a
+tiny SSD that learns to localize a synthetic square."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.models.image.objectdetection import (
+    DetectionOutputParam, MeanAveragePrecision, MultiBoxLoss, ObjectDetector,
+    PriorBox, average_precision, batched_detection_output, bbox_iou,
+    decode_boxes, encode_boxes, nms_mask, ssd_lite, ssd_priors)
+from analytics_zoo_tpu.models.image.objectdetection.multibox_loss import (
+    match_priors)
+
+
+def _naive_iou(a, b):
+    out = np.zeros((len(a), len(b)))
+    for i, x in enumerate(a):
+        for j, y in enumerate(b):
+            lt = np.maximum(x[:2], y[:2])
+            rb = np.minimum(x[2:], y[2:])
+            wh = np.clip(rb - lt, 0, None)
+            inter = wh[0] * wh[1]
+            ua = ((x[2] - x[0]) * (x[3] - x[1])
+                  + (y[2] - y[0]) * (y[3] - y[1]) - inter)
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+def _rand_boxes(rng, n):
+    xy = rng.uniform(0, 0.7, size=(n, 2))
+    wh = rng.uniform(0.05, 0.3, size=(n, 2))
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+def test_iou_matches_naive():
+    rng = np.random.default_rng(0)
+    a, b = _rand_boxes(rng, 7), _rand_boxes(rng, 5)
+    np.testing.assert_allclose(np.asarray(bbox_iou(a, b)),
+                               _naive_iou(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_encode_decode_roundtrip():
+    rng = np.random.default_rng(1)
+    priors = _rand_boxes(rng, 20)
+    gt = _rand_boxes(rng, 20)
+    enc = encode_boxes(gt, priors)
+    dec = np.asarray(decode_boxes(enc, priors))
+    np.testing.assert_allclose(dec, gt, rtol=1e-4, atol=1e-5)
+
+
+def test_nms_matches_naive():
+    rng = np.random.default_rng(2)
+    boxes = _rand_boxes(rng, 40)
+    scores = rng.uniform(size=40).astype(np.float32)
+    order = np.argsort(-scores)
+    boxes_s, scores_s = boxes[order], scores[order]
+    keep = np.asarray(nms_mask(boxes_s, 0.5))
+
+    # naive greedy NMS
+    iou = _naive_iou(boxes_s, boxes_s)
+    naive_keep = np.ones(40, bool)
+    for i in range(40):
+        if not naive_keep[i]:
+            continue
+        for j in range(i + 1, 40):
+            if iou[i, j] > 0.5:
+                naive_keep[j] = False
+    np.testing.assert_array_equal(keep, naive_keep)
+
+
+def test_match_priors_forced_assignment():
+    """A gt with max IoU below the threshold still gets its best prior."""
+    priors = np.array([[0.0, 0.0, 0.4, 0.4],
+                       [0.5, 0.5, 0.9, 0.9],
+                       [0.1, 0.6, 0.3, 0.9]], np.float32)
+    gt = np.array([[1, 0.05, 0.05, 0.45, 0.45],   # high IoU with prior 0
+                   [2, 0.45, 0.45, 0.55, 0.55],   # low IoU everywhere
+                   [-1, 0, 0, 0, 0]], np.float32)  # padding
+    gt_idx, pos = map(np.asarray, match_priors(gt, priors, 0.5))
+    assert pos[0] and gt_idx[0] == 0          # IoU > 0.5 match
+    forced_prior = int(np.argmax(_naive_iou(priors, gt[1:2, 1:5])[:, 0]))
+    assert pos[forced_prior] and gt_idx[forced_prior] == 1
+    assert pos.sum() == 2                     # padding row matched nothing
+
+
+def test_multibox_loss_prefers_correct_output():
+    rng = np.random.default_rng(3)
+    priors = _rand_boxes(rng, 30)
+    loss = MultiBoxLoss(num_classes=3, priors=priors)
+    gt = np.array([[[1, *priors[4]], [2, *priors[17]]]], np.float32)
+
+    perfect = np.zeros((1, 30, 7), np.float32)
+    perfect[..., 4] = 8.0          # background logit
+    perfect[0, 4, 4:] = [0, 8, 0]  # prior 4 → class 1
+    perfect[0, 17, 4:] = [0, 0, 8]
+    # loc offsets are zero == priors decode to themselves == the gt boxes
+    bad = np.zeros((1, 30, 7), np.float32)
+    bad[..., 5] = 8.0              # everything claims class 1
+
+    l_good = float(loss(gt, perfect))
+    l_bad = float(loss(gt, bad))
+    assert l_good < 0.1
+    assert l_bad > l_good + 1.0
+
+
+def test_hard_negative_mining_ratio():
+    """With 1 positive, at most ceil(3*1) negatives contribute conf loss."""
+    rng = np.random.default_rng(4)
+    priors = _rand_boxes(rng, 50)
+    loss = MultiBoxLoss(num_classes=2, priors=priors, neg_pos_ratio=3.0)
+    gt = np.zeros((1, 1, 5), np.float32)
+    gt[0, 0] = [1, *priors[0]]
+    # uniform wrong logits: every negative has identical CE c
+    pred = np.zeros((1, 50, 6), np.float32)
+    val = float(loss(gt, pred))
+    # CE per prior = log(2); 1 pos + 3 negs → 4*log2 + loc 0, / npos=1
+    assert abs(val - 4 * np.log(2.0)) < 1e-3
+
+
+def test_detection_output_shapes_and_nms():
+    rng = np.random.default_rng(5)
+    priors = _rand_boxes(rng, 30)
+    loc = np.zeros((2, 30, 4), np.float32)
+    conf = np.full((2, 30, 3), 0.01, np.float32)
+    conf[0, 7, 1] = 0.95   # one strong class-1 det in image 0
+    conf[1, 3, 2] = 0.9
+    conf[1, 21, 2] = 0.85
+    det = np.asarray(batched_detection_output(
+        loc, conf, priors, num_classes=3, conf_thresh=0.5, keep_topk=10))
+    assert det.shape == (2, 10, 6)
+    assert det[0, 0, 0] == 1 and abs(det[0, 0, 1] - 0.95) < 1e-5
+    np.testing.assert_allclose(det[0, 0, 2:],
+                               np.clip(priors[7], 0, 1), atol=1e-5)
+    assert (det[0, 1:, 0] == -1).all()
+    assert det[1, 0, 0] == 2 and det[1, 1, 0] == 2  # non-overlapping kept
+
+
+def test_detection_output_suppresses_overlaps():
+    priors = np.array([[0.1, 0.1, 0.5, 0.5],
+                       [0.12, 0.12, 0.52, 0.52],   # heavy overlap with 0
+                       [0.6, 0.6, 0.9, 0.9]], np.float32)
+    loc = np.zeros((1, 3, 4), np.float32)
+    conf = np.zeros((1, 3, 2), np.float32)
+    conf[0, :, 1] = [0.9, 0.8, 0.7]
+    det = np.asarray(batched_detection_output(
+        loc, conf, priors, num_classes=2, conf_thresh=0.5, nms_thresh=0.45,
+        keep_topk=3))
+    labels = det[0, :, 0]
+    assert (labels >= 0).sum() == 2  # the 0.8 duplicate was suppressed
+    assert abs(det[0, 0, 1] - 0.9) < 1e-6 and abs(det[0, 1, 1] - 0.7) < 1e-6
+
+
+def test_average_precision_hand_cases():
+    # perfect: 2 detections, both tp, 2 gt → AP 1
+    assert average_precision(np.array([0.9, 0.8]), np.array([1, 1]), 2) == 1.0
+    # one tp then one fp, 2 gt: precision env → AP = 0.5
+    ap = average_precision(np.array([0.9, 0.8]), np.array([1, 0]), 2)
+    assert abs(ap - 0.5) < 1e-6
+    assert average_precision(np.zeros(0), np.zeros(0), 0) == 0.0
+
+
+def test_map_streaming():
+    m = MeanAveragePrecision(num_classes=3)
+    gt = np.array([[[1, 0.1, 0.1, 0.4, 0.4],
+                    [2, 0.5, 0.5, 0.8, 0.8]]], np.float32)
+    det = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4],      # exact tp
+                     [2, 0.8, 0.52, 0.52, 0.8, 0.8],     # iou>0.5 tp
+                     [1, 0.7, 0.6, 0.1, 0.9, 0.3],       # fp
+                     [-1, 0, 0, 0, 0, 0]]], np.float32)
+    m.update(det, gt)
+    mean, aps = m.result()
+    assert aps["1"] == 1.0  # fp ranked below the tp: AP stays 1
+    assert aps["2"] == 1.0
+    assert mean == 1.0
+    # duplicate detection on one gt counts as fp
+    m2 = MeanAveragePrecision(num_classes=2)
+    det2 = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                      [1, 0.8, 0.1, 0.1, 0.4, 0.4]]], np.float32)
+    gt2 = np.array([[[1, 0.1, 0.1, 0.4, 0.4]]], np.float32)
+    m2.update(det2, gt2)
+    _, aps2 = m2.result()
+    assert aps2["1"] == 1.0  # 1 gt: tp at rank1, dup fp after full recall
+
+
+def test_priors_structure():
+    pb = PriorBox(min_size=30, max_size=60, aspect_ratios=(2.0,))
+    assert pb.num_priors == 4  # 1 + sqrt + ar2 + ar1/2
+    pri = pb.generate(4, 4, 128.0)
+    assert pri.shape == (4 * 4 * 4, 4)
+    # centers at (cell+0.5)*step; first cell's square prior
+    c = (0.5) * 32.0 / 128.0
+    np.testing.assert_allclose(pri[0], [c - 30 / 256, c - 30 / 256,
+                                        c + 30 / 256, c + 30 / 256],
+                               atol=1e-6)
+    stacked = ssd_priors([(4, 4), (2, 2)],
+                         [pb, PriorBox(60, 90, aspect_ratios=(2.0,))], 128.0)
+    assert stacked.shape == (64 + 16, 4)
+
+
+def test_tiny_ssd_learns_synthetic_square():
+    """End-to-end: images with one bright square; SSD loss must drop and
+    detection must localize the square."""
+    init_zoo_context()
+    rng = np.random.default_rng(6)
+    n, res = 64, 64
+    images = rng.normal(0, 0.05, size=(n, res, res, 3)).astype(np.float32)
+    gt = np.full((n, 3, 5), -1.0, np.float32)
+    for i in range(n):
+        size = int(rng.integers(14, 26))
+        x0 = int(rng.integers(0, res - size))
+        y0 = int(rng.integers(0, res - size))
+        images[i, y0:y0 + size, x0:x0 + size, :] = 1.0
+        gt[i, 0] = [1, x0 / res, y0 / res, (x0 + size) / res,
+                    (y0 + size) / res]
+
+    det_model = ObjectDetector("ssd-lite", num_classes=2, resolution=res)
+    det_model.init_weights(sample_input=images[:2])
+    loss = det_model.multibox_loss()
+    det_model.compile(optimizer="adam", loss=loss, lr=3e-3)
+    h = det_model.fit(images, gt, batch_size=16, nb_epoch=30)
+    assert h["loss"][-1] < h["loss"][0] * 0.5, h["loss"]
+
+    dets = det_model.detect(images[:8], conf_thresh=0.3)
+    assert dets.shape[0] == 8 and dets.shape[2] == 6
+    hits = 0
+    for i in range(8):
+        top = dets[i, 0]
+        if top[0] == 1:
+            iou = _naive_iou(top[None, 2:6], gt[i, :1, 1:5])[0, 0]
+            hits += iou > 0.3
+    assert hits >= 5, f"only {hits}/8 detections localized the square"
+
+
+def test_object_detector_save_load(tmp_path):
+    init_zoo_context()
+    rng = np.random.default_rng(7)
+    det_model = ObjectDetector("ssd-lite", num_classes=2, resolution=64)
+    x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    det_model.init_weights(sample_input=x)
+    p = det_model.save(str(tmp_path / "ssd"))
+    from analytics_zoo_tpu.models.common.zoo_model import load_model
+    back = load_model(p)
+    assert isinstance(back, ObjectDetector)
+    np.testing.assert_allclose(np.asarray(det_model.predict(x)),
+                               np.asarray(back.predict(x)),
+                               rtol=1e-5, atol=1e-5)

@@ -30,6 +30,9 @@ from ....pipeline.api.keras.layers import (AveragePooling2D, Convolution2D,
                                            MaxPooling2D, merge)
 from ...common.zoo_model import register_model
 from ..common.image_model import ImageModel
+from .topologies import (alexnet, densenet_161, inception_v3, mobilenet,
+                         mobilenet_v2, resnet_50, squeezenet, vgg_16,
+                         vgg_19)
 
 __all__ = ["ImageClassifier", "inception_v1"]
 
@@ -100,6 +103,15 @@ def _simple_cnn(input_shape, num_classes, dropout):
 _TOPOLOGIES = {
     "inception-v1": inception_v1,
     "simple-cnn": _simple_cnn,
+    "alexnet": alexnet,
+    "inception-v3": inception_v3,
+    "resnet-50": resnet_50,
+    "vgg-16": vgg_16,
+    "vgg-19": vgg_19,
+    "densenet-161": densenet_161,
+    "squeezenet": squeezenet,
+    "mobilenet": mobilenet,
+    "mobilenet-v2": mobilenet_v2,
 }
 
 
@@ -113,9 +125,20 @@ class ImageClassifier(ImageModel):
                  num_classes: int = 1000,
                  input_shape: Tuple[int, int, int] = (224, 224, 3),
                  dropout: float = 0.4, name: Optional[str] = None):
-        if model_name not in _TOPOLOGIES:
+        # "-quantize"/"-int8" suffixed registry names
+        # (ImageClassificationConfig.scala) share the float graph; the
+        # precision lives in the inference runtime (as_inference_model)
+        base = model_name
+        self.quantize: Optional[str] = None
+        for suffix in ("-quantize", "-int8"):
+            if base.endswith(suffix):
+                base = base[:-len(suffix)]
+                self.quantize = "int8"
+        if base not in _TOPOLOGIES:
             raise ValueError(f"unknown topology {model_name!r}; "
-                             f"available: {sorted(_TOPOLOGIES)}")
+                             f"available: {sorted(_TOPOLOGIES)} "
+                             f"(+ '-quantize'/'-int8' suffixes)")
+        self._base_name = base
         self.model_name = model_name
         self.num_classes = int(num_classes)
         self._input_shape = tuple(int(d) for d in input_shape)
@@ -123,9 +146,17 @@ class ImageClassifier(ImageModel):
         super().__init__(name=name)
 
     def build_model(self) -> KerasNet:
-        return _TOPOLOGIES[self.model_name](
+        return _TOPOLOGIES[self._base_name](
             input_shape=self._input_shape, num_classes=self.num_classes,
             dropout=self.dropout)
+
+    def as_inference_model(self, concurrent_num: int = 1):
+        """The serving-side counterpart: wrap the (trained) classifier in an
+        InferenceModel; ``*-quantize``/``*-int8`` names load int8
+        weight-only quantized."""
+        from ....pipeline.inference import InferenceModel
+        return InferenceModel(concurrent_num).from_keras(
+            self, quantize=self.quantize)
 
     def get_config(self) -> Dict[str, Any]:
         return {"model_name": self.model_name,
@@ -136,17 +167,29 @@ class ImageClassifier(ImageModel):
     # ---- transfer learning (NetUtils.scala newGraph role) -----------------
     def new_head(self, num_classes: int) -> "ImageClassifier":
         """Re-head for fine-tuning: keep every backbone weight, replace the
-        classifier Dense (named ``head_dense``). The returned model shares no
-        buffers with ``self``."""
+        class-count-dependent head. Grafting is shape-aware — a donor layer
+        is copied only when its whole param subtree matches the clone's
+        freshly-built shapes, so heads named ``fc8``/``conv10``/
+        ``head_dense`` alike keep their fresh init when ``num_classes``
+        changes. The returned model shares no buffers with ``self``."""
+        import jax
+        import numpy as np
         clone = ImageClassifier(self.model_name, num_classes,
                                 self._input_shape, self.dropout)
         clone.init_weights()
         if self.params is not None:
-            import jax
             donor = dict(self.params)
+
+            def shapes_match(a, b):
+                la = jax.tree_util.tree_flatten(a)
+                lb = jax.tree_util.tree_flatten(b)
+                return (la[1] == lb[1]
+                        and all(np.shape(x) == np.shape(y)
+                                for x, y in zip(la[0], lb[0])))
+
             for k in clone.params:
-                if k in donor and not k.startswith("head_"):
-                    clone.params[k] = jax.tree.map(lambda a: a.copy()
-                                                   if hasattr(a, "copy") else a,
-                                                   donor[k])
+                if k in donor and shapes_match(donor[k], clone.params[k]):
+                    clone.params[k] = jax.tree.map(
+                        lambda a: a.copy() if hasattr(a, "copy") else a,
+                        donor[k])
         return clone

@@ -7,7 +7,8 @@ from .embeddings import Embedding, SparseEmbedding, WordEmbedding  # noqa: F401
 from .normalization import BatchNormalization, LayerNorm, L2Normalize  # noqa: F401
 from .convolution import (AtrousConvolution1D, AtrousConvolution2D,  # noqa: F401
                           Convolution1D, Convolution2D, Cropping1D,
-                          Cropping2D, Deconvolution2D, LocallyConnected1D,
+                          Cropping2D, Deconvolution2D,
+                          DepthwiseConvolution2D, LocallyConnected1D,
                           SeparableConvolution2D, ShareConvolution2D,
                           UpSampling1D, UpSampling2D,
                           ZeroPadding1D, ZeroPadding2D)

@@ -141,6 +141,15 @@ class AtrousConvolution2D(Convolution2D):
                          **kwargs)
 
 
+def _depthwise_apply(x, w, strides, border_mode):
+    """Shared depthwise conv core (per-channel grouped conv, NHWC)."""
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=_padding(border_mode),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=x.shape[-1],
+        preferred_element_type=jnp.float32)
+
+
 class SeparableConvolution2D(Layer):
     """``SeparableConvolution2D.scala`` — depthwise conv (per-channel,
     ``feature_group_count``) followed by a 1x1 pointwise conv."""
@@ -175,18 +184,53 @@ class SeparableConvolution2D(Layer):
 
     def call(self, params, x, *, training=False, rng=None):
         cd = compute_dtype()
-        in_ch = x.shape[-1]
-        y = lax.conv_general_dilated(
-            x.astype(cd), params["depthwise"].astype(cd),
-            window_strides=self.subsample,
-            padding=_padding(self.border_mode),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=in_ch,
-            preferred_element_type=jnp.float32).astype(cd)
+        y = _depthwise_apply(x.astype(cd), params["depthwise"].astype(cd),
+                             self.subsample, self.border_mode).astype(cd)
         y = lax.conv_general_dilated(
             y, params["pointwise"].astype(cd), window_strides=(1, 1),
             padding="VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"),
             preferred_element_type=jnp.float32).astype(cd)
+        if self.bias:
+            y = y + params["b"].astype(cd)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+
+class DepthwiseConvolution2D(Layer):
+    """Standalone depthwise conv (one filter stack per input channel,
+    ``feature_group_count=in_ch``) — the building block MobileNet-style
+    topologies interleave with BatchNorm, which the fused
+    :class:`SeparableConvolution2D` can't express."""
+
+    def __init__(self, nb_row: int, nb_col: int,
+                 init: str = "glorot_uniform", activation=None,
+                 border_mode: str = "valid",
+                 subsample: Tuple[int, int] = (1, 1),
+                 depth_multiplier: int = 1, bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_row, self.nb_col = nb_row, nb_col
+        self.init = init
+        self.activation = get_activation(activation)
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample)
+        self.depth_multiplier = depth_multiplier
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        p = {"depthwise": get_initializer(self.init)(
+            rng, (self.nb_row, self.nb_col, 1,
+                  in_ch * self.depth_multiplier), param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((in_ch * self.depth_multiplier,),
+                               param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        y = _depthwise_apply(x.astype(cd), params["depthwise"].astype(cd),
+                             self.subsample, self.border_mode).astype(cd)
         if self.bias:
             y = y + params["b"].astype(cd)
         if self.activation is not None:

@@ -1,0 +1,119 @@
+"""Published classifier topologies: build + forward-shape for every
+registry name (small spatial inputs keep CPU compile fast), a train smoke
+on one real topology, and the quantized-suffix inference path."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.models.image.imageclassification import ImageClassifier
+from analytics_zoo_tpu.models.image.imageclassification.image_classifier import (
+    _TOPOLOGIES)
+
+
+# spatial sizes chosen so every topology's valid-padded reductions work
+_SHAPES = {
+    "alexnet": (127, 127, 3),
+    "inception-v1": (64, 64, 3),
+    "inception-v3": (139, 139, 3),
+    "resnet-50": (64, 64, 3),
+    "vgg-16": (64, 64, 3),
+    "vgg-19": (64, 64, 3),
+    "densenet-161": (64, 64, 3),
+    "squeezenet": (64, 64, 3),
+    "mobilenet": (64, 64, 3),
+    "mobilenet-v2": (64, 64, 3),
+    "simple-cnn": (32, 32, 3),
+}
+
+_LIGHT = ["simple-cnn", "squeezenet", "mobilenet", "resnet-50"]
+_HEAVY = [n for n in _TOPOLOGIES if n not in _LIGHT]
+
+
+@pytest.mark.parametrize("name", _LIGHT)
+def test_topology_builds_and_forwards(name):
+    init_zoo_context()
+    m = ImageClassifier(name, num_classes=7, input_shape=_SHAPES[name])
+    x = np.random.default_rng(0).normal(size=(2, *_SHAPES[name])) \
+        .astype(np.float32)
+    m.init_weights(sample_input=x)
+    y = np.asarray(m.predict(x, batch_size=2))
+    assert y.shape == (2, 7)
+    np.testing.assert_allclose(y.sum(axis=1), 1.0, atol=1e-4)
+
+
+@pytest.mark.parametrize("name", _HEAVY)
+def test_heavy_topology_builds(name):
+    """Shape-infer the whole graph abstractly (eval_shape: no weight
+    materialization, no FLOPs — keeps the big nets cheap on CPU)."""
+    import jax
+    import jax.numpy as jnp
+    init_zoo_context()
+    m = ImageClassifier(name, num_classes=5, input_shape=_SHAPES[name])
+    net = m.model
+    key = jax.random.PRNGKey(0)
+    params = jax.eval_shape(lambda k: net.build(k, net.input_shape), key)
+    state = net.initial_state(net.input_shape)
+    x = jax.ShapeDtypeStruct((2, *_SHAPES[name]), jnp.float32)
+    y = jax.eval_shape(
+        lambda p, s, xx: net.apply(p, s, xx, training=False, rng=None)[0],
+        params, state, x)
+    assert y.shape == (2, 5)
+
+
+def test_every_reference_topology_is_registered():
+    published = {"alexnet", "inception-v1", "inception-v3", "resnet-50",
+                 "vgg-16", "vgg-19", "densenet-161", "squeezenet",
+                 "mobilenet", "mobilenet-v2"}
+    assert published <= set(_TOPOLOGIES)
+
+
+def test_quantize_suffix_names():
+    init_zoo_context()
+    m = ImageClassifier("mobilenet-quantize", num_classes=4,
+                        input_shape=(32, 32, 3))
+    assert m.quantize == "int8" and m._base_name == "mobilenet"
+    x = np.random.default_rng(1).normal(size=(4, 32, 32, 3)) \
+        .astype(np.float32)
+    m.init_weights(sample_input=x)
+    inf = m.as_inference_model()
+    y8 = np.asarray(inf.predict(x))
+    y32 = np.asarray(m.predict(x, batch_size=4))
+    assert y8.shape == y32.shape == (4, 4)
+    # int8 weight-only quantization stays close to fp32
+    assert np.max(np.abs(y8 - y32)) < 0.1
+    with pytest.raises(ValueError, match="unknown topology"):
+        ImageClassifier("resnet-99")
+
+
+def test_new_head_works_for_non_head_prefix_names():
+    """vgg/alexnet/squeezenet heads are named fc8/conv10 (not head_*): the
+    shape-aware graft must re-init them while keeping every backbone
+    weight."""
+    init_zoo_context()
+    m = ImageClassifier("squeezenet", num_classes=10,
+                        input_shape=(48, 48, 3))
+    x = np.random.default_rng(3).normal(size=(2, 48, 48, 3)) \
+        .astype(np.float32)
+    m.init_weights(sample_input=x)
+    ft = m.new_head(3)
+    y = np.asarray(ft.predict(x, batch_size=2))
+    assert y.shape == (2, 3)
+    # backbone transferred, head re-initialized
+    np.testing.assert_allclose(
+        np.asarray(ft.params["fire2_squeeze"]["W"]),
+        np.asarray(m.params["fire2_squeeze"]["W"]))
+    assert np.asarray(ft.params["conv10"]["W"]).shape[-1] == 3
+
+
+def test_resnet_trains_smoke():
+    init_zoo_context()
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(64, 32, 32, 3)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    x[y == 1] += 0.5
+    m = ImageClassifier("resnet-50", num_classes=2, input_shape=(32, 32, 3))
+    m.init_weights(sample_input=x[:2])
+    m.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=1e-3)
+    h = m.fit(x, y, batch_size=16, nb_epoch=4)
+    assert h["loss"][-1] < h["loss"][0]

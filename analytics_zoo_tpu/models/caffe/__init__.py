@@ -1,0 +1,1 @@
+from .caffe_loader import CaffeLoader, CaffePooling2D, load_caffe  # noqa: F401

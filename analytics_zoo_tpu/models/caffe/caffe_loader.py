@@ -1,0 +1,558 @@
+"""Caffe model import — parity with ``models/caffe/CaffeLoader.scala`` (+
+``LayerConverter.scala`` / ``V1LayerConverter.scala``): read a binary
+``.caffemodel`` (NetParameter, V1 or V2 layer messages) with the in-repo
+proto codec and build a native, fine-tunable Keras-style graph with the
+pretrained weights installed.
+
+Layout translation is the TPU-relevant design decision: caffe is NCHW with
+OIHW kernels; the native layers are NHWC. Conv kernels are transposed to
+HWIO at load; the first 4D→2D transition (InnerProduct/Flatten) inserts an
+NHWC→NCHW transpose so caffe's ``C*H*W`` flatten order — and therefore the
+pretrained FC weights — stay bit-correct.
+
+Caffe's pooling is ceil-mode with count-include-pad averaging; neither maps
+onto the stock pooling layers, so :class:`CaffePooling2D` reproduces the
+exact ``pooling_layer.cpp`` arithmetic (window extent capped at
+``size + pad``, left pad counted in the divisor) with a static divisor
+table — one ``reduce_window`` per pool, no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ...pipeline.api.keras.engine import Input, KerasNet, Lambda, Layer, Model
+from ...pipeline.api.keras.layers import (Activation, BatchNormalization,
+                                          Convolution2D, Dense, Dropout,
+                                          Flatten, LRN2D, LeakyReLU, Scale,
+                                          ZeroPadding2D, merge)
+from ...utils.proto import parse_fields, parse_varint
+
+__all__ = ["CaffeLoader", "CaffePooling2D", "load_caffe"]
+
+
+# ---------------------------------------------------------------------------
+# caffe.proto subset decoding
+# ---------------------------------------------------------------------------
+
+def _ints(payload: bytes, wt: int) -> List[int]:
+    if wt == 2:  # packed
+        out, i = [], 0
+        while i < len(payload):
+            v, i = parse_varint(payload, i)
+            out.append(v)
+        return out
+    v, _ = parse_varint(payload, 0)
+    return [v]
+
+
+def _int(payload: bytes) -> int:
+    v, _ = parse_varint(payload, 0)
+    return v
+
+
+def _floats(payload: bytes, wt: int) -> np.ndarray:
+    if wt == 2:
+        return np.frombuffer(payload, "<f4")
+    return np.frombuffer(payload[:4], "<f4")
+
+
+def _f32(payload: bytes) -> float:
+    return struct.unpack("<f", payload)[0]
+
+
+def _decode_blob(buf: bytes) -> np.ndarray:
+    dims: List[int] = []
+    old = [0, 0, 0, 0]  # num/channels/height/width legacy 4D fields
+    data: List[np.ndarray] = []
+    for num, wt, payload in parse_fields(buf):
+        if num == 7 and wt == 2:       # shape: BlobShape{dim=1}
+            for n2, wt2, p2 in parse_fields(payload):
+                if n2 == 1:
+                    dims.extend(_ints(p2, wt2))
+        elif num == 5:                 # data (packed floats)
+            data.append(_floats(payload, wt))
+        elif num in (1, 2, 3, 4):
+            old[num - 1] = _int(payload)
+    arr = (np.concatenate(data).astype(np.float32) if data
+           else np.zeros(0, np.float32))
+    if dims:
+        return arr.reshape(dims)
+    if any(old):
+        # legacy blobs are always 4D; squeeze leading 1s later as needed
+        return arr.reshape([d or 1 for d in old])
+    return arr
+
+
+def _decode_conv_param(buf: bytes) -> Dict[str, Any]:
+    # pad/kernel/stride/dilation are proto2 repeated WITHOUT [packed=true]:
+    # each value arrives as its own field — extend, never overwrite
+    p: Dict[str, Any] = {"num_output": 0, "bias_term": True, "pad": [],
+                         "kernel": [], "stride": [], "group": 1,
+                         "dilation": []}
+    for num, wt, payload in parse_fields(buf):
+        if num == 1:
+            p["num_output"] = _int(payload)
+        elif num == 2:
+            p["bias_term"] = bool(_int(payload))
+        elif num == 3:
+            p["pad"].extend(_ints(payload, wt))
+        elif num == 4:
+            p["kernel"].extend(_ints(payload, wt))
+        elif num == 5:
+            p["group"] = _int(payload)
+        elif num == 6:
+            p["stride"].extend(_ints(payload, wt))
+        elif num == 9:
+            p["pad_h"] = _int(payload)
+        elif num == 10:
+            p["pad_w"] = _int(payload)
+        elif num == 11:
+            p["kernel_h"] = _int(payload)
+        elif num == 12:
+            p["kernel_w"] = _int(payload)
+        elif num == 13:
+            p["stride_h"] = _int(payload)
+        elif num == 14:
+            p["stride_w"] = _int(payload)
+        elif num == 18:
+            p["dilation"].extend(_ints(payload, wt))
+    for key, default in (("pad", 0), ("kernel", 0), ("stride", 1),
+                         ("dilation", 1)):
+        if not p[key]:
+            p[key] = [default]
+    return p
+
+
+def _decode_pool_param(buf: bytes) -> Dict[str, Any]:
+    p: Dict[str, Any] = {"mode": 0, "kernel": 0, "stride": 1, "pad": 0,
+                         "global": False}
+    for num, wt, payload in parse_fields(buf):
+        if num == 1:
+            p["mode"] = _int(payload)           # 0 MAX, 1 AVE
+        elif num == 2:
+            p["kernel"] = _int(payload)
+        elif num == 3:
+            p["stride"] = _int(payload)
+        elif num == 4:
+            p["pad"] = _int(payload)
+        elif num == 5:
+            p["kernel_h"] = _int(payload)
+        elif num == 6:
+            p["kernel_w"] = _int(payload)
+        elif num == 7:
+            p["stride_h"] = _int(payload)
+        elif num == 8:
+            p["stride_w"] = _int(payload)
+        elif num == 9:
+            p["pad_h"] = _int(payload)
+        elif num == 10:
+            p["pad_w"] = _int(payload)
+        elif num == 12:
+            p["global"] = bool(_int(payload))
+    return p
+
+
+def _decode_simple(buf: bytes, fields: Dict[int, Tuple[str, str]],
+                   defaults: Dict[str, Any]) -> Dict[str, Any]:
+    """Generic decoder: fields maps num → (name, kind) with kind in
+    int/float/bool."""
+    p = dict(defaults)
+    for num, wt, payload in parse_fields(buf):
+        if num in fields:
+            name, kind = fields[num]
+            if kind == "int":
+                p[name] = _int(payload)
+            elif kind == "float":
+                p[name] = _f32(payload)
+            elif kind == "bool":
+                p[name] = bool(_int(payload))
+    return p
+
+
+# V1LayerParameter type enum → canonical type string
+_V1_TYPES = {3: "Concat", 4: "Convolution", 5: "Data", 6: "Dropout",
+             8: "Flatten", 14: "InnerProduct", 15: "LRN", 17: "Pooling",
+             18: "ReLU", 19: "Sigmoid", 20: "Softmax", 21: "SoftmaxWithLoss",
+             22: "Split", 23: "TanH", 25: "Eltwise", 26: "Power",
+             39: "Deconvolution"}
+
+# LayerParameter(V2) / V1LayerParameter sub-message field numbers
+_PARAM_FIELDS = {
+    "v2": {"concat": 104, "conv": 106, "dropout": 108, "eltwise": 110,
+           "inner": 117, "lrn": 118, "pool": 121, "power": 122, "relu": 123,
+           "softmax": 125, "batch_norm": 139, "scale": 142},
+    "v1": {"concat": 9, "conv": 10, "dropout": 12, "eltwise": 24,
+           "inner": 17, "lrn": 18, "pool": 19, "power": 21, "relu": 30,
+           "softmax": 39},
+}
+
+
+def _decode_layer(buf: bytes, version: str) -> Dict[str, Any]:
+    v1 = version == "v1"
+    f = _PARAM_FIELDS[version]
+    layer: Dict[str, Any] = {"name": "", "type": "", "bottoms": [],
+                             "tops": [], "blobs": [], "param": {}}
+    for num, wt, payload in parse_fields(buf):
+        if num == (4 if v1 else 1) and wt == 2:
+            layer["name"] = payload.decode("utf-8")
+        elif num == (5 if v1 else 2):
+            layer["type"] = (_V1_TYPES.get(_int(payload), f"V1#{_int(payload)}")
+                             if v1 else payload.decode("utf-8"))
+        elif num == (2 if v1 else 3) and wt == 2:
+            layer["bottoms"].append(payload.decode("utf-8"))
+        elif num == (3 if v1 else 4) and wt == 2:
+            layer["tops"].append(payload.decode("utf-8"))
+        elif num == (6 if v1 else 7) and wt == 2:
+            layer["blobs"].append(_decode_blob(payload))
+        elif num == f["conv"] and wt == 2:
+            layer["param"] = _decode_conv_param(payload)
+        elif num == f["pool"] and wt == 2:
+            layer["param"] = _decode_pool_param(payload)
+        elif num == f["inner"] and wt == 2:
+            layer["param"] = _decode_simple(
+                payload, {1: ("num_output", "int"), 2: ("bias_term", "bool")},
+                {"num_output": 0, "bias_term": True})
+        elif num == f["lrn"] and wt == 2:
+            layer["param"] = _decode_simple(
+                payload, {1: ("local_size", "int"), 2: ("alpha", "float"),
+                          3: ("beta", "float"), 4: ("region", "int"),
+                          5: ("k", "float")},
+                {"local_size": 5, "alpha": 1.0, "beta": 0.75, "region": 0,
+                 "k": 1.0})
+        elif num == f["dropout"] and wt == 2:
+            layer["param"] = _decode_simple(
+                payload, {1: ("ratio", "float")}, {"ratio": 0.5})
+        elif num == f["concat"] and wt == 2:
+            layer["param"] = _decode_simple(
+                payload, {1: ("concat_dim", "int"), 2: ("axis", "int")},
+                {"concat_dim": 1})
+        elif num == f["eltwise"] and wt == 2:
+            layer["param"] = _decode_simple(
+                payload, {1: ("operation", "int")}, {"operation": 1})
+        elif num == f["relu"] and wt == 2:
+            layer["param"] = _decode_simple(
+                payload, {1: ("negative_slope", "float")},
+                {"negative_slope": 0.0})
+        elif num == f["power"] and wt == 2:
+            layer["param"] = _decode_simple(
+                payload, {1: ("power", "float"), 2: ("scale", "float"),
+                          3: ("shift", "float")},
+                {"power": 1.0, "scale": 1.0, "shift": 0.0})
+        elif not v1 and num == f["batch_norm"] and wt == 2:
+            layer["param"] = _decode_simple(
+                payload, {1: ("use_global_stats", "bool"),
+                          3: ("eps", "float")},
+                {"eps": 1e-5})
+        elif not v1 and num == f["scale"] and wt == 2:
+            layer["param"] = _decode_simple(
+                payload, {1: ("axis", "int"), 4: ("bias_term", "bool")},
+                {"axis": 1, "bias_term": False})
+    return layer
+
+
+def _decode_net(buf: bytes) -> Dict[str, Any]:
+    net: Dict[str, Any] = {"name": "", "inputs": [], "input_dims": [],
+                           "layers": []}
+    shapes: List[List[int]] = []
+    for num, wt, payload in parse_fields(buf):
+        if num == 1 and wt == 2:
+            net["name"] = payload.decode("utf-8")
+        elif num == 3 and wt == 2:
+            net["inputs"].append(payload.decode("utf-8"))
+        elif num == 4:
+            net["input_dims"].extend(_ints(payload, wt))
+        elif num == 8 and wt == 2:     # input_shape: BlobShape
+            dims = []
+            for n2, wt2, p2 in parse_fields(payload):
+                if n2 == 1:
+                    dims.extend(_ints(p2, wt2))
+            shapes.append(dims)
+        elif num == 2 and wt == 2:     # V1 layers
+            net["layers"].append(_decode_layer(payload, "v1"))
+        elif num == 100 and wt == 2:   # V2 layer
+            net["layers"].append(_decode_layer(payload, "v2"))
+    if shapes and not net["input_dims"]:
+        net["input_dims"] = [d for s in shapes for d in s]
+    return net
+
+
+# ---------------------------------------------------------------------------
+# caffe-exact pooling
+# ---------------------------------------------------------------------------
+
+class CaffePooling2D(Layer):
+    """Pooling with caffe's exact arithmetic (``pooling_layer.cpp``):
+    ceil-mode output size (clipped so the last window starts inside the
+    padded extent), MAX ignores padding, AVE divides by the window clipped
+    to ``size + pad`` with left pad included. NHWC."""
+
+    def __init__(self, mode: str, kernel: Tuple[int, int],
+                 stride: Tuple[int, int], pad: Tuple[int, int] = (0, 0),
+                 **kwargs):
+        super().__init__(**kwargs)
+        if mode not in ("max", "ave"):
+            raise ValueError(f"unsupported caffe pool mode {mode!r}")
+        self.mode = mode
+        self.kernel = tuple(kernel)
+        self.stride = tuple(stride)
+        self.pad = tuple(pad)
+
+    @staticmethod
+    def _out(size: int, k: int, s: int, p: int) -> int:
+        o = -(-(size + 2 * p - k) // s) + 1  # ceil
+        if p > 0 and (o - 1) * s >= size + p:
+            o -= 1
+        return o
+
+    def call(self, params, x, *, training=False, rng=None):
+        (kh, kw), (sh, sw), (ph, pw) = self.kernel, self.stride, self.pad
+        h, w = x.shape[1], x.shape[2]
+        oh, ow = self._out(h, kh, sh, ph), self._out(w, kw, sw, pw)
+        pe_h = max((oh - 1) * sh + kh - h - ph, 0)
+        pe_w = max((ow - 1) * sw + kw - w - pw, 0)
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        pads = ((0, 0), (ph, pe_h), (pw, pe_w), (0, 0))
+        if self.mode == "max":
+            return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides,
+                                     pads)
+        acc = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, dims,
+                                strides, pads)
+
+        def counts(size, k, s, p, out):
+            start = np.arange(out) * s - p          # ≥ -p always
+            end = np.minimum(start + k, size + p)   # capped at size+pad
+            return (end - start).astype(np.float32)
+
+        div = np.outer(counts(h, kh, sh, ph, oh),
+                       counts(w, kw, sw, pw, ow))[None, :, :, None]
+        return (acc / div).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# graph construction
+# ---------------------------------------------------------------------------
+
+def _nchw_to_nhwc_shape(dims: Sequence[int]) -> Tuple[int, int, int]:
+    if len(dims) != 4:
+        raise ValueError(f"expected a 4D NCHW input, got dims {list(dims)}")
+    _, c, h, w = dims
+    return (int(h), int(w), int(c))
+
+
+def _conv_kernel(blob: np.ndarray) -> np.ndarray:
+    return np.transpose(blob, (2, 3, 1, 0))  # OIHW → HWIO
+
+
+class CaffeLoader:
+    """``CaffeLoader.load(model_path)`` — class-name parity with
+    ``CaffeLoader.scala`` (binary NetParameter carries both topology and
+    weights; the deploy prototxt is unnecessary here)."""
+
+    @staticmethod
+    def load(model_path: str,
+             input_shape: Optional[Sequence[int]] = None) -> KerasNet:
+        return load_caffe(model_path, input_shape)
+
+
+def load_caffe(model_path: str,
+               input_shape: Optional[Sequence[int]] = None) -> KerasNet:
+    """Build a native NHWC graph from a ``.caffemodel``. ``input_shape`` is
+    NCHW sans batch (caffe convention) and overrides the net's own input
+    declaration. Returns a KerasNet with pretrained weights installed."""
+    with open(model_path, "rb") as fh:
+        net = _decode_net(fh.read())
+
+    if input_shape is not None:
+        dims = [1] + [int(d) for d in input_shape]
+    elif net["input_dims"]:
+        dims = net["input_dims"][:4]
+    else:
+        raise ValueError("net declares no input; pass input_shape=(C, H, W)")
+
+    inp = Input(shape=_nchw_to_nhwc_shape(dims), name="data")
+    blob: Dict[str, Any] = {}
+    blob_4d: Dict[str, bool] = {}
+    input_names = net["inputs"] or ["data"]
+    for n in input_names:
+        blob[n] = inp
+        blob_4d[n] = True
+    weights: Dict[str, Dict[str, np.ndarray]] = {}
+    states: Dict[str, Dict[str, np.ndarray]] = {}
+    last_node = None  # last layer actually built (loss/accuracy tails skip)
+
+    def to_chw_flat(x_node, name):
+        """NHWC → NCHW-ordered flatten, preserving caffe's C*H*W order."""
+        t = Lambda(lambda t: jnp.transpose(t, (0, 3, 1, 2)),
+                   name=f"{name}_nchw")(x_node)
+        return Flatten(name=f"{name}_flat")(t)
+
+    for layer in net["layers"]:
+        lt, name = layer["type"], layer["name"] or f"layer{len(blob)}"
+        bots, tops = layer["bottoms"], layer["tops"]
+        p = layer["param"]
+        blobs = layer["blobs"]
+        if lt in ("Data", "Input", "Accuracy", "SoftmaxWithLoss",
+                  "EuclideanLoss", "SigmoidCrossEntropyLoss"):
+            if lt == "Input" and tops:
+                for t in tops:
+                    blob[t] = inp
+                    blob_4d[t] = True
+            continue
+        if lt == "Split":
+            for t in tops:
+                blob[t] = blob[bots[0]]
+                blob_4d[t] = blob_4d[bots[0]]
+            continue
+        x = blob[bots[0]] if bots else inp
+        is4d = blob_4d.get(bots[0] if bots else input_names[0], True)
+
+        if lt == "Convolution":
+            k = blobs[0]
+            if k.ndim != 4:
+                raise ValueError(f"{name}: conv weight blob must be 4D")
+            if p.get("group", 1) != 1:
+                raise NotImplementedError(f"{name}: grouped caffe conv")
+            kh = p.get("kernel_h", p["kernel"][0])
+            kw = p.get("kernel_w", p["kernel"][-1])
+            sh = p.get("stride_h", p["stride"][0])
+            sw = p.get("stride_w", p["stride"][-1])
+            ph = p.get("pad_h", p["pad"][0])
+            pw = p.get("pad_w", p["pad"][-1])
+            if (ph, pw) != (0, 0):
+                x = ZeroPadding2D((ph, pw), name=f"{name}_pad")(x)
+            dil = p["dilation"][0] if p["dilation"] else 1
+            node = Convolution2D(p["num_output"], kh, kw,
+                                 subsample=(sh, sw), border_mode="valid",
+                                 dilation=(dil, dil),
+                                 bias=p["bias_term"], name=name)(x)
+            w = {"W": _conv_kernel(k)}
+            if p["bias_term"]:
+                w["b"] = blobs[1].reshape(-1)
+            weights[name] = w
+            out4d = True
+        elif lt == "InnerProduct":
+            if is4d:
+                x = to_chw_flat(x, name)
+            node = Dense(p["num_output"], bias=p.get("bias_term", True),
+                         name=name)(x)
+            wblob = blobs[0].reshape(p["num_output"], -1)
+            w = {"W": wblob.T}
+            if p.get("bias_term", True):
+                w["b"] = blobs[1].reshape(-1)
+            weights[name] = w
+            out4d = False
+        elif lt == "Pooling":
+            if p["global"]:
+                # bind the mode NOW — Lambda.fn runs at apply time, when the
+                # loop variable p belongs to a different layer
+                if p["mode"] == 1:
+                    node = Lambda(lambda t: jnp.mean(t, axis=(1, 2)),
+                                  name=name)(x)
+                else:
+                    node = Lambda(lambda t: jnp.max(t, axis=(1, 2)),
+                                  name=name)(x)
+                out4d = False
+            else:
+                kh = p.get("kernel_h", p["kernel"])
+                kw = p.get("kernel_w", p["kernel"])
+                sh = p.get("stride_h", p["stride"])
+                sw = p.get("stride_w", p["stride"])
+                ph = p.get("pad_h", p["pad"])
+                pw = p.get("pad_w", p["pad"])
+                mode = {0: "max", 1: "ave"}.get(p["mode"])
+                if mode is None:
+                    raise NotImplementedError(f"{name}: caffe pool mode "
+                                              f"{p['mode']}")
+                node = CaffePooling2D(mode, (kh, kw), (sh, sw), (ph, pw),
+                                      name=name)(x)
+                out4d = True
+        elif lt == "ReLU":
+            slope = p.get("negative_slope", 0.0)
+            node = (LeakyReLU(slope, name=name)(x) if slope
+                    else Activation("relu", name=name)(x))
+            out4d = is4d
+        elif lt == "Sigmoid":
+            node = Activation("sigmoid", name=name)(x)
+            out4d = is4d
+        elif lt == "TanH":
+            node = Activation("tanh", name=name)(x)
+            out4d = is4d
+        elif lt == "Softmax":
+            node = Activation("softmax", name=name)(x)
+            out4d = is4d
+        elif lt == "LRN":
+            if p.get("region", 0) != 0:
+                raise NotImplementedError(f"{name}: WITHIN_CHANNEL LRN")
+            node = LRN2D(alpha=p["alpha"], beta=p["beta"], k=p["k"],
+                         n=p["local_size"], name=name)(x)
+            out4d = is4d
+        elif lt == "Dropout":
+            node = Dropout(p.get("ratio", 0.5), name=name)(x)
+            out4d = is4d
+        elif lt == "Concat":
+            axis_nchw = p.get("axis", p.get("concat_dim", 1))
+            axis = {0: 0, 1: -1, 2: 1, 3: 2}[axis_nchw] if is4d else axis_nchw
+            node = merge([blob[b] for b in bots], "concat",
+                         concat_axis=axis, name=name)
+            out4d = is4d
+        elif lt == "Eltwise":
+            op = {0: "mul", 1: "sum", 2: "max"}.get(p.get("operation", 1))
+            node = merge([blob[b] for b in bots], op, name=name)
+            out4d = is4d
+        elif lt == "Power":
+            node = Lambda(lambda t, pw_=p["power"], sc=p["scale"],
+                          sh_=p["shift"]: jnp.power(sc * t + sh_, pw_),
+                          name=name)(x)
+            out4d = is4d
+        elif lt == "Flatten":
+            node = to_chw_flat(x, name) if is4d else x
+            out4d = False
+        elif lt == "BatchNorm":
+            node = BatchNormalization(epsilon=p.get("eps", 1e-5),
+                                      scale=False, center=False,
+                                      name=name)(x)
+            sf = float(blobs[2].reshape(-1)[0]) if len(blobs) > 2 else 1.0
+            sf = 1.0 / sf if sf != 0 else 0.0
+            states[name] = {"moving_mean": blobs[0].reshape(-1) * sf,
+                            "moving_var": blobs[1].reshape(-1) * sf}
+            out4d = is4d
+        elif lt == "Scale":
+            ch = blobs[0].reshape(-1).shape[0]
+            node = Scale((ch,), name=name)(x)
+            w = {"weight": blobs[0].reshape(-1)}
+            w["bias"] = (blobs[1].reshape(-1) if p.get("bias_term")
+                         and len(blobs) > 1 else np.zeros(ch, np.float32))
+            weights[name] = w
+            out4d = is4d
+        else:
+            raise NotImplementedError(f"caffe layer type {lt!r} "
+                                      f"(layer {name!r}) not supported")
+
+        for t in tops or [name]:
+            blob[t] = node
+            blob_4d[t] = out4d
+        last_node = node
+
+    if last_node is None:
+        raise ValueError("caffemodel contains no computational layers")
+    model = Model(input=inp, output=last_node)
+    model.init_weights()
+    for lname, w in weights.items():
+        tmpl = model.params.get(lname)
+        if tmpl is None:
+            raise ValueError(f"imported weights for unknown layer {lname!r}")
+        for k, v in w.items():
+            if np.shape(tmpl[k]) != np.shape(v):
+                raise ValueError(f"{lname}.{k}: caffe blob shape "
+                                 f"{np.shape(v)} vs graph {np.shape(tmpl[k])}")
+        model.params[lname] = {k: jnp.asarray(v) for k, v in w.items()}
+    for lname, s in states.items():
+        model.net_state[lname] = {k: jnp.asarray(v) for k, v in s.items()}
+    return model

@@ -1,0 +1,294 @@
+"""Caffe loader: hand-encoded .caffemodel fixtures (the env has no caffe —
+the in-repo proto codec is the point), torch as the numerical oracle,
+covering V2 + V1 layer formats, NCHW→NHWC weight translation, the C*H*W
+flatten order, caffe ceil-mode pooling, and BatchNorm+Scale running
+stats."""
+
+import struct
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.models.caffe import (CaffeLoader, CaffePooling2D,
+                                            load_caffe)
+from analytics_zoo_tpu.utils.proto import (field_bytes, field_float,
+                                           field_varint)
+
+
+# ---------------------------------------------------------------------------
+# minimal NetParameter encoder
+# ---------------------------------------------------------------------------
+
+def _packed_f32(num, values):
+    payload = b"".join(struct.pack("<f", float(v)) for v in values)
+    return field_bytes(num, payload)
+
+
+def _blob(arr):
+    arr = np.ascontiguousarray(arr, np.float32)
+    shape = field_bytes(7, b"".join(field_varint(1, d) for d in arr.shape))
+    return shape + _packed_f32(5, arr.reshape(-1))
+
+
+_f32_field = field_float
+
+
+def _layer_v2(name, type_, bottoms, tops, blobs=(), **params):
+    buf = field_bytes(1, name.encode()) + field_bytes(2, type_.encode())
+    buf += b"".join(field_bytes(3, b.encode()) for b in bottoms)
+    buf += b"".join(field_bytes(4, t.encode()) for t in tops)
+    buf += b"".join(field_bytes(7, _blob(b)) for b in blobs)
+    for num, sub in params.items():
+        buf += field_bytes(int(num), sub)
+    return field_bytes(100, buf)
+
+
+def _layer_v1(name, type_enum, bottoms, tops, blobs=(), **params):
+    buf = field_bytes(4, name.encode()) + field_varint(5, type_enum)
+    buf += b"".join(field_bytes(2, b.encode()) for b in bottoms)
+    buf += b"".join(field_bytes(3, t.encode()) for t in tops)
+    buf += b"".join(field_bytes(6, _blob(b)) for b in blobs)
+    for num, sub in params.items():
+        buf += field_bytes(int(num), sub)
+    return field_bytes(2, buf)
+
+
+def _net(layers, input_name="data", input_dims=(1, 3, 8, 8)):
+    buf = field_bytes(1, b"testnet")
+    buf += field_bytes(3, input_name.encode())
+    buf += b"".join(field_varint(4, d) for d in input_dims)
+    return buf + b"".join(layers)
+
+
+def _conv_param(num_output, kernel, stride=1, pad=0, bias=True):
+    p = field_varint(1, num_output) + field_varint(2, int(bias))
+    p += field_varint(3, pad) + field_varint(4, kernel)
+    p += field_varint(6, stride)
+    return p
+
+
+def _pool_param(mode, kernel, stride, pad=0, global_=False):
+    p = field_varint(1, mode) + field_varint(2, kernel)
+    p += field_varint(3, stride) + field_varint(4, pad)
+    if global_:
+        p += field_varint(12, 1)
+    return p
+
+
+def _np(t):
+    return t.detach().cpu().numpy()
+
+
+def _run(model, x_nchw):
+    """Forward the loaded NHWC model on NCHW input, NCHW-style output."""
+    x = np.transpose(x_nchw, (0, 2, 3, 1))
+    y = np.asarray(model.apply(model.params, model.net_state, x,
+                               training=False, rng=None)[0])
+    if y.ndim == 4:
+        y = np.transpose(y, (0, 3, 1, 2))
+    return y
+
+
+def test_v2_conv_relu_pool_fc_matches_torch(tmp_path):
+    init_zoo_context()
+    torch.manual_seed(0)
+    conv = torch.nn.Conv2d(3, 6, 3, stride=1, padding=1)
+    fc = torch.nn.Linear(6 * 4 * 4, 5)
+    x = np.random.default_rng(0).normal(size=(2, 3, 8, 8)).astype(np.float32)
+
+    layers = [
+        _layer_v2("conv1", "Convolution", ["data"], ["conv1"],
+                  blobs=[_np(conv.weight), _np(conv.bias)],
+                  **{"106": _conv_param(6, 3, 1, 1)}),
+        _layer_v2("relu1", "ReLU", ["conv1"], ["conv1"]),   # in-place
+        _layer_v2("pool1", "Pooling", ["conv1"], ["pool1"],
+                  **{"121": _pool_param(0, 2, 2)}),
+        _layer_v2("fc1", "InnerProduct", ["pool1"], ["fc1"],
+                  blobs=[_np(fc.weight), _np(fc.bias)],
+                  **{"117": field_varint(1, 5) + field_varint(2, 1)}),
+        _layer_v2("prob", "Softmax", ["fc1"], ["prob"]),
+    ]
+    path = tmp_path / "net.caffemodel"
+    path.write_bytes(_net(layers, input_dims=(1, 3, 8, 8)))
+
+    model = load_caffe(str(path))
+    got = _run(model, x)
+    with torch.no_grad():
+        t = F.max_pool2d(torch.relu(conv(torch.tensor(x))), 2, 2)
+        want = torch.softmax(fc(torch.flatten(t, 1)), dim=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_v1_format_and_lrn(tmp_path):
+    """V1 enum-typed layers (legacy caffemodels) + cross-channel LRN."""
+    init_zoo_context()
+    torch.manual_seed(1)
+    conv = torch.nn.Conv2d(3, 4, 1)
+    x = np.random.default_rng(1).normal(size=(1, 3, 6, 6)).astype(np.float32)
+    lrn_param = (field_varint(1, 3) + _f32_field(2, 5e-4)
+                 + _f32_field(3, 0.75) + _f32_field(5, 1.0))
+    layers = [
+        _layer_v1("c", 4, ["data"], ["c"],
+                  blobs=[_np(conv.weight), _np(conv.bias)],
+                  **{"10": _conv_param(4, 1)}),
+        _layer_v1("n", 15, ["c"], ["n"], **{"18": lrn_param}),
+    ]
+    path = tmp_path / "v1.caffemodel"
+    path.write_bytes(_net(layers, input_dims=(1, 3, 6, 6)))
+    model = load_caffe(str(path))
+    got = _run(model, x)
+    with torch.no_grad():
+        want = torch.nn.LocalResponseNorm(3, alpha=5e-4, beta=0.75, k=1.0)(
+            conv(torch.tensor(x))).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_caffe_pooling_ceil_and_include_pad():
+    """GoogLeNet-style 3x3/2 pooling: caffe rounds output UP. MAX ignores
+    pad; AVE divides by the pad-inclusive clipped window (torch
+    ceil_mode + count_include_pad oracle)."""
+    init_zoo_context()
+    x = np.random.default_rng(2).normal(size=(1, 5, 7, 7)).astype(np.float32)
+    xt = torch.tensor(x)
+    x_nhwc = np.transpose(x, (0, 2, 3, 1))
+
+    pm = CaffePooling2D("max", (3, 3), (2, 2), (0, 0))
+    got = np.asarray(pm.call({}, x_nhwc))
+    want = F.max_pool2d(xt, 3, 2, ceil_mode=True).numpy()
+    assert got.shape[1:3] == want.shape[2:]  # ceil: 4x4, not floor's 3x3
+    np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), want,
+                               rtol=1e-5, atol=1e-6)
+
+    pa = CaffePooling2D("ave", (3, 3), (2, 2), (1, 1))
+    got = np.asarray(pa.call({}, x_nhwc))
+    want = F.avg_pool2d(xt, 3, 2, padding=1, ceil_mode=True,
+                        count_include_pad=True).numpy()
+    np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_scale_and_eltwise(tmp_path):
+    init_zoo_context()
+    torch.manual_seed(2)
+    bn = torch.nn.BatchNorm2d(3).eval()
+    bn.running_mean.normal_()
+    bn.running_var.uniform_(0.5, 2.0)
+    bn.weight.data.uniform_(0.5, 1.5)
+    bn.bias.data.normal_()
+    x = np.random.default_rng(3).normal(size=(2, 3, 4, 4)).astype(np.float32)
+
+    sf = 2.0  # caffe stores mean*sf with blobs[2]=sf
+    layers = [
+        _layer_v2("bn", "BatchNorm", ["data"], ["bn"],
+                  blobs=[_np(bn.running_mean) * sf, _np(bn.running_var) * sf,
+                         np.array([sf], np.float32)],
+                  **{"139": _f32_field(3, bn.eps)}),
+        _layer_v2("sc", "Scale", ["bn"], ["sc"],
+                  blobs=[_np(bn.weight), _np(bn.bias)],
+                  **{"142": field_varint(4, 1)}),
+        _layer_v2("sum", "Eltwise", ["sc", "data"], ["sum"],
+                  **{"110": field_varint(1, 1)}),
+    ]
+    path = tmp_path / "bn.caffemodel"
+    path.write_bytes(_net(layers, input_dims=(1, 3, 4, 4)))
+    model = load_caffe(str(path))
+    got = _run(model, x)
+    with torch.no_grad():
+        want = (bn(torch.tensor(x)) + torch.tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_concat_and_global_pool(tmp_path):
+    init_zoo_context()
+    torch.manual_seed(3)
+    c1 = torch.nn.Conv2d(3, 2, 1)
+    c2 = torch.nn.Conv2d(3, 3, 1)
+    x = np.random.default_rng(4).normal(size=(2, 3, 5, 5)).astype(np.float32)
+    layers = [
+        _layer_v2("a", "Convolution", ["data"], ["a"],
+                  blobs=[_np(c1.weight), _np(c1.bias)],
+                  **{"106": _conv_param(2, 1)}),
+        _layer_v2("b", "Convolution", ["data"], ["b"],
+                  blobs=[_np(c2.weight), _np(c2.bias)],
+                  **{"106": _conv_param(3, 1)}),
+        _layer_v2("cat", "Concat", ["a", "b"], ["cat"],
+                  **{"104": field_varint(2, 1)}),
+        _layer_v2("gap", "Pooling", ["cat"], ["gap"],
+                  **{"121": _pool_param(1, 0, 1, global_=True)}),
+    ]
+    path = tmp_path / "cat.caffemodel"
+    path.write_bytes(_net(layers, input_dims=(1, 3, 5, 5)))
+    model = CaffeLoader.load(str(path))
+    got = _run(model, x)
+    with torch.no_grad():
+        xt = torch.tensor(x)
+        want = torch.cat([c1(xt), c2(xt)], dim=1).mean(dim=(2, 3)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_imported_caffe_model_fine_tunes(tmp_path):
+    """Imported graphs are native: they train under compile/fit."""
+    init_zoo_context()
+    torch.manual_seed(4)
+    conv = torch.nn.Conv2d(1, 4, 3, padding=1)
+    fc = torch.nn.Linear(4 * 3 * 3, 2)
+    layers = [
+        _layer_v2("conv", "Convolution", ["data"], ["conv"],
+                  blobs=[_np(conv.weight), _np(conv.bias)],
+                  **{"106": _conv_param(4, 3, 1, 1)}),
+        _layer_v2("relu", "ReLU", ["conv"], ["conv"]),
+        _layer_v2("pool", "Pooling", ["conv"], ["pool"],
+                  **{"121": _pool_param(0, 2, 2)}),
+        _layer_v2("fc", "InnerProduct", ["pool"], ["fc"],
+                  blobs=[_np(fc.weight), _np(fc.bias)],
+                  **{"117": field_varint(1, 2) + field_varint(2, 1)}),
+    ]
+    path = tmp_path / "ft.caffemodel"
+    path.write_bytes(_net(layers, input_dims=(1, 1, 6, 6)))
+    model = load_caffe(str(path))
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(128, 6, 6, 1)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    model.compile(optimizer="adam", loss="scce_with_logits",
+                  metrics=["accuracy"], lr=5e-3)
+    h = model.fit(x, y, batch_size=32, nb_epoch=10)
+    assert h["loss"][-1] < h["loss"][0]
+    assert model.evaluate(x, y, batch_size=32)["accuracy"] > 0.8
+
+
+def test_train_snapshot_with_loss_tail_and_mid_graph_global_pool(tmp_path):
+    """Train-net snapshots end in SoftmaxWithLoss (skipped); and a global
+    AVE pool mid-graph must stay an average pool when later layers
+    exist (Lambda late-binding regression)."""
+    init_zoo_context()
+    torch.manual_seed(5)
+    fc = torch.nn.Linear(3, 2)
+    x = np.random.default_rng(6).normal(size=(2, 3, 4, 4)).astype(np.float32)
+    layers = [
+        _layer_v2("gap", "Pooling", ["data"], ["gap"],
+                  **{"121": _pool_param(1, 0, 1, global_=True)}),
+        _layer_v2("fc", "InnerProduct", ["gap"], ["fc"],
+                  blobs=[_np(fc.weight), _np(fc.bias)],
+                  **{"117": field_varint(1, 2) + field_varint(2, 1)}),
+        _layer_v2("loss", "SoftmaxWithLoss", ["fc", "label"], ["loss"]),
+    ]
+    path = tmp_path / "train.caffemodel"
+    path.write_bytes(_net(layers, input_dims=(1, 3, 4, 4)))
+    model = load_caffe(str(path))  # must not KeyError on 'loss'
+    got = _run(model, x)
+    with torch.no_grad():
+        want = fc(torch.tensor(x).mean(dim=(2, 3))).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_unknown_layer_type_is_loud(tmp_path):
+    layers = [_layer_v2("w", "WeirdLayer", ["data"], ["w"])]
+    path = tmp_path / "bad.caffemodel"
+    path.write_bytes(_net(layers))
+    with pytest.raises(NotImplementedError, match="WeirdLayer"):
+        load_caffe(str(path))

@@ -9,8 +9,17 @@ onto the native JAX layers. This package ships:
 * ``BERTClassifier`` — the BERT fine-tune estimator (config #4 surface):
   native BERT encoder → pooled output → dropout → classifier head, trained
   with the ordinary compile/fit stack.
+* ``BERTNER`` / ``BERTSQuAD`` — the prebuilt token-level estimators (both
+  in this package's ``bert_ner.py``; reference
+  ``tfpark/text/estimator/bert_ner.py`` + ``bert_squad.py``): per-token
+  classification with ignore-label masking, and start/end span extraction.
+* ``GANEstimator`` — alternating G/D training (``gan_estimator.py`` here;
+  reference ``tfpark/gan/gan_estimator.py`` + ``GanOptimMethod.scala``) as
+  two independently jitted donated steps.
 * ``bert_params_from_torch`` — weight import from a HuggingFace/torch BERT
   ``state_dict`` (the analogue of TFPark's init_from_checkpoint path).
 """
 
 from .bert_classifier import BERTClassifier, bert_params_from_torch  # noqa: F401
+from .bert_ner import BERTNER, BERTSQuAD  # noqa: F401
+from .gan_estimator import GANEstimator, gan_d_loss, gan_g_loss  # noqa: F401

@@ -99,26 +99,40 @@ class BERTClassifier(ZooModel):
                     token_type_ids: Optional[np.ndarray] = None,
                     attention_mask: Optional[np.ndarray] = None):
         """[ids, token_type, position, mask] from just token ids."""
-        ids = np.asarray(token_ids, np.int32)
-        b, t = ids.shape
-        tt = (np.asarray(token_type_ids, np.int32)
-              if token_type_ids is not None else np.zeros((b, t), np.int32))
-        pos = np.tile(np.arange(t, dtype=np.int32), (b, 1))
-        mask = (np.asarray(attention_mask, np.float32)
-                if attention_mask is not None else np.ones((b, t), np.float32))
-        return [ids, tt, pos, mask]
+        return make_bert_inputs(token_ids, token_type_ids, attention_mask)
 
     def load_pretrained(self, state_dict: Mapping[str, Any]) -> "BERTClassifier":
         """Install encoder weights from a HuggingFace/torch BERT
         ``state_dict`` (classifier head keeps its fresh init — the
         fine-tuning setup of ``bert_classifier.py``)."""
-        if self.params is None:
-            self.init_weights()
-        bert_params = bert_params_from_torch(state_dict, self.n_block)
-        params = dict(self.params)
-        params["bert"] = _check_tree_shapes(self.params["bert"], bert_params)
-        self.params = params
-        return self
+        return install_pretrained_bert(self, state_dict)
+
+
+def make_bert_inputs(token_ids: np.ndarray,
+                     token_type_ids: Optional[np.ndarray] = None,
+                     attention_mask: Optional[np.ndarray] = None):
+    """[ids, token_type, position, mask] from just token ids — the input
+    assembly every BERT estimator shares."""
+    ids = np.asarray(token_ids, np.int32)
+    b, t = ids.shape
+    tt = (np.asarray(token_type_ids, np.int32)
+          if token_type_ids is not None else np.zeros((b, t), np.int32))
+    pos = np.tile(np.arange(t, dtype=np.int32), (b, 1))
+    mask = (np.asarray(attention_mask, np.float32)
+            if attention_mask is not None else np.ones((b, t), np.float32))
+    return [ids, tt, pos, mask]
+
+
+def install_pretrained_bert(model, state_dict: Mapping[str, Any]):
+    """Install torch BERT encoder weights into a ZooModel whose param tree
+    has a ``"bert"`` entry; the task head keeps its fresh init."""
+    if model.params is None:
+        model.init_weights()
+    bert_params = bert_params_from_torch(state_dict, model.n_block)
+    params = dict(model.params)
+    params["bert"] = _check_tree_shapes(model.params["bert"], bert_params)
+    model.params = params
+    return model
 
 
 def _check_tree_shapes(template, loaded):

@@ -1,0 +1,117 @@
+"""TFPark prebuilt estimators: NER masked loss semantics, SQuAD span head,
+GAN alternating training on a learnable 1D distribution."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.tfpark import BERTNER, BERTSQuAD, GANEstimator
+from analytics_zoo_tpu.tfpark.bert_ner import (masked_token_scce,
+                                               squad_span_loss)
+
+
+def _tiny_kwargs():
+    return dict(vocab=60, hidden_size=32, n_block=2, n_head=2, seq_len=10,
+                intermediate_size=64)
+
+
+def test_masked_token_scce_ignores_negative_labels():
+    import jax.numpy as jnp
+    logits = np.zeros((1, 4, 3), np.float32)
+    logits[0, 0, 1] = 10.0   # confident correct
+    logits[0, 1, 0] = 10.0   # confident wrong (label 2)
+    labels_all = np.array([[1, 2, -1, -1]], np.int32)
+    loss = float(masked_token_scce(labels_all, logits))
+    # two real tokens: one ~0 CE, one ~10 CE → mean ~5
+    assert 4.0 < loss < 6.0
+    # masking: flipping an ignored position's logits changes nothing
+    logits2 = logits.copy()
+    logits2[0, 2] = [99.0, -99.0, 0.0]
+    assert np.isclose(loss, float(masked_token_scce(labels_all, logits2)))
+
+
+def test_squad_span_loss_perfect_prediction_near_zero():
+    logits = np.zeros((2, 6, 2), np.float32)
+    spans = np.array([[1, 3], [0, 5]], np.int32)
+    for b, (s, e) in enumerate(spans):
+        logits[b, s, 0] = 12.0
+        logits[b, e, 1] = 12.0
+    assert float(squad_span_loss(spans, logits)) < 0.01
+    assert float(squad_span_loss(1 - spans, logits)) > 1.0
+
+
+def test_bert_ner_trains_and_predicts():
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    ner = BERTNER(num_entities=3, **_tiny_kwargs())
+    ids = rng.integers(0, 60, size=(24, 10)).astype(np.int32)
+    # learnable rule: token id < 30 → entity 1 else 2; pad tail ignored
+    labels = np.where(ids < 30, 1, 2).astype(np.int32)
+    labels[:, 8:] = -1
+    inputs = ner.make_inputs(ids)
+    ner.compile(optimizer="adam", lr=2e-3)
+    h = ner.fit(inputs, labels, batch_size=8, nb_epoch=8)
+    assert h["loss"][-1] < h["loss"][0]
+    tags = ner.predict_tags(inputs, batch_size=8)
+    assert tags.shape == (24, 10)
+    acc = (tags[:, :8] == labels[:, :8]).mean()
+    assert acc > 0.9, acc
+
+
+def test_bert_squad_shapes_and_span_decode():
+    init_zoo_context()
+    rng = np.random.default_rng(1)
+    squad = BERTSQuAD(**_tiny_kwargs())
+    ids = rng.integers(0, 60, size=(8, 10)).astype(np.int32)
+    spans = np.stack([rng.integers(0, 5, 8), rng.integers(5, 10, 8)],
+                     axis=1).astype(np.int32)
+    inputs = squad.make_inputs(ids)
+    squad.compile(optimizer="adam", lr=1e-3)
+    h = squad.fit(inputs, spans, batch_size=8, nb_epoch=3)
+    assert h["loss"][-1] < h["loss"][0]
+    out = squad.predict_spans(inputs, batch_size=8)
+    assert out.shape == (8, 2)
+    assert (out[:, 1] >= out[:, 0]).all()  # end ≥ start enforced
+
+
+def test_gan_estimator_learns_shifted_gaussian():
+    """G: noise→affine; D: 2-layer MLP. After alternating training the
+    generator distribution must move toward the real N(3, 0.5) data."""
+    init_zoo_context()
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    rng = np.random.default_rng(2)
+    real = rng.normal(3.0, 0.5, size=(512, 1)).astype(np.float32)
+    noise = rng.normal(size=(512, 4)).astype(np.float32)
+
+    g = Sequential()
+    g.add(Dense(16, activation="relu", input_shape=(4,)))
+    g.add(Dense(1))
+    d = Sequential()
+    d.add(Dense(16, activation="relu", input_shape=(1,)))
+    d.add(Dense(1))
+
+    est = GANEstimator(g, d, generator_lr=5e-3, discriminator_lr=5e-3,
+                       generator_steps=1, discriminator_steps=1, seed=3)
+    before = est_mean = None
+    hist = est.train(noise, real, batch_size=64, steps=400)
+    assert len(hist["d_loss"]) == 200 and len(hist["g_loss"]) == 200
+    fake = est.generate(noise[:256])
+    est_mean = float(fake.mean())
+    assert abs(est_mean - 3.0) < 1.0, est_mean
+
+
+def test_gan_step_cadence():
+    """discriminator_steps=2, generator_steps=1 → 2:1 update ratio."""
+    init_zoo_context()
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    g = Sequential(); g.add(Dense(1, input_shape=(2,)))
+    d = Sequential(); d.add(Dense(1, input_shape=(1,)))
+    est = GANEstimator(g, d, discriminator_steps=2, generator_steps=1)
+    rng = np.random.default_rng(4)
+    hist = est.train(rng.normal(size=(32, 2)).astype(np.float32),
+                     rng.normal(size=(32, 1)).astype(np.float32),
+                     batch_size=8, steps=9)
+    assert len(hist["d_loss"]) == 6 and len(hist["g_loss"]) == 3

@@ -1,0 +1,2 @@
+from .raycontext import (ActorHandle, ObjectRef, RayContext,  # noqa: F401
+                         RayTaskError)

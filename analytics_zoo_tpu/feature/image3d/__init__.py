@@ -1,0 +1,2 @@
+from .transforms import (AffineTransform3D, CenterCrop3D, Crop3D,  # noqa: F401
+                         ImageProcessing3D, RandomCrop3D, Rotate3D, Warp3D)

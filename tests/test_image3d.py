@@ -1,0 +1,142 @@
+"""3D transforms: crops, affine/rotation geometry vs a scalar-loop oracle
+mirroring the reference's Warp.scala arithmetic, and combinator chains."""
+
+import math
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.image3d import (AffineTransform3D,
+                                               CenterCrop3D, Crop3D,
+                                               RandomCrop3D, Rotate3D,
+                                               Warp3D)
+
+
+def _loop_affine(vol, mat, translation, clamp_mode="clamp", pad_val=0.0):
+    """Naive per-voxel mirror of Affine.scala + Warp.scala (1-based)."""
+    d, h, w = vol.shape[:3]
+    out = np.zeros_like(vol)
+    cz, cy, cx = (d + 1) / 2.0, (h + 1) / 2.0, (w + 1) / 2.0
+    for z in range(1, d + 1):
+        for y in range(1, h + 1):
+            for x in range(1, w + 1):
+                g = np.array([cz - z, cy - y, cx - x])
+                flow = g - mat @ g - np.asarray(translation)
+                iz, iy, ix = z + flow[0], y + flow[1], x + flow[2]
+                off = not (1 <= iz <= d and 1 <= iy <= h and 1 <= ix <= w)
+                if off and clamp_mode == "padding":
+                    out[z - 1, y - 1, x - 1] = pad_val
+                    continue
+                iz = min(max(iz, 1), d)
+                iy = min(max(iy, 1), h)
+                ix = min(max(ix, 1), w)
+                z0, y0, x0 = int(iz), int(iy), int(ix)
+                z1, y1, x1 = min(z0 + 1, d), min(y0 + 1, h), min(x0 + 1, w)
+                wz, wy, wx = iz - z0, iy - y0, ix - x0
+                v = vol
+                out[z - 1, y - 1, x - 1] = (
+                    (1 - wy) * (1 - wx) * (1 - wz) * v[z0-1, y0-1, x0-1]
+                    + (1 - wy) * (1 - wx) * wz * v[z1-1, y0-1, x0-1]
+                    + (1 - wy) * wx * (1 - wz) * v[z0-1, y0-1, x1-1]
+                    + (1 - wy) * wx * wz * v[z1-1, y0-1, x1-1]
+                    + wy * (1 - wx) * (1 - wz) * v[z0-1, y1-1, x0-1]
+                    + wy * (1 - wx) * wz * v[z1-1, y1-1, x0-1]
+                    + wy * wx * (1 - wz) * v[z0-1, y1-1, x1-1]
+                    + wy * wx * wz * v[z1-1, y1-1, x1-1])
+    return out
+
+
+def _vol(shape=(5, 6, 7, 1), seed=0):
+    return np.random.default_rng(seed).normal(
+        size=shape).astype(np.float32)
+
+
+def test_crop3d():
+    v = _vol((6, 8, 10, 2))
+    out = Crop3D((1, 2, 3), (4, 4, 4)).apply(v)
+    np.testing.assert_array_equal(out, v[1:5, 2:6, 3:7])
+    with pytest.raises(ValueError, match="exceeds"):
+        Crop3D((4, 0, 0), (4, 4, 4)).apply(v)
+    c = CenterCrop3D(2, 4, 6).apply(v)
+    np.testing.assert_array_equal(c, v[2:4, 2:6, 2:8])
+    r = RandomCrop3D(3, 3, 3, seed=1).apply(v)
+    assert r.shape == (3, 3, 3, 2)
+
+
+def test_identity_affine_is_identity():
+    v = _vol()
+    out = AffineTransform3D(np.eye(3)).apply(v)
+    np.testing.assert_allclose(out, v, atol=1e-6)
+
+
+def test_affine_matches_loop_oracle():
+    v = _vol((5, 5, 5, 1), seed=2)
+    mat = np.eye(3) + np.random.default_rng(3).normal(0, 0.1, (3, 3))
+    tr = (0.3, -0.5, 0.7)
+    got = AffineTransform3D(mat, tr).apply(v)
+    want = _loop_affine(v, mat, tr)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # padding mode actually pads (the reference's Warp.scala:67 string/int
+    # comparison bug silently clamps; here the documented mode works)
+    got_p = AffineTransform3D(mat, (3.0, 0, 0), clamp_mode="padding",
+                              pad_val=-7.0).apply(v)
+    want_p = _loop_affine(v, mat, (3.0, 0, 0), "padding", -7.0)
+    np.testing.assert_allclose(got_p, want_p, rtol=1e-5, atol=1e-6)
+    assert (got_p == -7.0).any()
+
+
+def test_rotate3d_90deg_roll_moves_delta_voxel():
+    """The reference's rotation matrices act on (z, y, x)-ordered vectors
+    (grid rows are z, y, x — Affine.scala:58-64), so its "roll" matrix is
+    the in-plane y–x rotation: a quarter roll keeps the z-slice and moves
+    the voxel around the center."""
+    v = np.zeros((5, 5, 5, 1), np.float32)
+    v[2, 1, 2, 0] = 1.0  # one voxel above center in y
+    out = Rotate3D([0.0, 0.0, math.pi / 2]).apply(v)
+    # rotation maps grid onto grid for odd sizes: mass stays a single voxel
+    assert np.isclose(out.sum(), 1.0, atol=1e-5)
+    pos = np.unravel_index(np.argmax(out[..., 0]), (5, 5, 5))
+    assert pos == (2, 2, 3)  # same z-slice, quarter turn in the y–x plane
+    # four quarter turns come back to the start
+    cur = v
+    for _ in range(4):
+        cur = Rotate3D([0.0, 0.0, math.pi / 2]).apply(cur)
+    np.testing.assert_allclose(cur, v, atol=1e-4)
+
+
+def test_review_regressions():
+    v = _vol((6, 8, 10, 2))
+    # oversized center crop must raise, not wrap negatively
+    with pytest.raises(ValueError, match="exceeds"):
+        CenterCrop3D(7, 4, 4).apply(v)
+    # list of channel-less volumes gets the C=1 normalization per item
+    vols = [np.zeros((5, 5, 5), np.float32), np.ones((5, 5, 5), np.float32)]
+    out = AffineTransform3D(np.eye(3)).apply(vols)
+    assert all(o.shape == (5, 5, 5) for o in out)
+    np.testing.assert_allclose(out[1], 1.0, atol=1e-6)
+    # clamp-mode typos are loud everywhere
+    with pytest.raises(ValueError, match="clamp_mode"):
+        Warp3D(np.zeros((3, 4, 4, 4)), clamp_mode="pad")
+    # integer volumes: padding value clips instead of wrapping
+    vu8 = np.full((4, 4, 4, 1), 10, np.uint8)
+    flow = np.zeros((3, 4, 4, 4))
+    flow[0] = 10.0  # everything off-image
+    out8 = Warp3D(flow, clamp_mode="padding", pad_val=-1).apply(vu8)
+    assert out8.dtype == np.uint8 and (out8 == 0).all()
+
+
+def test_warp3d_translation_flow():
+    v = _vol((4, 4, 4, 1), seed=4)
+    flow = np.zeros((3, 4, 4, 4))
+    flow[0] = 1.0  # sample z+1 → shift volume up by one slice
+    out = Warp3D(flow).apply(v)
+    np.testing.assert_allclose(out[:3], v[1:], atol=1e-6)
+    np.testing.assert_allclose(out[3], v[3], atol=1e-6)  # clamped edge
+
+
+def test_batch_and_chain():
+    vols = _vol((3, 6, 6, 6, 1), seed=5)
+    chain = CenterCrop3D(4, 4, 4) >> Rotate3D([0.0, 0.0, 0.0])
+    out = chain.apply(vols)
+    assert np.asarray(out).shape == (3, 4, 4, 4, 1)
+    np.testing.assert_allclose(out, vols[:, 1:5, 1:5, 1:5], atol=1e-6)

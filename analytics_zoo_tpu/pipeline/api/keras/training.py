@@ -131,17 +131,29 @@ def _fired_within(trigger: Optional[Trigger], state: TrainLoopState,
     return trigger(state)
 
 
+@jax.jit
+def _copy_leaves(leaves):
+    return [jnp.copy(a) for a in leaves]
+
+
 def _clone_tree(tree):
     """Fresh buffers for every array leaf. The donated train step deletes its
     input buffers, so any tree that outlives a step (``model.params``, the
-    retry snapshot) must never alias one that enters the step."""
-    def clone(a):
-        if isinstance(a, jax.Array):
-            return jnp.copy(a)
-        if isinstance(a, np.ndarray):
-            return np.copy(a)
-        return a
-    return jax.tree.map(clone, tree)
+    retry snapshot) must never alias one that enters the step.
+
+    All device leaves are copied in ONE jitted dispatch: a per-leaf
+    ``jnp.copy`` costs a separate ``jit(copy)`` trace/dispatch per leaf —
+    over a tunneled device link that is ~0.6 s of compile per leaf the
+    first time a tree arrives with new shardings, and a device round-trip
+    per leaf every time."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    dev_idx = [i for i, a in enumerate(leaves) if isinstance(a, jax.Array)]
+    if dev_idx:
+        copies = _copy_leaves([leaves[i] for i in dev_idx])
+        for i, c in zip(dev_idx, copies):
+            leaves[i] = c
+    leaves = [np.copy(a) if isinstance(a, np.ndarray) else a for a in leaves]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 # ---------------------------------------------------------------------------
@@ -163,6 +175,12 @@ class TrainingLoop:
         self._epoch_fns: Dict[Tuple, Any] = {}
         self._eval_step = None
         self._predict_step = None
+        # device-resident copy of the latest FeatureSet (device_cache path)
+        # — re-uploading per fit call costs a full host→device transfer of
+        # the whole set. The entry HOLDS the fs object: a bare id() key
+        # could be reused by a new FeatureSet after GC and silently serve
+        # the old dataset's arrays.
+        self._data_cache: Dict[Tuple, Any] = {}
 
     # -- jitted steps -------------------------------------------------------
     def build_train_step(self):
@@ -221,6 +239,28 @@ class TrainingLoop:
 
         self._scan_step = jax.jit(chunk, donate_argnums=(0, 1, 2))
         return self._scan_step
+
+    def _shard_opt_state(self, opt_state, psh, repl):
+        """Committed placement for optimizer state: param-shaped leaves
+        (adam moments) follow the param shardings, counters and the like
+        replicate. Used for BOTH fresh and reused state so every fit call
+        presents identical input shardings to the jitted step — otherwise
+        the first call hands uncommitted counters while later calls hand
+        committed ones, and each fit() misses the jit cache and recompiles
+        the whole epoch program (~20 s on a real chip)."""
+        try:
+            return optax.tree_map_params(
+                self.optimizer, lambda s, sh: jax.device_put(s, sh),
+                opt_state, psh,
+                transform_non_params=lambda s: jax.device_put(s, repl))
+        except (ValueError, TypeError, AttributeError) as e:
+            # structure quirks of custom/wrapped optimizers (e.g.
+            # multi_transform label fns failing placeholder introspection):
+            # replicated moments are correct — and identical under pure DP —
+            # but under TP they reshard every step, so say so
+            log.warning("could not apply param shardings to the optimizer "
+                        "state (%s); moments stay replicated", e)
+            return jax.device_put(opt_state, repl)
 
     def build_epoch_fn(self, n: int, batch_size: int, n_steps: int,
                        shuffle: bool = True):
@@ -462,28 +502,16 @@ class TrainingLoop:
             same = (jax.tree_util.tree_structure(model.opt_state)
                     == fresh_struct)
             if same:
-                opt_state = _clone_tree(model.opt_state)
-                try:
-                    # param-shaped leaves (adam moments) follow the param
-                    # shardings; counters and the like replicate
-                    opt_state = optax.tree_map_params(
-                        self.optimizer,
-                        lambda s, sh: jax.device_put(s, sh), opt_state, psh,
-                        transform_non_params=lambda s: jax.device_put(s, repl))
-                except (ValueError, TypeError) as e:
-                    # structure quirks of custom/wrapped optimizers: fall
-                    # back to replicated moments — correct but, under TP,
-                    # resharded every step; say so
-                    log.warning("could not apply param shardings to the "
-                                "optimizer state (%s); moments stay "
-                                "replicated", e)
-                    opt_state = jax.device_put(opt_state, repl)
+                opt_state = self._shard_opt_state(
+                    _clone_tree(model.opt_state), psh, repl)
             else:
                 log.warning("optimizer structure changed since the last fit; "
                             "resetting optimizer state")
-                opt_state = self.optimizer.init(params)
+                opt_state = self._shard_opt_state(
+                    self.optimizer.init(params), psh, repl)
         else:
-            opt_state = self.optimizer.init(params)
+            opt_state = self._shard_opt_state(self.optimizer.init(params),
+                                              psh, repl)
 
         # resume: if a checkpoint directory is configured and holds a snapshot
         # newer than this model's progress, restore it (process-death resume)
@@ -533,8 +561,14 @@ class TrainingLoop:
 
             epoch_fn = self.build_epoch_fn(len(fs), batch_size, n_steps,
                                            shuffle=fs.shuffle)
-            xs_dev = jax.tree.map(put, fs.x)
-            ys_dev = jax.tree.map(put, fs.y)
+            cache_key = (id(fs), len(fs), n_padded)
+            if cache_key not in self._data_cache:
+                # keep only the latest dataset resident (HBM is the scarce
+                # resource; switching sets back and forth re-uploads)
+                self._data_cache.clear()
+                self._data_cache[cache_key] = (fs, jax.tree.map(put, fs.x),
+                                               jax.tree.map(put, fs.y))
+            _, xs_dev, ys_dev = self._data_cache[cache_key]
 
         base_rng = rng if rng is not None else ctx.rng()
         history: Dict[str, List[float]] = {"loss": []}
@@ -617,9 +651,8 @@ class TrainingLoop:
             # publish progress every epoch — clones, because the live trees
             # feed the donating train step next epoch; this is also what a
             # retry attempt falls back to when the newest snapshot is older
-            model.params = _clone_tree(params)
-            model.net_state = _clone_tree(net_state)
-            model.opt_state = _clone_tree(opt_state)
+            model.params, model.net_state, model.opt_state = \
+                _clone_tree((params, net_state, opt_state))
             if completed:
                 model.finished_epochs = epoch
             model.finished_iterations = loop_state.iteration

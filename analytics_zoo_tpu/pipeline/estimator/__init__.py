@@ -1,5 +1,5 @@
 """Estimator facade (``pipeline/estimator`` of the reference, L4)."""
 
-from .estimator import Estimator
+from .estimator import Estimator, LocalEstimator  # noqa: F401
 
-__all__ = ["Estimator"]
+__all__ = ["Estimator", "LocalEstimator"]

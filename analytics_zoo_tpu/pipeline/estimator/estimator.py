@@ -10,8 +10,9 @@ the reference (``Topology.scala`` vs ``Estimator.scala`` both driving
 BigDL's DistriOptimizer).
 
 ``LocalEstimator`` (``pipeline/estimator/LocalEstimator.scala:39-48``) — the
-reference's single-JVM thread-pool trainer — has no TPU analogue to build:
-a single-process mesh IS the local mode here, so ``Estimator`` covers both.
+reference's single-JVM thread-pool trainer — needs no separate engine here:
+a single-process mesh IS the local mode. The class below keeps the
+array-based ``fit(x, y)`` surface and delegates to the same loop.
 """
 
 from __future__ import annotations
@@ -28,7 +29,7 @@ from ..api.keras import optimizers as optim_lib
 from ..api.keras.engine import KerasNet
 from ..api.keras.training import TrainingLoop
 
-__all__ = ["Estimator"]
+__all__ = ["Estimator", "LocalEstimator"]
 
 
 class Estimator:
@@ -139,3 +140,32 @@ class Estimator:
         loop = self._get_loop(criterion, validation_methods)
         return loop.evaluate(validation_set.x, validation_set.y,
                              batch_size=batch_size)
+
+
+class LocalEstimator(Estimator):
+    """``LocalEstimator(model, criterion, optimMethod).fit(data, ...)``
+    (``LocalEstimator.scala:39-48,89``) — the array-based single-host
+    surface. The reference needs a dedicated thread-pool trainer because
+    its distributed engine requires Spark; here local and distributed are
+    the same jitted loop, so this is the ``fit(x, y)`` facade over
+    :class:`Estimator`."""
+
+    def __init__(self, model: KerasNet, criterion: Any = "mse",
+                 optim_method: Union[str, optax.GradientTransformation,
+                                     None] = "adam"):
+        super().__init__(model, optim_methods=optim_method)
+        self.criterion = criterion
+
+    def fit(self, x, y, *, batch_size: int = 32, nb_epoch: int = 1,
+            validation_data=None,
+            validation_methods: Optional[Sequence[Any]] = None,
+            callbacks: Sequence[Callable] = ()) -> Dict[str, List[float]]:
+        val = (FeatureSet.array(*validation_data)
+               if (validation_data is not None
+                   and not isinstance(validation_data, FeatureSet))
+               else validation_data)
+        return self.train(FeatureSet.array(x, y),
+                          criterion=self.criterion, batch_size=batch_size,
+                          nb_epoch=nb_epoch, validation_set=val,
+                          validation_methods=validation_methods,
+                          callbacks=callbacks)

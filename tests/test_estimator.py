@@ -172,3 +172,27 @@ def test_clipping_change_between_trains_resets_opt_state():
     h2 = est.train(FeatureSet.array(x, y), "scce", batch_size=64, nb_epoch=3)
     assert np.isfinite(h2["loss"][-1])
     assert h2["loss"][-1] < h1["loss"][0]
+
+
+def test_local_estimator_array_surface():
+    """LocalEstimator.fit(x, y) — LocalEstimator.scala:89 array surface over
+    the shared loop."""
+    import numpy as np
+    from analytics_zoo_tpu.common.context import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.estimator import LocalEstimator
+
+    init_zoo_context()
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(256, 6)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(6,)))
+    m.add(Dense(2, activation="softmax"))
+    m.init_weights(sample_input=x)
+    est = LocalEstimator(m, criterion="scce", optim_method="adam")
+    h = est.fit(x, y, batch_size=32, nb_epoch=6,
+                validation_data=(x, y), validation_methods=["accuracy"])
+    assert h["loss"][-1] < h["loss"][0]
+    assert h["val_accuracy"][-1] > 0.8

@@ -69,6 +69,8 @@ CASES = {
         5, 3, 3, atrous_rate=(2, 2)), (10, 10, 3), "float"),
     "SeparableConvolution2D": (lambda: L.SeparableConvolution2D(6, 3, 3),
                                (8, 8, 3), "float"),
+    "DepthwiseConvolution2D": (lambda: L.DepthwiseConvolution2D(
+        3, 3, depth_multiplier=2), (8, 8, 3), "float"),
     "Deconvolution2D": (lambda: L.Deconvolution2D(5, 3, 3), (6, 6, 3), "float"),
     "LocallyConnected1D": (lambda: L.LocallyConnected1D(5, 3), (8, 4), "float"),
     "Cropping1D": (lambda: L.Cropping1D((1, 1)), (8, 4), "float"),

@@ -53,6 +53,10 @@ class MaxEpoch(Trigger):
         self.max_epoch = max_epoch
 
     def __call__(self, state: TrainLoopState) -> bool:
+        # at a boundary the finished count IS state.epoch; mid-epoch the
+        # current epoch has not finished yet
+        if state.epoch_finished:
+            return state.epoch >= self.max_epoch
         return state.epoch > self.max_epoch
 
 

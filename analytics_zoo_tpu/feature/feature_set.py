@@ -132,6 +132,12 @@ class FeatureSet:
         n = len(self)
         return n // batch_size if drop_last else (n + batch_size - 1) // batch_size
 
+    def sample(self, n: int):
+        """First ``n`` records — shape/dtype probing (e.g. lazy weight
+        init) without materializing more than ``n`` rows."""
+        bx = [a[:n] for a in self.xs]
+        return bx if len(bx) > 1 else bx[0]
+
 
 # ---------------------------------------------------------------------------
 # async host prefetch + double-buffered device transfer
@@ -214,3 +220,132 @@ def prefetch_to_device(it: Iterator, mesh=None, *, buffer_size: int = 2,
     finally:
         if threaded:
             src.close()
+
+
+# ---------------------------------------------------------------------------
+# disk tier (DiskFeatureSet, FeatureSet.scala:332-409)
+# ---------------------------------------------------------------------------
+
+class DiskFeatureSet(FeatureSet):
+    """``DISK_AND_DRAM(numSlice)`` semantics (``FeatureSet.scala:332-409``):
+    the dataset lives on disk (standard ``.npy`` files, memory-mapped by the
+    native IO library); each training pass materializes a random
+    ``1/num_slices`` DRAM slice, and the NEXT pass's pages stream in on a
+    background thread while the current slice trains. ``EveryEpoch``-style
+    triggers and ``nb_epoch`` count FULL passes (``num_slices`` slice
+    passes), matching ``ZooTrigger.scala:44-66``.
+
+    ``num_slices == 0`` declares an evaluation-only set (whole set readable,
+    no training slices), mirroring the reference's contract.
+    """
+
+    def __init__(self, x_paths, y_path: Optional[str] = None,
+                 num_slices: int = 2, shuffle: bool = True, seed: int = 0):
+        from ..native import NativeArrayFile
+        if num_slices == 1 or num_slices < 0:
+            raise ValueError(
+                "num_slices must be 0 (eval-only) or >= 2; for everything "
+                "in DRAM use FeatureSet.array (the reference's DRAM type)")
+        paths = [x_paths] if isinstance(x_paths, (str, bytes)) else list(x_paths)
+        self.files_x = [NativeArrayFile(p) for p in paths]
+        self.file_y = NativeArrayFile(y_path) if y_path is not None else None
+        self.total = self.files_x[0].n
+        for f in self.files_x:
+            if f.n != self.total:
+                raise ValueError("feature files disagree on record count")
+        if self.file_y is not None and self.file_y.n != self.total:
+            raise ValueError("label file disagrees with features on count")
+        self.num_slices = int(num_slices)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.slice_size = (self.total // self.num_slices
+                           if self.num_slices else self.total)
+        self._cur: Optional[Tuple[int, List[np.ndarray], Any]] = None
+
+    # -- factory ------------------------------------------------------------
+    @staticmethod
+    def disk(x_paths, y_path=None, *, num_slices: int = 2,
+             shuffle: bool = True, seed: int = 0) -> "DiskFeatureSet":
+        return DiskFeatureSet(x_paths, y_path, num_slices, shuffle, seed)
+
+    # -- protocol -----------------------------------------------------------
+    @property
+    def num_of_slice(self) -> int:
+        return self.num_slices
+
+    def __len__(self) -> int:
+        return self.slice_size
+
+    def _slice_indices(self, pass_idx: int) -> np.ndarray:
+        """Record indices of slice ``pass_idx``, SORTED for sequential disk
+        reads (within-slice order is reshuffled by ``_order`` anyway)."""
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + 7919 * pass_idx)
+            idx = rng.choice(self.total, size=self.slice_size, replace=False)
+            idx.sort()
+            return idx
+        # modular rotation so a total that doesn't divide num_slices still
+        # covers every record across passes (no permanently-dropped tail)
+        lo = (pass_idx * self.slice_size) % self.total
+        return (np.arange(lo, lo + self.slice_size) % self.total)
+
+    def _materialize(self, pass_idx: int) -> None:
+        if self._cur is not None and self._cur[0] == pass_idx:
+            return
+        idx = self._slice_indices(pass_idx)
+        xs = [f.gather(idx) for f in self.files_x]
+        y = self.file_y.gather(idx) if self.file_y is not None else None
+        self._cur = (pass_idx, xs, y)
+        # stream the NEXT slice's pages in while this one trains — only in
+        # rotation mode, where the next slice is a dense range; a shuffled
+        # slice's sorted sample spans ~the whole file, and prefetching all
+        # of it would read num_slices× the IO the slicing exists to avoid
+        if not self.shuffle:
+            nxt = self._slice_indices(pass_idx + 1)
+            lo, hi = int(nxt.min()), int(nxt.max()) + 1
+            for f in self.files_x + ([self.file_y] if self.file_y else []):
+                f.prefetch(lo, hi)
+
+    def iter_batches(self, batch_size: int, *, epoch: int = 0,
+                     drop_last: bool = True):
+        if self.num_slices == 0:
+            raise ValueError("num_slices=0 is an evaluation-only "
+                             "DiskFeatureSet — it cannot train "
+                             "(FeatureSet.scala:369-375)")
+        self._materialize(epoch)
+        _, xs, y = self._cur
+        order = self._order(epoch)
+        n = self.slice_size
+        end = n - (n % batch_size) if drop_last else n
+        for i in range(0, end, batch_size):
+            sel = order[i:i + batch_size]
+            bx = [a[sel] for a in xs]
+            yield (bx if len(bx) > 1 else bx[0],
+                   None if y is None else y[sel])
+
+    def sample(self, n: int):
+        """First ``n`` records straight from disk — no full-set gather."""
+        idx = np.arange(min(n, self.total))
+        bx = [f.gather(idx) for f in self.files_x]
+        return bx if len(bx) > 1 else bx[0]
+
+    # whole-set views (the reference's data(train=false) path)
+    @property
+    def xs(self):  # type: ignore[override]
+        all_idx = np.arange(self.total)
+        return [f.gather(all_idx) for f in self.files_x]
+
+    @property
+    def x(self):
+        xs = self.xs
+        return xs if len(xs) > 1 else xs[0]
+
+    @property
+    def y(self):
+        if self.file_y is None:
+            return None
+        return self.file_y.gather(np.arange(self.total))
+
+    def close(self):
+        for f in self.files_x + ([self.file_y] if self.file_y else []):
+            f.close()

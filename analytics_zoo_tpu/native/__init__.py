@@ -1,0 +1,2 @@
+from .io import (NativeArrayFile, native_io_available,  # noqa: F401
+                 load_native_io)

@@ -1,0 +1,164 @@
+"""ctypes binding for the native IO library (``native/zoo_io.cc``) — the
+host-side C++ component of the disk data tier (the reference's equivalent
+layer is JNI: ``PersistentMemoryAllocator.java:37-43`` + BigDL's DISK_ONLY
+persistence under ``FeatureSet.scala:332-409``).
+
+The library is compiled on first use with the in-image ``g++`` (no
+pybind11 — plain C ABI via ctypes) and cached next to the source. When no
+compiler is available, :class:`NativeArrayFile` transparently falls back to
+``numpy.memmap`` — same results, minus the native gather speed and the
+background page prefetch.
+
+File format: standard ``.npy`` (v1/v2). The Python side parses the header
+(dtype, shape, data offset); the native side only ever sees flat bytes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("analytics_zoo_tpu.native")
+
+_lib = None
+_lib_lock = threading.Lock()
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+
+
+def _configure(lib):
+    lib.zoo_open.restype = ctypes.c_void_p
+    lib.zoo_open.argtypes = [ctypes.c_char_p]
+    lib.zoo_size.restype = ctypes.c_long
+    lib.zoo_size.argtypes = [ctypes.c_void_p]
+    lib.zoo_data.restype = ctypes.c_void_p
+    lib.zoo_data.argtypes = [ctypes.c_void_p]
+    lib.zoo_gather.restype = ctypes.c_int
+    lib.zoo_gather.argtypes = [ctypes.c_void_p, ctypes.c_long, ctypes.c_long,
+                               ctypes.POINTER(ctypes.c_long), ctypes.c_long,
+                               ctypes.c_void_p]
+    lib.zoo_prefetch.restype = ctypes.c_int
+    lib.zoo_prefetch.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                 ctypes.c_long]
+    lib.zoo_prefetch_wait.restype = None
+    lib.zoo_prefetch_wait.argtypes = [ctypes.c_void_p]
+    lib.zoo_close.restype = None
+    lib.zoo_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def load_native_io() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) libzoo_io.so; None when unavailable."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib or None
+        so = os.path.join(_NATIVE_DIR, "libzoo_io.so")
+        src = os.path.join(_NATIVE_DIR, "zoo_io.cc")
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", src,
+                     "-shared", "-pthread", "-o", so],
+                    check=True, capture_output=True, timeout=120)
+                log.info("built native IO library at %s", so)
+            _lib = _configure(ctypes.CDLL(so))
+        except Exception as e:  # noqa: BLE001 — any failure → numpy fallback
+            log.warning("native IO unavailable (%s); numpy.memmap fallback "
+                        "in use", e)
+            _lib = False
+        return _lib or None
+
+
+def native_io_available() -> bool:
+    return load_native_io() is not None
+
+
+def _read_npy_header(path: str) -> Tuple[np.dtype, Tuple[int, ...], int]:
+    """(dtype, shape, data_offset) of a .npy file, C-order required."""
+    with open(path, "rb") as f:
+        version = np.lib.format.read_magic(f)
+        shape, fortran, dtype = np.lib.format._read_array_header(f, version)
+        if fortran:
+            raise ValueError(f"{path}: Fortran-order arrays not supported")
+        return np.dtype(dtype), tuple(shape), f.tell()
+
+
+class NativeArrayFile:
+    """Random-access reader over one ``.npy`` file: ``gather(indices)``
+    copies the selected records into fresh DRAM; ``prefetch(lo, hi)``
+    streams a record range's pages in the background."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.dtype, self.shape, self.offset = _read_npy_header(path)
+        if not self.shape:
+            raise ValueError(f"{path}: scalar arrays have no records")
+        self.n = int(self.shape[0])
+        self.record_shape = tuple(self.shape[1:])
+        self.record_bytes = int(np.prod(self.record_shape, dtype=np.int64)
+                                * self.dtype.itemsize) or self.dtype.itemsize
+        self._lib = load_native_io()
+        if self._lib is not None:
+            self._h = self._lib.zoo_open(path.encode())
+            if not self._h:
+                raise OSError(f"zoo_open failed for {path}")
+            expected = self.offset + self.n * self.record_bytes
+            if self._lib.zoo_size(self._h) < expected:
+                self._lib.zoo_close(self._h)
+                raise ValueError(f"{path}: file shorter than header claims")
+        else:
+            self._h = None
+            self._mm = np.memmap(path, dtype=self.dtype, mode="r",
+                                 offset=self.offset, shape=self.shape)
+
+    def gather(self, indices: np.ndarray) -> np.ndarray:
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        out = np.empty((len(idx),) + self.record_shape, self.dtype)
+        if self._h is not None:
+            rc = self._lib.zoo_gather(
+                self._h, self.offset, self.record_bytes,
+                idx.ctypes.data_as(ctypes.POINTER(ctypes.c_long)), len(idx),
+                out.ctypes.data_as(ctypes.c_void_p))
+            if rc != 0:
+                raise IndexError(f"{self.path}: gather index out of range")
+            return out
+        if len(idx) and (idx.min() < 0 or idx.max() >= self.n):
+            raise IndexError(f"{self.path}: gather index out of range")
+        out[...] = self._mm[idx]
+        return out
+
+    def prefetch(self, lo: int, hi: int) -> None:
+        """Async page-in of records [lo, hi); no-op on the numpy fallback."""
+        if self._h is None:
+            return
+        lo = max(int(lo), 0)
+        hi = min(int(hi), self.n)
+        if hi <= lo:
+            return
+        self._lib.zoo_prefetch(self._h, self.offset + lo * self.record_bytes,
+                               (hi - lo) * self.record_bytes)
+
+    def prefetch_wait(self) -> None:
+        if self._h is not None:
+            self._lib.zoo_prefetch_wait(self._h)
+
+    def close(self) -> None:
+        if getattr(self, "_h", None) is not None:
+            self._lib.zoo_close(self._h)
+            self._h = None
+        if hasattr(self, "_mm"):
+            del self._mm
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

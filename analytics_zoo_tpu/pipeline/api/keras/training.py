@@ -32,8 +32,8 @@ import numpy as np
 import optax
 
 from ....common.context import get_zoo_context
-from ....common.triggers import (EveryEpoch, SeveralIteration, TrainLoopState,
-                                 Trigger)
+from ....common.triggers import (EveryEpoch, MaxEpoch, SeveralIteration,
+                                 TrainLoopState, Trigger)
 from ....feature.feature_set import FeatureSet, prefetch_to_device
 from ....parallel import mesh as mesh_lib
 from ....utils.checkpoint import CheckpointManager
@@ -114,6 +114,18 @@ def _chunked(it, k: int):
             buf = []
     if buf:
         yield _stack_batches(buf)
+
+
+class _FullPassEveryEpoch(Trigger):
+    """``EveryEpoch`` over a sliced dataset: fires only when the finished
+    slice pass completes a FULL pass over all slices
+    (``ZooTrigger.scala:53-58``: ``currentSlice % numSlice == 0``)."""
+
+    def __init__(self, num_slices: int):
+        self.num_slices = int(num_slices)
+
+    def __call__(self, state: TrainLoopState) -> bool:
+        return state.epoch_finished and state.epoch % self.num_slices == 0
 
 
 def _fired_within(trigger: Optional[Trigger], state: TrainLoopState,
@@ -476,7 +488,7 @@ class TrainingLoop:
         scan_steps = max(1, int(ctx.get("zoo.train.scan_steps", 1)))
 
         if model.params is None:
-            model.init_weights(rng=rng, sample_input=_take(fs.x, np.arange(1)))
+            model.init_weights(rng=rng, sample_input=fs.sample(1))
         if scan_steps > 1 and self._scan_step is None:
             self.build_scan_step()
         if self._train_step is None:
@@ -527,17 +539,38 @@ class TrainingLoop:
                     model.finished_epochs = resumed_epoch
                 model.finished_iterations = int(meta.get(
                     "iteration", model.finished_iterations))
+        # sliced disk tier: one loop "epoch" is ONE slice pass; nb_epoch and
+        # EveryEpoch-style triggers count FULL passes of num_of_slice slices
+        # (DiskFeatureSet + ZooTrigger.scala:44-66 slice awareness)
+        n_slices = int(getattr(fs, "num_of_slice", 1) or 1)
+        if n_slices > 1:
+            def slice_aware(trig):
+                if isinstance(trig, EveryEpoch):
+                    return _FullPassEveryEpoch(n_slices)
+                if isinstance(trig, MaxEpoch):
+                    return MaxEpoch(trig.max_epoch * n_slices)
+                if trig is not None and not isinstance(
+                        trig, (SeveralIteration, _FullPassEveryEpoch)):
+                    log.warning("trigger %s under a %d-slice DiskFeatureSet "
+                                "observes SLICE passes as epochs, not full "
+                                "passes", type(trig).__name__, n_slices)
+                return trig
+            ckpt_trigger = slice_aware(ckpt_trigger)
+            end_trigger = slice_aware(end_trigger)
         if "target" not in target_holder:
             # "train nb_epoch more" counts from post-resume progress, matching
             # the reference's getFinishedEpoch continuation (Topology.scala:373-386)
-            target_holder["target"] = model.finished_epochs + nb_epoch
+            target_holder["target"] = (model.finished_epochs
+                                       + nb_epoch * n_slices)
         target_epoch = target_holder["target"]
 
         # device-cache fast path: dataset lives in HBM, one dispatch per epoch
         device_cache = bool(ctx.get("zoo.train.device_cache", False))
         epoch_fn = None
         xs_dev = ys_dev = None
-        if device_cache and fs.y is not None:
+        # n_slices first: DiskFeatureSet.y is a property that would gather
+        # the whole label file just to answer the None check
+        if device_cache and n_slices <= 1 and fs.y is not None:
             n_steps = fs.steps_per_epoch(batch_size, drop_last=True)
             for trig, role in ((ckpt_trigger, "checkpoint"),
                                (end_trigger, "end")):
@@ -582,6 +615,9 @@ class TrainingLoop:
             losses = []
             n_seen = 0
             loop_state.epoch = epoch
+            # clear the boundary flag: mid-epoch trigger checks must not see
+            # the previous epoch's True (stale EveryEpoch/MaxEpoch fires)
+            loop_state.epoch_finished = False
             if epoch_fn is not None:
                 prev_iter = loop_state.iteration
                 shuffle_rng = jax.random.key(fs.seed + ctx.seed + epoch)
@@ -706,7 +742,10 @@ class TrainingLoop:
                              (val.items() if val is not None else ())))
             for cb in callbacks:
                 cb(record)
-            loop_state.epoch_finished = False
+            # epoch_finished stays True through this boundary check (it is
+            # cleared at the next epoch's start): MaxEpoch must see the
+            # finished count, else a satisfied end trigger runs one extra
+            # partial epoch
             if stop or (end_trigger is not None and end_trigger(loop_state)):
                 break
 

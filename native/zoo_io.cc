@@ -1,0 +1,135 @@
+// Native host-side IO for the disk data tier — the TPU-native replacement
+// for the reference's PMEM/disk cache natives (PersistentMemoryAllocator
+// JNI, zoo/src/main/java/.../pmem/PersistentMemoryAllocator.java:37-43, and
+// the DISK_ONLY RDD under DiskFeatureSet, FeatureSet.scala:332-409).
+//
+// Design: datasets are memory-mapped read-only files; the OS page cache is
+// the DRAM tier. The library adds what numpy.memmap alone can't do cheaply:
+//  * gather(): one C++ loop copying an index-selected set of fixed-size
+//    records into a caller buffer (a DRAM slice materialization) without
+//    per-row Python/numpy overhead;
+//  * prefetch(): madvise(WILLNEED) plus a background touch thread per
+//    handle, so the NEXT slice's pages stream in from disk while the
+//    current slice trains — the double-buffering DiskFeatureSet gets from
+//    Spark's async persistence.
+//
+// C ABI (ctypes-consumed; no pybind11 in the image):
+//   void*  zoo_open(const char* path);
+//   long   zoo_size(void* h);                       // bytes
+//   const void* zoo_data(void* h);                  // mapped base
+//   int    zoo_gather(void* h, long offset, long record_bytes,
+//                     const long* indices, long n, void* dst);
+//   int    zoo_prefetch(void* h, long offset, long nbytes);   // async
+//   void   zoo_prefetch_wait(void* h);
+//   void   zoo_close(void* h);
+// All functions return 0/-1 for status where applicable; errno preserved.
+
+#include <atomic>
+#include <cerrno>
+#include <cstring>
+#include <thread>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Handle {
+  int fd = -1;
+  const char* base = nullptr;
+  long size = 0;
+  std::thread prefetcher;
+  std::atomic<bool> prefetch_running{false};
+};
+
+}  // namespace
+
+extern "C" {
+
+void* zoo_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = ::mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  // sequential scans are the common post-gather pattern; let readahead work
+  ::madvise(base, st.st_size, MADV_NORMAL);
+  Handle* h = new Handle();
+  h->fd = fd;
+  h->base = static_cast<const char*>(base);
+  h->size = static_cast<long>(st.st_size);
+  return h;
+}
+
+long zoo_size(void* hp) { return static_cast<Handle*>(hp)->size; }
+
+const void* zoo_data(void* hp) { return static_cast<Handle*>(hp)->base; }
+
+int zoo_gather(void* hp, long offset, long record_bytes, const long* indices,
+               long n, void* dst) {
+  Handle* h = static_cast<Handle*>(hp);
+  if (record_bytes <= 0 || offset < 0) {
+    errno = EINVAL;
+    return -1;
+  }
+  char* out = static_cast<char*>(dst);
+  const char* src = h->base + offset;
+  const long max_index = (h->size - offset) / record_bytes;
+  for (long i = 0; i < n; ++i) {
+    const long idx = indices[i];
+    if (idx < 0 || idx >= max_index) {
+      errno = ERANGE;
+      return -1;
+    }
+    std::memcpy(out + i * record_bytes, src + idx * record_bytes,
+                record_bytes);
+  }
+  return 0;
+}
+
+void zoo_prefetch_wait(void* hp) {
+  Handle* h = static_cast<Handle*>(hp);
+  if (h->prefetcher.joinable()) h->prefetcher.join();
+  h->prefetch_running = false;
+}
+
+int zoo_prefetch(void* hp, long offset, long nbytes) {
+  Handle* h = static_cast<Handle*>(hp);
+  if (offset < 0 || offset + nbytes > h->size) {
+    errno = ERANGE;
+    return -1;
+  }
+  zoo_prefetch_wait(hp);  // one in-flight prefetch per handle
+  const char* base = h->base + offset;
+  ::madvise(const_cast<char*>(base), nbytes, MADV_WILLNEED);
+  h->prefetch_running = true;
+  h->prefetcher = std::thread([base, nbytes, h]() {
+    // touch one byte per page to force residency even when WILLNEED is
+    // only advisory; volatile sink defeats dead-read elimination
+    volatile char sink = 0;
+    const long page = ::sysconf(_SC_PAGESIZE);
+    for (long i = 0; i < nbytes; i += page) sink ^= base[i];
+    (void)sink;
+    h->prefetch_running = false;
+  });
+  return 0;
+}
+
+void zoo_close(void* hp) {
+  Handle* h = static_cast<Handle*>(hp);
+  zoo_prefetch_wait(hp);
+  if (h->base) ::munmap(const_cast<char*>(h->base), h->size);
+  if (h->fd >= 0) ::close(h->fd);
+  delete h;
+}
+
+}  // extern "C"

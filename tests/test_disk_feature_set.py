@@ -1,0 +1,180 @@
+"""Native IO + DiskFeatureSet: gather vs numpy oracle, out-of-range
+safety, DISK_AND_DRAM slice semantics, full-pass epoch/trigger accounting,
+and disk-vs-RAM training equivalence."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.feature import DiskFeatureSet, FeatureSet
+from analytics_zoo_tpu.native import NativeArrayFile, native_io_available
+
+
+@pytest.fixture(scope="module")
+def npy_pair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("disk_fs")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1000, 6)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    xp, yp = str(d / "x.npy"), str(d / "y.npy")
+    np.save(xp, x)
+    np.save(yp, y)
+    return xp, yp, x, y
+
+
+def test_native_lib_builds():
+    assert native_io_available(), \
+        "g++ is in the image — the native lib must build"
+
+
+def test_gather_matches_numpy(npy_pair):
+    xp, yp, x, y = npy_pair
+    f = NativeArrayFile(xp)
+    assert f.n == 1000 and f.record_shape == (6,)
+    idx = np.array([0, 999, 3, 3, 500], np.int64)
+    np.testing.assert_array_equal(f.gather(idx), x[idx])
+    fy = NativeArrayFile(yp)
+    np.testing.assert_array_equal(fy.gather(idx), y[idx])
+    with pytest.raises(IndexError):
+        f.gather(np.array([1000]))
+    with pytest.raises(IndexError):
+        f.gather(np.array([-1]))
+    f.prefetch(0, 1000)   # async; must not crash or corrupt
+    f.prefetch_wait()
+    np.testing.assert_array_equal(f.gather(idx), x[idx])
+    f.close()
+    fy.close()
+
+
+def test_disk_feature_set_slices(npy_pair):
+    xp, yp, x, y = npy_pair
+    fs = DiskFeatureSet(xp, yp, num_slices=4, seed=1)
+    assert fs.num_of_slice == 4
+    assert len(fs) == 250  # slice size
+    assert fs.steps_per_epoch(50) == 5
+    # a slice pass yields slice-sized batches whose records exist in x
+    seen = []
+    for bx, by in fs.iter_batches(50, epoch=0):
+        assert bx.shape == (50, 6) and by.shape == (50,)
+        seen.append(bx)
+    rows = np.concatenate(seen)
+    assert rows.shape == (250, 6)
+    # every yielded row is a real record with its right label
+    matches = (rows[:, None, :] == x[None, :, :]).all(-1)
+    assert matches.any(axis=1).all()
+    # different passes draw different random slices
+    first = np.concatenate([bx for bx, _ in fs.iter_batches(50, epoch=0)])
+    second = np.concatenate([bx for bx, _ in fs.iter_batches(50, epoch=1)])
+    assert not np.array_equal(first, second)
+    fs.close()
+
+
+def test_disk_feature_set_validations(npy_pair):
+    xp, yp, _, _ = npy_pair
+    with pytest.raises(ValueError, match="num_slices"):
+        DiskFeatureSet(xp, yp, num_slices=1)
+    ev = DiskFeatureSet(xp, yp, num_slices=0)
+    assert ev.x.shape == (1000, 6)  # eval-only: whole set readable
+    with pytest.raises(ValueError, match="evaluation-only"):
+        next(ev.iter_batches(10))
+    ev.close()
+
+
+def test_training_on_disk_matches_ram(npy_pair):
+    """Same data, same epochs: the disk tier must train as well as RAM
+    (not bit-identical — slices resample — but to the same quality)."""
+    init_zoo_context()
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    xp, yp, x, y = npy_pair
+
+    def make_model():
+        m = Sequential()
+        m.add(Dense(16, activation="relu", input_shape=(6,)))
+        m.add(Dense(2, activation="softmax"))
+        m.init_weights(sample_input=x[:2])
+        m.compile(optimizer="adam", loss="scce", metrics=["accuracy"],
+                  lr=5e-3)
+        return m
+
+    disk_fs = DiskFeatureSet(xp, yp, num_slices=4, seed=2)
+    m_disk = make_model()
+    # nb_epoch counts FULL passes: 2 passes = 8 slice passes internally
+    h = m_disk.fit(disk_fs, batch_size=50, nb_epoch=2)
+    assert len(h["loss"]) == 8
+    assert m_disk.finished_epochs == 8
+    acc_disk = m_disk.evaluate(x, y, batch_size=100)["accuracy"]
+
+    m_ram = make_model()
+    m_ram.fit(FeatureSet.array(x, y, seed=2), batch_size=50, nb_epoch=2)
+    acc_ram = m_ram.evaluate(x, y, batch_size=100)["accuracy"]
+    assert acc_disk > 0.85, acc_disk
+    assert abs(acc_disk - acc_ram) < 0.12, (acc_disk, acc_ram)
+    disk_fs.close()
+
+
+def test_rotation_mode_covers_tail_records(tmp_path):
+    """total % num_slices != 0 with shuffle=False: modular rotation must
+    still reach every record across passes (no permanently-dropped tail)."""
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    xp = str(tmp_path / "x10.npy")
+    np.save(xp, x)
+    fs = DiskFeatureSet(xp, num_slices=3, shuffle=False)
+    assert len(fs) == 3
+    seen = set()
+    for p in range(10):
+        for bx, _ in fs.iter_batches(1, epoch=p, drop_last=False):
+            seen.add(float(bx[0, 0]))
+    assert seen == set(range(10)), seen
+    fs.close()
+
+
+def test_sample_does_not_materialize_whole_set(npy_pair):
+    xp, yp, x, _ = npy_pair
+    fs = DiskFeatureSet(xp, yp, num_slices=4)
+    s = fs.sample(2)
+    np.testing.assert_array_equal(s, x[:2])
+    fs.close()
+
+
+def test_max_epoch_end_trigger_counts_full_passes(npy_pair):
+    """MaxEpoch(1) under 4 slices must stop after 4 slice passes (one full
+    pass), not after the first slice."""
+    init_zoo_context()
+    from analytics_zoo_tpu.common.triggers import MaxEpoch
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    xp, yp, x, _ = npy_pair
+    m = Sequential()
+    m.add(Dense(2, activation="softmax", input_shape=(6,)))
+    m.init_weights(sample_input=x[:2])
+    m.compile(optimizer="adam", loss="scce")
+    fs = DiskFeatureSet(xp, yp, num_slices=4, seed=5)
+    h = m.fit(fs, batch_size=56, nb_epoch=3, end_trigger=MaxEpoch(1))
+    assert len(h["loss"]) == 4, h["loss"]  # exactly one full pass
+    fs.close()
+
+
+def test_every_epoch_trigger_fires_on_full_passes(npy_pair, tmp_path):
+    """EveryEpoch checkpoints under slicing fire once per FULL pass
+    (ZooTrigger.scala:53-58), not once per slice."""
+    init_zoo_context()
+    from analytics_zoo_tpu.common.triggers import EveryEpoch
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.utils.checkpoint import CheckpointManager
+
+    xp, yp, x, _ = npy_pair
+    m = Sequential()
+    m.add(Dense(4, activation="relu", input_shape=(6,)))
+    m.add(Dense(2, activation="softmax"))
+    m.init_weights(sample_input=x[:2])
+    m.compile(optimizer="adam", loss="scce")
+    m.set_checkpoint(str(tmp_path / "ck"), trigger=EveryEpoch())
+    fs = DiskFeatureSet(xp, yp, num_slices=4, seed=3)
+    m.fit(fs, batch_size=50, nb_epoch=2)  # 8 slice passes, 2 full passes
+    snaps = CheckpointManager(str(tmp_path / "ck")).steps()
+    assert len(snaps) == 2, snaps
+    fs.close()

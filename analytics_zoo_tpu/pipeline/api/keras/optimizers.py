@@ -194,7 +194,8 @@ def multi_optimizer(rules: Dict[str, Union[str, optax.GradientTransformation]],
                 for name, sub in params.items()}
 
     transforms = {k: get_optimizer(v) for k, v in rules.items()}
-    transforms["__default__"] = get_optimizer(default)
+    # an explicit "__default__" rule wins over the default parameter
+    transforms.setdefault("__default__", get_optimizer(default))
     return optax.multi_transform(transforms, label_fn)
 
 

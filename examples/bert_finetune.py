@@ -1,0 +1,38 @@
+"""BERT fine-tuning (parity config #4 shape): text classification with the
+tfpark BERTClassifier, optionally importing HuggingFace/torch weights.
+
+Run:  python examples/bert_finetune.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.feature.text import TextSet
+from analytics_zoo_tpu.tfpark import BERTClassifier
+
+
+def main():
+    init_zoo_context()
+    texts = (["great movie loved it", "what a fantastic film"] * 32
+             + ["terrible waste of time", "awful plot bad acting"] * 32)
+    labels = np.array([1, 1] * 32 + [0, 0] * 32, np.int32)
+
+    ts = TextSet.from_texts(texts, labels)
+    ts = ts.tokenize().word2idx().shape_sequence(16)
+    ids, y = ts.to_arrays()
+
+    clf = BERTClassifier(num_classes=2, vocab=len(ts.word_index) + 2,
+                         hidden_size=64, n_block=2, n_head=2, seq_len=16,
+                         intermediate_size=128)
+    # for a real checkpoint:
+    #   import torch; sd = torch.load("bert_base.pt")
+    #   clf.load_pretrained(sd)
+    # mask the pad tokens (id 0) so attention ignores them
+    inputs = clf.make_inputs(ids, attention_mask=(ids != 0))
+    clf.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=1e-3)
+    clf.fit(inputs, y, batch_size=16, nb_epoch=6)
+    print("accuracy:", clf.evaluate(inputs, y, batch_size=16))
+
+
+if __name__ == "__main__":
+    main()

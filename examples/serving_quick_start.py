@@ -1,0 +1,42 @@
+"""Cluster Serving quick start (the reference's serving/quick_start.py):
+wrap a trained model in the inference runtime, start the serving loop,
+push requests through the input queue and read predictions back.
+
+Run:  python examples/serving_quick_start.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+from analytics_zoo_tpu.serving import ClusterServing, InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.backend import LocalBackend
+
+
+def main():
+    init_zoo_context()
+    model = Sequential()
+    model.add(Dense(16, activation="relu", input_shape=(8,)))
+    model.add(Dense(3, activation="softmax"))
+    model.init_weights()
+
+    im = InferenceModel(concurrent_num=2).from_keras(model)
+    backend = LocalBackend()  # swap for RedisBackend(...) in production
+    serving = ClusterServing(im, backend=backend, batch_size=16).start()
+
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    rng = np.random.default_rng(0)
+    for i in range(8):
+        inq.enqueue(f"req-{i}", rng.normal(size=(8,)).astype(np.float32))
+    for i in range(8):
+        probs = outq.query(f"req-{i}", timeout=60.0)
+        if probs is None:
+            raise TimeoutError(f"req-{i}: no prediction within 60s")
+        print(f"req-{i}: class={int(np.argmax(probs))}")
+    serving.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -542,17 +542,6 @@ def load_caffe(model_path: str,
 
     if last_node is None:
         raise ValueError("caffemodel contains no computational layers")
+    from ...pipeline.api.keras.engine import install_imported_weights
     model = Model(input=inp, output=last_node)
-    model.init_weights()
-    for lname, w in weights.items():
-        tmpl = model.params.get(lname)
-        if tmpl is None:
-            raise ValueError(f"imported weights for unknown layer {lname!r}")
-        for k, v in w.items():
-            if np.shape(tmpl[k]) != np.shape(v):
-                raise ValueError(f"{lname}.{k}: caffe blob shape "
-                                 f"{np.shape(v)} vs graph {np.shape(tmpl[k])}")
-        model.params[lname] = {k: jnp.asarray(v) for k, v in w.items()}
-    for lname, s in states.items():
-        model.net_state[lname] = {k: jnp.asarray(v) for k, v in s.items()}
-    return model
+    return install_imported_weights(model, weights, states, source="caffe")

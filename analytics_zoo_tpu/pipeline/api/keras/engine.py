@@ -543,3 +543,27 @@ class Model(KerasNet):
             if n.name in params and n.name not in out:
                 out[n.name] = n.layer.param_sharding(params[n.name])
         return out
+
+
+def install_imported_weights(model: "KerasNet", weights, states=None,
+                             source: str = "imported") -> "KerasNet":
+    """Shared installer for model importers (caffe/torch/...): init the
+    graph, then overwrite named layers' params (shape-checked) and running
+    state. ``weights``/``states`` map layer name → leaf dict."""
+    model.init_weights()
+    for lname, w in weights.items():
+        tmpl = model.params.get(lname)
+        if tmpl is None:
+            raise ValueError(f"{source} weights for unknown layer {lname!r}")
+        for k, v in w.items():
+            if k not in tmpl:
+                raise ValueError(f"{lname}: {source} provides param {k!r}; "
+                                 f"layer has {sorted(tmpl)}")
+            if np.shape(tmpl[k]) != np.shape(v):
+                raise ValueError(f"{lname}.{k}: {source} weight shape "
+                                 f"{np.shape(v)} vs graph "
+                                 f"{np.shape(tmpl[k])}")
+        model.params[lname] = {k: jnp.asarray(v) for k, v in w.items()}
+    for lname, s in (states or {}).items():
+        model.net_state[lname] = {k: jnp.asarray(v) for k, v in s.items()}
+    return model

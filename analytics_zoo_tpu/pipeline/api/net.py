@@ -1,0 +1,288 @@
+"""Net — the unified model-import facade (parity with
+``pipeline/api/Net.scala:123-171``: ``Net.load`` / ``loadBigDL`` /
+``loadCaffe`` / ``loadTF`` / ``loadTorch``) plus the ``TorchNet`` role
+(``pipeline/api/net/TorchNet.scala``).
+
+The reference keeps foreign models foreign (TorchScript/libtensorflow
+sessions behind JNI); the TPU-native design converts them into native
+layers instead, so every import is jittable, shardable, and fine-tunable
+under the one training engine. ``TorchNet.from_module`` maps the common
+``torch.nn`` module types onto native layers with weights translated
+(Linear kernels transpose to (in, out); Conv2d OIHW kernels to HWIO with
+an NCHW→NHWC adapter at the graph edges, like the Caffe importer).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from .keras.engine import Input, KerasNet, Lambda, Model
+from .keras.layers import (Activation, BatchNormalization, Convolution2D,
+                           Dense, Dropout, Embedding, Flatten, LayerNorm,
+                           LeakyReLU, ZeroPadding2D)
+
+__all__ = ["Net", "TorchNet"]
+
+
+def _np(t):
+    return t.detach().cpu().numpy()
+
+
+class TorchNet:
+    """``TorchNet.from_module(torch_module, input_shape)`` — convert a
+    torch module tree into a native graph with the pretrained weights
+    installed. ``input_shape`` excludes the batch dim and uses the TORCH
+    convention (e.g. ``(3, 224, 224)`` for images); image graphs run NHWC
+    internally and accept NHWC input."""
+
+    SUPPORTED = ("Sequential, Linear, Conv2d, BatchNorm1d/2d, LayerNorm, "
+                 "Embedding, ReLU, LeakyReLU, Sigmoid, Tanh, Softmax, "
+                 "GELU, MaxPool2d, AvgPool2d, AdaptiveAvgPool2d(1), "
+                 "Flatten, Dropout, Identity")
+
+    @staticmethod
+    def from_module(module, input_shape: Sequence[int]) -> KerasNet:
+        import torch.nn as nn
+
+        mods = (list(module.children())
+                if isinstance(module, nn.Sequential) else [module])
+        mods = TorchNet._flatten(mods, nn)
+
+        shape = tuple(int(d) for d in input_shape)
+        is_image = len(shape) == 3
+        if is_image:
+            c, h, w = shape
+            inp = Input(shape=(h, w, c), name="input")
+        else:
+            inp = Input(shape=shape, name="input")
+        x = inp
+        # best-effort torch-convention shape (sans batch) threaded through
+        # the conversion: conv/pool arithmetic, flatten order, and axis
+        # decisions (BatchNorm1d, Softmax) all need it
+        tshape: Optional[tuple] = shape
+
+        for i, m in enumerate(mods):
+            name = f"torch{i}_{type(m).__name__.lower()}"
+            x, tshape = TorchNet._convert(m, x, name, tshape, nn)
+        return Model(input=inp, output=x)
+
+    @staticmethod
+    def _flatten(mods, nn) -> List[Any]:
+        out = []
+        for m in mods:
+            if isinstance(m, nn.Sequential):
+                out.extend(TorchNet._flatten(list(m.children()), nn))
+            else:
+                out.append(m)
+        return out
+
+    # -- per-module conversion ---------------------------------------------
+    @staticmethod
+    def _convert(m, x, name, tshape, nn):
+        if isinstance(m, nn.Linear):
+            layer = Dense(m.out_features, bias=m.bias is not None, name=name)
+            w = {"W": _np(m.weight).T}
+            if m.bias is not None:
+                w["b"] = _np(m.bias)
+            layer._pretrained = w
+            return layer(x), (m.out_features,)
+        if isinstance(m, nn.Conv2d):
+            if m.groups != 1:
+                raise NotImplementedError(f"{name}: grouped torch Conv2d")
+            if m.padding_mode != "zeros":
+                raise NotImplementedError(
+                    f"{name}: padding_mode={m.padding_mode!r} (only zeros)")
+            ph, pw = (m.padding if isinstance(m.padding, tuple)
+                      else (m.padding, m.padding))
+            if isinstance(ph, str):
+                raise NotImplementedError(f"{name}: string padding mode")
+            if (ph, pw) != (0, 0):
+                x = ZeroPadding2D((ph, pw), name=f"{name}_pad")(x)
+            layer = Convolution2D(
+                m.out_channels, m.kernel_size[0], m.kernel_size[1],
+                subsample=tuple(m.stride), border_mode="valid",
+                dilation=tuple(m.dilation), bias=m.bias is not None,
+                name=name)
+            w = {"W": np.transpose(_np(m.weight), (2, 3, 1, 0))}
+            if m.bias is not None:
+                w["b"] = _np(m.bias)
+            layer._pretrained = w
+            if tshape is not None and len(tshape) == 3:
+                c, h, wd = tshape
+                h2 = (h + 2 * ph - m.dilation[0] * (m.kernel_size[0] - 1)
+                      - 1) // m.stride[0] + 1
+                w2 = (wd + 2 * pw - m.dilation[1] * (m.kernel_size[1] - 1)
+                      - 1) // m.stride[1] + 1
+                tshape = (m.out_channels, h2, w2)
+            else:
+                tshape = None
+            return layer(x), tshape
+        if isinstance(m, (nn.BatchNorm1d, nn.BatchNorm2d)):
+            if not m.track_running_stats:
+                raise NotImplementedError(
+                    f"{name}: BatchNorm(track_running_stats=False) has no "
+                    f"inference-time statistics to import")
+            # BatchNorm1d over a (N, C, L) stream normalizes axis 1; on a
+            # 2D (N, C) stream the channel axis IS the last axis. Image
+            # streams run NHWC here, so BatchNorm2d normalizes -1.
+            axis = 1 if (isinstance(m, nn.BatchNorm1d) and tshape is not None
+                         and len(tshape) == 2) else -1
+            layer = BatchNormalization(epsilon=m.eps, axis=axis,
+                                       scale=m.affine, center=m.affine,
+                                       name=name)
+            if m.affine:
+                layer._pretrained = {"gamma": _np(m.weight),
+                                     "beta": _np(m.bias)}
+            layer._pretrained_state = {"moving_mean": _np(m.running_mean),
+                                       "moving_var": _np(m.running_var)}
+            return layer(x), tshape
+        if isinstance(m, nn.LayerNorm):
+            layer = LayerNorm(epsilon=m.eps, name=name)
+            if m.elementwise_affine:
+                layer._pretrained = {"gamma": _np(m.weight),
+                                     "beta": _np(m.bias)}
+            return layer(x), tshape
+        if isinstance(m, nn.Embedding):
+            layer = Embedding(m.num_embeddings, m.embedding_dim, name=name)
+            layer._pretrained = {"embeddings": _np(m.weight)}
+            return layer(x), (tshape + (m.embedding_dim,)
+                              if tshape is not None else None)
+        if isinstance(m, nn.ReLU):
+            return Activation("relu", name=name)(x), tshape
+        if isinstance(m, nn.LeakyReLU):
+            return LeakyReLU(m.negative_slope, name=name)(x), tshape
+        if isinstance(m, nn.Sigmoid):
+            return Activation("sigmoid", name=name)(x), tshape
+        if isinstance(m, nn.Tanh):
+            return Activation("tanh", name=name)(x), tshape
+        if isinstance(m, nn.Softmax):
+            # native softmax runs over the LAST axis; reject anything else
+            last = len(tshape) if tshape is not None else None
+            if m.dim not in (-1, last):
+                raise NotImplementedError(
+                    f"{name}: Softmax(dim={m.dim}) — only the last axis "
+                    f"maps onto the native layer")
+            return Activation("softmax", name=name)(x), tshape
+        if isinstance(m, nn.GELU):
+            import jax
+            approx = getattr(m, "approximate", "none") == "tanh"
+            return Lambda(lambda t, a=approx: jax.nn.gelu(t, approximate=a),
+                          name=name)(x), tshape
+        if isinstance(m, nn.MaxPool2d) or isinstance(m, nn.AvgPool2d):
+            from .keras.layers import AveragePooling2D, MaxPooling2D
+            k = (m.kernel_size if isinstance(m.kernel_size, tuple)
+                 else (m.kernel_size, m.kernel_size))
+            s = (m.stride if isinstance(m.stride, tuple)
+                 else (m.stride or m.kernel_size,) * 2)
+            p = (m.padding if isinstance(m.padding, tuple)
+                 else (m.padding, m.padding))
+            if getattr(m, "ceil_mode", False):
+                raise NotImplementedError(f"{name}: ceil_mode pooling")
+            if getattr(m, "dilation", 1) not in (1, (1, 1)):
+                raise NotImplementedError(f"{name}: dilated pooling")
+            if getattr(m, "return_indices", False):
+                raise NotImplementedError(f"{name}: return_indices pooling")
+            if isinstance(m, nn.AvgPool2d) and not m.count_include_pad:
+                raise NotImplementedError(
+                    f"{name}: AvgPool2d(count_include_pad=False)")
+            if p != (0, 0):
+                # zero-pad + valid pool = torch floor-mode semantics with
+                # count_include_pad=True (the torch default)
+                x = ZeroPadding2D(p, name=f"{name}_pad")(x)
+            pool_cls = (MaxPooling2D if isinstance(m, nn.MaxPool2d)
+                        else AveragePooling2D)
+            node = pool_cls(k, strides=s, border_mode="valid", name=name)(x)
+            if tshape is not None and len(tshape) == 3:
+                c, h, w = tshape
+                tshape = (c, (h + 2 * p[0] - k[0]) // s[0] + 1,
+                          (w + 2 * p[1] - k[1]) // s[1] + 1)
+            else:
+                tshape = None
+            return node, tshape
+        if isinstance(m, nn.AdaptiveAvgPool2d):
+            out_sz = m.output_size
+            if out_sz not in (1, (1, 1)):
+                raise NotImplementedError(f"{name}: adaptive pool to "
+                                          f"{out_sz}")
+            from .keras.layers import GlobalAveragePooling2D
+            node = GlobalAveragePooling2D(name=name)(x)
+            return node, ((tshape[0],) if tshape is not None
+                          and len(tshape) == 3 else None)
+        if isinstance(m, nn.Flatten):
+            if (m.start_dim, m.end_dim) != (1, -1):
+                raise NotImplementedError(
+                    f"{name}: Flatten(start_dim={m.start_dim}, "
+                    f"end_dim={m.end_dim}) — only full flatten")
+            if tshape is not None and len(tshape) == 3:
+                # torch flattens NCHW C*H*W order: transpose first so the
+                # following Linear's pretrained weights line up
+                import jax.numpy as jnp
+                x = Lambda(lambda t: jnp.transpose(t, (0, 3, 1, 2)),
+                           name=f"{name}_nchw")(x)
+            flat = (int(np.prod(tshape)),) if tshape is not None else None
+            return Flatten(name=name)(x), flat
+        if isinstance(m, nn.Dropout):
+            return Dropout(m.p, name=name)(x), tshape
+        if isinstance(m, nn.Identity):
+            return x, tshape
+        raise NotImplementedError(
+            f"torch module {type(m).__name__} not supported; supported: "
+            f"{TorchNet.SUPPORTED}")
+
+
+def _install_pretrained(model: KerasNet) -> KerasNet:
+    """After build, copy stashed ``_pretrained`` weights into the param
+    tree (and running stats into net_state), shape-checked."""
+    import jax.numpy as jnp
+    model.init_weights()
+    for node in model._topo:
+        layer = node.layer
+        lname = layer.name
+        w = getattr(layer, "_pretrained", None)
+        if w is not None:
+            tmpl = model.params.get(lname)
+            if tmpl is None:
+                raise ValueError(f"pretrained weights for unknown layer "
+                                 f"{lname!r}")
+            for k, v in w.items():
+                if np.shape(tmpl[k]) != np.shape(v):
+                    raise ValueError(
+                        f"{lname}.{k}: torch weight shape {np.shape(v)} vs "
+                        f"graph {np.shape(tmpl[k])}")
+            model.params[lname] = {k: jnp.asarray(v) for k, v in w.items()}
+        s = getattr(layer, "_pretrained_state", None)
+        if s is not None:
+            model.net_state[lname] = {k: jnp.asarray(v)
+                                      for k, v in s.items()}
+    return model
+
+
+class Net:
+    """Unified loader facade (``Net.scala:123-171``)."""
+
+    @staticmethod
+    def load(path: str):
+        """A model saved by this framework (ZooModel ``.npz``)."""
+        from ...models.common.zoo_model import load_model
+        return load_model(path)
+
+    @staticmethod
+    def load_caffe(model_path: str,
+                   input_shape: Optional[Sequence[int]] = None) -> KerasNet:
+        from ...models.caffe import load_caffe
+        return load_caffe(model_path, input_shape)
+
+    @staticmethod
+    def load_onnx(path: str):
+        from .onnx import load_onnx
+        return load_onnx(path)
+
+    @staticmethod
+    def load_torch(module, input_shape: Sequence[int]) -> KerasNet:
+        """An in-memory ``torch.nn`` module (the reference loads
+        TorchScript files; in-process conversion covers the same
+        workflow without a serialization detour)."""
+        model = TorchNet.from_module(module, input_shape)
+        return _install_pretrained(model)

@@ -1,0 +1,159 @@
+"""TorchNet / Net facade: torch modules convert to native graphs whose
+outputs match torch's forward, weights install correctly, and imported
+models fine-tune."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.net import Net, TorchNet
+
+
+def _run(model, x):
+    return np.asarray(model.apply(model.params, model.net_state,
+                                  np.asarray(x, np.float32),
+                                  training=False, rng=None)[0])
+
+
+def test_mlp_matches_torch():
+    init_zoo_context()
+    torch.manual_seed(0)
+    tm = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Dropout(0.2),
+                       nn.Linear(32, 16), nn.Tanh(), nn.Linear(16, 3),
+                       nn.Softmax(dim=-1)).eval()  # freeze torch dropout
+    x = np.random.default_rng(0).normal(size=(5, 8)).astype(np.float32)
+    model = Net.load_torch(tm, input_shape=(8,))
+    got = _run(model, x)
+    with torch.no_grad():
+        want = tm(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_cnn_matches_torch():
+    """Conv/BN/pool/flatten path incl. the NCHW flatten-order adapter."""
+    init_zoo_context()
+    torch.manual_seed(1)
+    tm = nn.Sequential(
+        nn.Conv2d(3, 8, 3, stride=1, padding=1), nn.BatchNorm2d(8),
+        nn.ReLU(), nn.MaxPool2d(2, 2),
+        nn.Conv2d(8, 4, 3), nn.ReLU(), nn.AvgPool2d(2, 2),
+        nn.Flatten(), nn.Linear(4 * 3 * 3, 5)).eval()
+    tm[1].running_mean.normal_()
+    tm[1].running_var.uniform_(0.5, 2.0)
+    x = np.random.default_rng(1).normal(size=(2, 3, 16, 16)) \
+        .astype(np.float32)
+    model = Net.load_torch(tm, input_shape=(3, 16, 16))
+    got = _run(model, np.transpose(x, (0, 2, 3, 1)))  # NHWC in
+    with torch.no_grad():
+        want = tm(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_gap_and_layernorm_and_gelu():
+    init_zoo_context()
+    torch.manual_seed(2)
+    tm = nn.Sequential(nn.Conv2d(2, 6, 1), nn.GELU(),
+                       nn.AdaptiveAvgPool2d(1), nn.Flatten(),
+                       nn.LayerNorm(6), nn.Linear(6, 2)).eval()
+    x = np.random.default_rng(2).normal(size=(3, 2, 5, 5)).astype(np.float32)
+    model = Net.load_torch(tm, input_shape=(2, 5, 5))
+    got = _run(model, np.transpose(x, (0, 2, 3, 1)))
+    with torch.no_grad():
+        want = tm(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_imported_torch_model_fine_tunes():
+    init_zoo_context()
+    torch.manual_seed(3)
+    tm = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = Net.load_torch(tm, input_shape=(6,))
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(256, 6)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    model.compile(optimizer="adam", loss="scce_with_logits",
+                  metrics=["accuracy"], lr=5e-3)
+    h = model.fit(x, y, batch_size=32, nb_epoch=8)
+    assert h["loss"][-1] < h["loss"][0]
+    assert model.evaluate(x, y, batch_size=32)["accuracy"] > 0.9
+
+
+def test_embedding_batchnorm1d_and_padded_avgpool():
+    """Review regressions: Embedding param key, BatchNorm1d channel axis on
+    a (N, C, L) stream, torch floor-mode padded avg pooling."""
+    init_zoo_context()
+    torch.manual_seed(4)
+    # Embedding → LayerNorm path (token models)
+    tm = nn.Sequential(nn.Embedding(30, 8), nn.LayerNorm(8)).eval()
+    ids = np.random.default_rng(4).integers(0, 30, size=(3, 7))
+    model = Net.load_torch(tm, input_shape=(7,))
+    got = np.asarray(model.apply(model.params, model.net_state,
+                                 ids.astype(np.int32), training=False,
+                                 rng=None)[0])
+    with torch.no_grad():
+        want = tm(torch.tensor(ids)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # BatchNorm1d over (N, C, L): channel axis 1
+    bn = nn.Sequential(nn.BatchNorm1d(4)).eval()
+    bn[0].running_mean.normal_()
+    bn[0].running_var.uniform_(0.5, 2.0)
+    x = np.random.default_rng(5).normal(size=(2, 4, 9)).astype(np.float32)
+    m2 = Net.load_torch(bn, input_shape=(4, 9))
+    got2 = np.asarray(m2.apply(m2.params, m2.net_state, x, training=False,
+                               rng=None)[0])
+    with torch.no_grad():
+        want2 = bn(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(got2, want2, rtol=1e-4, atol=1e-5)
+
+    # padded avg pool on an odd-size map: torch floor semantics
+    ap = nn.Sequential(nn.AvgPool2d(3, 2, padding=1)).eval()
+    xi = np.random.default_rng(6).normal(size=(1, 2, 7, 7)) \
+        .astype(np.float32)
+    m3 = Net.load_torch(ap, input_shape=(2, 7, 7))
+    got3 = np.asarray(m3.apply(m3.params, m3.net_state,
+                               np.transpose(xi, (0, 2, 3, 1)),
+                               training=False, rng=None)[0])
+    with torch.no_grad():
+        want3 = ap(torch.tensor(xi)).numpy()
+    np.testing.assert_allclose(np.transpose(got3, (0, 3, 1, 2)), want3,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_semantics_changing_attrs_are_loud():
+    init_zoo_context()
+    with pytest.raises(NotImplementedError, match="padding_mode"):
+        TorchNet.from_module(
+            nn.Sequential(nn.Conv2d(1, 1, 3, padding=1,
+                                    padding_mode="reflect")),
+            input_shape=(1, 8, 8))
+    with pytest.raises(NotImplementedError, match="Softmax"):
+        TorchNet.from_module(
+            nn.Sequential(nn.Softmax(dim=1)), input_shape=(3, 5))
+    with pytest.raises(NotImplementedError, match="Flatten"):
+        TorchNet.from_module(
+            nn.Sequential(nn.Flatten(start_dim=2)), input_shape=(2, 3, 4))
+    with pytest.raises(NotImplementedError, match="count_include_pad"):
+        TorchNet.from_module(
+            nn.Sequential(nn.AvgPool2d(2, 2, padding=1,
+                                       count_include_pad=False)),
+            input_shape=(1, 8, 8))
+
+
+def test_unsupported_module_is_loud():
+    init_zoo_context()
+    with pytest.raises(NotImplementedError, match="LSTM"):
+        TorchNet.from_module(nn.Sequential(nn.LSTM(4, 4)), input_shape=(4,))
+
+
+def test_net_facade_zoo_roundtrip(tmp_path):
+    init_zoo_context()
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    m = NeuralCF(50, 60, 5)
+    m.init_weights()
+    p = m.save(str(tmp_path / "ncf"))
+    back = Net.load(p)
+    assert isinstance(back, NeuralCF)

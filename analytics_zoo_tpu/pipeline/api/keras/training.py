@@ -436,6 +436,24 @@ class TrainingLoop:
         # the epoch target is fixed once, after any checkpoint resume inside
         # the first attempt — retries must not extend it
         target_holder: Dict[str, int] = {}
+        # one-shot profiler capture (model.set_profile): trace this fit
+        # call, retries included (profiling.trace no-ops on None)
+        profile_dir = getattr(self.model, "_profile_dir", None)
+        if profile_dir:
+            self.model._profile_dir = None
+        from ....utils import profiling
+        with profiling.trace(profile_dir):
+            return self._fit_with_retry(
+                fs, batch_size=batch_size, nb_epoch=nb_epoch,
+                target_holder=target_holder,
+                validation_data=validation_data, rng=rng,
+                callbacks=callbacks, end_trigger=end_trigger,
+                retry_times=retry_times, window_sec=window_sec,
+                attempts=attempts, window_start=window_start)
+
+    def _fit_with_retry(self, fs, *, batch_size, nb_epoch, target_holder,
+                        validation_data, rng, callbacks, end_trigger,
+                        retry_times, window_sec, attempts, window_start):
         while True:
             try:
                 return self._fit_impl(fs, batch_size=batch_size,
@@ -902,6 +920,15 @@ def _set_tensorboard(self: KerasNet, log_dir: str, app_name: str):
     return self
 
 
+def _set_profile(self: KerasNet, log_dir: str):
+    """Capture a ``jax.profiler`` trace of the NEXT ``fit`` call into
+    ``log_dir`` (one-shot) — view with TensorBoard's profile plugin/xprof.
+    The sampling-profiler capability the reference never had (SURVEY §5:
+    "no sampling profiler, no trace files")."""
+    self._profile_dir = log_dir
+    return self
+
+
 def _get_train_summary(self: KerasNet, tag: str = "Loss") -> np.ndarray:
     """``getTrainSummary(tag)`` (``Topology.scala:222-229``): (n, 3) rows of
     ``[iteration, value, wall_time]``."""
@@ -976,6 +1003,7 @@ KerasNet.compile = _compile
 KerasNet.init_weights = _init_weights
 KerasNet.set_checkpoint = _set_checkpoint
 KerasNet.set_tensorboard = _set_tensorboard
+KerasNet.set_profile = _set_profile
 KerasNet.get_train_summary = _get_train_summary
 KerasNet.get_validation_summary = _get_validation_summary
 KerasNet.fit = _fit

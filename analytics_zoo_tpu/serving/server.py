@@ -42,6 +42,21 @@ class ClusterServing:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.served = 0             # records processed (visible for tests/ops)
+        self._summary = None        # InferenceSummary role (TB scalars)
+        self._batches = 0
+
+    def set_tensorboard(self, log_dir: str,
+                        app_name: str = "serving") -> "ClusterServing":
+        """Write per-batch "Serving Throughput" / "Serving Records" scalars
+        (the reference's throughput-to-TensorBoard path,
+        ``ClusterServing.scala:291-317`` + ``InferenceSummary.scala``).
+        Call before ``start()``."""
+        import os
+        from ..utils.tensorboard import EventFileWriter
+        if self._summary is not None:  # redirecting: release the old fd
+            self._summary.close()
+        self._summary = EventFileWriter(os.path.join(log_dir, app_name))
+        return self
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ClusterServing":
@@ -72,6 +87,9 @@ class ClusterServing:
                 f"serve loop still running after {timeout}s (model dispatch "
                 f"in flight?); call stop() again to re-join")
         self._thread = None
+        if self._summary is not None:
+            self._summary.close()
+            self._summary = None
 
     # -- the loop -----------------------------------------------------------
     def _loop(self) -> None:
@@ -105,6 +123,8 @@ class ClusterServing:
             self._predict_and_store(uris, batch)
 
     def _predict_and_store(self, uris, batch) -> None:
+        import time
+        t0 = time.perf_counter()
         try:
             preds = np.asarray(self.model.predict(batch))
         except Exception:
@@ -116,3 +136,11 @@ class ClusterServing:
         for i, uri in enumerate(uris):
             self.backend.set_result(uri, {"value": encode_array(preds[i])})
         self.served += len(uris)
+        self._batches += 1
+        if self._summary is not None:
+            dt = max(time.perf_counter() - t0, 1e-9)
+            self._summary.add_scalar("Serving Throughput", len(uris) / dt,
+                                     self._batches)
+            self._summary.add_scalar("Serving Records", self.served,
+                                     self._batches)
+            self._summary.flush()

@@ -142,3 +142,27 @@ def test_default_backend_is_shared():
     res = outq.query("shared", timeout=30.0)
     serving.stop()
     assert res is not None and res.shape == (3,)
+
+
+def test_serving_tensorboard_summary(tmp_path):
+    """InferenceSummary parity: the serve loop writes Serving Throughput
+    scalars readable by the TB reader (ClusterServing.scala:291-317)."""
+    from analytics_zoo_tpu.utils.tensorboard import read_scalars
+
+    model = _toy_model()
+    im = InferenceModel().from_keras(model)
+    backend = LocalBackend()
+    serving = (ClusterServing(im, backend=backend, batch_size=4)
+               .set_tensorboard(str(tmp_path), "app").start())
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    rng = np.random.default_rng(2)
+    for i in range(12):
+        inq.enqueue(f"s-{i}", rng.normal(size=(6,)).astype(np.float32))
+    for i in range(12):
+        assert outq.query(f"s-{i}", timeout=30.0) is not None
+    serving.stop()
+    pts = read_scalars(str(tmp_path / "app"), "Serving Throughput")
+    assert len(pts) >= 1
+    assert all(v > 0 for _, v, _, _ in pts)
+    recs = read_scalars(str(tmp_path / "app"), "Serving Records")
+    assert max(v for _, v, _, _ in recs) == 12

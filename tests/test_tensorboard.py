@@ -109,3 +109,31 @@ def test_fit_writes_summaries_and_reads_back(tmp_path):
     # directory layout matches the reference: <log_dir>/<app>/train|validation
     assert (tmp_path / "app" / "train").is_dir()
     assert (tmp_path / "app" / "validation").is_dir()
+
+
+def test_set_profile_captures_trace(tmp_path):
+    """set_profile(dir) traces the next fit (one-shot) and writes xplane
+    files readable by TB's profile plugin."""
+    import glob
+    import numpy as np
+    from analytics_zoo_tpu.common.context import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    m = Sequential()
+    m.add(Dense(8, activation="relu", input_shape=(4,)))
+    m.add(Dense(2, activation="softmax"))
+    m.init_weights(sample_input=x)
+    m.compile(optimizer="adam", loss="scce")
+    m.set_profile(str(tmp_path / "prof"))
+    m.fit(x, y, batch_size=16, nb_epoch=1)
+    traces = glob.glob(str(tmp_path / "prof" / "**" / "*.xplane.pb"),
+                       recursive=True)
+    assert traces, "no profiler trace written"
+    # one-shot: the second fit must not require/overwrite a trace
+    assert getattr(m, "_profile_dir", None) is None
+    m.fit(x, y, batch_size=16, nb_epoch=1)

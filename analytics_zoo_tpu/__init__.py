@@ -2,14 +2,20 @@
 capabilities of robert-sbd/analytics-zoo, re-designed for JAX/XLA/pjit/pallas.
 
 Layer map (mirrors SURVEY.md §1):
-  common/    runtime bring-up (ZooContext ≅ NNContext)
-  feature/   data layer (FeatureSet, image/text pipelines, Preprocessing)
-  pipeline/  model API (keras-style + autograd), estimator, nnframes, inference
-  models/    built-in model zoo (NCF, Wide&Deep, TextClassifier, ...)
-  ops/       pallas TPU kernels
+  common/    runtime bring-up (ZooContext ≅ NNContext), triggers
+  feature/   data layer (FeatureSet + DiskFeatureSet, image/image3d/text
+             pipelines, Preprocessing combinators)
+  native/    ctypes binding for the C++ host IO library (native/zoo_io.cc)
+  pipeline/  model API (keras/keras2 + autograd + onnx + Net/TorchNet),
+             estimator, nnframes, inference runtime
+  models/    built-in model zoo (recommendation, anomaly detection, text,
+             seq2seq, image classification, object detection, caffe import)
+  ops/       attention + pallas TPU kernels (flash attention, int8 matmul)
   parallel/  mesh, shardings, collectives, ring attention
-  serving/   cluster-serving equivalent
-  utils/     tensorboard writer, checkpointing
+  serving/   cluster-serving equivalent (stream, batching, backpressure)
+  tfpark/    BERT estimators, GANEstimator, torch weight import
+  ray/       task/actor runtime (RayOnSpark role)
+  utils/     tensorboard writer/reader, checkpointing, profiling, proto
 """
 
 __version__ = "0.1.0"

@@ -622,6 +622,7 @@ class TrainingLoop:
             _, xs_dev, ys_dev = self._data_cache[cache_key]
 
         base_rng = rng if rng is not None else ctx.rng()
+        throttle_cpu = jax.default_backend() == "cpu"
         history: Dict[str, List[float]] = {"loss": []}
         loop_state = TrainLoopState(iteration=model.finished_iterations,
                                     epoch=model.finished_epochs + 1)
@@ -680,6 +681,13 @@ class TrainingLoop:
                     loop_state.iteration += 1
                     n_seen += batch_size
                 losses.append(l)
+                # XLA:CPU only — bound host run-ahead. Its in-process
+                # collective rendezvous aborts (40 s timeout) when dozens
+                # of slow queued programs starve some device threads;
+                # blocking every few dispatches caps the queue. Real TPU
+                # runtimes pipeline deeply and stay unthrottled.
+                if throttle_cpu and len(losses) % 4 == 0:
+                    jax.block_until_ready(l)
                 if mgr is not None and _fired_within(ckpt_trigger, loop_state,
                                                      prev_iter):
                     self._save_checkpoint(mgr, loop_state, params, opt_state,

@@ -1,0 +1,39 @@
+"""Anomaly detection on a univariate time series (the reference's
+anomaly-detection app): LSTM forecaster + threshold on prediction error.
+
+Run:  python examples/anomaly_detection.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
+from analytics_zoo_tpu.models.anomalydetection.anomaly_detector import (
+    detect_anomalies, unroll)
+
+
+def main():
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    t = np.arange(2000, dtype=np.float32)
+    series = np.sin(t / 24 * 2 * np.pi) + rng.normal(0, 0.05, t.shape)
+    spikes = rng.choice(2000, size=8, replace=False)
+    series[spikes] += rng.choice([-2.5, 2.5], size=8)  # injected anomalies
+
+    unroll_len = 24
+    x, y, _ = unroll(series[:, None], unroll_len)
+    model = AnomalyDetector(feature_shape=(unroll_len, 1))
+    model.compile(optimizer="adam", loss="mse", lr=1e-3)
+    model.fit(x, y, batch_size=64, nb_epoch=8)
+
+    preds = np.asarray(model.predict(x, batch_size=256)).reshape(-1)
+    flagged = detect_anomalies(y.reshape(-1), preds, anomaly_size=8)
+    flagged_idx = set(np.flatnonzero(~np.isnan(flagged)))
+    spike_idx = {s - unroll_len for s in spikes if s >= unroll_len}
+    hit = len(flagged_idx & spike_idx)
+    print(f"flagged {len(flagged_idx)} points; "
+          f"{hit}/{len(spike_idx)} injected spikes hit")
+
+
+if __name__ == "__main__":
+    main()

@@ -301,3 +301,21 @@ def test_session_recommender_with_history():
     m.compile(optimizer="adam", loss="scce", lr=0.01)
     h = m.fit([xs, xh], y, batch_size=32, nb_epoch=3)
     assert np.isfinite(h["loss"][-1])
+
+
+def test_long_lstm_training_does_not_deadlock():
+    """Regression: >25 queued LSTM steps on the 8-device CPU mesh starved
+    XLA:CPU's in-process collective rendezvous (fatal 40s abort); the
+    CPU-side run-ahead throttle bounds the dispatch queue."""
+    import numpy as np
+    from analytics_zoo_tpu.common.context import init_zoo_context
+    from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
+
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1976, 24, 1)).astype(np.float32)
+    y = rng.normal(size=(1976,)).astype(np.float32)
+    model = AnomalyDetector(feature_shape=(24, 1))
+    model.compile(optimizer="adam", loss="mse", lr=1e-3)
+    h = model.fit(x, y, batch_size=64, nb_epoch=1)
+    assert np.isfinite(h["loss"][0])

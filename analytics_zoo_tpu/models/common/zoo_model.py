@@ -127,6 +127,30 @@ class ZooModel(KerasNet):
         return "\n".join(lines)
 
 
+def _populate_registry() -> None:
+    """Import the built-in model packages so their ``@register_model``
+    decorators run — a fresh process (e.g. the serving CLI) may call
+    ``load_model`` before any zoo model module was imported."""
+    import importlib
+    import logging
+    for mod in ("analytics_zoo_tpu.models.recommendation",
+                "analytics_zoo_tpu.models.anomalydetection",
+                "analytics_zoo_tpu.models.textclassification",
+                "analytics_zoo_tpu.models.textmatching",
+                "analytics_zoo_tpu.models.seq2seq",
+                "analytics_zoo_tpu.models.image.imageclassification",
+                "analytics_zoo_tpu.models.image.objectdetection",
+                "analytics_zoo_tpu.tfpark"):
+        try:
+            importlib.import_module(mod)
+        except ImportError as e:  # pragma: no cover - partial installs
+            # keep going (other packages may hold the class) but say why a
+            # class might later come up missing
+            logging.getLogger("analytics_zoo_tpu.models").warning(
+                "model package %s failed to import (%s); its classes will "
+                "be unavailable to load_model", mod, e)
+
+
 def load_model(path: str) -> ZooModel:
     """``ZooModel.loadModel`` (``ZooModel.scala:119-154``): rebuild from the
     registered class + config, then install saved weights."""
@@ -139,6 +163,11 @@ def load_model(path: str) -> ZooModel:
         extras = {k: data[ref]
                   for k, ref in header.get("extra", {}).items()}
     cls = _REGISTRY.get(header["class"])
+    if cls is None:
+        # fresh process: the class's module may simply not be imported yet —
+        # sweep the built-in packages before giving up
+        _populate_registry()
+        cls = _REGISTRY.get(header["class"])
     if cls is None:
         raise ValueError(f"unknown model class {header['class']!r}; "
                          f"registered: {sorted(_REGISTRY)}")

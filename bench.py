@@ -43,7 +43,7 @@ N_USERS, N_ITEMS, N_CLASSES = 6040, 3706, 5
 N_EXAMPLES = 1_000_000
 BATCH = 8192
 SCAN_STEPS = 16          # optimizer steps fused per dispatch (lax.scan)
-TIMED_EPOCHS = 3
+TIMED_EPOCHS = 6
 
 
 def make_movielens_like(rng):

@@ -1,0 +1,74 @@
+"""ImageNet-style classifier training CLI — the reference's flagship
+training example (examples/inception/Train.scala) surface: pick a
+published topology, point it at a class-per-subfolder image directory (or
+use synthetic data), with checkpointing and TensorBoard.
+
+Run:  python examples/inception_training.py --topology simple-cnn --epochs 3
+      python examples/inception_training.py --data /path/to/imagefolders \
+             --topology inception-v1 --image-size 224 --batch 256 \
+             --checkpoint /tmp/ckpt --tensorboard /tmp/tb
+"""
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.common.triggers import EveryEpoch
+from analytics_zoo_tpu.feature import FeatureSet
+from analytics_zoo_tpu.feature.image import ImageSet
+from analytics_zoo_tpu.models.image.imageclassification import ImageClassifier
+
+
+def load_data(args):
+    if args.data:
+        iset = ImageSet.read(args.data, with_label=True,
+                             resize_h=args.image_size,
+                             resize_w=args.image_size)
+        x = np.asarray(iset.images, np.float32) / 255.0
+        y = iset.labels.astype(np.int32)
+        n_classes = int(y.max()) + 1
+        return x, y, n_classes
+    # synthetic fallback: class = dominant color channel
+    rng = np.random.default_rng(0)
+    n, s = 512, args.image_size
+    y = rng.integers(0, 3, n).astype(np.int32)
+    x = rng.normal(0.3, 0.1, size=(n, s, s, 3)).astype(np.float32)
+    x[np.arange(n), :, :, y] += 0.4
+    return x, y, 3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None,
+                    help="directory of class subfolders (else synthetic)")
+    ap.add_argument("--topology", default="simple-cnn")
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--tensorboard", default=None)
+    args = ap.parse_args()
+
+    init_zoo_context()
+    x, y, n_classes = load_data(args)
+    print(f"dataset: {x.shape[0]} images, {n_classes} classes")
+
+    model = ImageClassifier(args.topology, num_classes=n_classes,
+                            input_shape=(args.image_size, args.image_size, 3))
+    model.init_weights(sample_input=x[:2])
+    model.compile(optimizer="adam", loss="scce", metrics=["accuracy"],
+                  lr=args.lr)
+    if args.checkpoint:
+        model.set_checkpoint(args.checkpoint, trigger=EveryEpoch())
+    if args.tensorboard:
+        model.set_tensorboard(args.tensorboard, args.topology)
+
+    model.fit(FeatureSet.array(x, y), batch_size=args.batch,
+              nb_epoch=args.epochs, validation_data=(x, y))
+    print("final:", model.evaluate(x, y, batch_size=args.batch))
+
+
+if __name__ == "__main__":
+    main()

@@ -493,6 +493,13 @@ class TrainingLoop:
                   ) -> Dict[str, List[float]]:
         ctx = get_zoo_context()
         model = self.model
+        if (getattr(self.loss, "__name__", "") == "rank_hinge"
+                and getattr(fs, "shuffle", False)):
+            log.warning(
+                "rank_hinge consumes consecutive (positive, negative) rows, "
+                "but this FeatureSet shuffles — the pairing is scrambled and "
+                "the loss is meaningless; train with "
+                "FeatureSet.array(..., shuffle=False)")
         dp = mesh_lib.data_parallel_size(self.mesh)
         if batch_size % dp != 0:
             rounded = _round_up(batch_size, dp)

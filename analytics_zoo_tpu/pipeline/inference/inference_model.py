@@ -166,7 +166,14 @@ class InferenceModel:
             self._params = jax.tree.map(cast, params)
             self._scales = None
         elif quantize == "int8":
-            self._params, self._scales = quantize_int8(params)
+            q, s = quantize_int8(params)
+            # quantize_int8 produces HOST numpy arrays; pin them on device
+            # once — otherwise every predict re-uploads the whole int8
+            # weight set (catastrophic over a tunneled device link).
+            # Replicated over the mesh, matching the batch-sharded inputs.
+            repl = mesh_lib.replicated_sharding(self.mesh)
+            self._params = jax.device_put(q, repl)
+            self._scales = jax.device_put(s, repl)
         else:
             raise ValueError(f"unknown quantize mode {quantize!r}; "
                              "use None or 'int8'")

@@ -108,6 +108,43 @@ def bench_wide_deep():
     return max(r["throughput"] for r in records)
 
 
+def bench_int8_inference():
+    """The reference's int8 inference harness role
+    (``examples/vnni/openvino/Perf.scala:34-98``: ResNet int8 FPS): steady-
+    state image-classification FPS for the int8 weight-only path vs fp32."""
+    import jax
+
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        ImageClassifier)
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    rng = np.random.default_rng(2)
+    # vgg-16 at 112px: ~37M params (150 MB fp32) against a small batch —
+    # bandwidth-bound, the regime where weight-only int8 (4x less HBM
+    # traffic) pays, like the reference's ResNet int8 runs
+    x = rng.normal(size=(32, 112, 112, 3)).astype(np.float32)
+    m = ImageClassifier("vgg-16", num_classes=1000,
+                        input_shape=(112, 112, 3))
+    m.init_weights(sample_input=x[:2])
+
+    out = {}
+    x_dev = jax.device_put(x)
+    for mode, quant in (("fp32", None), ("int8", "int8")):
+        im = InferenceModel().from_keras(m, quantize=quant)
+        # device-resident timing: the tunnel/host transfer otherwise
+        # dominates and the number stops being about the chip
+        y = im._predict(im._params, im._net_state, x_dev)
+        jax.block_until_ready(y)  # compile + warm
+        reps = 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            y = im._predict(im._params, im._net_state, x_dev)
+        jax.block_until_ready(y)
+        out[f"image_infer_{mode}_fps"] = round(
+            reps * x.shape[0] / (time.perf_counter() - t0), 1)
+    return out
+
+
 def main():
     from analytics_zoo_tpu import init_zoo_context
     from analytics_zoo_tpu.feature import FeatureSet
@@ -204,6 +241,10 @@ def main():
         out["wide_deep_train_samples_per_sec"] = round(bench_wide_deep(), 1)
     except Exception as e:  # secondary metric must not sink the flagship
         print(f"# wide_deep bench failed: {e!r}", file=sys.stderr)
+    try:
+        out.update(bench_int8_inference())
+    except Exception as e:
+        print(f"# int8 inference bench failed: {e!r}", file=sys.stderr)
     print(json.dumps(out))
     print(f"# wall={wall:.2f}s epochs={TIMED_EPOCHS} batch={BATCH} "
           f"scan_steps={SCAN_STEPS} steps/epoch={steps_per_epoch} "

@@ -1,0 +1,69 @@
+"""QA ranking (the reference's QARanker example): KNRM kernel-pooling text
+matching trained on (question, answer) pairs with rank-hinge loss, scored
+with the Ranker NDCG / HitRate metrics.
+
+Run:  python examples/qa_ranker.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.feature import FeatureSet
+from analytics_zoo_tpu.models.textmatching import KNRM
+
+
+def make_pairs(rng, n_questions=64, vocab=200, q_len=10, a_len=20):
+    """Each question has one relevant answer (shares its rare tokens) and
+    negatives drawn at random."""
+    qs, pos, neg = [], [], []
+    for _ in range(n_questions):
+        topic = rng.integers(100, vocab, size=4)   # rare topic tokens
+        q = np.concatenate([topic, rng.integers(1, 100, q_len - 4)])
+        a_good = np.concatenate([topic, rng.integers(1, 100, a_len - 4)])
+        a_bad = rng.integers(1, 100, a_len)
+        qs.append(q)
+        pos.append(a_good)
+        neg.append(a_bad)
+    return (np.asarray(qs, np.int32), np.asarray(pos, np.int32),
+            np.asarray(neg, np.int32))
+
+
+def main():
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    q, pos, neg = make_pairs(rng)
+    q_len, a_len = q.shape[1], pos.shape[1]
+
+    # rank-hinge training data: (positive, negative) pair rows interleaved
+    x = np.concatenate([np.concatenate([q, pos], axis=1),
+                        np.concatenate([q, neg], axis=1)])
+    order = np.empty(2 * len(q), np.int64)
+    order[0::2] = np.arange(len(q))              # pos row
+    order[1::2] = np.arange(len(q)) + len(q)     # its neg row
+    x = x[order]
+    y = np.zeros((len(x), 1), np.float32)        # rank_hinge ignores labels
+
+    model = KNRM(text1_length=q_len, text2_length=a_len, vocab_size=200,
+                 embed_size=32, target_mode="ranking")
+    model.compile(optimizer="adam", loss="rank_hinge", lr=2e-3)
+    # rank_hinge consumes consecutive (positive, negative) rows: train
+    # UNSHUFFLED so the pairing survives batching
+    model.fit(FeatureSet.array(x, y, shuffle=False), batch_size=32,
+              nb_epoch=30)
+
+    # rank each question's candidate set: 1 relevant + 7 distractors
+    # (groups of (input rows, relevance) — the Ranker contract)
+    groups = []
+    for i in range(len(q)):
+        cands = [pos[i]] + [neg[(i + j) % len(q)] for j in range(7)]
+        rows = np.stack([np.concatenate([q[i], c]) for c in cands])
+        truth = np.zeros(8, np.float32)
+        truth[0] = 1.0
+        groups.append((rows, truth))
+    print("NDCG@3 :", round(model.evaluate_ndcg(groups, 3, batch_size=8), 3))
+    print("Hit@1  :", round(model.evaluate_hit_rate(groups, 1,
+                                                    batch_size=8), 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Text classification end-to-end (the reference's textclassification
+example): raw strings -> TextSet tokenize/word2idx/shape -> TextClassifier
+(CNN encoder) -> train/evaluate.
+
+Run:  python examples/text_classification.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.feature.text import TextSet
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+
+
+def make_corpus(rng, n_per_class=96):
+    sports = ["the team won the match", "a great goal in the final game",
+              "the player scored again", "championship race was close"]
+    tech = ["the new chip doubles performance", "software update improves the",
+            "machine learning model training", "the device battery lasts"]
+    texts, labels = [], []
+    for label, pool in enumerate((sports, tech)):
+        for _ in range(n_per_class):
+            words = []
+            for _ in range(3):
+                words.extend(rng.choice(pool).split())
+            texts.append(" ".join(words))
+            labels.append(label)
+    return texts, np.asarray(labels, np.int32)
+
+
+def main():
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    texts, labels = make_corpus(rng)
+
+    seq_len = 20
+    ts = TextSet.from_texts(texts, labels).tokenize().word2idx() \
+        .shape_sequence(seq_len)
+    x, y = ts.to_arrays()
+
+    model = TextClassifier(class_num=2, token_length=32,
+                           sequence_length=seq_len, encoder="cnn",
+                           vocab_size=len(ts.word_index) + 2)
+    model.compile(optimizer="adam", loss="scce", metrics=["accuracy"],
+                  lr=2e-3)
+    model.fit(x, y, batch_size=32, nb_epoch=8)
+    print("accuracy:", model.evaluate(x, y, batch_size=32))
+
+
+if __name__ == "__main__":
+    main()

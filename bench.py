@@ -31,6 +31,7 @@ that range in the baseline's favor; beating it by >=1x is the north star.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -44,6 +45,33 @@ N_EXAMPLES = 1_000_000
 BATCH = 8192
 SCAN_STEPS = 16          # optimizer steps fused per dispatch (lax.scan)
 TIMED_EPOCHS = 6
+
+
+def load_movielens(path):
+    """Real-data mode: parse MovieLens ``ratings.dat`` (``uid::mid::r::ts``)
+    or a ``.csv`` with user,item,rating columns. Ratings (incl. half-star
+    scales) round to 1..5 → classes 0..4. Activate with
+    ``ZOO_BENCH_DATA=/path/to/ratings.dat``."""
+    sep = "::" if path.endswith(".dat") else ","
+    users, items, ys = [], [], []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split(sep)
+            if len(parts) < 3 or not parts[0].isdigit():
+                continue
+            users.append(int(parts[0]))
+            items.append(int(parts[1]))
+            ys.append(round(float(parts[2])))
+    if not users:
+        raise ValueError(f"no ratings parsed from {path} — expected "
+                         f"'uid::mid::rating::ts' (.dat) or "
+                         f"'user,item,rating' (.csv) rows")
+    x = np.stack([np.asarray(users, np.int32),
+                  np.asarray(items, np.int32)], axis=1)
+    y = (np.asarray(ys, np.int32) - 1).clip(0, N_CLASSES - 1)
+    print(f"# real data: {len(y)} ratings from {os.path.basename(path)}",
+          file=sys.stderr)
+    return x, y
 
 
 def make_movielens_like(rng):
@@ -156,10 +184,18 @@ def main():
     init_zoo_context(train_scan_steps=SCAN_STEPS, train_device_cache=True)
 
     rng = np.random.default_rng(0)
-    x, y = make_movielens_like(rng)
+    data_path = os.environ.get("ZOO_BENCH_DATA")
+    if data_path:
+        x, y = load_movielens(data_path)
+    else:
+        x, y = make_movielens_like(rng)
 
-    # reference parity config: default NeuralCF dims (NeuralCF.scala:45-104)
-    model = NeuralCF(N_USERS, N_ITEMS, N_CLASSES)
+    # reference parity config: default NeuralCF dims (NeuralCF.scala:45-104);
+    # real datasets size the embedding tables from their actual id ranges
+    # (MovieLens-1M movie ids run to 3952, past the rated-movie count)
+    n_users = max(N_USERS, int(x[:, 0].max()))
+    n_items = max(N_ITEMS, int(x[:, 1].max()))
+    model = NeuralCF(n_users, n_items, N_CLASSES)
     model.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=1e-3)
 
     fs = FeatureSet.array(x, y, seed=0)
@@ -249,10 +285,16 @@ def main():
     print(f"# wall={wall:.2f}s epochs={TIMED_EPOCHS} batch={BATCH} "
           f"scan_steps={SCAN_STEPS} steps/epoch={steps_per_epoch} "
           f"device_kind={jax.devices()[0].device_kind}", file=sys.stderr)
-    if loss_last >= 1.55:
-        print("# FAIL: loss did not drop below the chance floor ln(5)=1.609 — "
-              "correctness regression; throughput number is void",
-              file=sys.stderr)
+    # correctness gate: the model must beat the zeroth-order predictor —
+    # the label-marginal entropy H (= ln 5 for the balanced synthetic set;
+    # lower for real MovieLens' skewed ratings)
+    counts = np.bincount(y, minlength=N_CLASSES).astype(np.float64)
+    p = counts / counts.sum()
+    entropy = float(-(p[p > 0] * np.log(p[p > 0])).sum())
+    if loss_last >= 0.97 * entropy:
+        print(f"# FAIL: loss {loss_last:.4f} did not beat the label-marginal "
+              f"entropy floor H={entropy:.4f} — correctness regression; "
+              f"throughput number is void", file=sys.stderr)
         sys.exit(1)
 
 

@@ -1,5 +1,5 @@
 from .common import (ArrayToTensor, ChainedPreprocessing,  # noqa: F401
                      FeatureLabelPreprocessing, FnPreprocessing, Normalize,
                      Preprocessing, ScalarToTensor, SeqToTensor)
-from .feature_set import (DiskFeatureSet, FeatureSet,  # noqa: F401
-                          prefetch_to_device)
+from .feature_set import (BucketedFeatureSet, DiskFeatureSet,  # noqa: F401
+                          FeatureSet, prefetch_to_device)

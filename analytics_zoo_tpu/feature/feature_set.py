@@ -349,3 +349,65 @@ class DiskFeatureSet(FeatureSet):
     def close(self):
         for f in self.files_x + ([self.file_y] if self.file_y else []):
             f.close()
+
+
+class BucketedFeatureSet(FeatureSet):
+    """Length-bucketed dataset for ragged sequences under XLA static
+    shapes (SURVEY §7 "hard parts": the reference just pads everything to
+    one length — bucketing compiles one program per bucket and wastes far
+    less padding compute). Batches never mix buckets; batch order
+    interleaves buckets, reshuffled per epoch.
+
+    Note: multi-step scan fusing (``zoo.train.scan_steps > 1``) stacks K
+    consecutive batches into one array and therefore cannot mix shapes —
+    use the default ``scan_steps=1`` with bucketed data.
+    """
+
+    device_cacheable = False  # ragged across buckets: no one HBM array
+    ragged = True             # evaluate/predict need a single dense array
+
+    def __init__(self, buckets: Sequence[FeatureSet], shuffle: bool = True,
+                 seed: int = 0):
+        buckets = [b for b in buckets if len(b) > 0]
+        if not buckets:
+            raise ValueError("BucketedFeatureSet needs non-empty buckets")
+        self.buckets = list(buckets)
+        self.shuffle = shuffle
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self.buckets)
+
+    def steps_per_epoch(self, batch_size: int, drop_last: bool = True) -> int:
+        return sum(b.steps_per_epoch(batch_size, drop_last)
+                   for b in self.buckets)
+
+    def iter_batches(self, batch_size: int, *, epoch: int = 0,
+                     drop_last: bool = True):
+        iters = [b.iter_batches(batch_size, epoch=epoch, drop_last=drop_last)
+                 for b in self.buckets]
+        order = [bi for bi, b in enumerate(self.buckets)
+                 for _ in range(b.steps_per_epoch(batch_size, drop_last))]
+        if self.shuffle:
+            np.random.default_rng(self.seed + 31 * epoch).shuffle(order)
+        for bi in order:
+            yield next(iters[bi])
+
+    def sample(self, n: int):
+        return self.buckets[0].sample(n)
+
+    @property
+    def xs(self):  # type: ignore[override]
+        raise ValueError("bucketed data is ragged across buckets; iterate "
+                         "with iter_batches or use the per-bucket sets")
+
+    @property
+    def x(self):
+        return self.xs
+
+    @property
+    def y(self):
+        ys = [b.y for b in self.buckets]
+        if any(v is None for v in ys):
+            return None
+        return np.concatenate(ys)

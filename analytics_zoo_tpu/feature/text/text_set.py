@@ -28,7 +28,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from ..feature_set import FeatureSet
+from ..feature_set import BucketedFeatureSet, FeatureSet
 
 __all__ = ["TextFeature", "TextSet"]
 
@@ -178,7 +178,46 @@ class TextSet:
         fs = self.generate_sample()
         return fs.x, fs.y
 
+    def to_bucketed(self, lengths, trunc_mode: str = "pre",
+                    shuffle: bool = True, seed: int = 0
+                    ) -> BucketedFeatureSet:
+        """Length-bucketed alternative to ``shape_sequence`` + one
+        FeatureSet: each text pads to the SMALLEST bucket length that fits
+        it (the longest bucket truncates), so short texts stop paying
+        full-length padding compute. Returns a ``BucketedFeatureSet``."""
+        if trunc_mode not in ("pre", "post"):
+            raise ValueError("trunc_mode must be 'pre' or 'post'")
+        lengths = sorted({int(ln) for ln in lengths})
+        if not lengths:
+            raise ValueError("need at least one bucket length")
+        groups: Dict[int, Tuple[list, list]] = {ln: ([], [])
+                                               for ln in lengths}
+        for f in self.features:
+            if f.indices is None:
+                raise RuntimeError("call tokenize() and word2idx() first")
+            idx = np.asarray(f.indices, np.int32)
+            ln = next((b for b in lengths if len(idx) <= b), lengths[-1])
+            if len(idx) > ln:
+                idx = idx[-ln:] if trunc_mode == "pre" else idx[:ln]
+            elif len(idx) < ln:
+                idx = np.concatenate(
+                    [idx, np.zeros(ln - len(idx), np.int32)])
+            xs, ys = groups[ln]
+            xs.append(idx)
+            ys.append(f.label)
+        buckets = []
+        for ln in lengths:
+            xs, ys = groups[ln]
+            if not xs:
+                continue
+            y = (np.asarray(ys, np.int32)
+                 if all(v is not None for v in ys) else None)
+            buckets.append(FeatureSet(np.stack(xs), y, shuffle=shuffle,
+                                      seed=seed))
+        return BucketedFeatureSet(buckets, shuffle=shuffle, seed=seed)
+
     def get_word_index(self) -> Dict[str, int]:
         if self.word_index is None:
             raise RuntimeError("word2idx() has not run")
         return self.word_index
+

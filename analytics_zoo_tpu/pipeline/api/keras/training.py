@@ -493,6 +493,19 @@ class TrainingLoop:
                   ) -> Dict[str, List[float]]:
         ctx = get_zoo_context()
         model = self.model
+        # fail NOW, not after an epoch of compute: scan fusing stacks K
+        # consecutive batches into one array (can't mix widths), and
+        # validation/evaluate need one dense array
+        if (getattr(fs, "ragged", False)
+                and int(ctx.get("zoo.train.scan_steps", 1)) > 1):
+            raise ValueError(
+                "bucketed (ragged) datasets cannot use "
+                "zoo.train.scan_steps > 1 — fused chunks stack same-shape "
+                "batches; set scan_steps=1")
+        if getattr(validation_data, "ragged", False):
+            raise ValueError(
+                "bucketed validation_data is not supported — evaluate per "
+                "bucket (validation_data.buckets) instead")
         if (getattr(self.loss, "__name__", "") == "rank_hinge"
                 and getattr(fs, "shuffle", False)):
             log.warning(
@@ -595,7 +608,9 @@ class TrainingLoop:
         xs_dev = ys_dev = None
         # n_slices first: DiskFeatureSet.y is a property that would gather
         # the whole label file just to answer the None check
-        if device_cache and n_slices <= 1 and fs.y is not None:
+        if (device_cache and n_slices <= 1
+                and getattr(fs, "device_cacheable", True)
+                and fs.y is not None):
             n_steps = fs.steps_per_epoch(batch_size, drop_last=True)
             for trig, role in ((ckpt_trigger, "checkpoint"),
                                (end_trigger, "end")):

@@ -135,3 +135,65 @@ def test_bert_small_classifier_finetune():
     assert h["loss"][-1] < h["loss"][0]
     res = m.evaluate([x, token_type, position, mask], y, batch_size=16)
     assert res["accuracy"] > 0.75
+
+
+def test_bucketed_training():
+    """Length bucketing (SURVEY §7 hard parts): ragged texts pad to the
+    smallest fitting bucket, batches never mix shapes, and a
+    length-agnostic model trains across buckets."""
+    import numpy as np
+    from analytics_zoo_tpu.common.context import init_zoo_context
+    from analytics_zoo_tpu.feature import BucketedFeatureSet
+    from analytics_zoo_tpu.feature.text import TextSet
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    short = ["good fun " * 2, "bad sad " * 2] * 24        # ~4 tokens
+    long_ = ["good fun nice day " * 5, "bad sad poor day " * 5] * 24
+    texts = short + long_
+    labels = np.asarray(([1, 0] * 24) + ([1, 0] * 24), np.int32)
+
+    ts = TextSet.from_texts(texts, labels).tokenize().word2idx()
+    fs = ts.to_bucketed([8, 24], seed=1)
+    assert isinstance(fs, BucketedFeatureSet)
+    assert len(fs) == 96
+    shapes = {bx.shape[1] for bx, _ in fs.iter_batches(8, epoch=0)}
+    assert shapes == {8, 24}  # batches never mix bucket lengths
+    # interleave reshuffles across epochs
+    o0 = [bx.shape[1] for bx, _ in fs.iter_batches(8, epoch=0)]
+    o1 = [bx.shape[1] for bx, _ in fs.iter_batches(8, epoch=1)]
+    assert o0 != o1
+
+    m = TextClassifier(class_num=2, token_length=16, sequence_length=24,
+                       encoder="cnn", vocab_size=len(ts.word_index) + 2)
+    m.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=2e-3)
+    h = m.fit(fs, batch_size=8, nb_epoch=6)
+    assert h["loss"][-1] < h["loss"][0]
+
+
+def test_bucketed_guards():
+    import numpy as np
+    import pytest
+    from analytics_zoo_tpu.common.context import (init_zoo_context,
+                                                  reset_zoo_context)
+    from analytics_zoo_tpu.feature.text import TextSet
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+
+    reset_zoo_context()
+    init_zoo_context(train_scan_steps=2)
+    try:
+        texts = ["a b c d"] * 16
+        labels = np.zeros(16, np.int32)
+        ts = TextSet.from_texts(texts, labels).tokenize().word2idx()
+        fs = ts.to_bucketed([4, 8])
+        assert len(fs.buckets) == 1  # all same length → one non-empty bucket
+        assert len(ts.to_bucketed([4, 4, 8]).buckets) == 1  # dup lens dedup
+        m = TextClassifier(class_num=2, token_length=8, sequence_length=4,
+                           encoder="cnn", vocab_size=10)
+        m.compile(optimizer="adam", loss="scce")
+        with pytest.raises(ValueError, match="scan_steps"):
+            m.fit(fs, batch_size=8, nb_epoch=1)
+    finally:
+        reset_zoo_context()
+        init_zoo_context()

@@ -126,7 +126,10 @@ def param_shardings(model, params, mesh: Optional[Mesh] = None):
 
     mesh = mesh or global_mesh()
     repl = replicated_sharding(mesh)
-    if mesh.shape[MODEL_AXIS] == 1 or not hasattr(model, "param_sharding"):
+    # fast path only when NO param-bearing axis exists: expert-stacked MoE
+    # weights shard over ``expert`` even without tensor parallelism
+    if (mesh.shape[MODEL_AXIS] * mesh.shape[EXPERT_AXIS] == 1
+            or not hasattr(model, "param_sharding")):
         return jax.tree.map(lambda _: repl, params)
     spec_tree = model.param_sharding(params)
     fallbacks: list = []

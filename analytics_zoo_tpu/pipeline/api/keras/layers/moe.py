@@ -1,0 +1,183 @@
+"""Sparse Mixture-of-Experts — the layer that makes the ``expert`` mesh axis
+real (SURVEY §2.4: EP is "absent in the reference; greenfield").
+
+The reference has no MoE (`pipeline/api/keras/layers/` contains none), so this
+is designed TPU-first rather than mirrored: the GShard einsum formulation —
+capacity-bounded token dispatch expressed as one-hot matmuls — keeps every
+shape static for XLA and puts the FLOPs on the MXU, and the expert-stacked
+weight tensors ``(E, d_in, d_h)`` shard over the ``expert`` mesh axis (their
+hidden dim can additionally shard over ``model``), so GSPMD inserts the
+dispatch/combine all-to-alls over ICI.
+
+Auxiliary losses (load-balance + router z-loss) ride the layer-state channel:
+``apply`` returns them under the reserved state key ``aux_loss``, which the
+training loop adds to the task loss *inside* the differentiated function —
+see ``training.py`` ``_aux_loss_sum`` — so the router receives gradient.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import Layer, compute_dtype, get_initializer, param_dtype
+from .core import get_activation
+
+
+class SparseMoE(Layer):
+    """Token-choice top-k sparse MoE with expert capacity.
+
+    Each token's router picks its ``top_k`` experts out of ``num_experts``;
+    every expert processes at most ``capacity`` tokens per batch
+    (``capacity = ceil(top_k * n_tokens / num_experts) * capacity_factor``),
+    overflow tokens are dropped (contribute zero — pair with a residual
+    connection, as in Switch/GShard). Input ``(B, d)`` or ``(B, T, d)``;
+    output has ``output_dim`` features (default: same as input).
+
+    The load-balance loss is the Switch-Transformer form
+    ``E * dot(frac_tokens_per_expert, mean_router_prob)`` scaled by
+    ``aux_loss_weight``; ``router_z_weight`` optionally adds the ST-MoE
+    z-loss ``mean(logsumexp(logits)^2)`` to keep router logits small.
+    """
+
+    def __init__(self, num_experts: int, hidden_dim: int,
+                 output_dim: Optional[int] = None, top_k: int = 2,
+                 capacity_factor: float = 1.25, activation="relu",
+                 aux_loss_weight: float = 1e-2, router_z_weight: float = 0.0,
+                 router_noise: float = 0.0, init: str = "glorot_uniform",
+                 **kwargs):
+        super().__init__(**kwargs)
+        if not 1 <= top_k <= num_experts:
+            raise ValueError(f"top_k={top_k} not in [1, {num_experts}]")
+        self.num_experts = num_experts
+        self.hidden_dim = hidden_dim
+        self.output_dim = output_dim
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.activation = get_activation(activation)
+        self.aux_loss_weight = aux_loss_weight
+        self.router_z_weight = router_z_weight
+        self.router_noise = router_noise
+        self.init = init
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        out = self.output_dim or d
+        E, h = self.num_experts, self.hidden_dim
+        init = get_initializer(self.init)
+        k = jax.random.split(rng, 3)
+        return {
+            # router kept in the param dtype; routing math runs in f32
+            "Wg": init(k[0], (d, E), param_dtype()),
+            "W1": init(k[1], (E, d, h), param_dtype()),
+            "b1": jnp.zeros((E, h), param_dtype()),
+            "W2": init(k[2], (E, h, out), param_dtype()),
+            "b2": jnp.zeros((E, out), param_dtype()),
+        }
+
+    def initial_state(self, input_shape):
+        return {"aux_loss": jnp.zeros((), jnp.float32)}
+
+    def param_sharding(self, params):
+        """Expert-stacked weights shard over the ``expert`` axis; their
+        hidden dim additionally over ``model`` (EP x TP). The router stays
+        replicated — every token needs all expert scores."""
+        from jax.sharding import PartitionSpec as P
+        from .....parallel.mesh import EXPERT_AXIS, MODEL_AXIS
+        return {
+            "Wg": None,
+            "W1": P(EXPERT_AXIS, None, MODEL_AXIS),
+            "b1": P(EXPERT_AXIS, MODEL_AXIS),
+            "W2": P(EXPERT_AXIS, MODEL_AXIS, None),
+            "b2": P(EXPERT_AXIS, None),
+        }
+
+    # -- routing ------------------------------------------------------------
+    def _route(self, logits):
+        """Top-k gates + capacity-bounded positions, all static shapes.
+
+        Returns ``(dispatch, combine, aux)``: dispatch ``(N, E, C)`` is the
+        0/1 token->(expert, slot) assignment, combine is dispatch weighted by
+        the renormalized gate values."""
+        N, E = logits.shape
+        k = self.top_k
+        cap = max(1, int(-(-k * N // E) * self.capacity_factor))
+        cap = min(cap, N)
+
+        probs = jax.nn.softmax(logits, axis=-1)              # (N, E) f32
+        gate_vals, idx = jax.lax.top_k(probs, k)             # (N, k)
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+
+        # (k, N, E) one-hot choices; choice rank 0 has dispatch priority —
+        # positions count choice-0 tokens before any choice-1 token, so a
+        # token's primary expert is the last to drop it under overflow
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32).transpose(1, 0, 2)
+        flat = mask.reshape(k * N, E)
+        pos_flat = jnp.cumsum(flat, axis=0) - flat           # (k*N, E)
+        pos = (pos_flat.reshape(k, N, E) * mask).sum(-1).astype(jnp.int32)
+        kept = mask * (pos_flat < cap).reshape(k, N, E)      # (k, N, E)
+
+        slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)   # (k, N, C)
+        assign = kept[..., None] * slot[:, :, None, :]       # (k, N, E, C)
+        dispatch = assign.sum(0)                             # (N, E, C)
+        combine = (assign * gate_vals.T[..., None, None]).sum(0)
+
+        # Switch load-balance loss on the primary choice + optional z-loss
+        frac_tokens = mask[0].mean(0)                        # (E,)
+        frac_probs = probs.mean(0)
+        aux = self.aux_loss_weight * E * jnp.dot(frac_tokens, frac_probs)
+        if self.router_z_weight:
+            z = jax.scipy.special.logsumexp(logits, axis=-1)
+            aux = aux + self.router_z_weight * jnp.mean(z * z)
+        return dispatch, combine, aux.astype(jnp.float32)
+
+    def _expert_constraint(self, a, spec):
+        """Pin the per-expert tensors to the ``expert`` axis when one exists,
+        forcing GSPMD to place the dispatch/combine all-to-all here."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .....parallel.mesh import EXPERT_AXIS, global_mesh
+        mesh = global_mesh()
+        if (mesh.shape[EXPERT_AXIS] > 1
+                and self.num_experts % mesh.shape[EXPERT_AXIS] == 0):
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P(EXPERT_AXIS, *spec)))
+        return a
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        lead = x.shape[:-1]
+        d = x.shape[-1]
+        tokens = x.reshape(-1, d)
+        N = tokens.shape[0]
+
+        logits = jnp.matmul(tokens.astype(jnp.float32),
+                            params["Wg"].astype(jnp.float32))
+        if training and self.router_noise > 0.0:
+            if rng is None:
+                raise ValueError(f"{self.name}: router noise needs an rng")
+            logits = logits * jax.random.uniform(
+                rng, logits.shape, minval=1.0 - self.router_noise,
+                maxval=1.0 + self.router_noise)
+        dispatch, combine, aux = self._route(logits)
+
+        xin = jnp.einsum("nec,nd->ecd", dispatch.astype(cd),
+                         tokens.astype(cd),
+                         preferred_element_type=jnp.float32).astype(cd)
+        xin = self._expert_constraint(xin, (None, None))
+        h = jnp.einsum("ecd,edh->ech", xin, params["W1"].astype(cd),
+                       preferred_element_type=jnp.float32).astype(cd)
+        h = self.activation(h + params["b1"].astype(cd)[:, None, :])
+        out = jnp.einsum("ech,eho->eco", h, params["W2"].astype(cd),
+                         preferred_element_type=jnp.float32).astype(cd)
+        out = out + params["b2"].astype(cd)[:, None, :]
+        out = self._expert_constraint(out, (None, None))
+        y = jnp.einsum("nec,eco->no", combine.astype(cd), out,
+                       preferred_element_type=jnp.float32).astype(cd)
+        return y.reshape(*lead, y.shape[-1]), {"aux_loss": aux}
+
+    def call(self, params, x, *, training=False, rng=None):
+        y, _ = self.apply(params, {}, x, training=training, rng=rng)
+        return y

@@ -98,6 +98,17 @@ def _round_up(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
 
 
+def _aux_loss_sum(state):
+    """Sum of every ``aux_loss`` leaf a layer left in the network state
+    (e.g. ``SparseMoE``'s load-balance loss). A trace-time pytree walk —
+    models without aux losses pay nothing. Returns None when absent."""
+    total = None
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if path and getattr(path[-1], "key", None) == "aux_loss":
+            total = leaf if total is None else total + leaf
+    return total
+
+
 def _stack_batches(items):
     """Stack K ``(x, y)`` minibatches into one ``(K, batch, ...)`` chunk for
     the multi-step scan dispatch. ``None`` labels pass through."""
@@ -201,7 +212,9 @@ class TrainingLoop:
         def step(params, opt_state, net_state, rng, x, y):
             def lfn(p):
                 yp, ns = model.apply(p, net_state, x, training=True, rng=rng)
-                return loss_fn(y, yp), ns
+                l = loss_fn(y, yp)
+                aux = _aux_loss_sum(ns)
+                return (l if aux is None else l + aux), ns
             (l, ns), grads = jax.value_and_grad(lfn, has_aux=True)(params)
             updates, opt_state = opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
@@ -224,7 +237,9 @@ class TrainingLoop:
 
             def lfn(p):
                 yp, ns = model.apply(p, net_state, x, training=True, rng=rng)
-                return loss_fn(y, yp), ns
+                l = loss_fn(y, yp)
+                aux = _aux_loss_sum(ns)
+                return (l if aux is None else l + aux), ns
 
             (l, ns), grads = jax.value_and_grad(lfn, has_aux=True)(params)
             updates, opt_state = opt.update(grads, opt_state, params)

@@ -33,6 +33,7 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.mesh.model": 1,
     "zoo.mesh.seq": 1,
     "zoo.mesh.expert": 1,
+    "zoo.mesh.pipe": 1,
     "zoo.seed": 0,
     # multi-host (DCN) bring-up — the reference's Spark executor topology
     # becomes the JAX multi-process runtime; empty coordinator = single host
@@ -237,6 +238,7 @@ def init_zoo_context(
         model=int(merged["zoo.mesh.model"]),
         seq=int(merged["zoo.mesh.seq"]),
         expert=int(merged["zoo.mesh.expert"]),
+        pipe=int(merged["zoo.mesh.pipe"]),
     )
     mesh_lib.set_global_mesh(mesh)
 

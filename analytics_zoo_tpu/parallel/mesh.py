@@ -11,7 +11,9 @@ In the reference (robert-sbd/analytics-zoo), physical parallelism is organised b
   ``Topology.scala:1150-1158``),
 * ``model`` — tensor/model parallelism (absent in the reference; greenfield),
 * ``seq``   — sequence/context parallelism (absent in the reference),
-* ``expert`` — expert parallelism for MoE layers (absent in the reference).
+* ``expert`` — expert parallelism for MoE layers (absent in the reference),
+* ``pipe``  — pipeline parallelism (GPipe microbatch schedule; absent in the
+  reference).
 
 Collectives ride ICI within a mesh; XLA inserts psum/all-gather from sharding
 annotations, replacing BigDL's Spark-BlockManager ``AllReduceParameter``
@@ -32,8 +34,9 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
 
-ALL_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS)
+ALL_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS, PIPE_AXIS)
 
 _global_mesh: Optional[Mesh] = None
 
@@ -43,6 +46,7 @@ def create_mesh(
     model: int = 1,
     seq: int = 1,
     expert: int = 1,
+    pipe: int = 1,
     *,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
@@ -52,26 +56,31 @@ def create_mesh(
     reference sizes data parallelism to the cluster (one model replica per
     Spark partition, ``Topology.scala:1102-1110``).
 
-    The axis order is (data, seq, expert, model), placing the model axis
-    innermost so tensor-parallel collectives ride the fastest ICI links.
+    The axis order is (data, pipe, seq, expert, model), placing the model
+    axis innermost so tensor-parallel collectives ride the fastest ICI links
+    and the pipe axis outermost-but-one so stage hops cross the slowest
+    links only once per microbatch.
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    fixed = model * seq * expert
+    fixed = model * seq * expert * pipe
     if data == -1:
         if n % fixed != 0:
             raise ValueError(
-                f"device count {n} not divisible by model*seq*expert={fixed}"
+                f"device count {n} not divisible by "
+                f"model*seq*expert*pipe={fixed}"
             )
         data = n // fixed
     total = data * fixed
     if total != n:
         raise ValueError(
-            f"mesh {data}x{seq}x{expert}x{model}={total} != device count {n}"
+            f"mesh {data}x{pipe}x{seq}x{expert}x{model}={total} "
+            f"!= device count {n}"
         )
-    dev_array = np.asarray(devices).reshape(data, seq, expert, model)
-    return Mesh(dev_array, (DATA_AXIS, SEQ_AXIS, EXPERT_AXIS, MODEL_AXIS))
+    dev_array = np.asarray(devices).reshape(data, pipe, seq, expert, model)
+    return Mesh(dev_array,
+                (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, EXPERT_AXIS, MODEL_AXIS))
 
 
 def set_global_mesh(mesh: Mesh) -> None:
@@ -127,8 +136,10 @@ def param_shardings(model, params, mesh: Optional[Mesh] = None):
     mesh = mesh or global_mesh()
     repl = replicated_sharding(mesh)
     # fast path only when NO param-bearing axis exists: expert-stacked MoE
-    # weights shard over ``expert`` even without tensor parallelism
-    if (mesh.shape[MODEL_AXIS] * mesh.shape[EXPERT_AXIS] == 1
+    # weights shard over ``expert``, GPipe stage stacks over ``pipe``, even
+    # without tensor parallelism
+    if (mesh.shape[MODEL_AXIS] * mesh.shape[EXPERT_AXIS]
+            * mesh.shape[PIPE_AXIS] == 1
             or not hasattr(model, "param_sharding")):
         return jax.tree.map(lambda _: repl, params)
     spec_tree = model.param_sharding(params)

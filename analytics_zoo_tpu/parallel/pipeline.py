@@ -1,0 +1,116 @@
+"""Pipeline parallelism — GPipe microbatch schedule over the ``pipe`` mesh
+axis (SURVEY §2.4: PP "NO — no stage partitioner / microbatch scheduler
+exists" in the reference; designed fresh for TPU).
+
+The TPU-native shape of pipeline parallelism: stage weights are STACKED into
+one ``(S, ...)`` tree sharded over the ``pipe`` axis, and the schedule is a
+single ``lax.scan`` of ``n_micro + S - 1`` ticks inside ``shard_map`` — each
+tick every pipe rank runs its stage on its current microbatch and the
+activations rotate one hop with ``lax.ppermute`` over ICI. No host-side
+scheduler, no per-stage processes: XLA sees one fused program, and autodiff
+through scan+ppermute yields the backward pipeline for free (1F1B-style
+memory tricks are a future refinement; GPipe semantics first).
+
+Stages must be homogeneous (same layer type/config, input shape == output
+shape) — the transformer-stack case pipeline parallelism exists for. On a
+mesh without a ``pipe`` axis the same stacked tree runs as a sequential
+``lax.scan`` over stages, so a model written with ``GPipe`` is portable from
+1 chip to a pipelined slice unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+
+def _rotate_perm(size: int):
+    return [(i, (i + 1) % size) for i in range(size)]
+
+
+def gpipe_apply(stage_fn: Callable, stacked_params, x, *, mesh,
+                n_micro: int, rng=None):
+    """Run ``x`` through ``S`` stacked stages with the GPipe schedule.
+
+    ``stage_fn(params, x, rng) -> y`` is one stage; ``stacked_params`` has
+    leading dim ``S`` on every leaf, sharded over ``pipe``; ``x`` is the
+    global batch ``(B, ...)`` (sharded over ``data``). The per-data-shard
+    batch must divide by ``n_micro``; wall-clock per batch is
+    ``(n_micro + S - 1)`` stage times, the classic GPipe bubble — raise
+    ``n_micro`` to amortize it.
+    """
+    S = mesh.shape[mesh_lib.PIPE_AXIS]
+    dp = mesh.shape[mesh_lib.DATA_AXIS]
+    B = x.shape[0]
+    if B % dp != 0 or (B // dp) % n_micro != 0:
+        raise ValueError(
+            f"per-shard batch {B}/{dp} not divisible by n_micro={n_micro}")
+
+    # one PartitionSpec prefix per argument: params split stage-wise over
+    # pipe, batch split over data (replicated over pipe)
+    pspec = jax.tree.map(lambda _: P(mesh_lib.PIPE_AXIS), stacked_params)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(pspec, P(mesh_lib.DATA_AXIS)),
+        out_specs=P(mesh_lib.DATA_AXIS),
+        check_vma=False)
+    def run(params_loc, x_loc):
+        # drop the local stage dim (S/pipe == 1 enforced by the caller)
+        p_stage = jax.tree.map(lambda a: a[0], params_loc)
+        r = jax.lax.axis_index(mesh_lib.PIPE_AXIS)
+        mbs = x_loc.reshape(n_micro, x_loc.shape[0] // n_micro,
+                            *x_loc.shape[1:])
+
+        def tick(carry, t):
+            state, out = carry
+            feed = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+            inp = jnp.where(r == 0, feed, state)
+            # unique key per (tick, rank) = per (microbatch, stage):
+            # stochastic stages decorrelate across the schedule (exact
+            # rng-stream parity with the sequential path is impossible —
+            # it draws once per stage for the whole batch)
+            trng = (jax.random.fold_in(jax.random.fold_in(rng, t), r)
+                    if rng is not None else None)
+            y = stage_fn(p_stage, inp, trng)
+            # the last rank retires microbatch t-(S-1) at tick t
+            widx = jnp.clip(t - (S - 1), 0, n_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(out, widx, 0, keepdims=False)
+            keep = jnp.logical_and(r == S - 1, t >= S - 1)
+            out = jax.lax.dynamic_update_index_in_dim(
+                out, jnp.where(keep, y, cur), widx, 0)
+            state = jax.lax.ppermute(y, mesh_lib.PIPE_AXIS, _rotate_perm(S))
+            return (state, out), None
+
+        out0 = jnp.zeros_like(mbs)
+        (_, out), _ = jax.lax.scan(tick, (jnp.zeros_like(mbs[0]), out0),
+                                   jnp.arange(n_micro + S - 1))
+        # results live on the last rank only; masked psum broadcasts them so
+        # every pipe rank returns the same (replicated) value
+        out = jax.lax.psum(jnp.where(r == S - 1, out, jnp.zeros_like(out)),
+                           mesh_lib.PIPE_AXIS)
+        return out.reshape(x_loc.shape)
+
+    return run(stacked_params, x)
+
+
+def sequential_apply(stage_fn: Callable, stacked_params, x, n_stages: int,
+                     rng=None):
+    """Portability fallback (pipe axis == 1): the same stacked tree runs as
+    a sequential ``lax.scan`` over stages — identical math for deterministic
+    stages, one device. ``n_stages`` comes from the caller: the param tree
+    may be empty (parameter-less stages like Dropout)."""
+    def body(h, sp):
+        p_stage, i = sp
+        trng = jax.random.fold_in(rng, i) if rng is not None else None
+        return stage_fn(p_stage, h, trng), None
+
+    y, _ = jax.lax.scan(body, x, (stacked_params, jnp.arange(n_stages)))
+    return y

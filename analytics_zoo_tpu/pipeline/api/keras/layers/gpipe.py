@@ -1,0 +1,96 @@
+"""GPipe layer — pipeline parallelism as a composable Keras-style layer.
+
+The reference has no pipeline parallelism at all (SURVEY §2.4: "NO — no
+stage partitioner / microbatch scheduler exists"); this is greenfield TPU
+design. The schedule itself lives in ``parallel/pipeline.py`` (shard_map +
+ppermute over the ``pipe`` mesh axis); this wrapper stacks ``num_stages``
+homogeneous stage layers into one ``(S, ...)`` param tree so the model code
+is a single layer that runs pipelined on a ``pipe=S`` mesh and sequentially
+(identical math, ``lax.scan`` over stages) everywhere else.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .....parallel import mesh as mesh_lib
+from .....parallel.pipeline import gpipe_apply, sequential_apply
+from ..engine import Layer, compute_dtype
+
+
+class GPipe(Layer):
+    """A stack of ``num_stages`` homogeneous layers over the ``pipe`` axis.
+
+    ``stage_factory()`` builds ONE stage (e.g. ``lambda:
+    TransformerBlock(8, 2)``); stages must preserve shape (input == output,
+    the transformer-stack case PP exists for) and be stateless. On a
+    ``pipe=S`` mesh each rank owns one stage and microbatches flow through
+    the GPipe schedule; on a ``pipe=1`` mesh the stack runs sequentially —
+    the model is portable either way (bit-identical for deterministic
+    stages; stochastic stages draw decorrelated per-(stage, microbatch)
+    keys under the schedule, so dropout masks differ across placements).
+    """
+
+    def __init__(self, stage_factory: Callable, num_stages: int,
+                 n_microbatches: Optional[int] = None, **kwargs):
+        super().__init__(**kwargs)
+        if num_stages < 1:
+            raise ValueError(f"num_stages={num_stages} < 1")
+        self.stage_factory = stage_factory
+        self.num_stages = num_stages
+        self.n_microbatches = n_microbatches
+        self.stage = stage_factory()  # template instance: defines the math
+
+    def build(self, rng, input_shape):
+        keys = jax.random.split(rng, self.num_stages)
+        trees = []
+        for i in range(self.num_stages):
+            stage = self.stage_factory() if i else self.stage
+            if stage.initial_state(input_shape):
+                raise ValueError(
+                    f"{self.name}: pipeline stages must be stateless")
+            p = stage.build(keys[i], input_shape)
+            out_shape = stage.output_shape_for(p, {}, input_shape)
+            if tuple(out_shape[1:]) != tuple(input_shape[1:]):
+                raise ValueError(
+                    f"{self.name}: stage must preserve shape, got "
+                    f"{tuple(input_shape[1:])} -> {tuple(out_shape[1:])}")
+            trees.append(p)
+        return jax.tree.map(lambda *ls: jnp.stack(ls), *trees)
+
+    def param_sharding(self, params):
+        """Stage dim over ``pipe``; inner dims replicated (composing the
+        stage's own model-axis rules inside PP is future work)."""
+        return jax.tree.map(lambda _: P(mesh_lib.PIPE_AXIS), params)
+
+    def _stage_fn(self, training):
+        def fn(p_stage, h, rng):
+            return self.stage.call(p_stage, h, training=training, rng=rng)
+        return fn
+
+    def call(self, params, x, *, training=False, rng=None):
+        mesh = mesh_lib.global_mesh()
+        S = mesh.shape[mesh_lib.PIPE_AXIS]
+        fn = self._stage_fn(training)
+        # the scan carry must be dtype-stable: enter at the compute dtype the
+        # stages will emit (bfloat16 under a mixed-precision policy)
+        x = x.astype(compute_dtype())
+        if S > 1:
+            if self.num_stages != S:
+                raise ValueError(
+                    f"{self.name}: num_stages={self.num_stages} must equal "
+                    f"the pipe axis size {S} (stage grouping not supported)")
+            n_micro = self.n_microbatches or S
+            dp = mesh.shape[mesh_lib.DATA_AXIS]
+            B = x.shape[0]
+            # batches the schedule can't split (shape inference's B=1
+            # probe, ragged predict tails) run the sequential path — the
+            # math is identical, only the chip placement differs
+            if B % dp == 0 and (B // dp) % n_micro == 0:
+                return gpipe_apply(fn, params, x, mesh=mesh,
+                                   n_micro=n_micro, rng=rng)
+        return sequential_apply(fn, params, x, self.num_stages, rng=rng)

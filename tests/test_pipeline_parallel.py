@@ -1,0 +1,186 @@
+"""Pipeline parallelism — GPipe schedule over the ``pipe`` mesh axis
+(SURVEY §2.4: PP absent in the reference; greenfield TPU design).
+
+Covers: pipelined forward == sequential stage application, dp-vs-pp training
+equality, stage weights committed to a ``pipe``-axis sharding, the
+microbatch-divisibility and shape-preservation guards, and portability (a
+GPipe model built on a pipe mesh runs unchanged on a pure-DP mesh).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import init_zoo_context
+from analytics_zoo_tpu.common.context import reset_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, GPipe
+
+
+def _data(n=256, d=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def _pp_net(S=4, d=8):
+    return Sequential([
+        Dense(16, activation="relu", input_shape=(d,)),
+        GPipe(lambda: Dense(16, activation="tanh"), num_stages=S,
+              name="pipe"),
+        Dense(4, activation="softmax"),
+    ])
+
+
+def test_gpipe_forward_matches_sequential_stages():
+    """pipe=4 schedule vs hand-rolled stage-after-stage application."""
+    init_zoo_context(mesh_pipe=4)  # data=2 x pipe=4
+    d = 8
+    layer = GPipe(lambda: Dense(d, activation="tanh"), num_stages=4)
+    p = layer.build(jax.random.key(0), (None, d))
+    x = np.random.default_rng(0).normal(size=(16, d)).astype(np.float32)
+
+    y_pipe = np.asarray(layer.call(p, jnp.asarray(x)))
+
+    h = x
+    for s in range(4):
+        W = np.asarray(p["W"][s])
+        b = np.asarray(p["b"][s])
+        h = np.tanh(h @ W + b)
+    np.testing.assert_allclose(y_pipe, h, rtol=2e-4, atol=2e-5)
+
+
+def test_gpipe_portable_to_pure_dp_mesh():
+    """Same stacked params, pipe=1 mesh: sequential scan path, same result."""
+    d = 8
+    x = np.random.default_rng(1).normal(size=(16, d)).astype(np.float32)
+
+    init_zoo_context(mesh_pipe=4)
+    layer = GPipe(lambda: Dense(d, activation="tanh"), num_stages=4)
+    p = layer.build(jax.random.key(0), (None, d))
+    y_pipe = np.asarray(layer.call(p, jnp.asarray(x)))
+
+    reset_zoo_context()
+    init_zoo_context()  # pure DP
+    p_host = jax.tree.map(np.asarray, p)
+    y_seq = np.asarray(layer.call(p_host, jnp.asarray(x)))
+    np.testing.assert_allclose(y_pipe, y_seq, rtol=2e-4, atol=2e-5)
+
+
+def test_dp_vs_pp_numerical_equality():
+    """data=8 vs data=2 x pipe=4: the schedule must not change the math."""
+    import optax
+    x, y = _data()
+
+    init_zoo_context()
+    m_dp = _pp_net()
+    m_dp.compile(optimizer=optax.adam(0.01), loss="scce")
+    h_dp = m_dp.fit(x, y, batch_size=64, nb_epoch=4)
+    p_dp = m_dp.predict(x, batch_size=64)
+
+    reset_zoo_context()
+    init_zoo_context(mesh_pipe=4)
+    m_pp = _pp_net()
+    m_pp.compile(optimizer=optax.adam(0.01), loss="scce")
+    h_pp = m_pp.fit(x, y, batch_size=64, nb_epoch=4)
+    p_pp = m_pp.predict(x, batch_size=64)
+
+    np.testing.assert_allclose(h_dp["loss"], h_pp["loss"], rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(p_dp, p_pp, rtol=1e-3, atol=1e-4)
+
+
+def test_pp_params_actually_sharded():
+    import optax
+    init_zoo_context(mesh_pipe=4)
+    x, y = _data()
+    m = _pp_net()
+    m.compile(optimizer=optax.adam(0.01), loss="scce")
+    m.fit(x, y, batch_size=64, nb_epoch=1)
+    W = m.params["pipe"]["W"]
+    assert "pipe" in str(W.sharding.spec), \
+        f"stage weights not pipe-sharded: {W.sharding.spec}"
+    assert W.shape[0] == 4
+
+
+def test_gpipe_guards():
+    init_zoo_context(mesh_pipe=4)
+    # stage count != pipe size
+    layer = GPipe(lambda: Dense(8, activation="tanh"), num_stages=3)
+    p = layer.build(jax.random.key(0), (None, 8))
+    with pytest.raises(ValueError, match="must equal"):
+        layer.call(p, jnp.zeros((8, 8)))
+    # shape-changing stage rejected at build
+    bad = GPipe(lambda: Dense(5), num_stages=4)
+    with pytest.raises(ValueError, match="preserve shape"):
+        bad.build(jax.random.key(0), (None, 8))
+
+
+def test_gpipe_indivisible_batch_falls_back_to_sequential():
+    """A batch the schedule can't split (ragged predict tail, B=1 shape
+    probe) still computes — via the sequential path, same math."""
+    init_zoo_context(mesh_pipe=4)
+    d = 8
+    layer = GPipe(lambda: Dense(d, activation="tanh"), num_stages=4)
+    p = layer.build(jax.random.key(0), (None, d))
+    x = np.random.default_rng(3).normal(size=(3, d)).astype(np.float32)
+    y = np.asarray(layer.call(p, jnp.asarray(x)))  # 3 % (2*4) != 0
+    h = x
+    for s in range(4):
+        h = np.tanh(h @ np.asarray(p["W"][s]) + np.asarray(p["b"][s]))
+    np.testing.assert_allclose(y, h, rtol=2e-4, atol=2e-5)
+
+
+def test_gpipe_bfloat16_policy():
+    """The scan carry must stay dtype-stable under a bf16 compute policy —
+    on both the pipelined and the sequential path (code-review regression)."""
+    from analytics_zoo_tpu.pipeline.api.keras.engine import set_policy
+    d = 8
+    x = np.random.default_rng(4).normal(size=(16, d)).astype(np.float32)
+    try:
+        set_policy(compute_dtype=jnp.bfloat16)
+        for pipe in (4, 1):
+            reset_zoo_context()
+            init_zoo_context(mesh_pipe=pipe)
+            layer = GPipe(lambda: Dense(d, activation="tanh"), num_stages=4)
+            p = layer.build(jax.random.key(0), (None, d))
+            y = layer.call(p, jnp.asarray(x))
+            assert y.dtype == jnp.bfloat16
+            assert np.all(np.isfinite(np.asarray(y, np.float32)))
+    finally:
+        set_policy()
+
+
+def test_gpipe_paramless_stage():
+    """Parameter-less shape-preserving stages (Dropout) must not crash the
+    stage-count inference (code-review regression)."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dropout
+    init_zoo_context(mesh_pipe=4)
+    layer = GPipe(lambda: Dropout(0.5), num_stages=4)
+    p = layer.build(jax.random.key(0), (None, 8))
+    x = np.random.default_rng(5).normal(size=(16, 8)).astype(np.float32)
+    # inference: dropout is identity
+    y = np.asarray(layer.call(p, jnp.asarray(x)))
+    np.testing.assert_allclose(y, x, rtol=1e-6)
+    # training: needs rng, draws per-(stage, microbatch) keys
+    yt = np.asarray(layer.call(p, jnp.asarray(x), training=True,
+                               rng=jax.random.key(1)))
+    assert (yt == 0.0).any(), "dropout never fired under the schedule"
+
+
+def test_gpipe_more_microbatches_than_stages():
+    """n_micro > S exercises the bubble-amortized schedule."""
+    init_zoo_context(mesh_pipe=4)
+    d = 8
+    layer = GPipe(lambda: Dense(d, activation="tanh"), num_stages=4,
+                  n_microbatches=8)
+    p = layer.build(jax.random.key(0), (None, d))
+    x = np.random.default_rng(2).normal(size=(32, d)).astype(np.float32)
+    y_pipe = np.asarray(layer.call(p, jnp.asarray(x)))
+    h = x
+    for s in range(4):
+        h = np.tanh(h @ np.asarray(p["W"][s]) + np.asarray(p["b"][s]))
+    np.testing.assert_allclose(y_pipe, h, rtol=2e-4, atol=2e-5)

@@ -48,9 +48,12 @@ def gpipe_apply(stage_fn: Callable, stacked_params, x, *, mesh,
     S = mesh.shape[mesh_lib.PIPE_AXIS]
     dp = mesh.shape[mesh_lib.DATA_AXIS]
     B = x.shape[0]
-    if B % dp != 0 or (B // dp) % n_micro != 0:
+    if B % dp != 0:
         raise ValueError(
-            f"per-shard batch {B}/{dp} not divisible by n_micro={n_micro}")
+            f"batch {B} not divisible by the data axis size {dp}")
+    if (B // dp) % n_micro != 0:
+        raise ValueError(
+            f"per-shard batch {B // dp} not divisible by n_micro={n_micro}")
 
     # one PartitionSpec prefix per argument: params split stage-wise over
     # pipe, batch split over data (replicated over pipe)

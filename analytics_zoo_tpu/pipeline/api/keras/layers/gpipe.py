@@ -44,6 +44,7 @@ class GPipe(Layer):
         self.num_stages = num_stages
         self.n_microbatches = n_microbatches
         self.stage = stage_factory()  # template instance: defines the math
+        self._warned_fallback = False
 
     def build(self, rng, input_shape):
         keys = jax.random.split(rng, self.num_stages)
@@ -93,4 +94,15 @@ class GPipe(Layer):
             if B % dp == 0 and (B // dp) % n_micro == 0:
                 return gpipe_apply(fn, params, x, mesh=mesh,
                                    n_micro=n_micro, rng=rng)
+            if B > dp and not self._warned_fallback:
+                # a real batch (not the B=1 probe / tiny tail) losing the
+                # pipeline is a silent S-times perf cliff — say so once
+                import logging
+                logging.getLogger("analytics_zoo_tpu.gpipe").warning(
+                    "%s: batch %d (per-shard %s) not divisible by "
+                    "n_microbatches=%d — running stages SEQUENTIALLY on the "
+                    "pipe=%d mesh; pick a divisible batch size to pipeline",
+                    self.name, B, B // dp if B % dp == 0 else f"{B}/{dp}",
+                    n_micro, S)
+                self._warned_fallback = True
         return sequential_apply(fn, params, x, self.num_stages, rng=rng)

@@ -286,3 +286,12 @@ class Net:
         workflow without a serialization detour)."""
         model = TorchNet.from_module(module, input_shape)
         return _install_pretrained(model)
+
+    @staticmethod
+    def load_tf(path: str, inputs=None, outputs=None, trainable: bool = True):
+        """A frozen TF GraphDef ``.pb`` (``Net.loadTF``,
+        ``Net.scala:123-171``) — executed as jitted JAX ops, no TF
+        runtime; see ``tfnet.py``."""
+        from .tfnet import load_tf
+        return load_tf(path, inputs=inputs, outputs=outputs,
+                       trainable=trainable)

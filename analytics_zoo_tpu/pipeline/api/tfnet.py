@@ -1,0 +1,594 @@
+"""TFNet — load a frozen TensorFlow ``GraphDef`` (.pb) and run it as a
+native JAX ``Layer``.
+
+Reference parity: ``pipeline/api/net/TFNet.scala:53-56`` wraps a frozen TF
+graph as a BigDL module via a libtensorflow JNI session (``TFNet.scala:
+158-162``); the Python side is ``pyzoo/zoo/pipeline/api/net/tfnet.py:51``.
+Here there is no TF runtime at all (SURVEY §2.3: "graphs become
+jit-compiled JAX fns"): the GraphDef protobuf is parsed with the in-repo
+wire codec (``utils/proto.py``) and each node maps to a jnp op, so the
+whole graph jits, fuses, shards, and — because float Const weights become
+layer params — fine-tunes under the standard train step, which the
+reference's frozen ``TFNet`` cannot do unless the graph ships gradient ops
+(``TFNet.scala:72-77``).
+
+Supported op set mirrors what the reference's TFNet examples feed it
+(frozen classifier/backbone graphs): MatMul/Conv2D/DepthwiseConv2d +
+BiasAdd, FusedBatchNorm(V3) (inference form), pooling, the elementwise/
+activation family, reduce/shape ops, ConcatV2/Pack/Transpose/Pad/Gather,
+Cast/ArgMax. Unsupported ops fail at load time with the op name.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.proto import parse_fields, parse_varint
+from .keras.engine import Layer
+
+__all__ = ["TFNet", "load_tf"]
+
+# tensorflow DataType enum → numpy (DT_BFLOAT16=14 widens to f32 on the
+# host via an explicit bit-pattern conversion in _decode_tensor)
+_DTYPES = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
+           5: np.int16, 6: np.int8, 9: np.int64, 10: np.bool_,
+           14: np.float32, 19: np.float16}
+
+
+def _signed(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _as_int(payload: bytes) -> int:
+    v, _ = parse_varint(payload, 0)
+    return v
+
+
+def _packed_ints(payload: bytes, wt: int) -> List[int]:
+    if wt == 2:
+        out, i = [], 0
+        while i < len(payload):
+            v, i = parse_varint(payload, i)
+            out.append(_signed(v))
+        return out
+    return [_signed(_as_int(payload))]
+
+
+# ---------------------------------------------------------------------------
+# GraphDef decoding (tensorflow/core/framework/{graph,node_def,attr_value,
+# tensor,tensor_shape}.proto subset)
+# ---------------------------------------------------------------------------
+
+def _decode_shape(buf: bytes) -> List[int]:
+    dims: List[int] = []
+    for num, wt, payload in parse_fields(buf):
+        if num == 2:  # Dim
+            size = -1
+            for n2, _, p2 in parse_fields(payload):
+                if n2 == 1:
+                    size = _signed(_as_int(p2))
+            dims.append(size)
+    return dims
+
+
+def _bits_to_float(vals: List[int], code: int) -> np.ndarray:
+    """half_val holds raw bit patterns for DT_HALF and DT_BFLOAT16."""
+    u16 = np.asarray(vals, np.uint16)
+    if code == 14:  # bfloat16: bits are the top half of a float32
+        return (u16.astype(np.uint32) << 16).view(np.float32)
+    return u16.view(np.float16).astype(np.float32)
+
+
+def _decode_tensor(buf: bytes) -> np.ndarray:
+    # field numbers per tensorflow/core/framework/tensor.proto:
+    # dtype=1 shape=2 tensor_content=4 half_val=13 float_val=5
+    # double_val=6 int_val=7 string_val=8 int64_val=10 bool_val=11
+    code = 1
+    shape: List[int] = []
+    content: Optional[bytes] = None
+    floats: List[float] = []
+    ints: List[int] = []
+    doubles: List[float] = []
+    bools: List[bool] = []
+    halves: List[int] = []
+    for num, wt, payload in parse_fields(buf):
+        if num == 1:
+            code = _as_int(payload)
+            if code not in _DTYPES:
+                raise NotImplementedError(f"TensorProto dtype {code}")
+        elif num == 2:
+            shape = _decode_shape(payload)
+        elif num == 4:
+            content = payload
+        elif num == 5:               # float_val
+            if wt == 2:
+                floats.extend(struct.unpack(f"<{len(payload) // 4}f", payload))
+            else:
+                floats.append(struct.unpack("<f", payload)[0])
+        elif num == 6:               # double_val
+            if wt == 2:
+                doubles.extend(struct.unpack(f"<{len(payload) // 8}d", payload))
+            else:
+                doubles.append(struct.unpack("<d", payload)[0])
+        elif num in (7, 10):         # int_val / int64_val
+            ints.extend(_packed_ints(payload, wt))
+        elif num == 11:              # bool_val
+            bools.extend(bool(v) for v in _packed_ints(payload, wt))
+        elif num == 13:              # half_val (f16/bf16 bit patterns)
+            halves.extend(_packed_ints(payload, wt))
+    dtype = _DTYPES[code]
+    n = int(np.prod(shape)) if shape else 1
+    if content is not None:
+        if code == 14:
+            u16 = np.frombuffer(content, dtype=np.uint16)
+            arr = (u16.astype(np.uint32) << 16).view(np.float32).copy()
+        else:
+            arr = np.frombuffer(content, dtype=dtype).copy()
+    elif halves:
+        arr = _bits_to_float(halves, code).astype(dtype)
+    elif floats:
+        arr = np.asarray(floats, dtype)
+    elif doubles:
+        arr = np.asarray(doubles, dtype)
+    elif ints:
+        arr = np.asarray(ints, dtype)
+    elif bools:
+        arr = np.asarray(bools, dtype)
+    else:
+        arr = np.zeros(0, dtype)
+    if arr.size == 1 and n > 1:      # TF scalar-splat encoding
+        arr = np.full(n, arr.reshape(-1)[0], dtype)
+    return arr.reshape(shape)
+
+
+def _decode_attr(buf: bytes) -> Any:
+    """AttrValue → python value (s/i/f/b/type/shape/tensor/list)."""
+    for num, wt, payload in parse_fields(buf):
+        if num == 2:
+            return payload.decode("utf-8", "replace")
+        if num == 3:
+            return _signed(_as_int(payload))
+        if num == 4:
+            return struct.unpack("<f", payload[:4])[0]
+        if num == 5:
+            return bool(_as_int(payload))
+        if num == 6:
+            return ("dtype", _as_int(payload))
+        if num == 7:
+            return _decode_shape(payload)
+        if num == 8:
+            return _decode_tensor(payload)
+        if num == 1:  # ListValue
+            ints: List[int] = []
+            strs: List[str] = []
+            floats: List[float] = []
+            for n2, wt2, p2 in parse_fields(payload):
+                if n2 == 2:
+                    strs.append(p2.decode("utf-8", "replace"))
+                elif n2 in (3, 6):
+                    ints.extend(_packed_ints(p2, wt2))
+                elif n2 == 4:
+                    if wt2 == 2:
+                        floats.extend(
+                            struct.unpack(f"<{len(p2) // 4}f", p2))
+                    else:
+                        floats.append(struct.unpack("<f", p2)[0])
+            return strs or floats or ints
+    return None
+
+
+def _decode_node(buf: bytes) -> Dict[str, Any]:
+    node = {"name": "", "op": "", "inputs": [], "attrs": {}}
+    for num, wt, payload in parse_fields(buf):
+        if num == 1:
+            node["name"] = payload.decode("utf-8")
+        elif num == 2:
+            node["op"] = payload.decode("utf-8")
+        elif num == 3:
+            node["inputs"].append(payload.decode("utf-8"))
+        elif num == 5:  # attr map entry
+            key, val = "", None
+            for n2, _, p2 in parse_fields(payload):
+                if n2 == 1:
+                    key = p2.decode("utf-8")
+                elif n2 == 2:
+                    val = _decode_attr(p2)
+            node["attrs"][key] = val
+    return node
+
+
+def _decode_graph(buf: bytes) -> List[Dict[str, Any]]:
+    nodes = []
+    for num, wt, payload in parse_fields(buf):
+        if num == 1:
+            nodes.append(_decode_node(payload))
+    return nodes
+
+
+# ---------------------------------------------------------------------------
+# op semantics
+# ---------------------------------------------------------------------------
+
+def _same_pad(in_size: int, k: int, s: int) -> Tuple[int, int]:
+    out = -(-in_size // s)
+    pad = max(0, (out - 1) * s + k - in_size)
+    return pad // 2, pad - pad // 2
+
+
+def _conv_pads(x, kh, kw, sh, sw, padding):
+    if padding == "VALID":
+        return ((0, 0), (0, 0))
+    return (_same_pad(x.shape[1], kh, sh), _same_pad(x.shape[2], kw, sw))
+
+
+def _conv2d(x, w, attrs, *, depthwise=False):
+    sh, sw = attrs.get("strides", [1, 1, 1, 1])[1:3]
+    dil = attrs.get("dilations", [1, 1, 1, 1])[1:3]
+    if attrs.get("data_format", "NHWC") != "NHWC":
+        raise NotImplementedError("only NHWC Conv2D is supported")
+    pads = _conv_pads(x, w.shape[0] * dil[0] - dil[0] + 1,
+                      w.shape[1] * dil[1] - dil[1] + 1, sh, sw,
+                      attrs.get("padding", "SAME"))
+    groups = w.shape[2] if depthwise else 1
+    if depthwise:
+        # HWCM -> HWC(M) with feature_group_count=C
+        w = w.reshape(w.shape[0], w.shape[1], 1, -1)
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(sh, sw), padding=pads,
+        rhs_dilation=tuple(dil), feature_group_count=groups,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _pool2d(x, attrs, op):
+    kh, kw = attrs.get("ksize", [1, 2, 2, 1])[1:3]
+    sh, sw = attrs.get("strides", [1, 2, 2, 1])[1:3]
+    pads = ((0, 0),) + _conv_pads(x, kh, kw, sh, sw,
+                                  attrs.get("padding", "VALID")) + ((0, 0),)
+    if op == "MaxPool":
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, kh, kw, 1), (1, sh, sw, 1), pads)
+    s = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, kh, kw, 1), (1, sh, sw, 1), pads)
+    ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+    cnt = jax.lax.reduce_window(
+        ones, 0.0, jax.lax.add, (1, kh, kw, 1), (1, sh, sw, 1), pads)
+    return s / cnt
+
+
+def _fused_bn(xs, attrs):
+    x, scale, offset, mean, var = xs
+    eps = attrs.get("epsilon", 1e-3) or 1e-3
+    if attrs.get("is_training", False):
+        raise NotImplementedError(
+            "FusedBatchNorm with is_training=True (frozen graphs only)")
+    inv = jax.lax.rsqrt(var + eps) * scale
+    return x * inv + (offset - mean * inv)
+
+
+_ELEMENTWISE = {
+    "Add": jnp.add, "AddV2": jnp.add, "Sub": jnp.subtract,
+    "Mul": jnp.multiply, "RealDiv": jnp.divide, "Div": jnp.divide,
+    "Maximum": jnp.maximum, "Minimum": jnp.minimum, "Pow": jnp.power,
+    "SquaredDifference": lambda a, b: jnp.square(a - b),
+    "FloorDiv": jnp.floor_divide, "Mod": jnp.mod, "FloorMod": jnp.mod,
+    "Greater": jnp.greater, "GreaterEqual": jnp.greater_equal,
+    "Less": jnp.less, "LessEqual": jnp.less_equal, "Equal": jnp.equal,
+    "LogicalAnd": jnp.logical_and, "LogicalOr": jnp.logical_or,
+}
+
+_UNARY = {
+    "Relu": jax.nn.relu, "Relu6": jax.nn.relu6, "Elu": jax.nn.elu,
+    "Selu": jax.nn.selu, "Softplus": jax.nn.softplus,
+    "Softsign": jax.nn.soft_sign, "Sigmoid": jax.nn.sigmoid,
+    "Tanh": jnp.tanh, "Exp": jnp.exp, "Log": jnp.log, "Neg": jnp.negative,
+    "Abs": jnp.abs, "Square": jnp.square, "Sqrt": jnp.sqrt,
+    "Rsqrt": jax.lax.rsqrt, "Erf": jax.scipy.special.erf,
+    "Floor": jnp.floor, "Ceil": jnp.ceil, "Round": jnp.round,
+    "Identity": lambda x: x, "StopGradient": jax.lax.stop_gradient,
+    "Reciprocal": jnp.reciprocal, "LogicalNot": jnp.logical_not,
+}
+
+_REDUCE = {"Mean": jnp.mean, "Sum": jnp.sum, "Max": jnp.max, "Min": jnp.min,
+           "Prod": jnp.prod, "All": jnp.all, "Any": jnp.any}
+
+# (op, input position) pairs whose values must stay host constants
+_STRUCTURAL = {("Reshape", 1), ("ConcatV2", -1), ("Transpose", 1),
+               ("Pad", 1), ("PadV2", 1), ("ExpandDims", 1), ("Mean", 1),
+               ("Sum", 1), ("Max", 1), ("Min", 1), ("Prod", 1), ("All", 1),
+               ("Any", 1), ("ArgMax", 1), ("GatherV2", 2), ("Split", 0),
+               ("Tile", 1), ("Fill", 0), ("StridedSlice", 1),
+               ("StridedSlice", 2), ("StridedSlice", 3)}
+
+
+def _static(v, what):
+    if isinstance(v, jnp.ndarray):
+        raise NotImplementedError(
+            f"{what} must be a graph constant, found a traced tensor")
+    return np.asarray(v)
+
+
+def _static_scalar(v, what) -> int:
+    return int(_static(v, what).reshape(-1)[0])
+
+
+def _run_node(node, vals):
+    op = node["op"]
+    attrs = node["attrs"]
+    names = [n for n in node["inputs"] if not n.startswith("^")]
+    xs = [vals[n] for n in names]  # producers register both name and name:0
+
+    if op in _UNARY:
+        out = _UNARY[op](xs[0])
+    elif op in _ELEMENTWISE:
+        out = _ELEMENTWISE[op](xs[0], xs[1])
+    elif op == "AddN":
+        out = xs[0]
+        for a in xs[1:]:
+            out = out + a
+    elif op == "LeakyRelu":
+        out = jax.nn.leaky_relu(xs[0], attrs.get("alpha", 0.2))
+    elif op == "Softmax":
+        out = jax.nn.softmax(xs[0], axis=-1)
+    elif op == "LogSoftmax":
+        out = jax.nn.log_softmax(xs[0], axis=-1)
+    elif op == "MatMul":
+        a = xs[0].T if attrs.get("transpose_a") else xs[0]
+        b = xs[1].T if attrs.get("transpose_b") else xs[1]
+        out = jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(
+            jnp.result_type(xs[0]))
+    elif op == "BatchMatMulV2" or op == "BatchMatMul":
+        a = jnp.swapaxes(xs[0], -1, -2) if attrs.get("adj_x") else xs[0]
+        b = jnp.swapaxes(xs[1], -1, -2) if attrs.get("adj_y") else xs[1]
+        out = jnp.matmul(a, b)
+    elif op == "BiasAdd":
+        if attrs.get("data_format", "NHWC") == "NCHW" and xs[0].ndim == 4:
+            out = xs[0] + xs[1].reshape(1, -1, 1, 1)
+        else:
+            out = xs[0] + xs[1]
+    elif op == "Conv2D":
+        out = _conv2d(xs[0], xs[1], attrs)
+    elif op == "DepthwiseConv2dNative":
+        out = _conv2d(xs[0], xs[1], attrs, depthwise=True)
+    elif op in ("MaxPool", "AvgPool"):
+        out = _pool2d(xs[0], attrs, op)
+    elif op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+        out = _fused_bn(xs, attrs)
+    elif op in _REDUCE:
+        axes = tuple(int(a) for a in
+                     np.atleast_1d(_static(xs[1], f"{op} axes")))
+        out = _REDUCE[op](xs[0], axis=axes or None,
+                          keepdims=bool(attrs.get("keep_dims", False)))
+    elif op == "Reshape":
+        out = jnp.reshape(
+            xs[0], tuple(int(d) for d in _static(xs[1], "Reshape shape")))
+    elif op == "Squeeze":
+        dims = attrs.get("squeeze_dims") or None
+        out = jnp.squeeze(xs[0], axis=tuple(dims) if dims else None)
+    elif op == "ExpandDims":
+        out = jnp.expand_dims(
+            xs[0], _static_scalar(xs[1], "ExpandDims axis"))
+    elif op == "ConcatV2":
+        axis = _static_scalar(xs[-1], "ConcatV2 axis")
+        out = jnp.concatenate(xs[:-1], axis=axis)
+    elif op == "Pack":
+        out = jnp.stack(xs, axis=attrs.get("axis", 0))
+    elif op == "Transpose":
+        out = jnp.transpose(
+            xs[0], tuple(int(p) for p in _static(xs[1], "Transpose perm")))
+    elif op in ("Pad", "PadV2"):
+        pads = [tuple(int(v) for v in row)
+                for row in _static(xs[1], "Pad paddings")]
+        cv = float(np.asarray(xs[2]).reshape(-1)[0]) if len(xs) > 2 else 0.0
+        out = jnp.pad(xs[0], pads, constant_values=cv)
+    elif op == "GatherV2" or op == "Gather":
+        axis = (_static_scalar(xs[2], "Gather axis")
+                if len(xs) > 2 else 0)
+        out = jnp.take(xs[0], jnp.asarray(xs[1]).astype(jnp.int32),
+                       axis=axis)
+    elif op == "Tile":
+        out = jnp.tile(
+            xs[0], tuple(int(v) for v in _static(xs[1], "Tile multiples")))
+    elif op == "Cast":
+        code = attrs.get("DstT")
+        code = code[1] if isinstance(code, tuple) else code
+        out = xs[0].astype(_DTYPES[code])
+    elif op == "ArgMax":
+        out = jnp.argmax(
+            xs[0], axis=_static_scalar(xs[1], "ArgMax axis")).astype(jnp.int64)
+    elif op == "Shape":
+        out = np.asarray(xs[0].shape, np.int32)
+    elif op == "Rank":
+        out = np.asarray(np.ndim(xs[0]), np.int32)
+    elif op == "StridedSlice":
+        out = _strided_slice(xs, attrs)
+    elif op == "Fill":
+        out = jnp.full(tuple(int(d) for d in _static(xs[0], "Fill dims")),
+                       xs[1])
+    else:
+        raise NotImplementedError(f"TF op {op!r} (node {node['name']!r})")
+    vals[node["name"]] = out
+    vals[node["name"] + ":0"] = out
+
+
+def _strided_slice(xs, attrs):
+    x = xs[0]
+    begin = _static(xs[1], "StridedSlice begin").astype(int)
+    end = _static(xs[2], "StridedSlice end").astype(int)
+    strides = (_static(xs[3], "StridedSlice strides").astype(int)
+               if len(xs) > 3 else np.ones_like(begin))
+    bm = attrs.get("begin_mask", 0)
+    em = attrs.get("end_mask", 0)
+    sm = attrs.get("shrink_axis_mask", 0)
+    if attrs.get("new_axis_mask", 0) or attrs.get("ellipsis_mask", 0):
+        raise NotImplementedError("StridedSlice new_axis/ellipsis masks")
+    idx = []
+    for i in range(len(begin)):
+        if sm & (1 << i):
+            idx.append(int(begin[i]))
+            continue
+        b = None if bm & (1 << i) else int(begin[i])
+        e = None if em & (1 << i) else int(end[i])
+        idx.append(slice(b, e, int(strides[i])))
+    return x[tuple(idx)]
+
+
+# ---------------------------------------------------------------------------
+# the Layer
+# ---------------------------------------------------------------------------
+
+class TFNet(Layer):
+    """A frozen TF graph as a Layer.
+
+    Float Const tensors of rank >= 1 become trainable params (pass
+    ``trainable=False`` to pin them as host constants, matching the frozen
+    semantics of the reference's TFNet); everything else (shapes, axes,
+    perms, scalars) stays a host constant so structural ops see static
+    values under jit.
+    """
+
+    def __init__(self, nodes: List[Dict[str, Any]],
+                 inputs: Optional[List[str]] = None,
+                 outputs: Optional[List[str]] = None,
+                 trainable: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        known = {n["name"] for n in nodes}
+        for node in nodes:
+            for raw in node["inputs"]:
+                base, _, port = raw.lstrip("^").partition(":")
+                if base not in known:
+                    raise ValueError(
+                        f"node {node['name']!r} consumes unknown tensor "
+                        f"{raw!r}")
+                if port not in ("", "0"):
+                    raise NotImplementedError(
+                        f"node {node['name']!r} consumes secondary output "
+                        f"{raw!r}; only :0 outputs are computed")
+        self.nodes = [n for n in nodes if n["op"] not in ("NoOp",)]
+        placeholders = [n["name"] for n in self.nodes
+                        if n["op"] in ("Placeholder", "PlaceholderWithDefault")]
+        self.feed_names = inputs or placeholders
+        if outputs:
+            self.output_names = outputs
+        else:
+            consumed = set()
+            for n in self.nodes:
+                consumed.update(i.lstrip("^").split(":")[0]
+                                for i in n["inputs"])
+            self.output_names = [n["name"] for n in self.nodes
+                                 if n["name"] not in consumed
+                                 and n["op"] != "Const"] or \
+                [self.nodes[-1]["name"]]
+
+        structural = set()
+        for n in self.nodes:
+            names = [i for i in n["inputs"] if not i.startswith("^")]
+            for pos, raw in enumerate(names):
+                key = (n["op"], pos)
+                last = (n["op"], -1)
+                if key in _STRUCTURAL or (last in _STRUCTURAL
+                                          and pos == len(names) - 1):
+                    structural.add(raw.split(":")[0])
+
+        self.consts: Dict[str, np.ndarray] = {}
+        weights: Dict[str, np.ndarray] = {}
+        for n in self.nodes:
+            if n["op"] != "Const":
+                continue
+            arr = n["attrs"].get("value")
+            if arr is None:
+                raise ValueError(f"Const node {n['name']!r} has no value")
+            arr = np.asarray(arr)
+            if (trainable and arr.ndim >= 1 and n["name"] not in structural
+                    and np.issubdtype(arr.dtype, np.floating)):
+                weights[n["name"]] = arr
+            else:
+                self.consts[n["name"]] = arr
+        self._weights: Optional[Dict[str, np.ndarray]] = weights
+        self._built_params: Optional[Dict[str, Any]] = None
+        exec_nodes = [n for n in self.nodes
+                      if n["op"] not in ("Const", "Placeholder",
+                                         "PlaceholderWithDefault")]
+        self._exec_nodes = self._topo_sort(exec_nodes)
+        # fail at load, not mid-trace: dry-check op coverage
+        for n in self._exec_nodes:
+            if (n["op"] not in _UNARY and n["op"] not in _ELEMENTWISE
+                    and n["op"] not in _REDUCE
+                    and n["op"] not in (
+                        "AddN", "LeakyRelu", "Softmax", "LogSoftmax",
+                        "MatMul", "BatchMatMul", "BatchMatMulV2", "BiasAdd",
+                        "Conv2D", "DepthwiseConv2dNative", "MaxPool",
+                        "AvgPool", "FusedBatchNorm", "FusedBatchNormV2",
+                        "FusedBatchNormV3", "Reshape", "Squeeze",
+                        "ExpandDims", "ConcatV2", "Pack", "Transpose",
+                        "Pad", "PadV2", "GatherV2", "Gather", "Tile",
+                        "Cast", "ArgMax", "Shape", "Rank", "StridedSlice",
+                        "Fill")):
+                raise NotImplementedError(
+                    f"TF op {n['op']!r} (node {n['name']!r})")
+
+    @staticmethod
+    def _topo_sort(nodes):
+        """GraphDef does NOT guarantee topological node order (ONNX does);
+        Kahn-sort so call() never reads a value before its producer ran.
+        File order is kept among ready nodes (stable/deterministic)."""
+        exec_names = {n["name"] for n in nodes}
+        deps = {n["name"]: {raw.lstrip("^").split(":")[0]
+                            for raw in n["inputs"]} & exec_names
+                for n in nodes}
+        ordered, placed = [], set()
+        pending = list(nodes)
+        while pending:
+            ready = [n for n in pending if deps[n["name"]] <= placed]
+            if not ready:
+                cyc = sorted(n["name"] for n in pending)[:5]
+                raise ValueError(f"GraphDef has a dependency cycle near "
+                                 f"{cyc}")
+            for n in ready:
+                ordered.append(n)
+                placed.add(n["name"])
+            pending = [n for n in pending if n["name"] not in placed]
+        return ordered
+
+    def build(self, rng, input_shape=None):
+        if self._built_params is None:
+            self._built_params = {n: jnp.asarray(a)
+                                  for n, a in self._weights.items()}
+            self._weights = None
+        return self._built_params
+
+    def call(self, params, x, *, training=False, rng=None):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        if len(xs) != len(self.feed_names):
+            raise ValueError(f"expected {len(self.feed_names)} inputs "
+                             f"({self.feed_names}), got {len(xs)}")
+        vals: Dict[str, Any] = {}
+        for name, arr in self.consts.items():
+            vals[name] = arr
+            vals[name + ":0"] = arr
+        for name, arr in params.items():
+            vals[name] = arr
+            vals[name + ":0"] = arr
+        for name, arr in zip(self.feed_names, xs):
+            vals[name] = arr
+            vals[name + ":0"] = arr
+        for node in self._exec_nodes:
+            _run_node(node, vals)
+        outs = [vals[n] for n in self.output_names]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def load_tf(path: str, inputs: Optional[List[str]] = None,
+            outputs: Optional[List[str]] = None,
+            trainable: bool = True) -> TFNet:
+    """Load a frozen GraphDef ``.pb`` — ``Net.loadTF`` /
+    ``TFNet(path)`` parity (``pipeline/api/Net.scala:123-171``)."""
+    with open(path, "rb") as f:
+        nodes = _decode_graph(f.read())
+    if not nodes:
+        raise ValueError(f"{path}: no nodes decoded — not a GraphDef?")
+    return TFNet(nodes, inputs=inputs, outputs=outputs, trainable=trainable)

@@ -1,0 +1,383 @@
+"""TFNet (frozen GraphDef importer) vs torch oracle: fixture ``.pb`` files
+are hand-encoded GraphDefs (the env has no tensorflow — the importer itself
+is the point, mirroring how test_onnx.py hand-encodes ModelProtos), weights
+come from real torch modules and torch's forward is the numerical oracle.
+Reference parity: ``pipeline/api/net/TFNet.scala:53-56``, ``Net.scala:123``.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.net import Net
+from analytics_zoo_tpu.pipeline.api.tfnet import TFNet, load_tf
+from analytics_zoo_tpu.utils.proto import field_bytes, field_varint, varint
+
+
+# ---------------------------------------------------------------------------
+# minimal GraphDef encoder (test fixture generator)
+# ---------------------------------------------------------------------------
+
+_TF_DT = {np.dtype(np.float32): 1, np.dtype(np.int32): 3,
+          np.dtype(np.int64): 9, np.dtype(np.bool_): 10}
+
+
+def _shape_proto(shape):
+    buf = b""
+    for d in shape:
+        buf += field_bytes(2, field_varint(1, d))
+    return buf
+
+
+def _tensor_proto(arr):
+    arr = np.ascontiguousarray(arr)
+    buf = field_varint(1, _TF_DT[arr.dtype])
+    buf += field_bytes(2, _shape_proto(arr.shape))
+    buf += field_bytes(4, arr.tobytes())
+    return buf
+
+
+def _attr(key, payload):
+    return field_bytes(5, field_bytes(1, key.encode()) +
+                       field_bytes(2, payload))
+
+
+def attr_tensor(key, arr):
+    return _attr(key, field_bytes(8, _tensor_proto(arr)))
+
+
+def attr_s(key, s):
+    return _attr(key, field_bytes(2, s.encode()))
+
+
+def attr_i(key, v):
+    return _attr(key, field_varint(3, v))
+
+
+def attr_f(key, v):
+    return _attr(key, varint((4 << 3) | 5) + struct.pack("<f", v))
+
+
+def attr_b(key, v):
+    return _attr(key, field_varint(5, int(v)))
+
+
+def attr_ints(key, vs):
+    packed = b"".join(varint(v) for v in vs)
+    return _attr(key, field_bytes(1, field_bytes(3, packed)))
+
+
+def node(name, op, inputs=(), *attrs):
+    buf = field_bytes(1, name.encode()) + field_bytes(2, op.encode())
+    for i in inputs:
+        buf += field_bytes(3, i.encode())
+    for a in attrs:
+        buf += a
+    return field_bytes(1, buf)
+
+
+def write_graph(path, *nodes):
+    with open(path, "wb") as f:
+        f.write(b"".join(nodes))
+    return str(path)
+
+
+def const(name, arr):
+    return node(name, "Const", (), attr_tensor("value", np.asarray(arr)))
+
+
+def _np(t):
+    return t.detach().numpy()
+
+
+# ---------------------------------------------------------------------------
+# tests
+# ---------------------------------------------------------------------------
+
+def test_mlp_matches_torch(tmp_path):
+    init_zoo_context()
+    tm = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = np.random.default_rng(0).normal(size=(5, 6)).astype(np.float32)
+    want = torch.softmax(tm(torch.from_numpy(x)), dim=-1).detach().numpy()
+
+    pb = write_graph(
+        tmp_path / "mlp.pb",
+        node("input", "Placeholder"),
+        const("w1", _np(tm[0].weight).T),
+        const("b1", _np(tm[0].bias)),
+        const("w2", _np(tm[2].weight).T),
+        const("b2", _np(tm[2].bias)),
+        node("mm1", "MatMul", ("input", "w1")),
+        node("h1", "BiasAdd", ("mm1", "b1")),
+        node("r1", "Relu", ("h1",)),
+        node("mm2", "MatMul", ("r1", "w2")),
+        node("h2", "BiasAdd", ("mm2", "b2")),
+        node("probs", "Softmax", ("h2",)),
+    )
+    net = Net.load_tf(pb)
+    assert net.feed_names == ["input"]
+    assert net.output_names == ["probs"]
+    p = net.build(None)
+    y = np.asarray(net.call(p, x))
+    np.testing.assert_allclose(y, want, rtol=2e-4, atol=2e-5)
+
+
+def test_cnn_matches_torch(tmp_path):
+    """Conv2D(SAME) + bias + relu + maxpool + mean-GAP + matmul vs torch."""
+    init_zoo_context()
+    conv = nn.Conv2d(3, 8, 3, stride=1, padding=1)
+    fc = nn.Linear(8, 5)
+    x = np.random.default_rng(1).normal(size=(2, 9, 9, 3)).astype(np.float32)
+
+    xt = torch.from_numpy(x.transpose(0, 3, 1, 2))
+    ht = torch.relu(conv(xt))
+    ht = torch.max_pool2d(ht, 2, 2)
+    ht = ht.mean(dim=(2, 3))
+    want = fc(ht).detach().numpy()
+
+    pb = write_graph(
+        tmp_path / "cnn.pb",
+        node("input", "Placeholder"),
+        # torch OIHW -> TF HWIO
+        const("k", _np(conv.weight).transpose(2, 3, 1, 0)),
+        const("kb", _np(conv.bias)),
+        const("axes", np.asarray([1, 2], np.int32)),
+        const("fw", _np(fc.weight).T),
+        const("fb", _np(fc.bias)),
+        node("c1", "Conv2D", ("input", "k"),
+             attr_ints("strides", [1, 1, 1, 1]), attr_s("padding", "SAME"),
+             attr_s("data_format", "NHWC")),
+        node("cb", "BiasAdd", ("c1", "kb")),
+        node("r", "Relu", ("cb",)),
+        node("p", "MaxPool", ("r",),
+             attr_ints("ksize", [1, 2, 2, 1]),
+             attr_ints("strides", [1, 2, 2, 1]), attr_s("padding", "VALID")),
+        node("gap", "Mean", ("p", "axes")),
+        node("mm", "MatMul", ("gap", "fw")),
+        node("out", "BiasAdd", ("mm", "fb")),
+    )
+    net = load_tf(pb)
+    y = np.asarray(net.call(net.build(None), x))
+    np.testing.assert_allclose(y, want, rtol=5e-4, atol=5e-4)
+
+
+def test_fused_batchnorm_matches_torch(tmp_path):
+    init_zoo_context()
+    bn = nn.BatchNorm2d(4)
+    bn.eval()
+    with torch.no_grad():
+        bn.weight.uniform_(0.5, 1.5)
+        bn.bias.uniform_(-0.5, 0.5)
+        bn.running_mean.uniform_(-1, 1)
+        bn.running_var.uniform_(0.5, 2.0)
+    x = np.random.default_rng(2).normal(size=(2, 5, 5, 4)).astype(np.float32)
+    want = bn(torch.from_numpy(x.transpose(0, 3, 1, 2))).detach() \
+        .numpy().transpose(0, 2, 3, 1)
+
+    pb = write_graph(
+        tmp_path / "bn.pb",
+        node("input", "Placeholder"),
+        const("scale", _np(bn.weight)),
+        const("offset", _np(bn.bias)),
+        const("mean", _np(bn.running_mean)),
+        const("var", _np(bn.running_var)),
+        node("y", "FusedBatchNormV3",
+             ("input", "scale", "offset", "mean", "var"),
+             attr_f("epsilon", bn.eps), attr_b("is_training", False)),
+    )
+    net = load_tf(pb)
+    y = np.asarray(net.call(net.build(None), x))
+    np.testing.assert_allclose(y, want, rtol=2e-4, atol=2e-4)
+
+
+def test_shape_ops_and_structural_consts(tmp_path):
+    """Reshape/ConcatV2/Transpose/StridedSlice with graph-const shapes;
+    int consts must stay host constants, not params."""
+    init_zoo_context()
+    x = np.arange(24, dtype=np.float32).reshape(2, 12)
+    pb = write_graph(
+        tmp_path / "shapes.pb",
+        node("input", "Placeholder"),
+        const("shp", np.asarray([2, 3, 4], np.int32)),
+        const("perm", np.asarray([0, 2, 1], np.int32)),
+        const("b0", np.asarray([0, 0, 0], np.int32)),
+        const("e0", np.asarray([2, 2, 3], np.int32)),
+        const("s0", np.asarray([1, 1, 1], np.int32)),
+        const("cax", np.asarray(2, np.int32)),
+        node("r", "Reshape", ("input", "shp")),
+        node("t", "Transpose", ("r", "perm")),          # (2,4,3)
+        node("sl", "StridedSlice", ("t", "b0", "e0", "s0"),
+             attr_i("begin_mask", 0), attr_i("end_mask", 0),
+             attr_i("shrink_axis_mask", 0)),            # (2,2,3)
+        node("c", "ConcatV2", ("sl", "sl", "cax")),     # (2,2,6)
+    )
+    net = load_tf(pb)
+    p = net.build(None)
+    assert p == {}, f"structural int consts leaked into params: {list(p)}"
+    y = np.asarray(net.call(p, x))
+    ref = x.reshape(2, 3, 4).transpose(0, 2, 1)[:2, :2, :3]
+    np.testing.assert_array_equal(y, np.concatenate([ref, ref], axis=2))
+
+
+def test_tfnet_finetunes_under_fit(tmp_path):
+    """The headline divergence from the reference: an imported frozen graph
+    is trainable — float weights are params under the jitted train step."""
+    import optax
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+
+    init_zoo_context()
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(6, 4)).astype(np.float32) * 0.1
+    pb = write_graph(
+        tmp_path / "lin.pb",
+        node("input", "Placeholder"),
+        const("w", w),
+        node("mm", "MatMul", ("input", "w")),
+        node("probs", "Softmax", ("mm",)),
+    )
+    net = load_tf(pb)
+    x = rng.normal(size=(256, 6)).astype(np.float32)
+    y = np.argmax(x @ rng.normal(size=(6, 4)).astype(np.float32), 1) \
+        .astype(np.int32)
+    m = Sequential([net], name="tf_import")
+    m.compile(optimizer=optax.adam(0.05), loss="scce")
+    h = m.fit(x, y, batch_size=64, nb_epoch=8)
+    assert h["loss"][-1] < h["loss"][0] * 0.7, h["loss"]
+    moved = np.asarray(m.params[net.name]["w"])
+    assert not np.allclose(moved, w), "imported weight never trained"
+
+
+def test_tfnet_frozen_mode(tmp_path):
+    pb = write_graph(
+        tmp_path / "lin2.pb",
+        node("input", "Placeholder"),
+        const("w", np.eye(4, dtype=np.float32)),
+        node("mm", "MatMul", ("input", "w")),
+    )
+    net = load_tf(pb, trainable=False)
+    assert net.build(None) == {}
+    assert "w" in net.consts
+
+
+def test_tfnet_rejects_unknown_op(tmp_path):
+    pb = write_graph(
+        tmp_path / "bad.pb",
+        node("input", "Placeholder"),
+        node("q", "SparseTensorDenseMatMul", ("input", "input")),
+    )
+    with pytest.raises(NotImplementedError, match="SparseTensorDenseMatMul"):
+        load_tf(pb)
+
+
+def test_tfnet_rejects_secondary_outputs(tmp_path):
+    pb = write_graph(
+        tmp_path / "mo.pb",
+        node("input", "Placeholder"),
+        node("bn", "FusedBatchNormV3",
+             ("input", "input", "input", "input", "input")),
+        node("use", "Relu", ("bn:1",)),
+    )
+    with pytest.raises(NotImplementedError, match="secondary"):
+        load_tf(pb)
+
+
+def _tensor_proto_typed(arr, field, pack):
+    """TensorProto using a typed value field instead of tensor_content."""
+    arr = np.ascontiguousarray(arr)
+    buf = field_varint(1, _TF_DT.get(arr.dtype, 1))
+    buf += field_bytes(2, _shape_proto(arr.shape))
+    buf += pack(field, arr)
+    return buf
+
+
+def test_typed_value_fields_decode(tmp_path):
+    """Const tensors stored in float_val(5)/int_val(7)/int64_val(10) —
+    TF's default for small tensors — not tensor_content (code-review
+    regression: the field numbers were transposed)."""
+    from analytics_zoo_tpu.pipeline.api.tfnet import _decode_tensor
+
+    # float_val: packed 4-byte floats in field 5
+    f = np.asarray([1.5, -2.25, 3.0], np.float32)
+    buf = _tensor_proto_typed(
+        f, 5, lambda n, a: field_bytes(n, a.tobytes()))
+    np.testing.assert_array_equal(_decode_tensor(buf), f)
+
+    # int_val: packed varints in field 7
+    iv = np.asarray([2, 3, 4], np.int32)
+    buf = _tensor_proto_typed(
+        iv, 7, lambda n, a: field_bytes(n, b"".join(varint(int(v))
+                                                    for v in a)))
+    np.testing.assert_array_equal(_decode_tensor(buf), iv)
+
+    # int64_val: field 10
+    iv64 = np.asarray([7, -1], np.int64)
+    buf = _tensor_proto_typed(
+        iv64, 10,
+        lambda n, a: field_bytes(n, b"".join(
+            varint(int(v) & 0xFFFFFFFFFFFFFFFF) for v in a)))
+    np.testing.assert_array_equal(_decode_tensor(buf), iv64)
+
+    # double_val: packed 8-byte doubles in field 6
+    d = np.asarray([0.5, 0.25], np.float64)
+    buf = _tensor_proto_typed(
+        d, 6, lambda n, a: field_bytes(n, a.tobytes()))
+    buf = field_varint(1, 2) + buf[len(field_varint(1, 1)):]
+    np.testing.assert_array_equal(_decode_tensor(buf), d)
+
+
+def test_bfloat16_const_decodes(tmp_path):
+    """DT_BFLOAT16 (code 14) tensor_content is 2 bytes/element — must
+    widen via bit patterns, not be reinterpreted as float32."""
+    from analytics_zoo_tpu.pipeline.api.tfnet import _decode_tensor
+
+    want = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+    bf16_bits = (want.view(np.uint32) >> 16).astype(np.uint16)
+    buf = field_varint(1, 14)
+    buf += field_bytes(2, _shape_proto((4,)))
+    buf += field_bytes(4, bf16_bits.tobytes())
+    got = _decode_tensor(buf)
+    assert got.dtype == np.float32
+    np.testing.assert_array_equal(got, want)  # these values are bf16-exact
+
+
+def test_out_of_order_graphdef(tmp_path):
+    """GraphDef does not guarantee topological node order — consumers may
+    be serialized before producers (code-review regression)."""
+    init_zoo_context()
+    x = np.random.default_rng(6).normal(size=(3, 4)).astype(np.float32)
+    pb = write_graph(
+        tmp_path / "ooo.pb",
+        node("out", "Relu", ("mm",)),           # consumer first
+        node("mm", "MatMul", ("input", "w")),
+        const("w", np.eye(4, dtype=np.float32) * 2),
+        node("input", "Placeholder"),
+    )
+    net = load_tf(pb)
+    y = np.asarray(net.call(net.build(None), x))
+    np.testing.assert_allclose(y, np.maximum(x * 2, 0), rtol=1e-6)
+
+
+def test_depthwise_conv_matches_torch(tmp_path):
+    init_zoo_context()
+    conv = nn.Conv2d(4, 4, 3, padding=1, groups=4)
+    x = np.random.default_rng(4).normal(size=(2, 7, 7, 4)).astype(np.float32)
+    want = conv(torch.from_numpy(x.transpose(0, 3, 1, 2))).detach() \
+        .numpy().transpose(0, 2, 3, 1)
+    pb = write_graph(
+        tmp_path / "dw.pb",
+        node("input", "Placeholder"),
+        # torch depthwise (C,1,H,W) -> TF HWCM (H,W,C,1)
+        const("k", _np(conv.weight).transpose(2, 3, 0, 1)),
+        const("kb", _np(conv.bias)),
+        node("c", "DepthwiseConv2dNative", ("input", "k"),
+             attr_ints("strides", [1, 1, 1, 1]), attr_s("padding", "SAME")),
+        node("y", "BiasAdd", ("c", "kb")),
+    )
+    net = load_tf(pb)
+    y = np.asarray(net.call(net.build(None), x))
+    np.testing.assert_allclose(y, want, rtol=5e-4, atol=5e-4)

@@ -37,11 +37,14 @@ _PUNCT_RE = re.compile(f"[{re.escape(string.punctuation)}]")
 
 class TextFeature:
     """One text record (``TextFeature.scala``): raw text, optional label,
-    accumulated pipeline fields (tokens, indices)."""
+    optional ``uri`` identifier (the reference keys relation corpora by
+    URI), accumulated pipeline fields (tokens, indices)."""
 
-    def __init__(self, text: str, label: Optional[int] = None):
+    def __init__(self, text: str, label: Optional[int] = None,
+                 uri: Optional[str] = None):
         self.text = text
         self.label = label
+        self.uri = uri
         self.tokens: Optional[List[str]] = None
         self.indices: Optional[np.ndarray] = None
 
@@ -67,6 +70,27 @@ class TextSet:
                    labels: Optional[Sequence[int]] = None) -> "TextSet":
         labels = labels if labels is not None else [None] * len(texts)
         return TextSet([TextFeature(t, l) for t, l in zip(texts, labels)])
+
+    @staticmethod
+    def from_corpus(mapping: Dict[str, str]) -> "TextSet":
+        """An id→text corpus (the reference's URI-keyed relation corpora,
+        ``TextSet.scala:399-470``); deterministic id order."""
+        return TextSet([TextFeature(t, uri=i)
+                        for i, t in sorted(mapping.items())])
+
+    def indices_by_id(self) -> Dict[str, np.ndarray]:
+        """URI → fixed-length index vector; requires the tokenize →
+        word2idx → shape_sequence chain to have run."""
+        out: Dict[str, np.ndarray] = {}
+        for f in self.features:
+            if f.uri is None:
+                raise RuntimeError("corpus features need uris; build via "
+                                   "TextSet.from_corpus")
+            if f.indices is None:
+                raise RuntimeError("run tokenize/word2idx/shape_sequence "
+                                   "before indices_by_id()")
+            out[f.uri] = f.indices
+        return out
 
     @staticmethod
     def read(path: str) -> "TextSet":

@@ -245,6 +245,8 @@ def _conv2d(x, w, attrs, *, depthwise=False):
 
 
 def _pool2d(x, attrs, op):
+    if attrs.get("data_format", "NHWC") != "NHWC":
+        raise NotImplementedError(f"only NHWC {op} is supported")
     kh, kw = attrs.get("ksize", [1, 2, 2, 1])[1:3]
     sh, sw = attrs.get("strides", [1, 2, 2, 1])[1:3]
     pads = ((0, 0),) + _conv_pads(x, kh, kw, sh, sw,
@@ -261,6 +263,8 @@ def _pool2d(x, attrs, op):
 
 
 def _fused_bn(xs, attrs):
+    if attrs.get("data_format", "NHWC") != "NHWC":
+        raise NotImplementedError("only NHWC FusedBatchNorm is supported")
     x, scale, offset, mean, var = xs
     eps = attrs.get("epsilon", 1e-3) or 1e-3
     if attrs.get("is_training", False):
@@ -300,9 +304,18 @@ _REDUCE = {"Mean": jnp.mean, "Sum": jnp.sum, "Max": jnp.max, "Min": jnp.min,
 _STRUCTURAL = {("Reshape", 1), ("ConcatV2", -1), ("Transpose", 1),
                ("Pad", 1), ("PadV2", 1), ("ExpandDims", 1), ("Mean", 1),
                ("Sum", 1), ("Max", 1), ("Min", 1), ("Prod", 1), ("All", 1),
-               ("Any", 1), ("ArgMax", 1), ("GatherV2", 2), ("Split", 0),
+               ("Any", 1), ("ArgMax", 1), ("GatherV2", 2),
                ("Tile", 1), ("Fill", 0), ("StridedSlice", 1),
                ("StridedSlice", 2), ("StridedSlice", 3)}
+
+# every op _run_node dispatches on; the load-time coverage check uses this
+_SUPPORTED_OPS = (set(_UNARY) | set(_ELEMENTWISE) | set(_REDUCE) | {
+    "AddN", "LeakyRelu", "Softmax", "LogSoftmax", "MatMul", "BatchMatMul",
+    "BatchMatMulV2", "BiasAdd", "Conv2D", "DepthwiseConv2dNative",
+    "MaxPool", "AvgPool", "FusedBatchNorm", "FusedBatchNormV2",
+    "FusedBatchNormV3", "Reshape", "Squeeze", "ExpandDims", "ConcatV2",
+    "Pack", "Transpose", "Pad", "PadV2", "GatherV2", "Gather", "Tile",
+    "Cast", "ArgMax", "Shape", "Rank", "StridedSlice", "Fill"})
 
 
 def _static(v, what):
@@ -470,7 +483,13 @@ class TFNet(Layer):
                         f"{raw!r}; only :0 outputs are computed")
         self.nodes = [n for n in nodes if n["op"] not in ("NoOp",)]
         placeholders = [n["name"] for n in self.nodes
-                        if n["op"] in ("Placeholder", "PlaceholderWithDefault")]
+                        if n["op"] == "Placeholder"]
+        # PlaceholderWithDefault: only a feed when explicitly requested;
+        # otherwise its input (the graph-supplied default) binds it at call
+        self._defaults = {n["name"]: n["inputs"][0].split(":")[0]
+                          for n in self.nodes
+                          if n["op"] == "PlaceholderWithDefault"
+                          and n["inputs"]}
         self.feed_names = inputs or placeholders
         if outputs:
             self.output_names = outputs
@@ -514,44 +533,44 @@ class TFNet(Layer):
                       if n["op"] not in ("Const", "Placeholder",
                                          "PlaceholderWithDefault")]
         self._exec_nodes = self._topo_sort(exec_nodes)
-        # fail at load, not mid-trace: dry-check op coverage
+        # fail at load, not mid-trace: dry-check op coverage against the
+        # SAME set _run_node dispatches on (no second hand-kept list)
         for n in self._exec_nodes:
-            if (n["op"] not in _UNARY and n["op"] not in _ELEMENTWISE
-                    and n["op"] not in _REDUCE
-                    and n["op"] not in (
-                        "AddN", "LeakyRelu", "Softmax", "LogSoftmax",
-                        "MatMul", "BatchMatMul", "BatchMatMulV2", "BiasAdd",
-                        "Conv2D", "DepthwiseConv2dNative", "MaxPool",
-                        "AvgPool", "FusedBatchNorm", "FusedBatchNormV2",
-                        "FusedBatchNormV3", "Reshape", "Squeeze",
-                        "ExpandDims", "ConcatV2", "Pack", "Transpose",
-                        "Pad", "PadV2", "GatherV2", "Gather", "Tile",
-                        "Cast", "ArgMax", "Shape", "Rank", "StridedSlice",
-                        "Fill")):
+            if n["op"] not in _SUPPORTED_OPS:
                 raise NotImplementedError(
                     f"TF op {n['op']!r} (node {n['name']!r})")
 
     @staticmethod
     def _topo_sort(nodes):
         """GraphDef does NOT guarantee topological node order (ONNX does);
-        Kahn-sort so call() never reads a value before its producer ran.
-        File order is kept among ready nodes (stable/deterministic)."""
-        exec_names = {n["name"] for n in nodes}
-        deps = {n["name"]: {raw.lstrip("^").split(":")[0]
-                            for raw in n["inputs"]} & exec_names
-                for n in nodes}
-        ordered, placed = [], set()
-        pending = list(nodes)
-        while pending:
-            ready = [n for n in pending if deps[n["name"]] <= placed]
-            if not ready:
-                cyc = sorted(n["name"] for n in pending)[:5]
-                raise ValueError(f"GraphDef has a dependency cycle near "
-                                 f"{cyc}")
-            for n in ready:
-                ordered.append(n)
-                placed.add(n["name"])
-            pending = [n for n in pending if n["name"] not in placed]
+        Kahn-sort (O(N+E), indegree counters + a by-file-order heap) so
+        call() never reads a value before its producer ran, with
+        deterministic ordering among ready nodes."""
+        import heapq
+
+        index = {n["name"]: i for i, n in enumerate(nodes)}
+        indeg = {n["name"]: 0 for n in nodes}
+        consumers: Dict[str, List[str]] = {n["name"]: [] for n in nodes}
+        for n in nodes:
+            deps = {raw.lstrip("^").split(":")[0] for raw in n["inputs"]}
+            for d in deps:
+                if d in indeg:
+                    indeg[n["name"]] += 1
+                    consumers[d].append(n["name"])
+        by_name = {n["name"]: n for n in nodes}
+        ready = [index[name] for name, d in indeg.items() if d == 0]
+        heapq.heapify(ready)
+        ordered = []
+        while ready:
+            n = nodes[heapq.heappop(ready)]
+            ordered.append(n)
+            for c in consumers[n["name"]]:
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    heapq.heappush(ready, index[c])
+        if len(ordered) != len(nodes):
+            cyc = sorted(name for name, d in indeg.items() if d > 0)[:5]
+            raise ValueError(f"GraphDef has a dependency cycle near {cyc}")
         return ordered
 
     def build(self, rng, input_shape=None):
@@ -576,6 +595,15 @@ class TFNet(Layer):
         for name, arr in zip(self.feed_names, xs):
             vals[name] = arr
             vals[name + ":0"] = arr
+        for name, src in self._defaults.items():
+            if name in vals:
+                continue  # explicitly fed
+            if src not in vals:
+                raise ValueError(
+                    f"PlaceholderWithDefault {name!r}: default {src!r} is "
+                    f"not a constant; feed it explicitly via inputs=[...]")
+            vals[name] = vals[src]
+            vals[name + ":0"] = vals[src]
         for node in self._exec_nodes:
             _run_node(node, vals)
         outs = [vals[n] for n in self.output_names]

@@ -1,6 +1,9 @@
-"""QA ranking (the reference's QARanker example): KNRM kernel-pooling text
-matching trained on (question, answer) pairs with rank-hinge loss, scored
-with the Ranker NDCG / HitRate metrics.
+"""QA ranking (the reference's QARanker example): raw question/answer texts
++ a relation table -> Relations pair generation -> KNRM kernel-pooling text
+matching trained with rank-hinge loss -> list-wise NDCG / MAP / HitRate via
+the Ranker metrics. Mirrors the reference flow ``Relations.read`` →
+``TextSet.fromRelationPairs`` → train → ``TextSet.fromRelationLists`` →
+evaluate (``feature/common/Relations.scala``, ``TextSet.scala:399-533``).
 
 Run:  python examples/qa_ranker.py
 """
@@ -9,58 +12,70 @@ import numpy as np
 
 from analytics_zoo_tpu import init_zoo_context
 from analytics_zoo_tpu.feature import FeatureSet
+from analytics_zoo_tpu.feature.text import (Relation, TextSet,
+                                            relation_lists_to_groups,
+                                            relation_pairs_to_arrays)
 from analytics_zoo_tpu.models.textmatching import KNRM
 
+Q_LEN, A_LEN = 10, 20
 
-def make_pairs(rng, n_questions=64, vocab=200, q_len=10, a_len=20):
-    """Each question has one relevant answer (shares its rare tokens) and
-    negatives drawn at random."""
-    qs, pos, neg = [], [], []
-    for _ in range(n_questions):
-        topic = rng.integers(100, vocab, size=4)   # rare topic tokens
-        q = np.concatenate([topic, rng.integers(1, 100, q_len - 4)])
-        a_good = np.concatenate([topic, rng.integers(1, 100, a_len - 4)])
-        a_bad = rng.integers(1, 100, a_len)
-        qs.append(q)
-        pos.append(a_good)
-        neg.append(a_bad)
-    return (np.asarray(qs, np.int32), np.asarray(pos, np.int32),
-            np.asarray(neg, np.int32))
+
+def make_corpus(rng, n_questions=64, vocab=200):
+    """Synthetic corpus: each question shares rare 'topic' words with its
+    one relevant answer; negatives are random common words."""
+    words = [f"w{i}" for i in range(vocab)]
+    questions, answers, relations = {}, {}, []
+    for i in range(n_questions):
+        topic = rng.integers(100, vocab, size=4)
+        q_toks = [words[t] for t in topic] + \
+            [words[t] for t in rng.integers(1, 100, Q_LEN - 4)]
+        a_toks = [words[t] for t in topic] + \
+            [words[t] for t in rng.integers(1, 100, A_LEN - 4)]
+        n_toks = [words[t] for t in rng.integers(1, 100, A_LEN)]
+        questions[f"q{i}"] = " ".join(q_toks)
+        answers[f"a{i}p"] = " ".join(a_toks)
+        answers[f"a{i}n"] = " ".join(n_toks)
+        relations.append(Relation(f"q{i}", f"a{i}p", 1))
+        relations.append(Relation(f"q{i}", f"a{i}n", 0))
+    return questions, answers, relations
 
 
 def main():
     init_zoo_context()
     rng = np.random.default_rng(0)
-    q, pos, neg = make_pairs(rng)
-    q_len, a_len = q.shape[1], pos.shape[1]
+    questions, answers, relations = make_corpus(rng)
 
-    # rank-hinge training data: (positive, negative) pair rows interleaved
-    x = np.concatenate([np.concatenate([q, pos], axis=1),
-                        np.concatenate([q, neg], axis=1)])
-    order = np.empty(2 * len(q), np.int64)
-    order[0::2] = np.arange(len(q))              # pos row
-    order[1::2] = np.arange(len(q)) + len(q)     # its neg row
-    x = x[order]
+    # text pipeline: one vocabulary over BOTH corpora (answer-only words
+    # must not collapse to the 0 padding index), then fixed lengths
+    vocab_set = TextSet.from_texts(list(questions.values())
+                                   + list(answers.values())).tokenize()
+    vocab_set.word2idx()
+    word_index = vocab_set.get_word_index()
+    c_q = TextSet.from_corpus(questions).tokenize()
+    c_q.word2idx(existing_map=word_index)
+    c_q.shape_sequence(Q_LEN)
+    c_a = TextSet.from_corpus(answers).tokenize()
+    c_a.word2idx(existing_map=word_index)
+    c_a.shape_sequence(A_LEN)
+    vocab_size = len(word_index) + 1
+
+    # pair training data: rows interleaved (positive, negative)
+    x, _ = relation_pairs_to_arrays(relations, c_q, c_a)
     y = np.zeros((len(x), 1), np.float32)        # rank_hinge ignores labels
 
-    model = KNRM(text1_length=q_len, text2_length=a_len, vocab_size=200,
-                 embed_size=32, target_mode="ranking")
+    model = KNRM(text1_length=Q_LEN, text2_length=A_LEN,
+                 vocab_size=vocab_size, embed_size=32,
+                 target_mode="ranking")
     model.compile(optimizer="adam", loss="rank_hinge", lr=2e-3)
     # rank_hinge consumes consecutive (positive, negative) rows: train
     # UNSHUFFLED so the pairing survives batching
     model.fit(FeatureSet.array(x, y, shuffle=False), batch_size=32,
               nb_epoch=30)
 
-    # rank each question's candidate set: 1 relevant + 7 distractors
-    # (groups of (input rows, relevance) — the Ranker contract)
-    groups = []
-    for i in range(len(q)):
-        cands = [pos[i]] + [neg[(i + j) % len(q)] for j in range(7)]
-        rows = np.stack([np.concatenate([q[i], c]) for c in cands])
-        truth = np.zeros(8, np.float32)
-        truth[0] = 1.0
-        groups.append((rows, truth))
+    # list-wise evaluation: every candidate of each question as one group
+    groups = relation_lists_to_groups(relations, c_q, c_a)
     print("NDCG@3 :", round(model.evaluate_ndcg(groups, 3, batch_size=8), 3))
+    print("MAP    :", round(model.evaluate_map(groups, batch_size=8), 3))
     print("Hit@1  :", round(model.evaluate_hit_rate(groups, 1,
                                                     batch_size=8), 3))
 

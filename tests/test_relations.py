@@ -1,0 +1,128 @@
+"""Relations (QA-ranking data path) — parity with
+``feature/common/Relations.scala`` + ``TextSet.fromRelationPairs/
+fromRelationLists`` (``TextSet.scala:399-533``)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import init_zoo_context
+from analytics_zoo_tpu.feature.text import (
+    Relation, TextSet, generate_relation_pairs, read_relations,
+    relation_lists_to_groups, relation_pairs_to_arrays)
+
+RELS = [
+    Relation("q1", "a1", 1),
+    Relation("q1", "a2", 0),
+    Relation("q1", "a3", 0),
+    Relation("q2", "a2", 1),
+    Relation("q2", "a4", 1),
+    Relation("q2", "a1", 0),
+    Relation("q3", "a3", 1),   # no negatives -> contributes no pairs
+]
+
+CORPUS_Q = {"q1": "what is tpu", "q2": "how fast is ici", "q3": "what is xla"}
+CORPUS_A = {"a1": "a tensor processing unit", "a2": "an accelerator chip",
+            "a3": "a compiler for linear algebra", "a4": "very fast links"}
+
+
+def _corpora(len1=4, len2=6):
+    c1 = TextSet.from_corpus(CORPUS_Q).tokenize()
+    c1.word2idx()
+    c1.shape_sequence(len1)
+    # share one vocabulary, as the reference's QARanker does
+    c2 = TextSet.from_corpus(CORPUS_A).tokenize()
+    c2.word2idx(existing_map=c1.get_word_index())
+    c2.shape_sequence(len2)
+    return c1, c2
+
+
+def test_read_relations(tmp_path):
+    p = tmp_path / "rel.csv"
+    p.write_text("q1,a1,1\nq1,a2,0\n\nq2,a3,1\n")
+    rels = read_relations(str(p))
+    assert rels == [Relation("q1", "a1", 1), Relation("q1", "a2", 0),
+                    Relation("q2", "a3", 1)]
+    bad = tmp_path / "bad.csv"
+    bad.write_text("q1,a1\n")
+    with pytest.raises(ValueError, match="bad relation line"):
+        read_relations(str(bad))
+
+
+def test_generate_relation_pairs():
+    pairs = generate_relation_pairs(RELS)
+    # q1: 1 pos x 2 neg = 2; q2: 2 pos x 1 neg = 2; q3: none
+    assert len(pairs) == 4
+    assert pairs[0].id1 == "q1" and pairs[0].id2_positive == "a1"
+    assert {p.id2_negative for p in pairs if p.id1 == "q1"} == {"a2", "a3"}
+    assert all(p.id1 != "q3" for p in pairs)
+
+
+def test_relation_pairs_to_arrays_interleaves_pos_neg():
+    c1, c2 = _corpora()
+    x, y = relation_pairs_to_arrays(RELS, c1, c2)
+    assert x.shape == (8, 10) and x.dtype == np.int32
+    np.testing.assert_array_equal(y, [1, 0, 1, 0, 1, 0, 1, 0])
+    qmap, amap = c1.indices_by_id(), c2.indices_by_id()
+    # row 0 = q1 ++ a1 (positive), row 1 = q1 ++ a2|a3 (negative)
+    np.testing.assert_array_equal(x[0], np.concatenate([qmap["q1"],
+                                                        amap["a1"]]))
+    np.testing.assert_array_equal(x[0][:4], x[1][:4])  # same query both rows
+
+
+def test_relation_lists_to_groups():
+    c1, c2 = _corpora()
+    groups = relation_lists_to_groups(RELS, c1, c2)
+    assert len(groups) == 3            # q1, q2, q3
+    x1, y1 = groups[0]
+    assert x1.shape == (3, 10)
+    np.testing.assert_array_equal(y1, [1, 0, 0])
+    x3, y3 = groups[2]
+    assert x3.shape == (1, 10) and y3.tolist() == [1.0]
+
+
+def test_missing_corpus_id_raises():
+    c1, c2 = _corpora()
+    with pytest.raises(KeyError, match="corpus2"):
+        relation_pairs_to_arrays([Relation("q1", "zzz", 1),
+                                  Relation("q1", "a1", 0)], c1, c2)
+
+
+def test_knrm_end_to_end_relations():
+    """The reference QARanker flow: relations + corpora -> pair training
+    with rank_hinge -> list evaluation with NDCG/MAP via RankerMixin."""
+    import optax
+    from analytics_zoo_tpu.models.textmatching import KNRM
+
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    n_q, n_a, vocab = 12, 20, 50
+    qs = {f"q{i}": " ".join(f"w{rng.integers(1, vocab)}"
+                            for _ in range(5)) for i in range(n_q)}
+    ans = {f"a{j}": " ".join(f"w{rng.integers(1, vocab)}"
+                             for _ in range(8)) for j in range(n_a)}
+    rels = []
+    for i in range(n_q):
+        picks = rng.choice(n_a, size=4, replace=False)
+        for rank, j in enumerate(picks):
+            rels.append(Relation(f"q{i}", f"a{j}", int(rank == 0)))
+
+    c1 = TextSet.from_corpus(qs).tokenize()
+    c1.word2idx()
+    c1.shape_sequence(6)
+    c2 = TextSet.from_corpus(ans).tokenize()
+    c2.word2idx(existing_map=c1.get_word_index())
+    c2.shape_sequence(10)
+
+    x, _ = relation_pairs_to_arrays(rels, c1, c2)
+    m = KNRM(6, 10, vocab_size=len(c1.get_word_index()) + 1, embed_size=8,
+             kernel_num=5)
+    m.compile(optimizer=optax.adam(0.01), loss="rank_hinge")
+    h = m.fit(x, np.zeros(len(x), np.float32), batch_size=8, nb_epoch=3)
+    assert np.isfinite(h["loss"][-1])
+
+    groups = relation_lists_to_groups(rels, c1, c2)
+    assert len(groups) == n_q
+    v = m.evaluate_ndcg(groups, k=3)
+    assert 0.0 <= v <= 1.0
+    v2 = m.evaluate_map(groups)
+    assert 0.0 <= v2 <= 1.0

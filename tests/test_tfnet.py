@@ -362,6 +362,42 @@ def test_out_of_order_graphdef(tmp_path):
     np.testing.assert_allclose(y, np.maximum(x * 2, 0), rtol=1e-6)
 
 
+def test_placeholder_with_default(tmp_path):
+    """PlaceholderWithDefault binds to its graph-supplied default unless
+    explicitly fed (code-review regression)."""
+    init_zoo_context()
+    x = np.ones((2, 3), np.float32)
+    pb = write_graph(
+        tmp_path / "pwd.pb",
+        node("input", "Placeholder"),
+        const("scale_default", np.asarray(2.0, np.float32)),
+        node("scale", "PlaceholderWithDefault", ("scale_default",)),
+        node("y", "Mul", ("input", "scale")),
+    )
+    net = load_tf(pb)
+    assert net.feed_names == ["input"]  # the default is not a feed
+    y = np.asarray(net.call(net.build(None), x))
+    np.testing.assert_allclose(y, x * 2.0)
+    # explicit feed overrides the default
+    net2 = load_tf(pb, inputs=["input", "scale"])
+    y2 = np.asarray(net2.call(net2.build(None),
+                              [x, np.asarray(3.0, np.float32)]))
+    np.testing.assert_allclose(y2, x * 3.0)
+
+
+def test_nchw_bn_rejected(tmp_path):
+    pb = write_graph(
+        tmp_path / "nchw.pb",
+        node("input", "Placeholder"),
+        const("s", np.ones(4, np.float32)),
+        node("y", "FusedBatchNormV3", ("input", "s", "s", "s", "s"),
+             attr_s("data_format", "NCHW")),
+    )
+    net = load_tf(pb)
+    with pytest.raises(NotImplementedError, match="NHWC"):
+        net.call(net.build(None), np.ones((1, 4, 5, 5), np.float32))
+
+
 def test_depthwise_conv_matches_torch(tmp_path):
     init_zoo_context()
     conv = nn.Conv2d(4, 4, 3, padding=1, groups=4)

@@ -306,11 +306,28 @@ class TrainingLoop:
         key = (n, batch_size, n_steps, shuffle)
         if key in self._epoch_fns:
             return self._epoch_fns[key]
-        stacked = mesh_lib.stacked_batch_sharding(self.mesh)
-        n_used = n_steps * batch_size
+        body = self._make_epoch_body(n, batch_size, n_steps, shuffle)
 
         def epoch(params, opt_state, net_state, base_rng, iter0, shuffle_rng,
                   xs, ys):
+            (params, opt_state, net_state, _), losses = body(
+                (params, opt_state, net_state, iter0), base_rng, shuffle_rng,
+                xs, ys)
+            return params, opt_state, net_state, losses
+
+        fn = jax.jit(epoch, donate_argnums=(0, 1, 2))
+        self._epoch_fns[key] = fn
+        return fn
+
+    def _make_epoch_body(self, n, batch_size, n_steps, shuffle):
+        """The shared whole-epoch body (on-device shuffle gather → scan of
+        optimizer steps) behind BOTH the per-epoch and the fused-epoch
+        dispatch, so the two paths cannot diverge numerically."""
+        stacked = mesh_lib.stacked_batch_sharding(self.mesh)
+        n_used = n_steps * batch_size
+
+        def body(carry, base_rng, shuffle_rng, xs, ys):
+            params, opt_state, net_state, it = carry
             if shuffle:
                 perm = jax.random.permutation(shuffle_rng, n)[:n_used]
             else:
@@ -321,14 +338,37 @@ class TrainingLoop:
                     (n_steps, batch_size) + a.shape[1:])
                 return jax.lax.with_sharding_constraint(out, stacked)
 
-            xs_s = jax.tree.map(shuffled, xs)
-            ys_s = jax.tree.map(shuffled, ys)
-            (params, opt_state, net_state, _), losses = jax.lax.scan(
+            return jax.lax.scan(
                 self._make_scan_body(base_rng),
-                (params, opt_state, net_state, iter0), (xs_s, ys_s))
-            return params, opt_state, net_state, losses
+                (params, opt_state, net_state, it),
+                (jax.tree.map(shuffled, xs), jax.tree.map(shuffled, ys)))
 
-        fn = jax.jit(epoch, donate_argnums=(0, 1, 2))
+        return body
+
+    def build_multi_epoch_fn(self, n: int, batch_size: int, n_steps: int,
+                             shuffle: bool, n_epochs: int):
+        """``zoo.train.fuse_epochs``: K whole epochs (shuffle + steps) in ONE
+        dispatch — a ``lax.scan`` over per-epoch shuffle keys around the
+        epoch body. On a tunneled/remote device the per-epoch dispatch +
+        loss-readback round-trips are the remaining host cost after
+        ``device_cache``; this amortizes them K-fold. The rng schedule is
+        identical to the per-epoch path, so losses match bit-for-bit."""
+        key = (n, batch_size, n_steps, shuffle, n_epochs)
+        if key in self._epoch_fns:
+            return self._epoch_fns[key]
+        body = self._make_epoch_body(n, batch_size, n_steps, shuffle)
+
+        def multi(params, opt_state, net_state, base_rng, iter0,
+                  shuffle_rngs, xs, ys):
+            def one_epoch(carry, ep_rng):
+                return body(carry, base_rng, ep_rng, xs, ys)
+
+            (params, opt_state, net_state, _), L = jax.lax.scan(
+                one_epoch, (params, opt_state, net_state, iter0),
+                shuffle_rngs)
+            return params, opt_state, net_state, L  # (n_epochs, n_steps)
+
+        fn = jax.jit(multi, donate_argnums=(0, 1, 2))
         self._epoch_fns[key] = fn
         return fn
 
@@ -664,6 +704,82 @@ class TrainingLoop:
         loop_state = TrainLoopState(iteration=model.finished_iterations,
                                     epoch=model.finished_epochs + 1)
         stop = False
+
+        # fused-epoch fast path: K epochs per dispatch. Only when nothing
+        # needs the host between epochs — no checkpointing, validation, or
+        # end trigger (nb_epoch still bounds the run); per-epoch losses and
+        # records come out identical to the per-epoch path (same rng
+        # schedule), only the wall timing is amortized across the block.
+        fuse = int(ctx.get("zoo.train.fuse_epochs", 1))
+        if (epoch_fn is not None and fuse > 1 and mgr is None
+                and validation_data is None and end_trigger is None):
+            n_steps = fs.steps_per_epoch(batch_size, drop_last=True)
+            tb = getattr(model, "_train_summary", None)
+            epoch = model.finished_epochs
+            while epoch < target_epoch:
+                g = min(fuse, target_epoch - epoch)
+                t0 = time.time()
+                it0 = jnp.asarray(loop_state.iteration, jnp.int32)
+                if g == 1:
+                    shuffle_rng = jax.random.key(
+                        fs.seed + ctx.seed + epoch + 1)
+                    params, opt_state, net_state, L = epoch_fn(
+                        params, opt_state, net_state, base_rng, it0,
+                        shuffle_rng, xs_dev, ys_dev)
+                else:
+                    mfn = self.build_multi_epoch_fn(
+                        len(fs), batch_size, n_steps, fs.shuffle, g)
+                    keys = jnp.stack(
+                        [jax.random.key(fs.seed + ctx.seed + e)
+                         for e in range(epoch + 1, epoch + g + 1)])
+                    params, opt_state, net_state, L = mfn(
+                        params, opt_state, net_state, base_rng, it0, keys,
+                        xs_dev, ys_dev)
+                L = np.asarray(jax.block_until_ready(L)).reshape(g, -1)
+                dt = (time.time() - t0) / g
+                loop_state.iteration += g * n_steps
+                # publish once per block: the intermediate epochs' params
+                # never materialize on the host (that is the point)
+                model.params, model.net_state, model.opt_state = _clone_tree(
+                    (params, net_state, opt_state))
+                model.finished_iterations = loop_state.iteration
+                thr = (n_steps * batch_size / dt) if dt > 0 else 0.0
+                lr = getattr(model, "_lr", None)
+                for j in range(g):
+                    e = epoch + 1 + j
+                    last = j == g - 1
+                    epoch_loss = float(L[j].mean())
+                    history["loss"].append(epoch_loss)
+                    model.finished_epochs = e
+                    loop_state.epoch = e
+                    it_e = loop_state.iteration - (g - 1 - j) * n_steps
+                    # intermediate epochs' weights never materialize on the
+                    # host (that is the point of fusing) — their records say
+                    # so with None rather than smuggling end-of-block params
+                    # under an earlier epoch number
+                    record = {"epoch": e, "loss": epoch_loss,
+                              "iteration": it_e, "throughput": thr,
+                              "params": model.params if last else None,
+                              "opt_state": model.opt_state if last else None,
+                              "net_state": model.net_state if last else None,
+                              "loop_state": loop_state}
+                    if tb is not None:
+                        for k2, lv in enumerate(L[j]):
+                            tb.add_scalar("Loss", float(lv),
+                                          it_e - n_steps + k2 + 1)
+                        tb.add_scalar("Throughput", thr, it_e)
+                        if callable(lr):
+                            tb.add_scalar("LearningRate", float(lr(it_e)),
+                                          it_e)
+                        elif isinstance(lr, (int, float)):
+                            tb.add_scalar("LearningRate", float(lr), it_e)
+                        tb.writer.flush()
+                    log.info("Epoch %d: loss=%.6f (%.1f ex/s)", e,
+                             epoch_loss, thr)
+                    for cb in callbacks:
+                        cb(record)
+                epoch += g
+            return history
 
         epoch = model.finished_epochs  # so nb_epoch=0 is a clean no-op
         for epoch in range(model.finished_epochs + 1, target_epoch + 1):

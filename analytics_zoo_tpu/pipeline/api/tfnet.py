@@ -485,12 +485,14 @@ class TFNet(Layer):
         placeholders = [n["name"] for n in self.nodes
                         if n["op"] == "Placeholder"]
         # PlaceholderWithDefault: only a feed when explicitly requested;
-        # otherwise its input (the graph-supplied default) binds it at call
+        # otherwise its input (the graph-supplied default) binds it at call.
+        # A graph with NO pure Placeholder still needs somewhere to put the
+        # caller's data — then the with-default nodes become the feeds.
         self._defaults = {n["name"]: n["inputs"][0].split(":")[0]
                           for n in self.nodes
                           if n["op"] == "PlaceholderWithDefault"
                           and n["inputs"]}
-        self.feed_names = inputs or placeholders
+        self.feed_names = inputs or placeholders or list(self._defaults)
         if outputs:
             self.output_names = outputs
         else:
@@ -557,7 +559,6 @@ class TFNet(Layer):
                 if d in indeg:
                     indeg[n["name"]] += 1
                     consumers[d].append(n["name"])
-        by_name = {n["name"]: n for n in nodes}
         ready = [index[name] for name, d in indeg.items() if d == 0]
         heapq.heapify(ready)
         ordered = []

@@ -385,6 +385,23 @@ def test_placeholder_with_default(tmp_path):
     np.testing.assert_allclose(y2, x * 3.0)
 
 
+def test_placeholder_with_default_as_only_input(tmp_path):
+    """A graph whose ONLY input node is a PlaceholderWithDefault must still
+    be callable with data (the with-default node becomes the feed)."""
+    init_zoo_context()
+    pb = write_graph(
+        tmp_path / "pwd2.pb",
+        const("input_default", np.zeros((1, 3), np.float32)),
+        node("input", "PlaceholderWithDefault", ("input_default",)),
+        node("y", "Relu", ("input",)),
+    )
+    net = load_tf(pb)
+    assert net.feed_names == ["input"]
+    x = np.asarray([[-1.0, 2.0, -3.0]], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(net.call(net.build(None), x)), [[0.0, 2.0, 0.0]])
+
+
 def test_nchw_bn_rejected(tmp_path):
     pb = write_graph(
         tmp_path / "nchw.pb",

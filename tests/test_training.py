@@ -139,6 +139,58 @@ def test_scan_steps_ragged_tail_chunk():
     assert h["loss"][-1] < h["loss"][0]
 
 
+def test_fused_epochs_match_per_epoch_path():
+    """zoo.train.fuse_epochs: K epochs per dispatch must produce IDENTICAL
+    per-epoch losses and final weights to the per-epoch device_cache path
+    (same rng schedule), including a ragged final group (7 epochs, fuse=3)."""
+    from analytics_zoo_tpu.common.context import reset_zoo_context
+
+    def build():
+        m = Sequential([Dense(16, activation="relu", input_shape=(6,)),
+                        Dense(1, activation="sigmoid")])
+        m.compile(optimizer="adam", loss="binary_crossentropy", lr=0.01)
+        return m
+
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(256, 6)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)[:, None]
+
+    init_zoo_context(train_device_cache=True)
+    m1 = build()
+    h1 = m1.fit(x, y, batch_size=32, nb_epoch=7)
+    p1 = m1.predict(x, batch_size=64)
+
+    reset_zoo_context()
+    init_zoo_context(train_device_cache=True, train_fuse_epochs=3)
+    m2 = build()
+    records = []
+    h2 = m2.fit(x, y, batch_size=32, nb_epoch=7, callbacks=[records.append])
+    p2 = m2.predict(x, batch_size=64)
+
+    np.testing.assert_allclose(h1["loss"], h2["loss"], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(p1, p2, rtol=1e-4, atol=1e-5)
+    assert m2.finished_epochs == 7
+    assert m2.finished_iterations == 7 * 8
+    assert [r["epoch"] for r in records] == list(range(1, 8))
+    assert all(np.isfinite(r["throughput"]) for r in records)
+
+
+def test_fused_epochs_defer_to_loop_when_host_needed(tmp_path):
+    """fuse_epochs must NOT engage when a checkpoint manager or validation
+    needs the host between epochs — bookkeeping stays per-epoch exact."""
+    init_zoo_context(train_device_cache=True, train_fuse_epochs=4)
+    x, y = _xor_data(n=64 * 4)
+    m = Sequential([Dense(16, activation="relu", input_shape=(2,)),
+                    Dense(1, activation="sigmoid")])
+    m.compile(optimizer="adam", loss="binary_crossentropy", lr=0.01)
+    m.set_checkpoint(str(tmp_path))
+    h = m.fit(x, y, batch_size=64, nb_epoch=4)
+    assert len(h["loss"]) == 4
+    assert m.finished_epochs == 4
+    import os
+    assert any(os.scandir(str(tmp_path))), "checkpoints were skipped"
+
+
 def test_device_cache_epoch_path_trains():
     """HBM-resident one-dispatch-per-epoch path (zoo.train.device_cache):
     must converge and keep epoch/iteration bookkeeping consistent."""

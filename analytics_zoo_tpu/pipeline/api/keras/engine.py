@@ -21,6 +21,8 @@ Design (idiomatic JAX, not a port):
 from __future__ import annotations
 
 import collections
+import contextlib
+import contextvars
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -96,6 +98,39 @@ def get_initializer(name: Union[str, Callable]) -> Callable:
     if name not in table:
         raise ValueError(f"unknown initializer: {name}")
     return table[name]
+
+
+# --------------------------------------------------------------------------
+# layer-call interception (calibration / quantized execution)
+# --------------------------------------------------------------------------
+
+_LAYER_HOOK = contextvars.ContextVar("zoo_layer_hook", default=None)
+
+
+@contextlib.contextmanager
+def intercept_layer_calls(hook):
+    """Scope a hook over every container-dispatched layer call.
+
+    ``hook(layer, params, state, x, training, rng)`` returns ``(y, state)``
+    to substitute the call, or ``None`` to run the layer normally. Used by
+    the inference runtime for int8 activation calibration (record input
+    ranges eagerly) and quantized execution (swap in ``quantized_call`` at
+    trace time); sub-layers invoked *inside* wrapper layers (TimeDistributed,
+    Bidirectional) are not dispatched through containers and stay float."""
+    token = _LAYER_HOOK.set(hook)
+    try:
+        yield
+    finally:
+        _LAYER_HOOK.reset(token)
+
+
+def dispatch_layer(layer, params, state, x, *, training=False, rng=None):
+    hook = _LAYER_HOOK.get()
+    if hook is not None:
+        out = hook(layer, params, state, x, training, rng)
+        if out is not None:
+            return out
+    return layer.apply(params, state, x, training=training, rng=rng)
 
 
 # --------------------------------------------------------------------------
@@ -395,8 +430,8 @@ class Sequential(KerasNet):
         for i, layer in enumerate(self.layers):
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
             lstate = state.get(layer.name, {}) if state else {}
-            h, ns = layer.apply(params.get(layer.name, {}), lstate, h,
-                                training=training, rng=lrng)
+            h, ns = dispatch_layer(layer, params.get(layer.name, {}), lstate,
+                                   h, training=training, rng=lrng)
             if ns:
                 new_state[layer.name] = ns
         return h, new_state
@@ -517,8 +552,8 @@ class Model(KerasNet):
             arg = args if len(args) > 1 else args[0]
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
             lstate = state.get(node.name, {}) if state else {}
-            y, ns = node.layer.apply(params.get(node.name, {}), lstate, arg,
-                                     training=training, rng=lrng)
+            y, ns = dispatch_layer(node.layer, params.get(node.name, {}),
+                                   lstate, arg, training=training, rng=lrng)
             if ns:
                 new_state[node.name] = ns
             value_of[id(node)] = y

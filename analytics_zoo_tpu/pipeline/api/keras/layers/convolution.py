@@ -131,6 +131,25 @@ class Convolution2D(Layer):
             y = self.activation(y)
         return y
 
+    def quantized_call(self, qp, x):
+        """Static int8 conv (inference runtime): calibrated activation scale,
+        int8 x int8 -> int32 accumulation on the MXU, fused per-channel
+        rescale — the OpenVINO-calibrated-int8 replacement (SURVEY §2.3)."""
+        xq = jnp.clip(jnp.round(x / qp["x_scale"]), -127, 127).astype(jnp.int8)
+        y = lax.conv_general_dilated(
+            xq, qp["W"],
+            window_strides=self.subsample,
+            padding=_padding(self.border_mode),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.int32)
+        y = y.astype(jnp.float32) * (qp["x_scale"] * qp["w_scale"])
+        if self.bias:
+            y = y + qp["b"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
 
 class AtrousConvolution2D(Convolution2D):
     """``AtrousConvolution2D.scala`` — dilated 2D conv."""

@@ -745,6 +745,8 @@ class TrainingLoop:
                 model.finished_iterations = loop_state.iteration
                 thr = (n_steps * batch_size / dt) if dt > 0 else 0.0
                 lr = getattr(model, "_lr", None)
+                # every epoch inside a fused block completes by construction
+                loop_state.epoch_finished = True
                 for j in range(g):
                     e = epoch + 1 + j
                     last = j == g - 1

@@ -34,7 +34,7 @@ import numpy as np
 
 from ...models.common.zoo_model import load_model
 from ...parallel import mesh as mesh_lib
-from ..api.keras.engine import KerasNet
+from ..api.keras.engine import KerasNet, intercept_layer_calls
 from ...utils.checkpoint import CheckpointManager
 
 __all__ = ["InferenceModel"]
@@ -84,6 +84,21 @@ def quantize_int8(params) -> Tuple[Any, Any]:
             jax.tree_util.tree_unflatten(treedef, list(scales)))
 
 
+def _quantize_layer_entry(sub, act_scale: float):
+    """Per-layer static-int8 params: int8 weight + per-out-channel scale +
+    the calibrated activation scale (what ``quantized_call`` consumes)."""
+    W = np.asarray(jax.device_get(sub["W"]))
+    axes = tuple(range(W.ndim - 1))
+    amax = np.max(np.abs(W), axis=axes)
+    w_scale = np.where(amax == 0, 1.0, amax / 127.0).astype(np.float32)
+    entry = {"W": np.clip(np.round(W / w_scale), -127, 127).astype(np.int8),
+             "w_scale": w_scale, "x_scale": np.float32(act_scale)}
+    for k, v in sub.items():
+        if k != "W":
+            entry[k] = np.asarray(jax.device_get(v))
+    return entry
+
+
 def dequantize_int8(q_tree, scale_tree, dtype=jnp.float32):
     """Inverse of :func:`quantize_int8`, run INSIDE the jitted predict so the
     int8 leaves are what lives in HBM."""
@@ -129,9 +144,11 @@ class InferenceModel:
 
     # ---- loaders (InferenceModel.scala:80-450 family) ---------------------
     def load(self, path: str, *, dtype: str = "float32",
-             quantize: Optional[str] = None) -> "InferenceModel":
+             quantize: Optional[str] = None,
+             calibrate=None) -> "InferenceModel":
         """Load a ZooModel one-file ``.npz`` (``doLoadBigDL`` role)."""
-        return self.from_keras(load_model(path), dtype=dtype, quantize=quantize)
+        return self.from_keras(load_model(path), dtype=dtype,
+                               quantize=quantize, calibrate=calibrate)
 
     def load_checkpoint(self, model: KerasNet, ckpt_dir: str, *,
                         dtype: str = "float32",
@@ -151,14 +168,28 @@ class InferenceModel:
         return self.from_keras(model, dtype=dtype, quantize=quantize)
 
     def from_keras(self, model: KerasNet, *, dtype: str = "float32",
-                   quantize: Optional[str] = None) -> "InferenceModel":
-        """Wrap an in-memory KerasNet/ZooModel (weights already present)."""
+                   quantize: Optional[str] = None,
+                   calibrate=None) -> "InferenceModel":
+        """Wrap an in-memory KerasNet/ZooModel (weights already present).
+
+        ``quantize="int8"`` alone is weight-only (int8 in HBM, float
+        compute). Adding ``calibrate=representative_batch`` runs one eager
+        calibration pass recording each Dense/Conv2D input range, then
+        executes those layers as int8 x int8 -> int32 MXU ops with a fused
+        rescale — the native equivalent of the reference's OpenVINO
+        calibrate-then-int8 pipeline (``InferenceModel.scala:80-450``,
+        ``OpenVinoInferenceSupportive.scala:61-68``)."""
         if model.params is None:
             model.init_weights()
         self._model = model
         self._dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
                        "bf16": jnp.bfloat16}[dtype]
         params, net_state = model.params, model.net_state
+        self._act_scales = None
+        if calibrate is not None and quantize != "int8":
+            raise ValueError(
+                "calibrate= requires quantize='int8' (a calibration batch "
+                "without a quantized mode would be silently ignored)")
         if quantize is None:
             cast = (lambda a: a.astype(self._dtype)
                     if hasattr(a, "dtype") and a.dtype == jnp.float32
@@ -166,19 +197,35 @@ class InferenceModel:
             self._params = jax.tree.map(cast, params)
             self._scales = None
         elif quantize == "int8":
-            q, s = quantize_int8(params)
-            # quantize_int8 produces HOST numpy arrays; pin them on device
-            # once — otherwise every predict re-uploads the whole int8
-            # weight set (catastrophic over a tunneled device link).
-            # Replicated over the mesh, matching the batch-sharded inputs.
             repl = mesh_lib.replicated_sharding(self.mesh)
-            self._params = jax.device_put(q, repl)
-            self._scales = jax.device_put(s, repl)
+            if calibrate is not None:
+                self._act_scales = self._calibrate(model, params, net_state,
+                                                   calibrate)
+                q = self._rewrite_quantized(params, self._act_scales)
+                self._params = jax.device_put(q, repl)
+                self._scales = None
+            else:
+                q, s = quantize_int8(params)
+                # quantize_int8 produces HOST numpy arrays; pin them on
+                # device once — otherwise every predict re-uploads the whole
+                # int8 weight set (catastrophic over a tunneled device
+                # link). Replicated over the mesh, matching the batch-
+                # sharded inputs.
+                self._params = jax.device_put(q, repl)
+                self._scales = jax.device_put(s, repl)
         else:
             raise ValueError(f"unknown quantize mode {quantize!r}; "
                              "use None or 'int8'")
         self._net_state = net_state
         model, dtype, scales = self._model, self._dtype, self._scales
+        act_scales = self._act_scales
+
+        def qhook(layer, p, s, x, training, rng):
+            if (act_scales is not None and layer.name in act_scales
+                    and isinstance(p, dict) and "x_scale" in p
+                    and not isinstance(x, (list, tuple))):
+                return layer.quantized_call(p, x), (s or {})
+            return None
 
         def run(params, net_state, x):
             if scales is not None:
@@ -186,7 +233,9 @@ class InferenceModel:
             if dtype != jnp.float32:
                 x = jax.tree.map(
                     lambda a: a.astype(dtype) if a.dtype.kind == "f" else a, x)
-            yp, _ = model.apply(params, net_state, x, training=False, rng=None)
+            with intercept_layer_calls(qhook if act_scales else None):
+                yp, _ = model.apply(params, net_state, x, training=False,
+                                    rng=None)
             return jax.tree.map(lambda a: a.astype(jnp.float32)
                                 if a.dtype == jnp.bfloat16 else a, yp)
 
@@ -195,6 +244,59 @@ class InferenceModel:
         # is itself thread-safe
         self._predict = jax.jit(run)
         return self
+
+    @staticmethod
+    def _quantizable(layer) -> bool:
+        """True when the class that provides the layer's EFFECTIVE ``call``
+        also provides a matching ``quantized_call`` — a subclass that
+        overrides ``call`` (ShareConvolution2D's explicit padding,
+        Deconvolution2D's transpose) must not inherit a quantized path with
+        different semantics."""
+        for cls in type(layer).__mro__:
+            if "call" in cls.__dict__:
+                return "quantized_call" in cls.__dict__
+        return False
+
+    @staticmethod
+    def _calibrate(model, params, net_state, calibrate) -> Dict[str, float]:
+        """One eager forward over the calibration batch, recording the
+        abs-max input of every container-dispatched layer that has a
+        ``quantized_call``. Layer names collide only across nested
+        containers; the max of colliding ranges is taken (conservative)."""
+        records: Dict[str, float] = {}
+
+        def rec(layer, p, s, x, training, rng):
+            if (InferenceModel._quantizable(layer) and isinstance(p, dict)
+                    and "W" in p and not isinstance(x, (list, tuple))):
+                amax = float(jnp.abs(x).max())
+                records[layer.name] = max(records.get(layer.name, 0.0), amax)
+            return None
+
+        xs = [jnp.asarray(a) for a in _as_list(calibrate)]
+        with intercept_layer_calls(rec):
+            model.apply(params, net_state, xs if len(xs) > 1 else xs[0],
+                        training=False, rng=None)
+        if not records:
+            raise ValueError("calibration found no quantizable layer "
+                             "(Dense/Convolution2D) in the model")
+        return {name: max(amax, 1e-8) / 127.0
+                for name, amax in records.items()}
+
+    @staticmethod
+    def _rewrite_quantized(params, act_scales):
+        """Replace each calibrated layer's param subtree with its static-int8
+        entry, recursing through nested containers."""
+        def rewrite(tree):
+            if not isinstance(tree, dict):
+                return tree
+            out = {}
+            for k, v in tree.items():
+                if (k in act_scales and isinstance(v, dict) and "W" in v):
+                    out[k] = _quantize_layer_entry(v, act_scales[k])
+                else:
+                    out[k] = rewrite(v)
+            return out
+        return rewrite(params)
 
     # ---- predict (InferenceModel.scala:622-656) ---------------------------
     def predict(self, x, batch_size: Optional[int] = None):

@@ -142,7 +142,10 @@ def bench_wide_deep():
 def bench_int8_inference():
     """The reference's int8 inference harness role
     (``examples/vnni/openvino/Perf.scala:34-98``: ResNet int8 FPS): steady-
-    state image-classification FPS for the int8 weight-only path vs fp32."""
+    state image-classification FPS for the CALIBRATED static-int8 path
+    (int8 x int8 -> int32 MXU compute + 4x smaller weights) vs fp32.
+    (Through r3 mid-round this metric measured weight-only int8; the key
+    kept its name when activation quantization landed.)"""
     import jax
 
     from analytics_zoo_tpu.models.image.imageclassification import (
@@ -159,20 +162,28 @@ def bench_int8_inference():
     m.init_weights(sample_input=x[:2])
 
     out = {}
-    x_dev = jax.device_put(x)
+    # DISTINCT device-resident inputs per rep: the tunneled runtime caches
+    # pure (executable, args) executions, so repeating one buffer measures
+    # the cache, not the chip (best-of-identical-windows read 724k FPS)
+    x_devs = [jax.device_put(np.roll(x, i, axis=0)) for i in range(8)]
     for mode, quant in (("fp32", None), ("int8", "int8")):
-        im = InferenceModel().from_keras(m, quantize=quant)
-        # device-resident timing: the tunnel/host transfer otherwise
-        # dominates and the number stops being about the chip
-        y = im._predict(im._params, im._net_state, x_dev)
-        jax.block_until_ready(y)  # compile + warm
-        reps = 10
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            y = im._predict(im._params, im._net_state, x_dev)
-        jax.block_until_ready(y)
-        out[f"image_infer_{mode}_fps"] = round(
-            reps * x.shape[0] / (time.perf_counter() - t0), 1)
+        im = InferenceModel().from_keras(
+            m, quantize=quant,
+            calibrate=x[:8] if quant == "int8" else None)
+        y = im._predict(im._params, im._net_state, x_devs[0])
+        np.asarray(y)  # compile + warm; block_until_ready alone does NOT
+        # reliably fence on the tunneled backend — only a data readback does
+        reps, best = 24, 0.0
+        # best of 3 windows: a single short window flaps under tunnel jitter
+        for w in range(3):
+            t0 = time.perf_counter()
+            for r in range(reps):
+                y = im._predict(im._params, im._net_state,
+                                x_devs[(w * reps + r) % len(x_devs)])
+            np.asarray(y)  # readback = the only trustworthy fence
+            best = max(best, reps * x.shape[0]
+                       / (time.perf_counter() - t0))
+        out[f"image_infer_{mode}_fps"] = round(best, 1)
     return out
 
 
@@ -242,13 +253,14 @@ def main():
     # donated args: re-feed outputs so buffers stay valid
     params, opt_state, net_state, l = epoch_fn(
         params, opt_state, net_state, base_rng, it0, shuffle_rng, xs_dev, ys_dev)
-    jax.block_until_ready(l)
+    np.asarray(l)  # readback fence — block_until_ready alone does not
+    # reliably fence on the tunneled backend
     n_rep, td0 = 3, time.perf_counter()
     for _ in range(n_rep):
         params, opt_state, net_state, l = epoch_fn(
             params, opt_state, net_state, base_rng, it0, shuffle_rng,
             xs_dev, ys_dev)
-    jax.block_until_ready(l)
+    np.asarray(l)
     device_step_ms = ((time.perf_counter() - td0)
                       / (n_rep * steps_per_epoch) * 1e3)
 

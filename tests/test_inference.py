@@ -95,6 +95,90 @@ def test_int8_quantization_memory_and_accuracy():
     assert acc > 0.9
 
 
+def test_int8_static_activation_quantization():
+    """quantize="int8" + calibrate: Dense layers execute int8 x int8 ->
+    int32 with calibrated activation scales; predictions must track fp32."""
+    init_zoo_context()
+    m, x, y = _trained_mlp(n=1024)
+    fp = InferenceModel().from_keras(m)
+    q8 = InferenceModel().from_keras(m, quantize="int8", calibrate=x[:64])
+    assert q8._act_scales and len(q8._act_scales) == 2  # both Dense layers
+    pf, pq = fp.predict(x), q8.predict(x)
+    agree = (np.argmax(pf, -1) == np.argmax(pq, -1)).mean()
+    assert agree > 0.97, agree
+    acc = (q8.predict_classes(x) == y).mean()
+    assert acc > 0.9
+    # the quantized kernels really are int8 on device
+    sub = q8._params["dense_0"]
+    assert np.asarray(sub["W"]).dtype == np.int8
+    assert "x_scale" in sub and "w_scale" in sub
+
+
+def test_int8_static_conv_model():
+    """Calibrated int8 through a conv graph Model (the ImageClassifier
+    shape): conv + dense layers quantize, output stays close to fp32."""
+    from analytics_zoo_tpu.pipeline.api.keras import Model
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Input
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D, Flatten, GlobalAveragePooling2D)
+
+    init_zoo_context()
+    rng = np.random.default_rng(5)
+    inp = Input((8, 8, 3))
+    h = Convolution2D(8, 3, 3, activation="relu", border_mode="same")(inp)
+    h = GlobalAveragePooling2D()(h)
+    out = Dense(4, activation="softmax")(h)
+    m = Model(input=inp, output=out)
+    m.compile(optimizer="adam", loss="scce")
+    x = rng.normal(size=(64, 8, 8, 3)).astype(np.float32)
+    m.init_weights(sample_input=x[:2])
+
+    fp = InferenceModel().from_keras(m)
+    q8 = InferenceModel().from_keras(m, quantize="int8", calibrate=x[:16])
+    assert len(q8._act_scales) == 2  # conv + dense
+    pf, pq = fp.predict(x), q8.predict(x)
+    assert (np.argmax(pf, -1) == np.argmax(pq, -1)).mean() > 0.95
+    np.testing.assert_allclose(pq, pf, atol=0.08)
+
+
+def test_int8_static_skips_call_overriding_subclass():
+    """A conv subclass that overrides call() with different semantics
+    (ShareConvolution2D's explicit pad) must NOT be routed through the
+    inherited quantized path (code-review regression)."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import ShareConvolution2D
+    init_zoo_context()
+    rng = np.random.default_rng(7)
+    m = Sequential([ShareConvolution2D(4, 3, 3, pad_h=1, pad_w=1,
+                                       input_shape=(8, 8, 3)),
+                    Dense(4, activation="softmax")])
+    m.compile(optimizer="adam", loss="scce")
+    x = rng.normal(size=(16, 8, 8, 3)).astype(np.float32)
+    m.init_weights(sample_input=x[:2])
+    fp = InferenceModel().from_keras(m)
+    q8 = InferenceModel().from_keras(m, quantize="int8", calibrate=x[:8])
+    # only the Dense quantizes; the ShareConvolution2D stays float
+    assert list(q8._act_scales) == ["dense_1"]
+    np.testing.assert_allclose(q8.predict(x), fp.predict(x), atol=0.05)
+
+
+def test_calibrate_without_quantize_mode_raises():
+    init_zoo_context()
+    m, x, _ = _trained_mlp()
+    with pytest.raises(ValueError, match="requires quantize"):
+        InferenceModel().from_keras(m, calibrate=x[:8])
+
+
+def test_int8_calibrate_without_quantizable_layer_raises():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Activation
+    init_zoo_context()
+    m = Sequential([Activation("tanh", input_shape=(4,))])
+    m.compile(optimizer="adam", loss="mse")
+    m.init_weights()
+    with pytest.raises(ValueError, match="no quantizable layer"):
+        InferenceModel().from_keras(m, quantize="int8",
+                                    calibrate=np.ones((2, 4), np.float32))
+
+
 def test_quantize_int8_roundtrip_error_bounded():
     rng = np.random.default_rng(3)
     w = {"k": rng.normal(0, 0.1, (64, 32)).astype(np.float32),

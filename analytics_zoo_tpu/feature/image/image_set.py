@@ -30,10 +30,12 @@ class ImageSet:
 
     def __init__(self, images: Union[np.ndarray, List[np.ndarray]],
                  labels: Optional[np.ndarray] = None,
-                 label_map: Optional[Dict[str, int]] = None):
+                 label_map: Optional[Dict[str, int]] = None,
+                 paths: Optional[List[str]] = None):
         self.images = images
         self.labels = None if labels is None else np.asarray(labels)
         self.label_map = label_map
+        self.paths = paths  # origin files, kept for NNImageReader tables
 
     # ---- factories (ImageSet.scala:236 read) ------------------------------
     @staticmethod
@@ -53,7 +55,11 @@ class ImageSet:
             return np.asarray(im, np.uint8)
 
         if os.path.isfile(path):
-            return ImageSet([load(path)])
+            if with_label:
+                raise ValueError(
+                    f"{path} is a single file; with_label=True needs a "
+                    "directory of per-class subdirectories")
+            return ImageSet([load(path)], paths=[path])
         if not os.path.isdir(path):
             raise FileNotFoundError(path)
         if with_label:
@@ -63,22 +69,26 @@ class ImageSet:
                 raise ValueError(f"{path}: with_label=True needs per-class "
                                  "subdirectories")
             label_map = {c: i for i, c in enumerate(classes)}
-            images, labels = [], []
+            images, labels, paths = [], [], []
             for c in classes:
                 for f in sorted(os.listdir(os.path.join(path, c))):
                     if f.lower().endswith(_EXTS):
-                        images.append(load(os.path.join(path, c, f)))
+                        p = os.path.join(path, c, f)
+                        images.append(load(p))
                         labels.append(label_map[c])
+                        paths.append(p)
             if not images:
                 raise ValueError(
                     f"no images under {path} (recognized extensions: "
                     f"{', '.join(_EXTS)})")
-            return ImageSet(images, np.asarray(labels, np.int32), label_map)
-        images = [load(os.path.join(path, f)) for f in sorted(os.listdir(path))
-                  if f.lower().endswith(_EXTS)]
+            return ImageSet(images, np.asarray(labels, np.int32), label_map,
+                            paths=paths)
+        files = [os.path.join(path, f) for f in sorted(os.listdir(path))
+                 if f.lower().endswith(_EXTS)]
+        images = [load(f) for f in files]
         if not images:
             raise ValueError(f"no images under {path}")
-        return ImageSet(images)
+        return ImageSet(images, paths=files)
 
     @staticmethod
     def from_arrays(images, labels=None) -> "ImageSet":
@@ -93,7 +103,7 @@ class ImageSet:
         """Apply an image-transform chain (``ImageSet.transform``); labels
         ride along unchanged."""
         return ImageSet(preprocessing(self.images), self.labels,
-                        self.label_map)
+                        self.label_map, paths=self.paths)
 
     def to_feature_set(self, shuffle: bool = True, seed: int = 0) -> FeatureSet:
         """Finalize into the training/inference ``FeatureSet``: stacks to a

@@ -152,7 +152,8 @@ class InferenceModel:
 
     def load_checkpoint(self, model: KerasNet, ckpt_dir: str, *,
                         dtype: str = "float32",
-                        quantize: Optional[str] = None) -> "InferenceModel":
+                        quantize: Optional[str] = None,
+                        calibrate=None) -> "InferenceModel":
         """Load the newest training snapshot from ``ckpt_dir`` into
         ``model``'s architecture (``doLoadTF(checkpoint)`` role)."""
         if model.params is None:
@@ -165,7 +166,8 @@ class InferenceModel:
                                       "net_state": model.net_state})
         model.params = trees["params"]
         model.net_state = trees["net_state"]
-        return self.from_keras(model, dtype=dtype, quantize=quantize)
+        return self.from_keras(model, dtype=dtype, quantize=quantize,
+                               calibrate=calibrate)
 
     def from_keras(self, model: KerasNet, *, dtype: str = "float32",
                    quantize: Optional[str] = None,

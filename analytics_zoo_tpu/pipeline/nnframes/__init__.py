@@ -2,5 +2,7 @@
 reference, L6)."""
 
 from .nn_estimator import NNClassifier, NNClassifierModel, NNEstimator, NNModel
+from .nn_image_reader import NNImageReader
 
-__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel"]
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+           "NNImageReader"]

@@ -1,0 +1,44 @@
+"""NNImageReader — image files into the NNFrames columnar table, parity
+with ``pipeline/nnframes/NNImageReader.scala`` (which reads image files
+into a Spark DataFrame of image rows via OpenCV JNI).
+
+TPU-native shape: the "image DataFrame" is the same dict-of-arrays table
+NNFrames trains from — ``{"image": NHWC uint8, "path": origin files,
+["label": int32]}`` — decoded on the host with PIL (the OpenCV-JNI role,
+SURVEY §2.3) and resized to a common static shape so batches stack dense
+for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...feature.image import ImageSet
+from .nn_estimator import Table
+
+__all__ = ["NNImageReader"]
+
+
+class NNImageReader:
+    """``NNImageReader.readImages(path, ...)`` equivalent."""
+
+    @staticmethod
+    def read_images(path: str, resize_h: int, resize_w: int,
+                    with_label: bool = False) -> Table:
+        """Read a file / directory / per-class directory tree into a table.
+
+        A common ``(resize_h, resize_w)`` is REQUIRED (the reference keeps
+        ragged mats and pays per-image work downstream; a dense NHWC column
+        is the XLA-friendly contract).
+        """
+        iset = ImageSet.read(path, with_label=with_label,
+                             resize_h=resize_h, resize_w=resize_w)
+        images = (iset.images if isinstance(iset.images, np.ndarray)
+                  else np.stack(iset.images))
+        table: Table = {"image": images,
+                        "path": np.asarray(iset.paths or [""] * len(iset))}
+        if iset.labels is not None:
+            table["label"] = iset.labels.astype(np.int32)
+        return table

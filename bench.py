@@ -162,24 +162,27 @@ def bench_int8_inference():
     m.init_weights(sample_input=x[:2])
 
     out = {}
-    # DISTINCT device-resident inputs per rep: the tunneled runtime caches
-    # pure (executable, args) executions, so repeating one buffer measures
-    # the cache, not the chip (best-of-identical-windows read 724k FPS)
-    x_devs = [jax.device_put(np.roll(x, i, axis=0)) for i in range(8)]
+    # EVERY timed rep gets its own device buffer (never reused across
+    # windows or modes): repeated identical (executable, args) dispatches
+    # risk hitting runtime/tunnel caching instead of the chip, and
+    # block_until_ready alone does not reliably fence on the tunneled
+    # backend — only a data readback does
+    reps, windows = 16, 3
+    x_devs = [jax.device_put(np.roll(x, i + 1, axis=0))
+              for i in range(reps * windows)]
+    warm = jax.device_put(x)
     for mode, quant in (("fp32", None), ("int8", "int8")):
         im = InferenceModel().from_keras(
             m, quantize=quant,
             calibrate=x[:8] if quant == "int8" else None)
-        y = im._predict(im._params, im._net_state, x_devs[0])
-        np.asarray(y)  # compile + warm; block_until_ready alone does NOT
-        # reliably fence on the tunneled backend — only a data readback does
-        reps, best = 24, 0.0
+        np.asarray(im._predict(im._params, im._net_state, warm))
+        best = 0.0
         # best of 3 windows: a single short window flaps under tunnel jitter
-        for w in range(3):
+        for w in range(windows):
             t0 = time.perf_counter()
             for r in range(reps):
                 y = im._predict(im._params, im._net_state,
-                                x_devs[(w * reps + r) % len(x_devs)])
+                                x_devs[w * reps + r])
             np.asarray(y)  # readback = the only trustworthy fence
             best = max(best, reps * x.shape[0]
                        / (time.perf_counter() - t0))

@@ -111,6 +111,46 @@ def test_image_set_read_with_labels(tmp_path):
     assert len(fs) == 5
 
 
+def test_nn_image_reader_table_and_classifier_fit(tmp_path):
+    """NNImageReader.read_images -> columnar table -> NNClassifier fit:
+    the reference's image-DataFrame pipeline (``NNImageReader.scala``) on
+    the dict-of-arrays table."""
+    import optax
+    from PIL import Image
+
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D, Dense, Flatten)
+    from analytics_zoo_tpu.pipeline.nnframes import NNClassifier, NNImageReader
+
+    rng = np.random.default_rng(0)
+    # dark vs bright images — learnable from pixel means
+    for cls, lo, hi in (("dark", 0, 80), ("bright", 170, 255)):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(8):
+            arr = rng.integers(lo, hi, (14, 12, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.png")
+
+    table = NNImageReader.read_images(str(tmp_path), resize_h=8, resize_w=8,
+                                      with_label=True)
+    assert table["image"].shape == (16, 8, 8, 3)
+    assert table["image"].dtype == np.uint8
+    assert len(table["path"]) == 16 and table["label"].shape == (16,)
+
+    m = Sequential([Convolution2D(4, 3, 3, activation="relu",
+                                  input_shape=(8, 8, 3)),
+                    Flatten(), Dense(2, activation="softmax")])
+    clf = (NNClassifier(m, feature_preprocessing=lambda t:
+                        t["image"].astype(np.float32) / 255.0)
+           .set_optim_method(optax.adam(0.01))
+           .set_batch_size(8).set_max_epoch(10))
+    model = clf.fit(table)
+    out = model.transform(table)
+    acc = (out["prediction"] == table["label"]).mean()
+    assert acc > 0.9, acc
+
+
 # ---- ImageClassifier ------------------------------------------------------
 
 def test_simple_cnn_trains_on_stripes():

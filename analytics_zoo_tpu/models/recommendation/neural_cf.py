@@ -33,6 +33,20 @@ class Recommender(RankerMixin, ZooModel):
         """Probability per (user, item) row — ``predictUserItemPair``."""
         return self.predict(np.asarray(user_item_pairs), batch_size=batch_size)
 
+    @staticmethod
+    def _top_ids(ids: np.ndarray, probs: np.ndarray,
+                 max_items: int) -> np.ndarray:
+        """Recommender.scala:55,92-96 sorts by (predicted class desc,
+        probability of that class desc): a confidently-rated-5 item
+        outranks any rated-4 item regardless of probability mass."""
+        if probs.ndim > 1:
+            cls = np.argmax(probs, axis=1)
+            p_cls = probs[np.arange(len(cls)), cls]
+            top = np.lexsort((-p_cls, -cls))[:max_items]
+        else:
+            top = np.argsort(-probs)[:max_items]
+        return ids[top]
+
     def recommend_for_user(self, user_id: int, candidate_items: np.ndarray,
                            max_items: int = 10,
                            batch_size: int = 1024) -> np.ndarray:
@@ -40,17 +54,18 @@ class Recommender(RankerMixin, ZooModel):
         Scores every candidate item in one batched forward."""
         items = np.asarray(candidate_items).reshape(-1)
         pairs = np.stack([np.full_like(items, user_id), items], axis=1)
-        probs = self.predict(pairs, batch_size=batch_size)
-        if probs.ndim > 1:
-            # Recommender.scala:55,92-96 sorts by (predicted class desc,
-            # probability of that class desc): a confidently-rated-5 item
-            # outranks any rated-4 item regardless of probability mass.
-            cls = np.argmax(probs, axis=1)
-            p_cls = probs[np.arange(len(cls)), cls]
-            top = np.lexsort((-p_cls, -cls))[:max_items]
-        else:
-            top = np.argsort(-probs)[:max_items]
-        return items[top]
+        return self._top_ids(items, self.predict(pairs, batch_size=batch_size),
+                             max_items)
+
+    def recommend_for_item(self, item_id: int, candidate_users: np.ndarray,
+                           max_items: int = 10,
+                           batch_size: int = 1024) -> np.ndarray:
+        """Top-``max_items`` user ids for one item — ``recommendForItem``
+        (``Recommender.scala:67``), same class-then-probability ordering."""
+        users = np.asarray(candidate_users).reshape(-1)
+        pairs = np.stack([users, np.full_like(users, item_id)], axis=1)
+        return self._top_ids(users, self.predict(pairs, batch_size=batch_size),
+                             max_items)
 
 
 @register_model

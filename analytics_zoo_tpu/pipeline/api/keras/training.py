@@ -411,9 +411,18 @@ class TrainingLoop:
 
     def build_predict_step(self):
         model = self.model
+        # multi-host: batch-sharded outputs span processes, which the host
+        # cannot device_get; replicate them on-device (an all-gather over
+        # ICI/DCN — the reference ships predictions back through Spark the
+        # same way, Predictor.scala:136-208)
+        gather = jax.process_count() > 1
+        repl = (mesh_lib.replicated_sharding(self.mesh) if gather else None)
 
         def step(params, net_state, x):
             yp, _ = model.apply(params, net_state, x, training=False, rng=None)
+            if gather:
+                yp = jax.tree.map(
+                    lambda a: jax.lax.with_sharding_constraint(a, repl), yp)
             return yp
 
         self._predict_step = jax.jit(step)

@@ -59,6 +59,10 @@ def test_ncf_predict_classes_and_recommend():
                                 max_items=7)
     assert recs.shape == (7,)
     assert len(set(recs.tolist())) == 7
+    urecs = m.recommend_for_item(item_id=5, candidate_users=np.arange(1, 51),
+                                 max_items=6)
+    assert urecs.shape == (6,)
+    assert len(set(urecs.tolist())) == 6
 
 
 def test_zoo_model_save_load_roundtrip(tmp_path):

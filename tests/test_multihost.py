@@ -1,0 +1,107 @@
+"""REAL multi-host training test: two OS processes, each with 2 virtual CPU
+devices, joined by ``jax.distributed`` through ``init_zoo_context``'s
+coordinator conf — collectives ride Gloo across process boundaries (the DCN
+role). The reference never tests its cluster path in-repo (SURVEY §4:
+"no multi-process/multi-node test harness"); this does.
+
+Checks: both ranks come up with the 4-device global mesh, fit runs the
+GSPMD-sharded step across processes, per-epoch losses are IDENTICAL on both
+ranks AND identical to a single-process run (sharding is layout, not math),
+and predict returns the full output on every rank (replicated gather).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import init_zoo_context
+
+_WORKER = r"""
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, optax
+from analytics_zoo_tpu.common import init_zoo_context
+init_zoo_context(distributed_coordinator=f"localhost:{port}",
+                 distributed_num_processes=2, distributed_process_id=pid)
+assert jax.process_count() == 2 and jax.device_count() == 4
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+rng = np.random.default_rng(0)  # identical data on every process
+x = rng.normal(size=(256, 8)).astype(np.float32)
+w = rng.normal(size=(8, 3)).astype(np.float32)
+y = np.argmax(x @ w, 1).astype(np.int32)
+m = Sequential([Dense(16, activation="relu", input_shape=(8,)),
+                Dense(3, activation="softmax")])
+m.compile(optimizer=optax.adam(0.01), loss="scce")
+h = m.fit(x, y, batch_size=64, nb_epoch=3)
+p = m.predict(x[:8], batch_size=8)
+print("RESULT", pid, ",".join(f"{v:.6f}" for v in h["loss"]),
+      ",".join(f"{v:.6f}" for v in np.asarray(p[0])), flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_training_matches_single_process(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (os.path.dirname(os.path.dirname(__file__)),
+                    env.get("PYTHONPATH")) if p)
+    procs = [subprocess.Popen([sys.executable, str(worker), str(i), str(port)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for i in range(2)]
+    try:
+        # one rank dying leaves the other blocked in the coordinator
+        # barrier — always reap both
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT"):
+                _, pid, losses, pred = line.split(" ")
+                results[int(pid)] = (losses, pred)
+    assert set(results) == {0, 1}, f"missing RESULT lines: {outs}"
+    # both ranks observe identical losses and the full prediction
+    assert results[0] == results[1]
+
+    # and the math matches a single-process run bit-for-bit-ish: sharding
+    # across processes is a layout choice, not a different algorithm
+    import optax
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 3)).astype(np.float32)
+    y = np.argmax(x @ w, 1).astype(np.int32)
+    m = Sequential([Dense(16, activation="relu", input_shape=(8,)),
+                    Dense(3, activation="softmax")])
+    m.compile(optimizer=optax.adam(0.01), loss="scce")
+    h = m.fit(x, y, batch_size=64, nb_epoch=3)
+    got = [float(v) for v in results[0][0].split(",")]
+    np.testing.assert_allclose(got, h["loss"], rtol=1e-4, atol=1e-5)

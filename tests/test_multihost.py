@@ -42,8 +42,10 @@ m = Sequential([Dense(16, activation="relu", input_shape=(8,)),
 m.compile(optimizer=optax.adam(0.01), loss="scce")
 h = m.fit(x, y, batch_size=64, nb_epoch=3)
 p = m.predict(x[:8], batch_size=8)
+ev = m.evaluate(x, y, batch_size=64)   # reduced totals replicate: works
 print("RESULT", pid, ",".join(f"{v:.6f}" for v in h["loss"]),
-      ",".join(f"{v:.6f}" for v in np.asarray(p[0])), flush=True)
+      ",".join(f"{v:.6f}" for v in np.asarray(p[0])),
+      f"{ev['loss']:.6f}", flush=True)
 """
 
 
@@ -82,8 +84,8 @@ def test_two_process_training_matches_single_process(tmp_path):
     for out in outs:
         for line in out.splitlines():
             if line.startswith("RESULT"):
-                _, pid, losses, pred = line.split(" ")
-                results[int(pid)] = (losses, pred)
+                _, pid, losses, pred, ev = line.split(" ")
+                results[int(pid)] = (losses, pred, ev)
     assert set(results) == {0, 1}, f"missing RESULT lines: {outs}"
     # both ranks observe identical losses and the full prediction
     assert results[0] == results[1]

@@ -218,13 +218,21 @@ def init_zoo_context(
         return _context
 
     merged: Dict[str, Any] = dict(DEFAULT_CONF)
+    explicit: set = set()
     if conf_path:
-        merged.update(_load_yaml(conf_path))
-    merged.update(_env_overrides())
+        loaded = _load_yaml(conf_path)
+        merged.update(loaded)
+        explicit.update(loaded)
+    env = _env_overrides()
+    merged.update(env)
+    explicit.update(env)
     if conf:
         merged.update(conf)
+        explicit.update(conf)
     for k, v in kwargs.items():
-        merged[_canonical_key(k)] = v
+        ck = _canonical_key(k)
+        merged[ck] = v
+        explicit.add(ck)
 
     logging.basicConfig(level=merged.get("zoo.log.level", "INFO"))
 
@@ -234,6 +242,11 @@ def init_zoo_context(
     if precision != "default":
         jax.config.update("jax_default_matmul_precision", precision)
 
+    dtype = str(merged.get("zoo.compute.dtype", "float32"))
+    if dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"zoo.compute.dtype must be float32|bfloat16, "
+                         f"got {dtype!r}")
+
     mesh = mesh_lib.create_mesh(
         data=int(merged["zoo.mesh.data"]),
         model=int(merged["zoo.mesh.model"]),
@@ -242,6 +255,16 @@ def init_zoo_context(
         pipe=int(merged["zoo.mesh.pipe"]),
     )
     mesh_lib.set_global_mesh(mesh)
+
+    # mixed-precision policy: params stay float32, layer compute runs at
+    # zoo.compute.dtype (bfloat16 = MXU native). Applied only AFTER the
+    # mesh commits (a failed re-init must not leave a half-applied
+    # context), and only when the key was explicitly provided — a lazy
+    # default init inside fit() must not clobber a direct
+    # ``engine.set_policy(...)`` call
+    if "zoo.compute.dtype" in explicit:
+        from ..pipeline.api.keras import engine as _engine
+        _engine.set_policy(compute_dtype=dtype)
 
     _context = ZooContext(conf=merged, mesh=mesh)
     log.info(
@@ -263,3 +286,5 @@ def reset_zoo_context() -> None:
     global _context
     _context = None
     mesh_lib.reset_global_mesh()
+    from ..pipeline.api.keras import engine as _engine
+    _engine.set_policy()  # back to the float32 default

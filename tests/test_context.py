@@ -50,3 +50,38 @@ def test_context_idempotent():
     a = init_zoo_context()
     b = get_zoo_context()
     assert a is b
+
+
+def test_compute_dtype_policy_wired():
+    """zoo.compute.dtype drives the engine precision policy (it was once a
+    documented-but-dead conf key)."""
+    import jax.numpy as jnp
+    import pytest
+
+    from analytics_zoo_tpu.common import init_zoo_context
+    from analytics_zoo_tpu.common.context import reset_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras.engine import compute_dtype
+
+    init_zoo_context(compute_dtype="bfloat16")
+    assert compute_dtype() == jnp.bfloat16
+    reset_zoo_context()
+    init_zoo_context()
+    assert compute_dtype() == jnp.float32
+    reset_zoo_context()
+    with pytest.raises(ValueError, match="float32|bfloat16"):
+        init_zoo_context(compute_dtype="float16")
+
+
+def test_lazy_init_does_not_clobber_manual_policy():
+    """A direct set_policy() call must survive the lazy default
+    init_zoo_context() that fit() triggers (code-review regression)."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.common import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras.engine import (compute_dtype,
+                                                             set_policy)
+
+    set_policy(compute_dtype=jnp.bfloat16)
+    init_zoo_context()  # lazy default init — no explicit compute_dtype
+    assert compute_dtype() == jnp.bfloat16
+    set_policy()

@@ -136,22 +136,18 @@ def test_gpipe_indivisible_batch_falls_back_to_sequential():
 
 def test_gpipe_bfloat16_policy():
     """The scan carry must stay dtype-stable under a bf16 compute policy —
-    on both the pipelined and the sequential path (code-review regression)."""
-    from analytics_zoo_tpu.pipeline.api.keras.engine import set_policy
+    on both the pipelined and the sequential path (code-review regression).
+    The policy rides zoo.compute.dtype (init_zoo_context owns set_policy)."""
     d = 8
     x = np.random.default_rng(4).normal(size=(16, d)).astype(np.float32)
-    try:
-        set_policy(compute_dtype=jnp.bfloat16)
-        for pipe in (4, 1):
-            reset_zoo_context()
-            init_zoo_context(mesh_pipe=pipe)
-            layer = GPipe(lambda: Dense(d, activation="tanh"), num_stages=4)
-            p = layer.build(jax.random.key(0), (None, d))
-            y = layer.call(p, jnp.asarray(x))
-            assert y.dtype == jnp.bfloat16
-            assert np.all(np.isfinite(np.asarray(y, np.float32)))
-    finally:
-        set_policy()
+    for pipe in (4, 1):
+        reset_zoo_context()
+        init_zoo_context(mesh_pipe=pipe, compute_dtype="bfloat16")
+        layer = GPipe(lambda: Dense(d, activation="tanh"), num_stages=4)
+        p = layer.build(jax.random.key(0), (None, d))
+        y = layer.call(p, jnp.asarray(x))
+        assert y.dtype == jnp.bfloat16
+        assert np.all(np.isfinite(np.asarray(y, np.float32)))
 
 
 def test_gpipe_paramless_stage():

@@ -234,6 +234,19 @@ def init_zoo_context(
         merged[ck] = v
         explicit.add(ck)
 
+    # validate BEFORE any global side-effect (jax config, distributed
+    # bring-up): a rejected call must not leave half-applied state.
+    # jnp.dtype normalization accepts both "bfloat16" and jnp.bfloat16.
+    import jax.numpy as jnp
+    try:
+        dtype = jnp.dtype(merged.get("zoo.compute.dtype", "float32")).name
+    except TypeError:
+        dtype = str(merged.get("zoo.compute.dtype"))
+    if dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"zoo.compute.dtype must be float32|bfloat16, "
+                         f"got {merged.get('zoo.compute.dtype')!r}")
+    merged["zoo.compute.dtype"] = dtype
+
     logging.basicConfig(level=merged.get("zoo.log.level", "INFO"))
 
     _maybe_init_distributed(merged)
@@ -241,11 +254,6 @@ def init_zoo_context(
     precision = merged.get("zoo.matmul.precision", "default")
     if precision != "default":
         jax.config.update("jax_default_matmul_precision", precision)
-
-    dtype = str(merged.get("zoo.compute.dtype", "float32"))
-    if dtype not in ("float32", "bfloat16"):
-        raise ValueError(f"zoo.compute.dtype must be float32|bfloat16, "
-                         f"got {dtype!r}")
 
     mesh = mesh_lib.create_mesh(
         data=int(merged["zoo.mesh.data"]),
@@ -259,10 +267,11 @@ def init_zoo_context(
     # mixed-precision policy: params stay float32, layer compute runs at
     # zoo.compute.dtype (bfloat16 = MXU native). Applied only AFTER the
     # mesh commits (a failed re-init must not leave a half-applied
-    # context), and only when the key was explicitly provided — a lazy
-    # default init inside fit() must not clobber a direct
-    # ``engine.set_policy(...)`` call
-    if "zoo.compute.dtype" in explicit:
+    # context), and only when the caller passed ANY explicit setting —
+    # then the policy always tracks the new context's conf (a re-init
+    # restarts from defaults like every other key), while the bare lazy
+    # init inside fit() never clobbers a direct ``engine.set_policy(...)``
+    if explicit:
         from ..pipeline.api.keras import engine as _engine
         _engine.set_policy(compute_dtype=dtype)
 

@@ -85,3 +85,22 @@ def test_lazy_init_does_not_clobber_manual_policy():
     init_zoo_context()  # lazy default init — no explicit compute_dtype
     assert compute_dtype() == jnp.bfloat16
     set_policy()
+
+
+def test_reinit_resets_policy_to_conf_default():
+    """An explicit re-init restarts the compute policy from the merged conf
+    like every other key — no stale bf16 leaking past a re-init."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.common import init_zoo_context
+    from analytics_zoo_tpu.common.context import reset_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras.engine import compute_dtype
+
+    init_zoo_context(compute_dtype="bfloat16")
+    assert compute_dtype() == jnp.bfloat16
+    init_zoo_context(seed=7)  # explicit re-init, dtype not given
+    assert compute_dtype() == jnp.float32
+    reset_zoo_context()
+    # dtype objects are accepted like the old direct set_policy was
+    init_zoo_context(compute_dtype=jnp.bfloat16)
+    assert compute_dtype() == jnp.bfloat16

@@ -89,10 +89,15 @@ class ObjectDetector(ZooModel):
         return MultiBoxLoss(self.num_classes, self.priors,
                             bg_label=self.post_param.bg_label, **kw)
 
-    def detect(self, images: np.ndarray, batch_size: int = 32,
+    def decode(self, raw: np.ndarray,
                conf_thresh: Optional[float] = None) -> np.ndarray:
-        """Images (B, H, W, 3) → detections (B, keep_topk, 6)."""
-        raw = np.asarray(self.predict(images, batch_size=batch_size))
+        """Raw scores (B, priors, 4 + classes) → detections
+        (B, keep_topk, 6). The decode half of :meth:`detect`, exposed so
+        out-of-process consumers (Cluster Serving clients streaming raw
+        scores) run the identical post-processing."""
+        raw = np.asarray(raw)
+        if raw.ndim == 2:
+            raw = raw[None]
         loc, conf = raw[..., :4], raw[..., 4:]
         import jax
         probs = np.asarray(jax.nn.softmax(conf, axis=-1))
@@ -103,3 +108,9 @@ class ObjectDetector(ZooModel):
                          else conf_thresh),
             nms_thresh=p.nms_thresh, nms_topk=p.nms_topk,
             keep_topk=p.keep_topk, bg_label=p.bg_label))
+
+    def detect(self, images: np.ndarray, batch_size: int = 32,
+               conf_thresh: Optional[float] = None) -> np.ndarray:
+        """Images (B, H, W, 3) → detections (B, keep_topk, 6)."""
+        raw = self.predict(images, batch_size=batch_size)
+        return self.decode(raw, conf_thresh=conf_thresh)

@@ -211,14 +211,33 @@ def _conv_padding(attrs, spatial, in_shape=None, kernel=None, strides=None):
     return list(zip(pads[:half], pads[half:]))
 
 
-def _pool(x, op, init, attrs):
-    if attrs.get("ceil_mode"):
-        raise NotImplementedError("ceil_mode pooling not supported yet")
+def _pool_cfg(x, attrs):
+    """Window/stride/pad config shared by Max/AveragePool. Returns
+    ``(window, strides, real_pads, full_pads)`` where full_pads includes the
+    ceil-mode END extension and real_pads is the user-requested padding
+    (the distinction matters for AveragePool divisors)."""
     k = attrs["kernel_shape"]
     strides = attrs.get("strides", [1] * len(k))
     pads = _conv_padding(attrs, len(k), x.shape, k, strides)
-    window = (1, 1) + tuple(k)
-    strd = (1, 1) + tuple(strides)
+    real = pads
+    if attrs.get("ceil_mode") and not isinstance(pads, str):
+        full = []
+        for i in range(len(k)):
+            size = int(x.shape[2 + i]) + pads[i][0] + pads[i][1]
+            s, kk = int(strides[i]), int(k[i])
+            out_ceil = -(-(size - kk) // s) + 1
+            # ONNX/torch/caffe drop a window that would START in the ceil
+            # extension (at or past input + real padding)
+            if (out_ceil - 1) * s >= size:
+                out_ceil -= 1
+            need = max(0, (out_ceil - 1) * s + kk - size)
+            full.append((pads[i][0], pads[i][1] + need))
+        pads = full
+    return ((1, 1) + tuple(k), (1, 1) + tuple(strides), real, pads)
+
+
+def _pool(x, op, init, attrs):
+    window, strd, _, pads = _pool_cfg(x, attrs)
     pad_cfg = (pads if isinstance(pads, str)
                else [(0, 0), (0, 0)] + list(pads))
     return jax.lax.reduce_window(x, init, op, window, strd, pad_cfg)
@@ -273,9 +292,7 @@ def _run_node(node: Dict[str, Any], vals: Dict[str, Any],
             flat = ins[0].reshape(int(np.prod(shape[:ax]) if ax else 1), -1)
             vals[out] = jax.nn.softmax(flat, axis=-1).reshape(shape)
     elif op == "Conv":
-        if attrs.get("group", 1) != 1:
-            raise NotImplementedError("grouped Conv not supported yet")
-        spatial = ins[1].ndim - 2  # kernel is (O, I, *spatial) — 1/2/3D
+        spatial = ins[1].ndim - 2  # kernel is (O, I/g, *spatial) — 1/2/3D
         if not 1 <= spatial <= 3:
             raise NotImplementedError(f"Conv with {spatial} spatial dims")
         strides = attrs.get("strides", [1] * spatial)
@@ -286,6 +303,7 @@ def _run_node(node: Dict[str, Any], vals: Dict[str, Any],
         vals[out] = jax.lax.conv_general_dilated(
             ins[0], ins[1], tuple(strides), pads, rhs_dilation=tuple(dil),
             dimension_numbers=("NC" + chars, "OI" + chars, "NC" + chars),
+            feature_group_count=int(attrs.get("group", 1)),
             preferred_element_type=jnp.float32)
         if len(ins) > 2 and ins[2] is not None:
             vals[out] = vals[out] + ins[2].reshape(1, -1, *([1] * spatial))
@@ -293,9 +311,23 @@ def _run_node(node: Dict[str, Any], vals: Dict[str, Any],
         vals[out] = _pool(ins[0], jax.lax.max, -jnp.inf, attrs)
     elif op == "AveragePool":
         s = _pool(ins[0], jax.lax.add, 0.0, attrs)
+        window, strd, real, full = _pool_cfg(ins[0], attrs)
         if attrs.get("count_include_pad"):
-            # torch AvgPool2d default: padded zeros count in the divisor
-            vals[out] = s / float(np.prod(attrs["kernel_shape"]))
+            # the divisor counts input + REAL padding cells — never the
+            # ceil-mode extension (ONNX/torch clip it out): pool a ones
+            # array pre-padded with ones over the real pads, zero-padded
+            # over only the ceil extension
+            if isinstance(real, str) or real == full:
+                vals[out] = s / float(np.prod(attrs["kernel_shape"]))
+            else:
+                ones = jnp.pad(jnp.ones_like(ins[0]),
+                               [(0, 0), (0, 0)] + list(real),
+                               constant_values=1.0)
+                ext = [(0, f[1] - r[1]) for r, f in zip(real, full)]
+                n = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add, window, strd,
+                    [(0, 0), (0, 0)] + ext)
+                vals[out] = s / n
         else:
             n = _pool(jnp.ones_like(ins[0]), jax.lax.add, 0.0, attrs)
             vals[out] = s / n

@@ -45,22 +45,6 @@ def main():
     t = threading.Thread(target=camera)
     t.start()
 
-    import jax
-
-    from analytics_zoo_tpu.models.image.objectdetection.bbox import (
-        batched_detection_output)
-
-    def decode(raw):
-        """Client-side decode, the same path ObjectDetector.detect runs."""
-        raw = raw[None] if raw.ndim == 2 else raw
-        loc, conf = raw[..., :4], raw[..., 4:]
-        probs = np.asarray(jax.nn.softmax(conf, axis=-1))
-        p = det.post_param
-        return np.asarray(batched_detection_output(
-            loc, probs, det.priors, num_classes=det.num_classes,
-            conf_thresh=0.3, nms_thresh=p.nms_thresh, nms_topk=p.nms_topk,
-            keep_topk=p.keep_topk, bg_label=p.bg_label))[0]
-
     got = 0
     deadline = time.time() + 120
     while got < FRAMES and time.time() < deadline:
@@ -68,7 +52,7 @@ def main():
         if getattr(outq, "last_errors", None):
             raise RuntimeError(f"serving errors: {outq.last_errors}")
         for uri, scores in sorted(ready.items()):
-            dets = decode(np.asarray(scores))
+            dets = det.decode(np.asarray(scores), conf_thresh=0.3)[0]
             kept = dets[dets[:, 1] > 0]
             print(f"{uri}: {len(kept)} boxes "
                   + " ".join(f"cls{int(b[0])}:{b[1]:.2f}" for b in kept[:3]))

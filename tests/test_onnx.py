@@ -311,16 +311,6 @@ def test_opset11_softmax_flattens(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
-def test_ceil_mode_pool_is_loud(tmp_path):
-    nodes = [_node("MaxPool", ["x"], ["y"],
-                   [_attr_ints("kernel_shape", [3, 3]),
-                    _attr_i("ceil_mode", 1)])]
-    path = tmp_path / "ceil.onnx"
-    path.write_bytes(_model(nodes, [], ["x"], ["y"]))
-    net = load_onnx(str(path))
-    with pytest.raises(NotImplementedError, match="ceil_mode"):
-        net.call({}, np.zeros((1, 1, 5, 5), np.float32))
-
 
 def test_unsupported_op_is_loud(tmp_path):
     nodes = [_node("FancyCustomOp", ["x"], ["y"])]
@@ -329,3 +319,76 @@ def test_unsupported_op_is_loud(tmp_path):
     net = load_onnx(str(path))
     with pytest.raises(NotImplementedError):
         net.call({}, np.zeros((1, 2), np.float32))
+
+def test_grouped_conv_and_ceil_pool_match_torch(tmp_path):
+    """Grouped/depthwise Conv (feature_group_count) and ceil_mode pooling —
+    two formerly-unsupported ONNX attributes (code-review backlog)."""
+    torch.manual_seed(2)
+    conv = nn.Conv2d(4, 8, 3, padding=1, groups=2)
+    dw = nn.Conv2d(8, 8, 3, padding=1, groups=8)  # depthwise
+    x = np.random.default_rng(2).normal(size=(2, 4, 7, 7)).astype(np.float32)
+
+    nodes = [
+        _node("Conv", ["x", "w1", "b1"], ["c1"],
+              [_attr_ints("kernel_shape", [3, 3]),
+               _attr_ints("strides", [1, 1]),
+               _attr_ints("pads", [1, 1, 1, 1]), _attr_i("group", 2)]),
+        _node("Conv", ["c1", "w2", "b2"], ["c2"],
+              [_attr_ints("kernel_shape", [3, 3]),
+               _attr_ints("strides", [1, 1]),
+               _attr_ints("pads", [1, 1, 1, 1]), _attr_i("group", 8)]),
+        _node("MaxPool", ["c2"], ["p1"],
+              [_attr_ints("kernel_shape", [2, 2]),
+               _attr_ints("strides", [2, 2]), _attr_i("ceil_mode", 1)]),
+        _node("AveragePool", ["p1"], ["y"],
+              [_attr_ints("kernel_shape", [2, 2]),
+               _attr_ints("strides", [2, 2]), _attr_i("ceil_mode", 1)]),
+    ]
+    inits = [_tensor("w1", _np(conv.weight)), _tensor("b1", _np(conv.bias)),
+             _tensor("w2", _np(dw.weight)), _tensor("b2", _np(dw.bias))]
+    path = tmp_path / "gc.onnx"
+    path.write_bytes(_model(nodes, inits, ["x"], ["y"]))
+
+    net = OnnxLoader.load(str(path))
+    got = np.asarray(net.call(net.build(None), np.asarray(x)))
+    with torch.no_grad():
+        h = torch.max_pool2d(dw(conv(torch.tensor(x))), 2, 2, ceil_mode=True)
+        want = torch.nn.functional.avg_pool2d(
+            h, 2, 2, ceil_mode=True, count_include_pad=False).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_ceil_pool_phantom_window_clipped(tmp_path):
+    """A ceil window starting entirely in the extension must be dropped
+    (torch/ONNX clip it) — no -inf/NaN phantom outputs."""
+    torch.manual_seed(3)
+    x = np.random.default_rng(3).normal(size=(1, 2, 4, 4)).astype(np.float32)
+    nodes = [_node("MaxPool", ["x"], ["y"],
+                   [_attr_ints("kernel_shape", [2, 2]),
+                    _attr_ints("strides", [4, 4]), _attr_i("ceil_mode", 1)])]
+    path = tmp_path / "cp.onnx"
+    path.write_bytes(_model(nodes, [], ["x"], ["y"]))
+    net = OnnxLoader.load(str(path))
+    got = np.asarray(net.call(net.build(None), np.asarray(x)))
+    want = torch.max_pool2d(torch.tensor(x), 2, 4, ceil_mode=True).numpy()
+    assert got.shape == want.shape, (got.shape, want.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_ceil_avgpool_count_include_pad_matches_torch(tmp_path):
+    """ceil_mode + count_include_pad: the divisor counts input + real
+    padding but never the ceil extension (code-review regression)."""
+    x = np.random.default_rng(4).normal(size=(1, 3, 5, 5)).astype(np.float32)
+    nodes = [_node("AveragePool", ["x"], ["y"],
+                   [_attr_ints("kernel_shape", [2, 2]),
+                    _attr_ints("strides", [2, 2]),
+                    _attr_i("ceil_mode", 1),
+                    _attr_i("count_include_pad", 1)])]
+    path = tmp_path / "cap.onnx"
+    path.write_bytes(_model(nodes, [], ["x"], ["y"]))
+    net = OnnxLoader.load(str(path))
+    got = np.asarray(net.call(net.build(None), np.asarray(x)))
+    want = torch.nn.functional.avg_pool2d(
+        torch.tensor(x), 2, 2, ceil_mode=True,
+        count_include_pad=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

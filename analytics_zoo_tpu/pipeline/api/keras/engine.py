@@ -39,10 +39,16 @@ _param_dtype = jnp.float32
 
 def set_policy(compute_dtype: Any = jnp.float32, param_dtype: Any = jnp.float32):
     """Set the global mixed-precision policy. ``bfloat16`` compute keeps the
-    MXU fed at full rate; params stay float32 for stable updates."""
+    MXU fed at full rate; params stay float32 for stable updates.
+
+    A direct call takes OWNERSHIP of the policy: later context inits that
+    don't name ``zoo.compute.dtype`` leave it alone (see
+    ``common.context.init_zoo_context``)."""
     global _compute_dtype, _param_dtype
     _compute_dtype = jnp.dtype(compute_dtype)
     _param_dtype = jnp.dtype(param_dtype)
+    from ....common import context as _ctx
+    _ctx._policy_owned_by_context = False
 
 
 def compute_dtype():

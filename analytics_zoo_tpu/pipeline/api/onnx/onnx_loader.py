@@ -226,9 +226,10 @@ def _pool_cfg(x, attrs):
             size = int(x.shape[2 + i]) + pads[i][0] + pads[i][1]
             s, kk = int(strides[i]), int(k[i])
             out_ceil = -(-(size - kk) // s) + 1
-            # ONNX/torch/caffe drop a window that would START in the ceil
-            # extension (at or past input + real padding)
-            if (out_ceil - 1) * s >= size:
+            # torch's clip rule (Pool.h): drop a window that would START at
+            # or past input + BEGIN padding — end padding doesn't host
+            # window starts
+            if (out_ceil - 1) * s >= int(x.shape[2 + i]) + pads[i][0]:
                 out_ceil -= 1
             need = max(0, (out_ceil - 1) * s + kk - size)
             full.append((pads[i][0], pads[i][1] + need))
@@ -310,8 +311,11 @@ def _run_node(node: Dict[str, Any], vals: Dict[str, Any],
     elif op == "MaxPool":
         vals[out] = _pool(ins[0], jax.lax.max, -jnp.inf, attrs)
     elif op == "AveragePool":
-        s = _pool(ins[0], jax.lax.add, 0.0, attrs)
         window, strd, real, full = _pool_cfg(ins[0], attrs)
+        pad_cfg = (full if isinstance(full, str)
+                   else [(0, 0), (0, 0)] + list(full))
+        s = jax.lax.reduce_window(ins[0], 0.0, jax.lax.add, window, strd,
+                                  pad_cfg)
         if attrs.get("count_include_pad"):
             # the divisor counts input + REAL padding cells — never the
             # ceil-mode extension (ONNX/torch clip it out): pool a ones
@@ -329,7 +333,8 @@ def _run_node(node: Dict[str, Any], vals: Dict[str, Any],
                     [(0, 0), (0, 0)] + ext)
                 vals[out] = s / n
         else:
-            n = _pool(jnp.ones_like(ins[0]), jax.lax.add, 0.0, attrs)
+            n = jax.lax.reduce_window(jnp.ones_like(ins[0]), 0.0,
+                                      jax.lax.add, window, strd, pad_cfg)
             vals[out] = s / n
     elif op == "GlobalAveragePool":
         vals[out] = jnp.mean(ins[0], axis=tuple(range(2, ins[0].ndim)),

@@ -104,3 +104,18 @@ def test_reinit_resets_policy_to_conf_default():
     # dtype objects are accepted like the old direct set_policy was
     init_zoo_context(compute_dtype=jnp.bfloat16)
     assert compute_dtype() == jnp.bfloat16
+
+
+def test_direct_set_policy_owns_across_reinit():
+    """engine.set_policy after an explicit-dtype init takes ownership: a
+    later unrelated re-init must not clobber it (code-review regression)."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.common import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras.engine import (compute_dtype,
+                                                             set_policy)
+
+    init_zoo_context(compute_dtype="bfloat16")
+    set_policy(compute_dtype=jnp.float32)       # user's direct override
+    init_zoo_context(seed=11)                   # unrelated re-init
+    assert compute_dtype() == jnp.float32

@@ -392,3 +392,22 @@ def test_ceil_avgpool_count_include_pad_matches_torch(tmp_path):
         torch.tensor(x), 2, 2, ceil_mode=True,
         count_include_pad=True).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_ceil_pool_phantom_window_with_padding(tmp_path):
+    """Clip rule with nonzero pads: a window starting in the END padding is
+    dropped (torch Pool.h: (out-1)*stride >= input + pad_begin)."""
+    x = np.random.default_rng(5).normal(size=(1, 1, 4, 4)).astype(np.float32)
+    nodes = [_node("MaxPool", ["x"], ["y"],
+                   [_attr_ints("kernel_shape", [2, 2]),
+                    _attr_ints("strides", [5, 5]),
+                    _attr_ints("pads", [1, 1, 1, 1]),
+                    _attr_i("ceil_mode", 1)])]
+    path = tmp_path / "cpp.onnx"
+    path.write_bytes(_model(nodes, [], ["x"], ["y"]))
+    net = OnnxLoader.load(str(path))
+    got = np.asarray(net.call(net.build(None), np.asarray(x)))
+    want = torch.max_pool2d(torch.tensor(x), 2, 5, padding=1,
+                            ceil_mode=True).numpy()
+    assert got.shape == want.shape, (got.shape, want.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-6)

@@ -6,12 +6,15 @@ Layer map (mirrors SURVEY.md §1):
   feature/   data layer (FeatureSet + DiskFeatureSet, image/image3d/text
              pipelines, Preprocessing combinators)
   native/    ctypes binding for the C++ host IO library (native/zoo_io.cc)
-  pipeline/  model API (keras/keras2 + autograd + onnx + Net/TorchNet),
-             estimator, nnframes, inference runtime
+  pipeline/  model API (keras/keras2 + autograd + onnx + Net/TorchNet/
+             TFNet frozen-graph import), estimator, nnframes, inference
+             runtime (bf16 + calibrated static int8)
   models/    built-in model zoo (recommendation, anomaly detection, text,
              seq2seq, image classification, object detection, caffe import)
   ops/       attention + pallas TPU kernels (flash attention, int8 matmul)
-  parallel/  mesh, shardings, collectives, ring attention
+  parallel/  mesh (data/pipe/seq/expert/model axes), shardings, ring
+             attention, GPipe pipeline schedule; SparseMoE lives with the
+             layers; multi-host bring-up in common/
   serving/   cluster-serving equivalent (stream, batching, backpressure)
   tfpark/    BERT estimators, GANEstimator, torch weight import
   ray/       task/actor runtime (RayOnSpark role)

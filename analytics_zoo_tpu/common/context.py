@@ -170,8 +170,6 @@ class ZooContext:
 
 _context: Optional[ZooContext] = None
 _distributed_initialized = False
-_policy_owned_by_context = False  # True iff an explicit zoo.compute.dtype
-# set the engine precision policy (see init_zoo_context)
 
 
 def _maybe_init_distributed(conf: Mapping[str, Any]) -> None:
@@ -269,22 +267,16 @@ def init_zoo_context(
     # mixed-precision policy: params stay float32, layer compute runs at
     # zoo.compute.dtype (bfloat16 = MXU native). Applied only AFTER the
     # mesh commits (a failed re-init must not leave a half-applied
-    # context). Ownership semantics: an explicit zoo.compute.dtype makes
-    # the CONTEXT own the policy; a later re-init without one resets a
+    # context). Ownership semantics (the flag lives in engine, the module
+    # that owns the policy): an explicit zoo.compute.dtype makes the
+    # CONTEXT own the policy; a later re-init without one resets a
     # context-owned policy back to the conf default (re-inits restart from
     # defaults like every other key); a policy set directly via
-    # ``engine.set_policy(...)`` is never touched by inits that don't name
-    # a dtype — including the lazy default init inside fit() and inits
-    # that only carry unrelated env/kwarg settings.
-    global _policy_owned_by_context
-    if "zoo.compute.dtype" in explicit:
-        from ..pipeline.api.keras import engine as _engine
-        _engine.set_policy(compute_dtype=dtype)
-        _policy_owned_by_context = True
-    elif _policy_owned_by_context:
-        from ..pipeline.api.keras import engine as _engine
-        _engine.set_policy(compute_dtype=dtype)
-        _policy_owned_by_context = False
+    # ``engine.set_policy(...)`` is never touched by inits that don't
+    # name a dtype.
+    from ..pipeline.api.keras import engine as _engine
+    if "zoo.compute.dtype" in explicit or _engine.policy_owner() == "context":
+        _engine._set_policy_from_context(dtype)
 
     _context = ZooContext(conf=merged, mesh=mesh)
     log.info(
@@ -303,9 +295,8 @@ def get_zoo_context() -> ZooContext:
 
 def reset_zoo_context() -> None:
     """Tear down the global context (mainly for tests)."""
-    global _context, _policy_owned_by_context
+    global _context
     _context = None
-    _policy_owned_by_context = False
     mesh_lib.reset_global_mesh()
     from ..pipeline.api.keras import engine as _engine
-    _engine.set_policy()  # back to the float32 default
+    _engine._reset_policy()

@@ -35,6 +35,10 @@ import numpy as np
 
 _compute_dtype = jnp.float32
 _param_dtype = jnp.float32
+#: who last set the policy: "default" | "direct" (user set_policy) |
+#: "context" (init_zoo_context's zoo.compute.dtype). The context only
+#: overrides policies IT owns — see init_zoo_context.
+_policy_owner = "default"
 
 
 def set_policy(compute_dtype: Any = jnp.float32, param_dtype: Any = jnp.float32):
@@ -44,11 +48,28 @@ def set_policy(compute_dtype: Any = jnp.float32, param_dtype: Any = jnp.float32)
     A direct call takes OWNERSHIP of the policy: later context inits that
     don't name ``zoo.compute.dtype`` leave it alone (see
     ``common.context.init_zoo_context``)."""
-    global _compute_dtype, _param_dtype
+    global _compute_dtype, _param_dtype, _policy_owner
     _compute_dtype = jnp.dtype(compute_dtype)
     _param_dtype = jnp.dtype(param_dtype)
-    from ....common import context as _ctx
-    _ctx._policy_owned_by_context = False
+    _policy_owner = "direct"
+
+
+def policy_owner() -> str:
+    return _policy_owner
+
+
+def _set_policy_from_context(compute_dtype: Any):
+    """Context-owned policy write (init_zoo_context only)."""
+    global _policy_owner
+    set_policy(compute_dtype=compute_dtype)
+    _policy_owner = "context"
+
+
+def _reset_policy():
+    """Back to the pristine float32 default (reset_zoo_context only)."""
+    global _policy_owner
+    set_policy()
+    _policy_owner = "default"
 
 
 def compute_dtype():

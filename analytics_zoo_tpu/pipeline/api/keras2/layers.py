@@ -30,7 +30,7 @@ __all__ = [
     "LeakyReLU", "ELU", "PReLU", "ThresholdedReLU", "Softmax",
     "GaussianNoise", "GaussianDropout",
     "SpatialDropout1D", "SpatialDropout2D", "SpatialDropout3D",
-    "add", "multiply", "average", "maximum", "concatenate", "dot",
+    "add", "multiply", "average", "maximum", "minimum", "concatenate", "dot",
 ]
 
 
@@ -347,6 +347,10 @@ def average(inputs, **kwargs):
 
 def maximum(inputs, **kwargs):
     return K1.merge(inputs, mode="max", **kwargs)
+
+
+def minimum(inputs, **kwargs):
+    return K1.merge(inputs, mode="min", **kwargs)
 
 
 def concatenate(inputs, axis=-1, **kwargs):

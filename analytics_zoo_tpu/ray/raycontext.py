@@ -94,14 +94,14 @@ def _pool_worker(parent_pid: int, task_q: mp.Queue, result_q: mp.Queue):
 
 
 def _actor_worker(parent_pid: int, cls, init_args, init_kwargs,
-                  cmd_q: mp.Queue, result_q: mp.Queue):
+                  cmd_q: mp.Queue, result_q: mp.Queue, ack_id: int):
     _parent_guard(parent_pid)
     try:
         obj = cls(*init_args, **init_kwargs)
     except BaseException:
-        result_q.put((-1, False, traceback.format_exc()))
+        result_q.put((ack_id, False, traceback.format_exc()))
         return
-    result_q.put((-1, True, None))  # construction ack
+    result_q.put((ack_id, True, None))  # construction ack
     while True:
         item = cmd_q.get()
         if item is None:
@@ -214,13 +214,17 @@ class RayContext:
         self._check_picklable((cls, args, kwargs), "actor spec")
         ctx = self._mp_ctx
         cmd_q = ctx.Queue()
+        # construction ack uses a UNIQUE id from the shared counter — a
+        # fixed sentinel would hit the first actor's cached ack and mask a
+        # later actor's failed __init__ (results are cached, never popped)
+        ack_id = next(self._ids)
         p = ctx.Process(target=_actor_worker,
                         args=(os.getpid(), cls, args, kwargs, cmd_q,
-                              self._result_q),
+                              self._result_q, ack_id),
                         daemon=True)
         p.start()
-        # construction ack (id -1) — surface __init__ failures immediately
-        ok, payload = self._wait_for(-1)
+        # surface __init__ failures immediately
+        ok, payload = self._wait_for(ack_id)
         if not ok:
             p.join(timeout=1)
             raise RayTaskError(f"actor construction failed:\n{payload}")

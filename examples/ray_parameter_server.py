@@ -6,8 +6,9 @@ process-pool actor runtime.
 A ``ParameterServer`` actor owns the weights; worker TASKS pull weights,
 compute a logistic-regression gradient on their data shard (pure numpy —
 actor processes stay off the TPU; the chip belongs to the main process),
-and push updates back. Both sync (barrier per round) and async
-(Hogwild-style, apply-as-they-arrive) modes run.
+and push updates back. Two modes: sync (average all shard gradients, one
+barriered update per round) and async (shard gradients computed
+concurrently from a stale snapshot, applied one by one as they arrive).
 
 Run:  python examples/ray_parameter_server.py
 """
@@ -70,15 +71,18 @@ def main():
               f"acc={(((x @ w) > 0) == y).mean():.3f}")
         ps.terminate()
 
-        # ---- async mode: workers push whenever they finish ---------------
+        # ---- async mode: shard gradients compute CONCURRENTLY from the
+        # same (stale) weight snapshot and apply as each arrives — between
+        # applies the weights the others used are already out of date,
+        # the Hogwild-style staleness the reference's async PS exhibits
         ps = ctx.actor(ParameterServer, DIM, 0.5)
-        pending = []
+        last = None
         for r in range(ROUNDS):
             w = ctx.get(ps.get_weights.remote())
-            for sx, sy in shards:
-                g = ctx.remote(grad_shard, w, sx, sy)
-                pending.append(ps.apply_gradient.remote(ctx.get(g)))
-        ctx.get(pending[-1])
+            grads = [ctx.remote(grad_shard, w, sx, sy) for sx, sy in shards]
+            for g in grads:
+                last = ps.apply_gradient.remote(ctx.get(g))
+        ctx.get(last)
         w = ctx.get(ps.get_weights.remote())
         async_loss = loss_of(w, x, y)
         print(f"async  PS: loss={async_loss:.4f} "

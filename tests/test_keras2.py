@@ -108,3 +108,16 @@ def test_keras2_functional_merge_trains():
     h_ = m.fit([a, b], y, batch_size=32, nb_epoch=8)
     assert h_["loss"][-1] < h_["loss"][0]
     assert m.evaluate([a, b], y, batch_size=32)["accuracy"] > 0.85
+
+
+def test_keras2_minimum_merge():
+    init_zoo_context()
+    xa = K2.Input(shape=(4,))
+    xb = K2.Input(shape=(4,))
+    out = K2.minimum([xa, xb])
+    m = K2.Model([xa, xb], out)
+    m.init_weights(input_shape=[(None, 4), (None, 4)])
+    a = np.asarray([[1.0, -2.0, 3.0, 0.0]], np.float32)
+    b = np.asarray([[0.5, 5.0, -1.0, 0.0]], np.float32)
+    got = m.predict([a, b], batch_size=1)
+    np.testing.assert_allclose(got, np.minimum(a, b))

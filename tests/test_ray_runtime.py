@@ -144,3 +144,38 @@ def test_numpy_payloads(ctx):
     a = np.arange(6).reshape(2, 3)
     ref = ctx.remote(np.dot, a, a.T)
     np.testing.assert_array_equal(ctx.get(ref), a @ a.T)
+
+
+class _BoomInit:
+    def __init__(self):
+        raise RuntimeError("boom at init")
+
+
+class _Counter2:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+        return self.n
+
+
+def test_second_actor_init_failure_not_masked():
+    """Actor construction acks use unique ids — a second actor's failed
+    __init__ must raise immediately, not be masked by the first actor's
+    cached ack (code-review regression)."""
+    import pytest
+
+    from analytics_zoo_tpu.ray import RayContext
+    from analytics_zoo_tpu.ray.raycontext import RayTaskError
+
+    ctx = RayContext(num_workers=1).init()
+    try:
+        ok = ctx.actor(_Counter2)
+        assert ctx.get(ok.bump.remote()) == 1
+        with pytest.raises(RayTaskError, match="boom at init"):
+            ctx.actor(_BoomInit)
+        # first actor still healthy afterwards
+        assert ctx.get(ok.bump.remote()) == 2
+    finally:
+        ctx.stop()

@@ -78,6 +78,41 @@ class MultiHeadSelfAttention(Layer):
         except Exception:
             return False
 
+    def _ring_mesh(self, mask, drop, seq_len):
+        """Sequence parallelism from the LAYER API: on a mesh with a ``seq``
+        axis, mask-free/dropout-free attention rotates KV blocks over ICI
+        (``parallel/ring_attention.py``) instead of gathering the full
+        sequence per chip — the long-context path (SURVEY §5). Padding
+        masks stay on the full XLA op (a masked ring needs per-block mask
+        rotation, not implemented)."""
+        try:
+            from .....parallel import mesh as mesh_lib
+            mesh = mesh_lib.global_mesh()
+            n_seq = mesh.shape[mesh_lib.SEQ_AXIS]
+        except Exception:
+            return None
+        if n_seq <= 1:
+            return None
+        if mask is not None or drop > 0.0:
+            # a seq mesh exists but this call can't ride the ring — say so
+            # ONCE: falling back to full O(T^2) attention at long-context
+            # scale is an OOM surprise, not a detail (set attn_drop=0 /
+            # drop the padding mask to ring)
+            if not getattr(self, "_warned_no_ring", False):
+                import logging
+                logging.getLogger("analytics_zoo_tpu.attention").warning(
+                    "%s: seq-axis mesh active but %s keeps attention on the "
+                    "full XLA op (no sequence parallelism for this layer)",
+                    self.name,
+                    "a padding mask" if mask is not None else
+                    f"attn_drop={drop}")
+                self._warned_no_ring = True
+            return None
+        batch, t = seq_len  # (B, T): both must split over their axes
+        if t % n_seq == 0 and batch % mesh.shape[mesh_lib.DATA_AXIS] == 0:
+            return mesh
+        return None
+
     def call(self, params, x, *, training=False, rng=None):
         mask = None
         if isinstance(x, (list, tuple)):
@@ -90,7 +125,12 @@ class MultiHeadSelfAttention(Layer):
             r1, r2 = jax.random.split(rng)
         qh, kh, vh = (split_heads(a, self.n_head) for a in (q, k, v))
         drop = self.attn_drop if training else 0.0
-        if self._use_flash(mask, drop):
+        ring_mesh = self._ring_mesh(mask, drop, (qh.shape[0], qh.shape[2]))
+        if ring_mesh is not None:
+            from .....parallel.ring_attention import ring_self_attention
+            out = ring_self_attention(qh, kh, vh, mesh=ring_mesh,
+                                      causal=self.causal)
+        elif self._use_flash(mask, drop):
             from .....ops.pallas import flash_attention
             out = flash_attention(qh, kh, vh, self.causal)
         else:

@@ -223,8 +223,10 @@ class RayContext:
                               self._result_q, ack_id),
                         daemon=True)
         p.start()
-        # surface __init__ failures immediately
-        ok, payload = self._wait_for(ack_id)
+        # surface __init__ failures immediately; p is passed so a child
+        # dying WITHOUT an ack (segfault, os._exit, unpicklable class in a
+        # spawn context) raises instead of hanging the 0.2s poll forever
+        ok, payload = self._wait_for(ack_id, extra_proc=p)
         if not ok:
             p.join(timeout=1)
             raise RayTaskError(f"actor construction failed:\n{payload}")
@@ -237,9 +239,11 @@ class RayContext:
         return [p.pid for p in self._procs if not p.is_alive()] + \
             [h._proc.pid for h in self._actors if not h._proc.is_alive()]
 
-    def _wait_for(self, task_id: int, deadline: Optional[float] = None):
+    def _wait_for(self, task_id: int, deadline: Optional[float] = None,
+                  extra_proc=None):
         # results are cached, not popped: get() on the same ref twice
         # returns the same value (ray.get semantics)
+        extra_grace = False
         while task_id not in self._results:
             if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutError(f"ObjectRef({task_id}) not ready before "
@@ -251,6 +255,13 @@ class RayContext:
                 self._results[got_id] = (ok, payload)
             except queue_mod.Empty:
                 dead = self._dead_workers()
+                if extra_proc is not None and not extra_proc.is_alive():
+                    # one extra 0.2s drain first: the dead child's queue
+                    # feeder may still flush a final (failure) ack
+                    if not extra_grace:
+                        extra_grace = True
+                        continue
+                    dead = dead + [extra_proc.pid]
                 if dead:
                     raise RayTaskError(
                         f"worker process(es) {dead} died before delivering "

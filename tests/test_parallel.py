@@ -107,6 +107,50 @@ def test_ring_attention_matches_full():
                                    rtol=2e-4, atol=2e-5)
 
 
+def test_transformer_layer_routes_through_ring_attention():
+    """Sequence parallelism from the LAYER API: on a seq-axis mesh a
+    mask-free TransformerBlock forward equals the pure-DP forward, and a
+    causal LM-style fit trains — long context without touching model code."""
+    import optax
+    from analytics_zoo_tpu.pipeline.api.keras.layers import TransformerBlock
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16, 8)).astype(np.float32)
+
+    init_zoo_context()  # pure DP
+    blk = TransformerBlock(8, 2, causal=True)
+    p = blk.build(jax.random.key(0), (None, 16, 8))
+    y_dp = np.asarray(blk.call(p, jnp.asarray(x)))
+
+    reset_zoo_context()
+    init_zoo_context(mesh_data=2, mesh_seq=4)
+    p_host = jax.tree.map(np.asarray, p)
+    # prove the ring path is ACTUALLY taken (full attention would produce
+    # the same numbers, so equality alone can't catch a routing regression)
+    from analytics_zoo_tpu.parallel import ring_attention as ra
+    calls = {"n": 0}
+    orig = ra.ring_self_attention
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    ra.ring_self_attention = counting
+    try:
+        y_sp = np.asarray(blk.call(p_host, jnp.asarray(x)))
+    finally:
+        ra.ring_self_attention = orig
+    assert calls["n"] == 1, "seq mesh did not route through ring attention"
+    np.testing.assert_allclose(y_sp, y_dp, rtol=2e-4, atol=2e-5)
+
+    # and it trains end-to-end under the seq mesh
+    m = Sequential([TransformerBlock(8, 2, causal=True,
+                                     input_shape=(16, 8))])
+    m.compile(optimizer=optax.adam(0.01), loss="mse")
+    h = m.fit(x, x, batch_size=8, nb_epoch=2)
+    assert np.isfinite(h["loss"][-1])
+
+
 def test_ring_attention_rejects_ragged_seq():
     from analytics_zoo_tpu.parallel import mesh as mesh_lib
     from analytics_zoo_tpu.parallel.ring_attention import ring_self_attention

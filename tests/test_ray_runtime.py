@@ -179,3 +179,30 @@ def test_second_actor_init_failure_not_masked():
         assert ctx.get(ok.bump.remote()) == 2
     finally:
         ctx.stop()
+
+
+class _ExitInit:
+    def __init__(self):
+        import os
+        os._exit(7)  # dies WITHOUT sending a construction ack
+
+
+def test_actor_dying_without_ack_raises_not_hangs():
+    """A child that exits before acking (segfault/os._exit) must raise
+    RayTaskError promptly instead of spinning forever (code-review
+    regression)."""
+    import time
+
+    import pytest
+
+    from analytics_zoo_tpu.ray import RayContext
+    from analytics_zoo_tpu.ray.raycontext import RayTaskError
+
+    ctx = RayContext(num_workers=1).init()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RayTaskError, match="died"):
+            ctx.actor(_ExitInit)
+        assert time.monotonic() - t0 < 30
+    finally:
+        ctx.stop()

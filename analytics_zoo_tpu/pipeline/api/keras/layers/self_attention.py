@@ -111,6 +111,15 @@ class MultiHeadSelfAttention(Layer):
         batch, t = seq_len  # (B, T): both must split over their axes
         if t % n_seq == 0 and batch % mesh.shape[mesh_lib.DATA_AXIS] == 0:
             return mesh
+        # batch > 1: the B=1 shape-inference probe is not a real call
+        if batch > 1 and not getattr(self, "_warned_no_ring", False):
+            import logging
+            logging.getLogger("analytics_zoo_tpu.attention").warning(
+                "%s: seq-axis mesh active but shapes can't split (T=%d over "
+                "seq=%d, B=%d over data=%d) — full O(T^2) attention for "
+                "this layer", self.name, t, n_seq, batch,
+                mesh.shape[mesh_lib.DATA_AXIS])
+            self._warned_no_ring = True
         return None
 
     def call(self, params, x, *, training=False, rng=None):

@@ -226,7 +226,11 @@ class RayContext:
         # surface __init__ failures immediately; p is passed so a child
         # dying WITHOUT an ack (segfault, os._exit, unpicklable class in a
         # spawn context) raises instead of hanging the 0.2s poll forever
-        ok, payload = self._wait_for(ack_id, extra_proc=p)
+        try:
+            ok, payload = self._wait_for(ack_id, extra_proc=p)
+        except BaseException:
+            p.join(timeout=1)  # reap — a no-ack death must not zombie
+            raise
         if not ok:
             p.join(timeout=1)
             raise RayTaskError(f"actor construction failed:\n{payload}")
@@ -243,11 +247,23 @@ class RayContext:
                   extra_proc=None):
         # results are cached, not popped: get() on the same ref twice
         # returns the same value (ray.get semantics)
-        extra_grace = False
+        extra_grace = 0
         while task_id not in self._results:
             if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutError(f"ObjectRef({task_id}) not ready before "
                                    f"timeout")
+            # liveness of the just-spawned (untracked) process is checked
+            # EVERY iteration: a steady stream of unrelated pool results
+            # would otherwise starve the Empty branch and re-open the hang
+            if extra_proc is not None and not extra_proc.is_alive():
+                # grant a couple of drains first: the dead child's queue
+                # feeder may still flush a final (failure) ack
+                extra_grace += 1
+                if extra_grace > 2:
+                    raise RayTaskError(
+                        f"actor process {extra_proc.pid} died before "
+                        f"delivering its construction ack (segfault / "
+                        f"os._exit in __init__?)")
             try:
                 # bounded poll so crashed workers are detected even with no
                 # deadline (a dead worker's result will never arrive)
@@ -255,13 +271,6 @@ class RayContext:
                 self._results[got_id] = (ok, payload)
             except queue_mod.Empty:
                 dead = self._dead_workers()
-                if extra_proc is not None and not extra_proc.is_alive():
-                    # one extra 0.2s drain first: the dead child's queue
-                    # feeder may still flush a final (failure) ack
-                    if not extra_grace:
-                        extra_grace = True
-                        continue
-                    dead = dead + [extra_proc.pid]
                 if dead:
                     raise RayTaskError(
                         f"worker process(es) {dead} died before delivering "

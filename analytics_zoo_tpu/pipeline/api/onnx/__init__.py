@@ -6,3 +6,4 @@ so an imported ONNX model predicts, fine-tunes, shards, and serializes like
 any other model here."""
 
 from .onnx_loader import OnnxLoader, OnnxNet, load_onnx  # noqa: F401
+from .onnx_export import export_onnx  # noqa: F401

@@ -1,0 +1,310 @@
+"""ONNX export — serialize a trained model to a standard ``.onnx`` file.
+
+The reference's escape hatch is exporting trained definitions to
+TF/Keras2 via a spawned python (``Topology.scala:557-572``,
+``Net.scala:264+``); the portable interchange format today is ONNX, so
+this exporter writes ModelProto with the in-repo wire codec
+(``utils/proto.py`` — no onnx package needed), the inverse of
+``onnx_loader.py``.
+
+Scope: the common feed-forward subset — Dense (Gemm), Convolution2D /
+pooling / BatchNormalization (exported in ONNX's NCHW layout with
+Transpose bridges from this framework's NHWC), Flatten/Reshape/Dropout,
+activations, softmax. Models touching anything else fail loudly with the
+layer name. Round-trip fidelity is tested through ``OnnxLoader`` and the
+torch-oracle-checked loader op set.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ....utils.proto import field_bytes, field_varint, varint
+from ..keras.engine import KerasNet, Layer, Sequential
+from ..keras.layers import (Activation, BatchNormalization, Convolution2D,
+                            Dense, Dropout, Flatten, GlobalAveragePooling2D,
+                            MaxPooling2D, AveragePooling2D, Reshape)
+
+__all__ = ["export_onnx"]
+
+
+# ---------------------------------------------------------------------------
+# proto writers (onnx.proto3 subset — field numbers per the spec)
+# ---------------------------------------------------------------------------
+
+def _tensor(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    code = {np.dtype(np.float32): 1, np.dtype(np.int64): 7}[arr.dtype]
+    buf = b"".join(field_varint(1, d) for d in arr.shape)
+    buf += field_varint(2, code)
+    buf += field_bytes(8, name.encode())
+    buf += field_bytes(9, arr.tobytes())
+    return buf
+
+
+def _attr_i(name: str, v: int) -> bytes:
+    return (field_bytes(1, name.encode()) + field_varint(3, v)
+            + field_varint(20, 2))
+
+
+def _attr_f(name: str, v: float) -> bytes:
+    return (field_bytes(1, name.encode())
+            + varint((2 << 3) | 5) + struct.pack("<f", v)
+            + field_varint(20, 1))
+
+
+def _attr_ints(name: str, vs) -> bytes:
+    buf = field_bytes(1, name.encode())
+    for v in vs:
+        buf += field_varint(8, int(v))
+    return buf + field_varint(20, 7)
+
+
+def _node(op: str, inputs, outputs, attrs=()) -> bytes:
+    buf = b"".join(field_bytes(1, i.encode()) for i in inputs)
+    buf += b"".join(field_bytes(2, o.encode()) for o in outputs)
+    buf += field_bytes(4, op.encode())
+    buf += b"".join(field_bytes(5, a) for a in attrs)
+    return buf
+
+
+def _value_info(name: str, shape=None) -> bytes:
+    """ValueInfoProto WITH TypeProto (onnx.checker requires typed graph
+    inputs/outputs): float32 tensor, symbolic "N" for the batch dim."""
+    buf = field_bytes(1, name.encode())
+    if shape is not None:
+        dims = b""
+        for d in shape:
+            if d is None:
+                dims += field_bytes(1, field_bytes(2, b"N"))  # dim_param
+            else:
+                dims += field_bytes(1, field_varint(1, int(d)))
+        tensor_type = field_varint(1, 1) + field_bytes(2, dims)
+        buf += field_bytes(2, field_bytes(1, tensor_type))
+    return buf
+
+
+def _model_bytes(nodes, initializers, inputs, outputs) -> bytes:
+    g = b"".join(field_bytes(1, n) for n in nodes)
+    g += b"".join(field_bytes(5, t) for t in initializers)
+    g += b"".join(field_bytes(11, _value_info(n, s)) for n, s in inputs)
+    g += b"".join(field_bytes(12, _value_info(n, s)) for n, s in outputs)
+    # ir_version 8, graph, opset_import {version 13}
+    opset = field_varint(2, 13)
+    return (field_varint(1, 8) + field_bytes(7, g)
+            + field_bytes(8, opset))
+
+
+# ---------------------------------------------------------------------------
+# layer → node emission (data flows in ONNX NCHW between conv-family ops)
+# ---------------------------------------------------------------------------
+
+_ONNX_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softmax": "Softmax", "elu": "Elu", "selu": "Selu",
+             "softplus": "Softplus", "softsign": "Softsign",
+             "linear": None}
+
+
+class _Emitter:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.inits: List[bytes] = []
+        self._uid = 0
+
+    def name(self, base: str) -> str:
+        self._uid += 1
+        return f"{base}_{self._uid}"
+
+    def init(self, base: str, arr: np.ndarray) -> str:
+        n = self.name(base)
+        self.inits.append(_tensor(n, np.asarray(arr)))
+        return n
+
+    def emit(self, op: str, inputs, attrs=(), base: Optional[str] = None
+             ) -> str:
+        out = self.name(base or op.lower())
+        self.nodes.append(_node(op, inputs, [out], attrs))
+        return out
+
+    def activation(self, act_name: Optional[str], cur: str) -> str:
+        if act_name is None or act_name == "linear":
+            return cur
+        if act_name not in _ONNX_ACT or _ONNX_ACT[act_name] is None:
+            raise NotImplementedError(
+                f"activation {act_name!r} has no ONNX export mapping")
+        return self.emit(_ONNX_ACT[act_name], [cur])
+
+
+def _act_name(layer) -> Optional[str]:
+    # layers store the callable; the constructor name survives on Dense/etc
+    # via the Activation registry lookup — recover it by identity
+    from ..keras.layers.core import ACTIVATIONS
+    fn = getattr(layer, "activation", None)
+    if fn is None:
+        return None
+    for k, v in ACTIVATIONS.items():
+        if v is fn:
+            return k
+    raise NotImplementedError(
+        f"{layer.name}: custom activation can't be exported")
+
+
+def _export_layer(e: _Emitter, layer: Layer, params: Dict[str, Any],
+                  state: Dict[str, Any], cur: str, nchw: bool,
+                  in_shape=None) -> Tuple[str, bool]:
+    """Returns (output name, data-is-NCHW). Conv-family ops run in NCHW;
+    a Transpose bridge is inserted at layout changes."""
+    def p(k):
+        return np.asarray(params[k], np.float32)
+
+    if isinstance(layer, Dense):
+        if nchw:
+            raise NotImplementedError(
+                f"{layer.name}: Dense after conv needs Flatten/"
+                f"GlobalAveragePooling2D first")
+        w = e.init(layer.name + "_W", p("W"))          # (in, out)
+        ins = [cur, w]
+        attrs = []
+        if layer.bias:
+            ins.append(e.init(layer.name + "_b", p("b")))
+        out = e.emit("Gemm", ins, attrs, base=layer.name)
+        return e.activation(_act_name(layer), out), False
+
+    if isinstance(layer, Convolution2D) and type(layer) is Convolution2D:
+        if not nchw:
+            cur = e.emit("Transpose", [cur],
+                         [_attr_ints("perm", [0, 3, 1, 2])])
+        w = e.init(layer.name + "_W",
+                   p("W").transpose(3, 2, 0, 1))       # HWIO -> OIHW
+        ins = [cur, w]
+        if layer.bias:
+            ins.append(e.init(layer.name + "_b", p("b")))
+        kh, kw = p("W").shape[0], p("W").shape[1]
+        attrs = [_attr_ints("kernel_shape", [kh, kw]),
+                 _attr_ints("strides", list(layer.subsample)),
+                 _attr_ints("dilations", list(layer.dilation))]
+        if layer.border_mode.lower() == "same":
+            attrs.append(field_bytes(1, b"auto_pad")
+                         + field_bytes(4, b"SAME_UPPER")
+                         + field_varint(20, 3))
+        out = e.emit("Conv", ins, attrs, base=layer.name)
+        return e.activation(_act_name(layer), out), True
+
+    if isinstance(layer, BatchNormalization):
+        rank = len(in_shape) if in_shape is not None else 4
+        if not nchw and rank == 4:
+            cur = e.emit("Transpose", [cur],
+                         [_attr_ints("perm", [0, 3, 1, 2])])
+            nchw = True
+        # rank-2 (B, C): ONNX BatchNormalization takes C at axis 1 as-is
+        mean = np.asarray(state["moving_mean"], np.float32)
+        var = np.asarray(state["moving_var"], np.float32)
+        gamma = (p("gamma") if "gamma" in params
+                 else np.ones_like(mean))
+        beta = (p("beta") if "beta" in params
+                else np.zeros_like(mean))
+        ins = [cur,
+               e.init(layer.name + "_g", gamma),
+               e.init(layer.name + "_b", beta),
+               e.init(layer.name + "_m", mean),
+               e.init(layer.name + "_v", var)]
+        out = e.emit("BatchNormalization", ins,
+                     [_attr_f("epsilon", float(layer.epsilon))],
+                     base=layer.name)
+        return out, nchw
+
+    if isinstance(layer, (MaxPooling2D, AveragePooling2D)):
+        if not nchw:
+            cur = e.emit("Transpose", [cur],
+                         [_attr_ints("perm", [0, 3, 1, 2])])
+        op = ("MaxPool" if isinstance(layer, MaxPooling2D)
+              else "AveragePool")
+        attrs = [_attr_ints("kernel_shape", list(layer.pool_size)),
+                 _attr_ints("strides", list(layer.strides))]
+        if layer.border_mode.lower() == "same":
+            attrs.append(field_bytes(1, b"auto_pad")
+                         + field_bytes(4, b"SAME_UPPER")
+                         + field_varint(20, 3))
+        return e.emit(op, [cur], attrs, base=layer.name), True
+
+    if isinstance(layer, GlobalAveragePooling2D):
+        if not nchw:
+            cur = e.emit("Transpose", [cur],
+                         [_attr_ints("perm", [0, 3, 1, 2])])
+        out = e.emit("GlobalAveragePool", [cur], base=layer.name)
+        return e.emit("Flatten", [out], [_attr_i("axis", 1)]), False
+
+    if isinstance(layer, Flatten):
+        if nchw:  # restore NHWC order before flattening: the in-framework
+            # flatten sees NHWC memory order
+            cur = e.emit("Transpose", [cur],
+                         [_attr_ints("perm", [0, 2, 3, 1])])
+        return e.emit("Flatten", [cur], [_attr_i("axis", 1)]), False
+
+    if isinstance(layer, Dropout):
+        return cur, nchw  # inference graph: identity
+
+    if isinstance(layer, Activation):
+        if layer.activation_name is None:
+            raise NotImplementedError(
+                f"{layer.name}: callable activation can't be exported")
+        return e.activation(layer.activation_name, cur), nchw
+
+    if isinstance(layer, Reshape):
+        if nchw:  # in-framework Reshape sees NHWC memory order
+            cur = e.emit("Transpose", [cur],
+                         [_attr_ints("perm", [0, 2, 3, 1])])
+        shape = e.init(layer.name + "_shape", np.asarray(
+            (-1,) + tuple(layer.target_shape), np.int64))
+        return e.emit("Reshape", [cur, shape], base=layer.name), False
+
+    raise NotImplementedError(
+        f"layer {layer.name} ({type(layer).__name__}) has no ONNX export "
+        f"mapping")
+
+
+def export_onnx(model: KerasNet, path: str) -> str:
+    """Write ``model`` (a built Sequential of exportable layers) to
+    ``path`` as ONNX. Conv-family models export with NCHW inputs (the ONNX
+    convention); pass images as (B, C, H, W) to the exported graph."""
+    if not isinstance(model, Sequential):
+        raise NotImplementedError(
+            "export_onnx covers Sequential models (graph Models: walk "
+            "model.new_graph sub-Sequentials or export per-branch)")
+    if model.params is None:
+        raise ValueError("model has no weights; fit() or init_weights() "
+                         "first")
+    e = _Emitter()
+    cur = "input"
+    shapes = list(getattr(model, "_shapes", [])) or [None] * len(model.layers)
+    in_shape = shapes[0] if shapes and shapes[0] is not None else None
+    # a stack starting conv-family takes NCHW input per ONNX convention
+    nchw = bool(model.layers) and isinstance(
+        model.layers[0], (Convolution2D, MaxPooling2D, AveragePooling2D))
+    net_state = model.net_state or {}
+    for layer, lshape in zip(model.layers, shapes):
+        cur, nchw = _export_layer(e, layer, model.params.get(layer.name, {}),
+                                  net_state.get(layer.name, {}), cur, nchw,
+                                  in_shape=lshape)
+    in_decl = None
+    if in_shape is not None:
+        dims = list(in_shape)
+        if len(dims) == 4 and isinstance(
+                model.layers[0], (Convolution2D, MaxPooling2D,
+                                  AveragePooling2D)):
+            dims = [dims[0], dims[3], dims[1], dims[2]]  # NHWC -> NCHW decl
+        in_decl = dims
+    out_shape = getattr(model, "_built_output_shape", None)
+    out_decl = list(out_shape) if isinstance(out_shape, tuple) else None
+    if out_decl is not None and len(out_decl) == 4 and nchw:
+        out_decl = [out_decl[0], out_decl[3], out_decl[1], out_decl[2]]
+    blob = _model_bytes(e.nodes, e.inits, [("input", in_decl)],
+                        [(cur, out_decl)])
+    if not path.endswith(".onnx"):
+        path += ".onnx"
+    with open(path, "wb") as f:
+        f.write(blob)
+    return path

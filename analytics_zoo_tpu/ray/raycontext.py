@@ -247,7 +247,7 @@ class RayContext:
                   extra_proc=None):
         # results are cached, not popped: get() on the same ref twice
         # returns the same value (ray.get semantics)
-        extra_grace = 0
+        extra_dead_at = None
         while task_id not in self._results:
             if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutError(f"ObjectRef({task_id}) not ready before "
@@ -256,10 +256,13 @@ class RayContext:
             # EVERY iteration: a steady stream of unrelated pool results
             # would otherwise starve the Empty branch and re-open the hang
             if extra_proc is not None and not extra_proc.is_alive():
-                # grant a couple of drains first: the dead child's queue
-                # feeder may still flush a final (failure) ack
-                extra_grace += 1
-                if extra_grace > 2:
+                # wall-clock grace (not iterations — a busy result queue
+                # spins iterations in microseconds): the dead child's queue
+                # feeder gets ~1s to flush a final (failure) ack
+                now = time.monotonic()
+                if extra_dead_at is None:
+                    extra_dead_at = now
+                elif now - extra_dead_at > 1.0:
                     raise RayTaskError(
                         f"actor process {extra_proc.pid} died before "
                         f"delivering its construction ack (segfault / "

@@ -411,3 +411,80 @@ def test_ceil_pool_phantom_window_with_padding(tmp_path):
                             ceil_mode=True).numpy()
     assert got.shape == want.shape, (got.shape, want.shape)
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_export_roundtrip_mlp(tmp_path):
+    """export_onnx -> OnnxLoader round-trip: a trained MLP's exported graph
+    reproduces its predictions bit-close (the reference's model-export
+    escape hatch, Topology.scala:557-572, in ONNX form)."""
+    import optax
+
+    from analytics_zoo_tpu.common import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, Dropout
+    from analytics_zoo_tpu.pipeline.api.onnx import export_onnx
+
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    y = np.argmax(x @ rng.normal(size=(6, 3)).astype(np.float32), 1) \
+        .astype(np.int32)
+    m = Sequential([Dense(16, activation="relu", input_shape=(6,)),
+                    Dropout(0.1),
+                    Dense(3, activation="softmax")])
+    m.compile(optimizer=optax.adam(0.01), loss="scce")
+    m.fit(x, y, batch_size=32, nb_epoch=3)
+    want = np.asarray(m.predict(x, batch_size=64))
+
+    path = export_onnx(m, str(tmp_path / "mlp"))
+    net = OnnxLoader.load(path)
+    got = np.asarray(net.call(net.build(None), np.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_export_roundtrip_cnn(tmp_path):
+    """Conv/BN/pool export (NHWC -> ONNX NCHW with transpose bridges)."""
+    from analytics_zoo_tpu.common import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        BatchNormalization, Convolution2D, Dense, GlobalAveragePooling2D,
+        MaxPooling2D)
+    from analytics_zoo_tpu.pipeline.api.onnx import export_onnx
+
+    init_zoo_context()
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(4, 8, 8, 3)).astype(np.float32)
+    m = Sequential([
+        Convolution2D(6, 3, 3, activation="relu", border_mode="same",
+                      input_shape=(8, 8, 3)),
+        BatchNormalization(),
+        MaxPooling2D((2, 2)),
+        Convolution2D(4, 3, 3, border_mode="same"),
+        GlobalAveragePooling2D(),
+        Dense(3, activation="softmax"),
+    ])
+    m.compile(optimizer="adam", loss="scce")
+    m.init_weights(sample_input=x[:2])
+    # push some running stats into BN state
+    yl = rng.integers(0, 3, 4).astype(np.int32)
+    m.fit(x, yl, batch_size=4, nb_epoch=2)
+    want = np.asarray(m.predict(x, batch_size=4))
+
+    path = export_onnx(m, str(tmp_path / "cnn"))
+    net = OnnxLoader.load(path)
+    x_nchw = np.ascontiguousarray(x.transpose(0, 3, 1, 2))
+    got = np.asarray(net.call(net.build(None), x_nchw))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_export_unsupported_layer_is_loud(tmp_path):
+    from analytics_zoo_tpu.common import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import LSTM
+    from analytics_zoo_tpu.pipeline.api.onnx import export_onnx
+
+    init_zoo_context()
+    m = Sequential([LSTM(4, input_shape=(5, 3))])
+    m.init_weights()
+    with pytest.raises(NotImplementedError, match="LSTM"):
+        export_onnx(m, str(tmp_path / "bad"))

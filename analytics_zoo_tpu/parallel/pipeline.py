@@ -35,15 +35,17 @@ def _rotate_perm(size: int):
 
 
 def gpipe_apply(stage_fn: Callable, stacked_params, x, *, mesh,
-                n_micro: int, rng=None):
+                n_micro: int, rng=None, stages_per_rank: int = 1):
     """Run ``x`` through ``S`` stacked stages with the GPipe schedule.
 
     ``stage_fn(params, x, rng) -> y`` is one stage; ``stacked_params`` has
-    leading dim ``S`` on every leaf, sharded over ``pipe``; ``x`` is the
-    global batch ``(B, ...)`` (sharded over ``data``). The per-data-shard
-    batch must divide by ``n_micro``; wall-clock per batch is
-    ``(n_micro + S - 1)`` stage times, the classic GPipe bubble — raise
-    ``n_micro`` to amortize it.
+    leading dim ``total_stages`` on every leaf, sharded over ``pipe``; ``x``
+    is the global batch ``(B, ...)`` (sharded over ``data``). With
+    ``stages_per_rank`` k > 1 each pipe rank owns k consecutive stages and
+    applies them back-to-back per tick (a deeper pipeline than chips). The
+    per-data-shard batch must divide by ``n_micro``; wall-clock per batch
+    is ``(n_micro + P - 1)`` superstage times (P = pipe size), the classic
+    GPipe bubble — raise ``n_micro`` to amortize it.
     """
     S = mesh.shape[mesh_lib.PIPE_AXIS]
     dp = mesh.shape[mesh_lib.DATA_AXIS]
@@ -65,24 +67,34 @@ def gpipe_apply(stage_fn: Callable, stacked_params, x, *, mesh,
         out_specs=P(mesh_lib.DATA_AXIS),
         check_vma=False)
     def run(params_loc, x_loc):
-        # drop the local stage dim (S/pipe == 1 enforced by the caller)
-        p_stage = jax.tree.map(lambda a: a[0], params_loc)
         r = jax.lax.axis_index(mesh_lib.PIPE_AXIS)
         mbs = x_loc.reshape(n_micro, x_loc.shape[0] // n_micro,
                             *x_loc.shape[1:])
+
+        def super_stage(h, t):
+            """The rank's k consecutive stages applied back-to-back."""
+            def body(h, sp):
+                p_j, j = sp
+                # unique key per (tick, rank, local stage) = per
+                # (microbatch, stage): stochastic stages decorrelate across
+                # the schedule (exact rng-stream parity with the sequential
+                # path is impossible — it draws once per stage for the
+                # whole batch)
+                srng = (jax.random.fold_in(jax.random.fold_in(
+                    jax.random.fold_in(rng, t), r), j)
+                    if rng is not None else None)
+                return stage_fn(p_j, h, srng), None
+
+            h, _ = jax.lax.scan(
+                body, h, (params_loc, jnp.arange(stages_per_rank)))
+            return h
 
         def tick(carry, t):
             state, out = carry
             feed = jax.lax.dynamic_index_in_dim(
                 mbs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
             inp = jnp.where(r == 0, feed, state)
-            # unique key per (tick, rank) = per (microbatch, stage):
-            # stochastic stages decorrelate across the schedule (exact
-            # rng-stream parity with the sequential path is impossible —
-            # it draws once per stage for the whole batch)
-            trng = (jax.random.fold_in(jax.random.fold_in(rng, t), r)
-                    if rng is not None else None)
-            y = stage_fn(p_stage, inp, trng)
+            y = super_stage(inp, t)
             # the last rank retires microbatch t-(S-1) at tick t
             widx = jnp.clip(t - (S - 1), 0, n_micro - 1)
             cur = jax.lax.dynamic_index_in_dim(out, widx, 0, keepdims=False)

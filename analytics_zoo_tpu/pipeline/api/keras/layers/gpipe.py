@@ -28,11 +28,13 @@ class GPipe(Layer):
     ``stage_factory()`` builds ONE stage (e.g. ``lambda:
     TransformerBlock(8, 2)``); stages must preserve shape (input == output,
     the transformer-stack case PP exists for) and be stateless. On a
-    ``pipe=S`` mesh each rank owns one stage and microbatches flow through
-    the GPipe schedule; on a ``pipe=1`` mesh the stack runs sequentially —
-    the model is portable either way (bit-identical for deterministic
-    stages; stochastic stages draw decorrelated per-(stage, microbatch)
-    keys under the schedule, so dropout masks differ across placements).
+    ``pipe=P`` mesh (``num_stages`` a multiple of P) each rank owns
+    ``num_stages/P`` consecutive stages, applied back-to-back per tick,
+    and microbatches flow through the GPipe schedule; on a ``pipe=1`` mesh
+    the stack runs sequentially — the model is portable either way
+    (bit-identical for deterministic stages; stochastic stages draw
+    decorrelated per-(stage, microbatch) keys under the schedule, so
+    dropout masks differ across placements).
     """
 
     def __init__(self, stage_factory: Callable, num_stages: int,
@@ -81,10 +83,10 @@ class GPipe(Layer):
         # stages will emit (bfloat16 under a mixed-precision policy)
         x = x.astype(compute_dtype())
         if S > 1:
-            if self.num_stages != S:
+            if self.num_stages % S != 0:
                 raise ValueError(
-                    f"{self.name}: num_stages={self.num_stages} must equal "
-                    f"the pipe axis size {S} (stage grouping not supported)")
+                    f"{self.name}: num_stages={self.num_stages} must be a "
+                    f"multiple of the pipe axis size {S}")
             n_micro = self.n_microbatches or S
             dp = mesh.shape[mesh_lib.DATA_AXIS]
             B = x.shape[0]
@@ -93,7 +95,8 @@ class GPipe(Layer):
             # math is identical, only the chip placement differs
             if B % dp == 0 and (B // dp) % n_micro == 0:
                 return gpipe_apply(fn, params, x, mesh=mesh,
-                                   n_micro=n_micro, rng=rng)
+                                   n_micro=n_micro, rng=rng,
+                                   stages_per_rank=self.num_stages // S)
             if B > dp and not self._warned_fallback:
                 # a real batch (not the B=1 probe / tiny tail) losing the
                 # pipeline is a silent S-times perf cliff — say so once

@@ -88,7 +88,8 @@ def _value_info(name: str, shape=None) -> bytes:
 
 
 def _model_bytes(nodes, initializers, inputs, outputs) -> bytes:
-    g = b"".join(field_bytes(1, n) for n in nodes)
+    g = field_bytes(2, b"analytics_zoo_tpu")  # GraphProto.name (checker-required)
+    g += b"".join(field_bytes(1, n) for n in nodes)
     g += b"".join(field_bytes(5, t) for t in initializers)
     g += b"".join(field_bytes(11, _value_info(n, s)) for n, s in inputs)
     g += b"".join(field_bytes(12, _value_info(n, s)) for n, s in outputs)
@@ -129,13 +130,19 @@ class _Emitter:
         self.nodes.append(_node(op, inputs, [out], attrs))
         return out
 
-    def activation(self, act_name: Optional[str], cur: str) -> str:
+    def activation(self, act_name: Optional[str], cur: str,
+                   nchw: bool = False) -> str:
         if act_name is None or act_name == "linear":
             return cur
         if act_name not in _ONNX_ACT or _ONNX_ACT[act_name] is None:
             raise NotImplementedError(
                 f"activation {act_name!r} has no ONNX export mapping")
-        return self.emit(_ONNX_ACT[act_name], [cur])
+        attrs = ()
+        if act_name == "softmax" and nchw:
+            # the framework softmaxes the channel axis (last, NHWC); in the
+            # exported NCHW layout channels sit at axis 1
+            attrs = [_attr_i("axis", 1)]
+        return self.emit(_ONNX_ACT[act_name], [cur], attrs)
 
 
 def _act_name(layer) -> Optional[str]:
@@ -165,6 +172,10 @@ def _export_layer(e: _Emitter, layer: Layer, params: Dict[str, Any],
             raise NotImplementedError(
                 f"{layer.name}: Dense after conv needs Flatten/"
                 f"GlobalAveragePooling2D first")
+        if in_shape is not None and len(in_shape) > 2:
+            raise NotImplementedError(
+                f"{layer.name}: Dense on rank-{len(in_shape)} input has no "
+                f"valid ONNX Gemm export (A must be 2D); Flatten first")
         w = e.init(layer.name + "_W", p("W"))          # (in, out)
         ins = [cur, w]
         attrs = []
@@ -191,10 +202,15 @@ def _export_layer(e: _Emitter, layer: Layer, params: Dict[str, Any],
                          + field_bytes(4, b"SAME_UPPER")
                          + field_varint(20, 3))
         out = e.emit("Conv", ins, attrs, base=layer.name)
-        return e.activation(_act_name(layer), out), True
+        return e.activation(_act_name(layer), out, nchw=True), True
 
     if isinstance(layer, BatchNormalization):
         rank = len(in_shape) if in_shape is not None else 4
+        if rank == 3:
+            raise NotImplementedError(
+                f"{layer.name}: BatchNormalization on rank-3 (B, T, C) "
+                f"input exports to ONNX axis-1 semantics, which differ "
+                f"from this framework's last-axis normalization")
         if not nchw and rank == 4:
             cur = e.emit("Transpose", [cur],
                          [_attr_ints("perm", [0, 3, 1, 2])])

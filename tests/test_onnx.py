@@ -488,3 +488,47 @@ def test_export_unsupported_layer_is_loud(tmp_path):
     m.init_weights()
     with pytest.raises(NotImplementedError, match="LSTM"):
         export_onnx(m, str(tmp_path / "bad"))
+
+
+def test_export_conv_softmax_axis(tmp_path):
+    """Softmax after conv exports with axis=1 (channels in NCHW) — the
+    framework softmaxes channels (last axis, NHWC). Code-review repro."""
+    from analytics_zoo_tpu.common import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Convolution2D
+    from analytics_zoo_tpu.pipeline.api.onnx import export_onnx
+
+    init_zoo_context()
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(2, 5, 5, 3)).astype(np.float32)
+    m = Sequential([Convolution2D(4, 3, 3, activation="softmax",
+                                  border_mode="same",
+                                  input_shape=(5, 5, 3))])
+    m.compile(optimizer="adam", loss="mse")
+    m.init_weights(sample_input=x)
+    want = np.asarray(m.predict(x, batch_size=2))          # NHWC
+    path = export_onnx(m, str(tmp_path / "sm"))
+    net = OnnxLoader.load(path)
+    got = np.asarray(net.call(net.build(None),
+                              np.ascontiguousarray(x.transpose(0, 3, 1, 2))))
+    np.testing.assert_allclose(got.transpose(0, 2, 3, 1), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_export_rank_guards_are_loud(tmp_path):
+    from analytics_zoo_tpu.common import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        BatchNormalization, Dense)
+    from analytics_zoo_tpu.pipeline.api.onnx import export_onnx
+
+    init_zoo_context()
+    m = Sequential([Dense(4, input_shape=(5, 3))])
+    m.init_weights()
+    with pytest.raises(NotImplementedError, match="rank-3"):
+        export_onnx(m, str(tmp_path / "d3"))
+
+    m2 = Sequential([BatchNormalization(input_shape=(5, 3))])
+    m2.init_weights()
+    with pytest.raises(NotImplementedError, match="rank-3"):
+        export_onnx(m2, str(tmp_path / "bn3"))

@@ -267,7 +267,7 @@ def _export_layer(e: _Emitter, layer: Layer, params: Dict[str, Any],
         if layer.activation_name is None:
             raise NotImplementedError(
                 f"{layer.name}: callable activation can't be exported")
-        return e.activation(layer.activation_name, cur), nchw
+        return e.activation(layer.activation_name, cur, nchw=nchw), nchw
 
     if isinstance(layer, Reshape):
         if nchw:  # in-framework Reshape sees NHWC memory order

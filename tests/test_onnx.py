@@ -532,3 +532,29 @@ def test_export_rank_guards_are_loud(tmp_path):
     m2.init_weights()
     with pytest.raises(NotImplementedError, match="rank-3"):
         export_onnx(m2, str(tmp_path / "bn3"))
+
+
+def test_export_standalone_softmax_after_conv(tmp_path):
+    """Activation('softmax') as its own layer after conv must also export
+    with axis=1 (code-review repro)."""
+    from analytics_zoo_tpu.common import init_zoo_context
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (Activation,
+                                                             Convolution2D)
+    from analytics_zoo_tpu.pipeline.api.onnx import export_onnx
+
+    init_zoo_context()
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(2, 5, 5, 3)).astype(np.float32)
+    m = Sequential([Convolution2D(4, 3, 3, border_mode="same",
+                                  input_shape=(5, 5, 3)),
+                    Activation("softmax")])
+    m.compile(optimizer="adam", loss="mse")
+    m.init_weights(sample_input=x)
+    want = np.asarray(m.predict(x, batch_size=2))
+    path = export_onnx(m, str(tmp_path / "sma"))
+    net = OnnxLoader.load(path)
+    got = np.asarray(net.call(net.build(None),
+                              np.ascontiguousarray(x.transpose(0, 3, 1, 2))))
+    np.testing.assert_allclose(got.transpose(0, 2, 3, 1), want,
+                               rtol=1e-4, atol=1e-5)

@@ -3,22 +3,31 @@
 whole-matrix softmax inside ``TransformerLayer.scala:56``/``BERT.scala:66``,
 materializing the (T, T) score matrix in HBM).
 
-Design: grid (batch*head, q-blocks, k-blocks) with the k dimension innermost —
-TPU pallas runs the grid sequentially, so the online-softmax carry (acc/m/l)
-lives in VMEM scratch across the k steps of one q block: initialized at
-``ki == 0``, folded per k block, written out at the last k block. VMEM per
-cell is O(block_q·D + block_k·D) — K/V stream block-by-block, never the whole
-sequence — and both matmuls (QK^T, PV) hit the MXU at tile-aligned sizes.
-Causal cells predicate away k blocks strictly right of the diagonal.
+Forward design: grid (batch*head, q-blocks, k-blocks) with the k dimension
+innermost — TPU pallas runs the grid sequentially, so the online-softmax
+carry (acc/m/l) lives in VMEM scratch across the k steps of one q block:
+initialized at ``ki == 0``, folded per k block, written out (with the row
+log-sum-exp for the backward) at the last k block. VMEM per cell is
+O(block_q·D + block_k·D) — K/V stream block-by-block, never the whole
+sequence — and both matmuls (QK^T, PV) hit the MXU at tile-aligned sizes in
+the input dtype (bfloat16 operands run the MXU at full rate; accumulation is
+always float32). Causal cells predicate away k blocks strictly right of the
+diagonal. An optional per-batch key-padding mask (B, Tk) streams in
+(1, block_k) slices — this is the BERT ``attention_mask`` path.
 
 Causal masking is BOTTOM-RIGHT aligned like the XLA oracle
 (``ops/attention.py:41``): query i attends keys ``j <= i + (t_kv - t_q)``.
-Rows with no visible key (t_q > t_kv tails) return zeros — the one spot the
-oracle differs (its -1e9 fill degrades to uniform weights there).
+Rows with no visible key (t_q > t_kv tails, or fully-masked rows) return
+zeros — the one spot the oracle differs (its -1e9 fill degrades to uniform
+weights there).
 
-Backward runs as XLA recompute (``jax.custom_vjp`` whose bwd re-derives the
-probabilities like the checkpointed form) — the classic flash trade: don't
-store the (T, T) weights, re-make them.
+Backward: the standard two-kernel recompute scheme (no (T, T) tensor is ever
+materialized, unlike the r3 XLA-recompute fallback this replaces):
+``delta = rowsum(dO·O)`` in XLA, then a dq kernel (grid bh, qi, ki — k
+innermost, dq accumulates in VMEM) and a dk/dv kernel (grid bh, ki, qi — q
+innermost, dk/dv accumulate in VMEM), each re-forming one (block_q, block_k)
+probability tile at a time from the saved log-sum-exp. Memory stays
+O(block²) end to end, which is what makes long-context *training* fit.
 """
 
 from __future__ import annotations
@@ -35,14 +44,44 @@ from .common import pad_to_multiple
 
 __all__ = ["flash_attention"]
 
-_LANES = 128  # scratch lane width (TPU min tile last dim)
+_LANES = 128     # lane width (TPU min tile last dim)
+_SUBLANES = 8    # sublane width (TPU min tile second-to-last dim)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                scale: float, block_q: int, block_k: int, t_q: int,
-                t_kv: int, causal: bool):
+def _visibility(qi, ki, s_shape, *, t_q, t_kv, offset, causal, mask_blk):
+    """The (block_q, block_k) keep-mask of one probability tile: kv padding,
+    causal alignment, and the optional key-padding mask row."""
+    block_q, block_k = s_shape
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    ok = k_pos < t_kv
+    if causal:
+        ok = ok & (k_pos <= q_pos + offset)
+    if mask_blk is not None:
+        # keep-masks are a binary contract (1.0 = attend); >= 1.0 matches
+        # the XLA oracle's additive -1e9*(1-mask) on stray soft values too
+        # (anything < 1 is effectively hidden there)
+        ok = ok & (mask_blk[None, :] >= 1.0)
+    return ok
+
+
+def _fwd_kernel(*refs, scale: float, block_q: int, block_k: int, t_q: int,
+                t_kv: int, causal: bool, has_mask: bool, want_lse: bool):
     """Grid cell (bh, qi, ki). q (1, block_q, D); k/v (1, block_k, D);
-    o (1, block_q, D); scratch acc (block_q, D), m/l (block_q, LANES)."""
+    [mask (1, SUBLANES, block_k)]; o (1, block_q, D);
+    lse (1, block_q, LANES); scratch acc (block_q, D), m/l (block_q, LANES).
+    Row/key vectors carry 8-sublane/128-lane broadcast dims — TPU blocks
+    need tileable trailing dims (the same layout jax's reference TPU flash
+    kernel uses for segment ids and l/m)."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    mask_ref = refs[3] if has_mask else None
+    rest = refs[3 + int(has_mask):]
+    o_ref = rest[0]
+    lse_ref = rest[1] if want_lse else None
+    acc_ref, m_ref, l_ref = rest[-3:]
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -63,18 +102,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(needed)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        ok = k_pos < t_kv                              # kv padding mask
-        if causal:
-            ok = ok & (k_pos <= q_pos + offset)
+        # operands stay in the input dtype (bf16 operands = full MXU rate);
+        # the product accumulates f32 via preferred_element_type
+        q = q_ref[0]
+        s = jax.lax.dot_general(q, k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        ok = _visibility(qi, ki, (block_q, block_k), t_q=t_q, t_kv=t_kv,
+                         offset=offset, causal=causal,
+                         mask_blk=mask_ref[0, 0] if has_mask else None)
         s = jnp.where(ok, s, -jnp.inf)
 
         m_prev = m_ref[:, :1]
@@ -85,107 +120,306 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l_ref[:, :1] = l_ref[:, :1] * corr + jnp.sum(p, axis=-1,
                                                      keepdims=True)
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:, :1] = m_new
 
     @pl.when(ki == n_k - 1)
     def _finish():
         l = l_ref[:, :1]
+        m = m_ref[:, :1]
         o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
                     ).astype(o_ref.dtype)
+        if want_lse:
+            # rows with no visible key: +inf sentinel makes every backward
+            # probability exp(s - inf) = 0, matching the zero forward output
+            lse = jnp.where(l == 0.0, jnp.inf, m + jnp.log(jnp.where(
+                l == 0.0, 1.0, l)))
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
-               interpret: bool):
+def _prep(q, k, v, mask, block_q, block_k):
     b, h, t_q, d = q.shape
     t_kv = k.shape[2]
-    scale = 1.0 / float(d) ** 0.5
     block_q = min(block_q, max(t_q, 1))
     block_k = min(block_k, max(t_kv, 1))
-
     qr = pad_to_multiple(q.reshape(b * h, t_q, d), 1, block_q)
     kr = pad_to_multiple(k.reshape(b * h, t_kv, d), 1, block_k)
     vr = pad_to_multiple(v.reshape(b * h, t_kv, d), 1, block_k)
+    mr = None
+    if mask is not None:
+        mr = pad_to_multiple(mask.astype(jnp.float32), 1, block_k)
+        mr = jnp.broadcast_to(mr[:, None, :],
+                              (mr.shape[0], _SUBLANES, mr.shape[1]))
+    return qr, kr, vr, mr, block_q, block_k
+
+
+def _flash_fwd(q, k, v, mask, causal: bool, block_q: int, block_k: int,
+               interpret: bool, want_lse: bool):
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[2]
+    scale = 1.0 / float(d) ** 0.5
+    qr, kr, vr, mr, block_q, block_k = _prep(q, k, v, mask, block_q, block_k)
     n_q = qr.shape[1] // block_q
     n_k = kr.shape[1] // block_k
+    has_mask = mr is not None
 
     kernel = functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
                                block_k=block_k, t_q=t_q, t_kv=t_kv,
-                               causal=causal)
-    out = pl.pallas_call(
+                               causal=causal, has_mask=has_mask,
+                               want_lse=want_lse)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+    ]
+    operands = [qr, kr, vr]
+    if has_mask:
+        in_specs.append(pl.BlockSpec(
+            (1, _SUBLANES, block_k), lambda bh, qi, ki: (bh // h, 0, ki)))
+        operands.append(mr)
+    out_specs = [pl.BlockSpec((1, block_q, d),
+                              lambda bh, qi, ki: (bh, qi, 0))]
+    out_shape = [jax.ShapeDtypeStruct(qr.shape, q.dtype)]
+    if want_lse:
+        # inference/primal calls skip the lse output entirely — pallas
+        # outputs are opaque to XLA DCE, so an unconditional write would
+        # cost real HBM traffic on every no-grad forward
+        out_specs.append(pl.BlockSpec((1, block_q, _LANES),
+                                      lambda bh, qi, ki: (bh, qi, 0)))
+        out_shape.append(jax.ShapeDtypeStruct(
+            (qr.shape[0], qr.shape[1], _LANES), jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),       # acc
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
         ],
         interpret=interpret,
-    )(qr, kr, vr)
-    return out[:, :t_q, :].reshape(b, h, t_q, d)
+    )(*operands)
+    out = res[0]  # out_shape is a list either way
+    o = out[:, :t_q, :].reshape(b, h, t_q, d)
+    return (o, res[1]) if want_lse else o
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(*refs, scale: float, block_q: int, block_k: int,
+                   t_q: int, t_kv: int, causal: bool, has_mask: bool):
+    """Grid (bh, qi, ki), k innermost: dq accumulates over k blocks."""
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, mask_ref, dq_ref,
+         acc_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, acc_ref = refs
+        mask_ref = None
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    offset = t_kv - t_q
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    needed = True
+    if causal:
+        needed = ki * block_k <= (qi + 1) * block_q - 1 + offset
+
+    @pl.when(needed)
+    def _step():
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        ok = _visibility(qi, ki, (block_q, block_k), t_q=t_q, t_kv=t_kv,
+                         offset=offset, causal=causal,
+                         mask_blk=mask_ref[0, 0] if has_mask else None)
+        lse = lse_ref[0, :, :1]
+        p = jnp.where(ok, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0, :, :1])
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
+                    t_q: int, t_kv: int, causal: bool, has_mask: bool):
+    """Grid (bh, ki, qi), q innermost: dk/dv accumulate over q blocks."""
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, mask_ref, dk_ref,
+         dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref, dv_ref,
+         dk_acc, dv_acc) = refs
+        mask_ref = None
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    offset = t_kv - t_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    needed = True
+    if causal:
+        needed = ki * block_k <= (qi + 1) * block_q - 1 + offset
+
+    @pl.when(needed)
+    def _step():
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        ok = _visibility(qi, ki, (block_q, block_k), t_q=t_q, t_kv=t_kv,
+                         offset=offset, causal=causal,
+                         mask_blk=mask_ref[0, 0] if has_mask else None)
+        lse = lse_ref[0, :, :1]
+        p = jnp.where(ok, jnp.exp(s - lse), 0.0)
+        do = do_ref[0]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0, :, :1])
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, mask, out, lse, g, causal, block_q, block_k,
+               interpret):
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[2]
+    scale = 1.0 / float(d) ** 0.5
+    qr, kr, vr, mr, block_q, block_k = _prep(q, k, v, mask, block_q, block_k)
+    gr = pad_to_multiple(g.reshape(b * h, t_q, d), 1, block_q)
+    orr = pad_to_multiple(out.reshape(b * h, t_q, d), 1, block_q)
+    # delta_i = sum_d dO_id * O_id — rowwise, cheap in XLA (no (T,T) tensor)
+    delta = jnp.sum(gr.astype(jnp.float32) * orr.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, :, None],
+                             (*delta.shape, _LANES))
+    n_q = qr.shape[1] // block_q
+    n_k = kr.shape[1] // block_k
+    has_mask = mr is not None
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0))
+    rowspec = pl.BlockSpec((1, block_q, _LANES),
+                           lambda bh, qi, ki: (bh, qi, 0))
+    mspec = pl.BlockSpec((1, _SUBLANES, block_k),
+                         lambda bh, qi, ki: (bh // h, 0, ki))
+    operands = [qr, kr, vr, gr, lse, delta] + ([mr] if has_mask else [])
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, t_q=t_q, t_kv=t_kv, causal=causal,
+                          has_mask=has_mask),
+        grid=(b * h, n_q, n_k),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec]
+                 + ([mspec] if has_mask else []),
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(*operands)
+
+    # dk/dv grid: (bh, ki, qi) — remap the spec index args accordingly
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0))
+    rowspec2 = pl.BlockSpec((1, block_q, _LANES),
+                            lambda bh, ki, qi: (bh, qi, 0))
+    mspec2 = pl.BlockSpec((1, _SUBLANES, block_k),
+                          lambda bh, ki, qi: (bh // h, 0, ki))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, t_q=t_q, t_kv=t_kv, causal=causal,
+                          has_mask=has_mask),
+        grid=(b * h, n_k, n_q),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2]
+                 + ([mspec2] if has_mask else []),
+        out_specs=[kspec2, kspec2],
+        out_shape=[jax.ShapeDtypeStruct(kr.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vr.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(*operands)
+
+    dq = dq[:, :t_q, :].reshape(b, h, t_q, d)
+    dk = dk[:, :t_kv, :].reshape(b, h, t_kv, d)
+    dv = dv[:, :t_kv, :].reshape(b, h, t_kv, d)
+    dmask = None if mask is None else jnp.zeros_like(mask,
+                                                     dtype=jnp.float32)
+    return dq, dk, dv, dmask
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, mask, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, mask, causal, block_q, block_k, interpret,
+                      want_lse=False)
+
+
+def _vjp_fwd(q, k, v, mask, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, mask, causal, block_q, block_k, interpret,
+                          want_lse=True)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, mask, out, lse = res
+    return _flash_bwd(q, k, v, mask, out, lse, g, causal, block_q, block_k,
+                      interpret)
+
+
+_flash.defvjp(_vjp_fwd, _vjp_bwd)
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    mask: Optional[jax.Array] = None,
                     causal: bool = False, block_q: int = 256,
                     block_k: int = 256,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Blockwise-softmax attention: q/k/v (B, H, T, D) → (B, H, Tq, D).
 
-    Numerically equivalent to ``ops.attention.dot_product_attention`` (minus
-    dropout/mask arguments — those paths stay on the XLA op). ``interpret``
-    defaults to auto: compiled on TPU, interpreter elsewhere (tests).
-    """
+    ``mask``: optional per-batch key-padding keep-mask, (B, Tk) with
+    nonzero = attend (the BERT ``attention_mask``; full (B, H, Tq, Tk)
+    masks stay on the XLA op). Numerically equivalent to
+    ``ops.attention.dot_product_attention`` (minus dropout — that path
+    stays on the XLA op). Forward and backward are both Pallas kernels with
+    O(block²) memory; gradients flow to q/k/v (the mask gets zeros).
+    ``interpret`` defaults to auto: compiled on TPU, interpreter elsewhere
+    (tests)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-
-
-def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    out = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
-
-
-def _vjp_bwd(causal, block_q, block_k, interpret, res, g):
-    """Recompute-form backward: differentiate the reference attention math
-    (no (T,T) tensor was saved by the forward; XLA re-materializes it here,
-    which is the standard flash-attention memory/compute trade)."""
-    q, k, v = res
-
-    def ref(q, k, v):
-        d = q.shape[-1]
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                       preferred_element_type=jnp.float32)
-        s = s / jnp.sqrt(jnp.asarray(d, jnp.float32))
-        if causal:
-            tq, tk = s.shape[-2], s.shape[-1]
-            cm = jnp.tril(jnp.ones((tq, tk), jnp.bool_), k=tk - tq)
-            s = jnp.where(cm[None, None], s, -1e9)
-        w = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v,
-                         preferred_element_type=jnp.float32)
-        if causal:
-            # match the kernel exactly: rows with NO visible key (t_q > t_kv
-            # tails) are zero in the forward, so they must be constants here
-            # too — the -1e9 fill alone would leak uniform-weight gradients
-            has_key = (jnp.arange(s.shape[-2])
-                       + (s.shape[-1] - s.shape[-2])) >= 0
-            out = out * has_key[None, None, :, None].astype(out.dtype)
-        return out.astype(v.dtype)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
-
-
-flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+    if mask is not None:
+        if isinstance(mask, bool):
+            raise TypeError("flash_attention's 4th argument is now the "
+                            "key-padding mask; pass causal=... by keyword")
+        if mask.ndim != 2:
+            raise ValueError(f"flash_attention mask must be (B, Tk); got "
+                             f"shape {mask.shape} — reduce broadcast masks "
+                             f"at the layer level")
+        mask = jax.lax.stop_gradient(mask.astype(jnp.float32))
+    return _flash(q, k, v, mask, causal, block_q, block_k, interpret)

@@ -66,17 +66,47 @@ class MultiHeadSelfAttention(Layer):
         return {"qkv": _dense_params(k1, self.hidden_size, 3 * self.hidden_size),
                 "proj": _dense_params(k2, self.hidden_size, self.hidden_size)}
 
-    def _use_flash(self, mask, drop) -> bool:
-        """The pallas flash kernel covers the mask-free, dropout-free case;
-        opt in via ``zoo.pallas.attention`` (attention masks and in-kernel
-        dropout stay on the XLA op)."""
-        if mask is not None or drop > 0.0:
+    @staticmethod
+    def _kv_mask(mask):
+        """Reduce a broadcastable attention mask to the (B, Tk) key-padding
+        form the flash kernel streams blockwise; None if it can't be (a
+        genuinely per-query mask stays on the XLA op)."""
+        if mask is None:
+            return None
+        if mask.ndim == 2:                      # (B, Tk)
+            return mask
+        if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+            return mask[:, 0, 0, :]             # (B, 1, 1, Tk)
+        return None
+
+    #: auto mode hands sequences this long to the flash kernel: below it the
+    #: fused XLA softmax-attention wins (flash's sequential grid has per-cell
+    #: overhead; measured slower than XLA at T=128 on v5e), above it the
+    #: O(T²) HBM materialization starts to dominate and blockwise wins.
+    FLASH_AUTO_MIN_SEQ = 512
+
+    def _use_flash(self, mask, drop, seq_len: int) -> bool:
+        """The pallas flash kernel covers key-padding masks (the BERT
+        ``attention_mask`` form) and mask-free attention, forward AND
+        backward; in-kernel dropout and per-query masks stay on the XLA op.
+        ``zoo.pallas.attention``: True/False force it; ``auto`` (default)
+        enables it on TPU backends for sequences ≥ FLASH_AUTO_MIN_SEQ (the
+        CPU interpreter path is for tests, not speed)."""
+        if drop > 0.0:
+            return False
+        if mask is not None and self._kv_mask(mask) is None:
             return False
         from .....common.context import get_zoo_context
         try:
-            return bool(get_zoo_context().get("zoo.pallas.attention", False))
+            flag = get_zoo_context().get("zoo.pallas.attention", "auto")
         except Exception:
-            return False
+            flag = "auto"
+        if isinstance(flag, str):
+            if flag.lower() == "auto":
+                return (jax.default_backend() == "tpu"
+                        and seq_len >= self.FLASH_AUTO_MIN_SEQ)
+            return flag.lower() in ("1", "true", "yes", "on")
+        return bool(flag)
 
     def _ring_mesh(self, mask, drop, seq_len):
         """Sequence parallelism from the LAYER API: on a mesh with a ``seq``
@@ -139,9 +169,10 @@ class MultiHeadSelfAttention(Layer):
             from .....parallel.ring_attention import ring_self_attention
             out = ring_self_attention(qh, kh, vh, mesh=ring_mesh,
                                       causal=self.causal)
-        elif self._use_flash(mask, drop):
+        elif self._use_flash(mask, drop, qh.shape[2]):
             from .....ops.pallas import flash_attention
-            out = flash_attention(qh, kh, vh, self.causal)
+            out = flash_attention(qh, kh, vh, mask=self._kv_mask(mask),
+                                  causal=self.causal)
         else:
             out = dot_product_attention(qh, kh, vh, mask=mask,
                                         causal=self.causal,
@@ -311,10 +342,15 @@ class BERT(Layer):
                 f"position_ids, attention_mask]")
         ids, token_type, pos, mask = x
         cd = compute_dtype()
-        h = (jnp.take(params["word"], ids.astype(jnp.int32), axis=0)
-             + jnp.take(params["position"], pos.astype(jnp.int32), axis=0)
-             + jnp.take(params["token_type"], token_type.astype(jnp.int32),
-                        axis=0))
+        # cast tables to the compute dtype BEFORE the gather: halves the
+        # gather read and (more importantly) the backward scatter-add
+        # traffic under bf16 — the table-sized cast is one cheap pass
+        h = (jnp.take(params["word"].astype(cd), ids.astype(jnp.int32),
+                      axis=0)
+             + jnp.take(params["position"].astype(cd),
+                        pos.astype(jnp.int32), axis=0)
+             + jnp.take(params["token_type"].astype(cd),
+                        token_type.astype(jnp.int32), axis=0))
         h = self.emb_ln.call(params["emb_ln"], h).astype(cd)
         r = rng
         if rng is not None:

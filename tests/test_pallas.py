@@ -24,7 +24,7 @@ def _qkv(b, h, tq, tk, d, seed=0):
 def test_flash_matches_xla_multiblock(causal):
     # several q and k blocks, t NOT a multiple of the block size
     q, k, v = _qkv(2, 3, 50, 50, 8)
-    out = flash_attention(q, k, v, causal, 16, 16)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
     ref = dot_product_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=RTOL, atol=ATOL)
@@ -32,7 +32,7 @@ def test_flash_matches_xla_multiblock(causal):
 
 def test_flash_single_block_and_tiny():
     q, k, v = _qkv(1, 1, 3, 5, 4, seed=1)
-    out = flash_attention(q, k, v, False, 128, 128)
+    out = flash_attention(q, k, v, causal=False, block_q=128, block_k=128)
     ref = dot_product_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=RTOL, atol=ATOL)
@@ -42,7 +42,7 @@ def test_flash_single_block_and_tiny():
 def test_flash_cross_attention_lengths(causal):
     """tq != tkv, incl. the bottom-right-aligned causal convention."""
     q, k, v = _qkv(1, 2, 7, 33, 8, seed=2)
-    out = flash_attention(q, k, v, causal, 4, 8)
+    out = flash_attention(q, k, v, causal=causal, block_q=4, block_k=8)
     ref = dot_product_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=RTOL, atol=ATOL)
@@ -51,7 +51,7 @@ def test_flash_cross_attention_lengths(causal):
 def test_flash_bf16():
     q, k, v = _qkv(1, 2, 32, 32, 8, seed=3)
     qb, kb, vb = (a.astype(jnp.bfloat16) for a in (q, k, v))
-    out = flash_attention(qb, kb, vb, True, 16, 16)
+    out = flash_attention(qb, kb, vb, causal=True, block_q=16, block_k=16)
     assert out.dtype == jnp.bfloat16
     ref = dot_product_attention(qb, kb, vb, causal=True)
     np.testing.assert_allclose(np.asarray(out, np.float32),
@@ -64,7 +64,7 @@ def test_flash_gradients_match_xla(causal):
     q, k, v = _qkv(1, 2, 24, 24, 4, seed=4)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal, 8, 8) ** 2)
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=8, block_k=8) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(dot_product_attention(q, k, v, causal=causal) ** 2)
@@ -81,12 +81,12 @@ def test_flash_causal_tq_gt_tkv_zero_rows_have_zero_grad():
     the backward must treat those rows as constants — no uniform-weight
     gradient leak from the recompute reference."""
     q, k, v = _qkv(1, 1, 5, 3, 4, seed=8)
-    out = flash_attention(q, k, v, True, 4, 4)
+    out = flash_attention(q, k, v, causal=True, block_q=4, block_k=4)
     # rows 0..1 see no key (offset = 3 - 5 = -2): exactly zero
     np.testing.assert_array_equal(np.asarray(out[0, 0, :2]), 0.0)
 
     def f(v):
-        return jnp.sum(flash_attention(q, k, v, True, 4, 4)[0, 0, 0])
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=4, block_k=4)[0, 0, 0])
 
     g = jax.grad(f)(v)
     np.testing.assert_array_equal(np.asarray(g), 0.0)
@@ -94,7 +94,7 @@ def test_flash_causal_tq_gt_tkv_zero_rows_have_zero_grad():
 
 def test_flash_rejects_nothing_when_t_one():
     q, k, v = _qkv(1, 1, 1, 1, 4, seed=5)
-    out = flash_attention(q, k, v, True)
+    out = flash_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(v),
                                rtol=RTOL, atol=ATOL)
 
@@ -118,6 +118,130 @@ def test_attention_layer_flash_optin_matches_xla_path():
     reset_zoo_context()
     init_zoo_context(conf={"zoo.pallas.attention": True})
     y_flash = np.asarray(layer.call(params, x))
+    reset_zoo_context()
+    np.testing.assert_allclose(y_flash, y_xla, rtol=RTOL, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_key_padding_mask_matches_xla(causal):
+    """(B, Tk) keep-mask (the BERT attention_mask form) — forward parity with
+    the XLA oracle's broadcast mask."""
+    q, k, v = _qkv(2, 2, 20, 20, 8, seed=9)
+    rng = np.random.default_rng(9)
+    lens = rng.integers(5, 21, 2)
+    mask = (np.arange(20)[None, :] < lens[:, None]).astype(np.float32)
+    out = flash_attention(q, k, v, mask=jnp.asarray(mask), causal=causal,
+                          block_q=8, block_k=8)
+    ref = dot_product_attention(q, k, v, mask=jnp.asarray(mask)[:, None, None, :],
+                                causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=RTOL, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_masked_gradients_match_xla(causal):
+    q, k, v = _qkv(2, 2, 16, 16, 4, seed=10)
+    mask = jnp.asarray((np.arange(16)[None, :]
+                        < np.array([[9], [16]])).astype(np.float32))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, mask=mask, causal=causal,
+                                       block_q=8, block_k=8) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(
+            q, k, v, mask=mask[:, None, None, :], causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_flash_fully_masked_row_zero_everywhere():
+    """A batch row whose mask hides every key: zero output, zero grads —
+    the lse=+inf sentinel path."""
+    q, k, v = _qkv(2, 1, 6, 6, 4, seed=11)
+    mask = jnp.asarray(np.stack([np.zeros(6), np.ones(6)]).astype(np.float32))
+    out = flash_attention(q, k, v, mask=mask, block_q=4, block_k=4)
+    np.testing.assert_array_equal(np.asarray(out[0]), 0.0)
+
+    def f(v):
+        return jnp.sum(flash_attention(q, k, v, mask=mask,
+                                       block_q=4, block_k=4)[0] ** 2)
+
+    g = jax.grad(f)(v)
+    np.testing.assert_array_equal(np.asarray(g[0]), 0.0)
+
+
+def test_flash_bwd_no_quadratic_memory():
+    """The backward must be the Pallas two-kernel scheme, not an XLA
+    recompute that materializes (T, T): assert no O(T^2) intermediate in the
+    jaxpr-compiled HLO at a length where (T,T) f32 would be 64 MB."""
+    t = 4096
+    q = jnp.zeros((1, 1, t, 8), jnp.float32)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True))
+
+    # abstract trace only — no execution needed to inspect shapes
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, q, q)
+    biggest = 0
+    for eqn in jaxpr.jaxpr.eqns:
+        for var in eqn.outvars:
+            if hasattr(var.aval, "shape"):
+                n = int(np.prod(var.aval.shape)) if var.aval.shape else 1
+                biggest = max(biggest, n)
+    # largest live tensor should be O(T*D) / O(T*LANES), nowhere near T^2
+    assert biggest < t * t // 8, f"O(T^2) intermediate found: {biggest}"
+
+
+def test_flash_gradients_bf16():
+    q, k, v = _qkv(1, 2, 32, 32, 8, seed=12)
+    qb, kb, vb = (a.astype(jnp.bfloat16) for a in (q, k, v))
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=16, block_k=16)
+                       .astype(jnp.float32) ** 2)
+
+    gf = jax.grad(loss, argnums=(0, 1, 2))(qb, kb, vb)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b),
+                                   rtol=1e-1, atol=1e-1)
+
+
+def test_attention_layer_flash_handles_bert_mask():
+    """With flash forced on, a (B, 1, 1, T) padding mask routes through the
+    kernel (not the XLA fallback) and matches the XLA path."""
+    from analytics_zoo_tpu.common.context import (init_zoo_context,
+                                                  reset_zoo_context)
+    from analytics_zoo_tpu.pipeline.api.keras.layers import \
+        MultiHeadSelfAttention
+
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.normal(size=(2, 12, 16)), jnp.float32)
+    mask = jnp.asarray((np.arange(12)[None, :]
+                        < np.array([[7], [12]])).astype(np.float32)
+                       )[:, None, None, :]
+    layer = MultiHeadSelfAttention(16, 4)
+    params = layer.build(jax.random.key(0), (None, 12, 16))
+
+    reset_zoo_context()
+    init_zoo_context(conf={"zoo.pallas.attention": False})
+    y_xla = np.asarray(layer.call(params, [x, mask]))
+    reset_zoo_context()
+    init_zoo_context(conf={"zoo.pallas.attention": True})
+    assert layer._use_flash(mask, 0.0, 12)
+    y_flash = np.asarray(layer.call(params, [x, mask]))
     reset_zoo_context()
     np.testing.assert_allclose(y_flash, y_xla, rtol=RTOL, atol=1e-4)
 

@@ -171,6 +171,9 @@ class ZooContext:
 
 
 _context: Optional[ZooContext] = None
+#: jax_default_prng_impl before init_zoo_context first overrode it (None =
+#: never overridden); reset_zoo_context restores it
+_prng_impl_before_init: Optional[str] = None
 _distributed_initialized = False
 
 
@@ -272,6 +275,9 @@ def init_zoo_context(
         raise ValueError(f"zoo.rng.impl must be auto|default|rbg, got "
                          f"{merged.get('zoo.rng.impl')!r}")
     if impl:
+        global _prng_impl_before_init
+        if _prng_impl_before_init is None:
+            _prng_impl_before_init = jax.config.jax_default_prng_impl
         jax.config.update("jax_default_prng_impl", impl)
 
     mesh = mesh_lib.create_mesh(
@@ -314,10 +320,13 @@ def get_zoo_context() -> ZooContext:
 
 def reset_zoo_context() -> None:
     """Tear down the global context (mainly for tests)."""
-    global _context
+    global _context, _prng_impl_before_init
     _context = None
     mesh_lib.reset_global_mesh()
-    import jax
-    jax.config.update("jax_default_prng_impl", "threefry2x32")
+    if _prng_impl_before_init is not None:
+        # restore the PRE-init value: a user's own jax.config choice made
+        # outside the zoo context is not ours to clobber
+        jax.config.update("jax_default_prng_impl", _prng_impl_before_init)
+        _prng_impl_before_init = None
     from ..pipeline.api.keras import engine as _engine
     _engine._reset_policy()

@@ -403,9 +403,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Blockwise-softmax attention: q/k/v (B, H, T, D) → (B, H, Tq, D).
 
-    ``mask``: optional per-batch key-padding keep-mask, (B, Tk) with
-    nonzero = attend (the BERT ``attention_mask``; full (B, H, Tq, Tk)
-    masks stay on the XLA op). Numerically equivalent to
+    ``mask``: optional per-batch key-padding keep-mask, (B, Tk), a BINARY
+    contract: values >= 1.0 attend, anything below is hidden — matching the
+    XLA oracle's additive ``-1e9*(1-mask)`` on stray soft values (the BERT
+    ``attention_mask``; full (B, H, Tq, Tk) masks stay on the XLA op). Numerically equivalent to
     ``ops.attention.dot_product_attention`` (minus dropout — that path
     stays on the XLA op). Forward and backward are both Pallas kernels with
     O(block²) memory; gradients flow to q/k/v (the mask gets zeros).

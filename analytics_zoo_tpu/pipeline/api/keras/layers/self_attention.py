@@ -102,10 +102,16 @@ class MultiHeadSelfAttention(Layer):
         except Exception:
             flag = "auto"
         if isinstance(flag, str):
-            if flag.lower() == "auto":
+            low = flag.strip().lower()
+            if low == "auto":
                 return (jax.default_backend() == "tpu"
                         and seq_len >= self.FLASH_AUTO_MIN_SEQ)
-            return flag.lower() in ("1", "true", "yes", "on")
+            if low in ("1", "true", "yes", "on"):
+                return True
+            if low in ("0", "false", "no", "off", ""):
+                return False
+            raise ValueError(f"zoo.pallas.attention must be auto|true|false,"
+                             f" got {flag!r}")
         return bool(flag)
 
     def _ring_mesh(self, mask, drop, seq_len):

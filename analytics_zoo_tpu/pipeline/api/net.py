@@ -288,10 +288,22 @@ class Net:
         return _install_pretrained(model)
 
     @staticmethod
-    def load_tf(path: str, inputs=None, outputs=None, trainable: bool = True):
+    def load_tf(path: str, inputs=None, outputs=None, trainable: bool = True,
+                **kwargs):
         """A frozen TF GraphDef ``.pb`` (``Net.loadTF``,
-        ``Net.scala:123-171``) — executed as jitted JAX ops, no TF
-        runtime; see ``tfnet.py``."""
+        ``Net.scala:123-171``) or a SavedModel DIRECTORY
+        (``TFNetForInference.scala:412`` role: graph + restored variables,
+        fine-tunable) — executed as jitted JAX ops, no TF runtime; see
+        ``tfnet.py`` / ``saved_model.py``."""
+        import os
+        if os.path.isdir(path):
+            from .saved_model import load_saved_model
+            return load_saved_model(path, inputs=inputs, outputs=outputs,
+                                    trainable=trainable, **kwargs)
+        if kwargs:
+            raise TypeError(f"unexpected arguments for a frozen GraphDef "
+                            f"file: {sorted(kwargs)} (signature/tags apply "
+                            f"to SavedModel directories only)")
         from .tfnet import load_tf
         return load_tf(path, inputs=inputs, outputs=outputs,
                        trainable=trainable)

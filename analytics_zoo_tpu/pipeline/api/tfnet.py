@@ -96,13 +96,19 @@ def _decode_tensor(buf: bytes) -> np.ndarray:
     doubles: List[float] = []
     bools: List[bool] = []
     halves: List[int] = []
+    strings: List[bytes] = []
     for num, wt, payload in parse_fields(buf):
         if num == 1:
             code = _as_int(payload)
-            if code not in _DTYPES:
+            # DT_STRING (7) decodes to an object array so Saver-machinery
+            # consts (file patterns, slice names) survive GRAPH DECODE;
+            # executing one still fails at the consuming op
+            if code != 7 and code not in _DTYPES:
                 raise NotImplementedError(f"TensorProto dtype {code}")
         elif num == 2:
             shape = _decode_shape(payload)
+        elif num == 8:               # string_val
+            strings.append(bytes(payload))
         elif num == 4:
             content = payload
         elif num == 5:               # float_val
@@ -121,6 +127,9 @@ def _decode_tensor(buf: bytes) -> np.ndarray:
             bools.extend(bool(v) for v in _packed_ints(payload, wt))
         elif num == 13:              # half_val (f16/bf16 bit patterns)
             halves.extend(_packed_ints(payload, wt))
+    if code == 7:
+        return np.asarray(strings, dtype=object).reshape(
+            shape if shape else (len(strings),) if len(strings) != 1 else ())
     dtype = _DTYPES[code]
     n = int(np.prod(shape)) if shape else 1
     if content is not None:
@@ -294,6 +303,9 @@ _UNARY = {
     "Rsqrt": jax.lax.rsqrt, "Erf": jax.scipy.special.erf,
     "Floor": jnp.floor, "Ceil": jnp.ceil, "Round": jnp.round,
     "Identity": lambda x: x, "StopGradient": jax.lax.stop_gradient,
+    # resource-variable read: the SavedModel importer turns VarHandleOp
+    # into a Const carrying the restored value, so the read is identity
+    "ReadVariableOp": lambda x: x,
     "Reciprocal": jnp.reciprocal, "LogicalNot": jnp.logical_not,
 }
 
@@ -307,6 +319,15 @@ _STRUCTURAL = {("Reshape", 1), ("ConcatV2", -1), ("Transpose", 1),
                ("Any", 1), ("ArgMax", 1), ("GatherV2", 2),
                ("Tile", 1), ("Fill", 0), ("StridedSlice", 1),
                ("StridedSlice", 2), ("StridedSlice", 3)}
+
+# (op, input position) pairs pinned NON-trainable even under trainable=True:
+# FusedBatchNorm's moving mean/variance (positions 3, 4) are inference-mode
+# STATISTICS — updating them by gradient descent silently diverges from
+# frozen-BN fine-tune semantics (scale/offset at 1, 2 stay trainable, as in
+# a standard BN fine-tune)
+_FROZEN_STATS = {("FusedBatchNorm", 3), ("FusedBatchNorm", 4),
+                 ("FusedBatchNormV2", 3), ("FusedBatchNormV2", 4),
+                 ("FusedBatchNormV3", 3), ("FusedBatchNormV3", 4)}
 
 # every op _run_node dispatches on; the load-time coverage check uses this
 _SUPPORTED_OPS = (set(_UNARY) | set(_ELEMENTWISE) | set(_REDUCE) | {
@@ -511,8 +532,9 @@ class TFNet(Layer):
             for pos, raw in enumerate(names):
                 key = (n["op"], pos)
                 last = (n["op"], -1)
-                if key in _STRUCTURAL or (last in _STRUCTURAL
-                                          and pos == len(names) - 1):
+                if (key in _STRUCTURAL or key in _FROZEN_STATS
+                        or (last in _STRUCTURAL
+                            and pos == len(names) - 1)):
                     structural.add(raw.split(":")[0])
 
         self.consts: Dict[str, np.ndarray] = {}

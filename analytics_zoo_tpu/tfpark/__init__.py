@@ -18,8 +18,18 @@ onto the native JAX layers. This package ships:
   two independently jitted donated steps.
 * ``bert_params_from_torch`` — weight import from a HuggingFace/torch BERT
   ``state_dict`` (the analogue of TFPark's init_from_checkpoint path).
+* ``TFEstimator`` / ``TFEstimatorSpec`` — the GENERIC model_fn estimator
+  (``pyzoo/zoo/tfpark/estimator.py:84``): bring-your-own graph code over
+  native layers, autograd ops, or imported ``Net.load_tf`` graphs.
+* ``KerasModel`` — the compiled-model facade with the
+  fit/evaluate/predict/weights surface (``pyzoo/zoo/tfpark/model.py:30``).
+* ``TFDataset`` / ``TensorMeta`` — the feed contract (structure metas +
+  batch_size-divides-the-mesh rule, ``tf_dataset.py:112-212``).
 """
 
 from .bert_classifier import BERTClassifier, bert_params_from_torch  # noqa: F401
 from .bert_ner import BERTNER, BERTSQuAD  # noqa: F401
 from .gan_estimator import GANEstimator, gan_d_loss, gan_g_loss  # noqa: F401
+from .tf_dataset import TFDataset, TensorMeta  # noqa: F401
+from .estimator import TFEstimator, TFEstimatorSpec, ModeKeys  # noqa: F401
+from .model import KerasModel  # noqa: F401

@@ -1,0 +1,207 @@
+"""KerasModel — the TFPark keras-model facade
+(reference: ``pyzoo/zoo/tfpark/model.py:30-318``).
+
+The reference wraps a compiled tf.keras model and routes fit/evaluate/
+predict either through the local keras session or, when handed a TFDataset
+or ``distributed=True``, through TFOptimizer/TFPredictor onto the cluster.
+Here there is one runtime: the wrapped net is a native compiled ``KerasNet``
+and every path runs the jitted mesh-aware loop — ``distributed`` is
+accepted for API parity and is a no-op (the mesh decides placement).
+Weight IO matches the reference surface (get/set/save/load_weights,
+save_model/load_model)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..feature import FeatureSet
+from .tf_dataset import TFDataset
+
+__all__ = ["KerasModel"]
+
+
+class KerasModel:
+    """``KerasModel(model)`` where ``model`` is a compiled native
+    ``Sequential``/``Model`` (``tfpark/model.py:32``)."""
+
+    def __init__(self, model):
+        if getattr(model, "_compiled", None) is None:
+            raise ValueError("KerasModel expects a compiled model — call "
+                             "model.compile(optimizer=..., loss=...) first")
+        self.model = model
+
+    # -- weights ------------------------------------------------------------
+    @property
+    def metrics_names(self) -> List[str]:
+        spec = self.model._compiled
+        return ["loss"] + [m.name for m in (spec.metrics or [])]
+
+    def get_weights(self) -> List[np.ndarray]:
+        if self.model.params is None:
+            self.model.init_weights()
+        leaves = jax.tree_util.tree_leaves(self.model.params)
+        return [np.asarray(w) for w in leaves]
+
+    def set_weights(self, weights: List[np.ndarray]):
+        if self.model.params is None:
+            self.model.init_weights()
+        treedef = jax.tree_util.tree_structure(self.model.params)
+        template = jax.tree_util.tree_leaves(self.model.params)
+        if len(template) != len(weights):
+            raise ValueError(f"expected {len(template)} weight arrays, got "
+                             f"{len(weights)}")
+        import jax.numpy as jnp
+        leaves = []
+        for t, w in zip(template, weights):
+            if np.shape(t) != np.shape(w):
+                raise ValueError(f"weight shape mismatch: model {np.shape(t)}"
+                                 f" vs given {np.shape(w)}")
+            leaves.append(jnp.asarray(w, np.asarray(t).dtype))
+        self.model.params = jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def save_weights(self, filepath: str, overwrite: bool = True,
+                     save_format=None):
+        if os.path.exists(filepath) and not overwrite:
+            raise IOError(f"{filepath} exists and overwrite=False")
+        if self.model.params is None:
+            self.model.init_weights()
+        leaves, _ = jax.tree_util.tree_flatten_with_path(self.model.params)
+        np.savez(filepath, **{jax.tree_util.keystr(k): np.asarray(v)
+                              for k, v in leaves})
+
+    def load_weights(self, filepath: str, by_name: bool = False):
+        if self.model.params is None:
+            self.model.init_weights()
+        data = np.load(filepath)
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(
+            self.model.params)
+        import jax.numpy as jnp
+        restored = []
+        for k, v in leaves:
+            key = jax.tree_util.keystr(k)
+            if key not in data:
+                if by_name:  # tolerate missing entries, keep current value
+                    restored.append(v)
+                    continue
+                raise ValueError(f"{filepath} missing weight {key}")
+            restored.append(jnp.asarray(data[key], np.asarray(v).dtype))
+        self.model.params = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self.model.params), restored)
+
+    def save_model(self, path: str):
+        """Structure + weights in one file (the HDF5-save role,
+        ``tfpark/model.py:56``). Compile state is not serialized — call
+        ``compile`` after load, as with the reference's custom-object
+        models."""
+        net = self.model
+        params = (jax.tree_util.tree_map(lambda a: np.asarray(a), net.params)
+                  if net.params is not None else None)
+        state = (jax.tree_util.tree_map(lambda a: np.asarray(a),
+                                        net.net_state)
+                 if getattr(net, "net_state", None) else None)
+        loop, compiled = net._loop if hasattr(net, "_loop") else None, net._compiled
+        net._loop = net._compiled = None
+        old_p, old_s = net.params, getattr(net, "net_state", None)
+        net.params = net.net_state = None
+        try:
+            import cloudpickle
+            with open(path, "wb") as f:
+                cloudpickle.dump({"net": net, "params": params,
+                                  "state": state}, f)
+        finally:
+            net._loop, net._compiled = loop, compiled
+            net.params, net.net_state = old_p, old_s
+
+    @staticmethod
+    def load_model(path: str) -> "KerasModel":
+        import jax.numpy as jnp
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        net = blob["net"]
+        if blob["params"] is not None:
+            net.params = jax.tree_util.tree_map(jnp.asarray, blob["params"])
+        if blob["state"] is not None:
+            net.net_state = jax.tree_util.tree_map(jnp.asarray,
+                                                   blob["state"])
+        # loaded nets need a fresh compile; wrap lazily via a passthrough
+        km = object.__new__(KerasModel)
+        km.model = net
+        return km
+
+    # -- summaries (delegate to the native TensorBoard writer) --------------
+    def set_train_summary(self, log_dir: str, app_name: str = "kerasmodel"):
+        self.model.set_tensorboard(log_dir, app_name)
+
+    set_val_summary = set_train_summary
+
+    # -- train / eval / predict --------------------------------------------
+    def fit(self, x=None, y=None, batch_size: Optional[int] = None,
+            epochs: int = 1, validation_split: float = 0.0,
+            validation_data=None, distributed: bool = False, **kwargs):
+        """``tfpark/model.py:90`` — ``x`` may be ndarrays (+ ``y``), a
+        ``TFDataset``, or a ``FeatureSet``. ``validation_split`` carves the
+        tail off an ndarray dataset like the reference's keras path."""
+        del distributed  # one runtime; the mesh decides placement
+        if isinstance(x, TFDataset):
+            bs = batch_size or x.effective_batch()
+            vd = x.validation_arrays()
+            return self.model.fit(x.feature_arrays(), x.label_arrays(),
+                                  batch_size=bs, nb_epoch=epochs,
+                                  validation_data=vd, **kwargs)
+        if isinstance(x, FeatureSet):
+            return self.model.fit(x, batch_size=batch_size or 32,
+                                  nb_epoch=epochs,
+                                  validation_data=validation_data, **kwargs)
+        if validation_split > 0.0 and validation_data is None:
+            xs = x if isinstance(x, (list, tuple)) else [x]
+            n = len(xs[0])
+            cut = n - int(n * validation_split)
+            validation_data = ([a[cut:] for a in xs] if len(xs) > 1
+                               else xs[0][cut:], y[cut:])
+            x = [a[:cut] for a in xs] if len(xs) > 1 else xs[0][:cut]
+            y = y[:cut]
+        return self.model.fit(x, y, batch_size=batch_size or 32,
+                              nb_epoch=epochs,
+                              validation_data=validation_data, **kwargs)
+
+    def evaluate(self, x=None, y=None, batch_per_thread: Optional[int] = None,
+                 distributed: bool = False) -> Dict[str, float]:
+        del distributed
+        if isinstance(x, TFDataset):
+            bs = x.effective_batch(batch_per_thread or 32)
+            return self.model.evaluate(x.feature_arrays(), x.label_arrays(),
+                                       batch_size=bs)
+        return self.model.evaluate(x, y, batch_size=batch_per_thread or 32)
+
+    def predict(self, x, batch_per_thread: Optional[int] = None,
+                distributed: bool = False):
+        del distributed
+        if isinstance(x, TFDataset):
+            bs = x.effective_batch(batch_per_thread or 32)
+            return self.model.predict(x.feature_arrays(), batch_size=bs)
+        return self.model.predict(x, batch_size=batch_per_thread or 32)
+
+    # -- single-batch conveniences (``tfpark/model.py:297-317``) ------------
+    def train_on_batch(self, x, y=None, sample_weight=None):
+        if sample_weight is not None:
+            raise ValueError("sample_weight is not supported")
+        n = len(x[0] if isinstance(x, (list, tuple)) else x)
+        h = self.model.fit(x, y, batch_size=n, nb_epoch=1, shuffle=False)
+        return h["loss"][-1]
+
+    def test_on_batch(self, x, y=None, sample_weight=None,
+                      reset_metrics: bool = True):
+        del reset_metrics
+        if sample_weight is not None:
+            raise ValueError("sample_weight is not supported")
+        n = len(x[0] if isinstance(x, (list, tuple)) else x)
+        return self.model.evaluate(x, y, batch_size=n)
+
+    def predict_on_batch(self, x):
+        n = len(x[0] if isinstance(x, (list, tuple)) else x)
+        return self.model.predict(x, batch_size=n)

@@ -1,0 +1,193 @@
+"""TFDataset — the TFPark feed contract
+(reference: ``pyzoo/zoo/pipeline/api/net/tf_dataset.py:112-212``).
+
+The reference's TFDataset describes a distributed collection (RDD-backed)
+plus the tensor structure it will be fed into a TF graph as: per-element
+name/shape/dtype metas, a global ``batch_size`` for training that must
+divide over the cluster's cores, and a per-thread ``batch_per_thread`` for
+inference. Here the same contract maps onto the TPU runtime: the structure
+feeds graph ``Input`` nodes, ``batch_size`` must divide over the mesh's
+``data`` axis (the core-count rule of ``tf_dataset.py:134-141``), and the
+payload is served through :class:`~analytics_zoo_tpu.feature.FeatureSet`
+(DRAM cache + double-buffered device feed) instead of an RDD.
+
+Structures may be a single array, a list/tuple, or a dict (flattened in
+sorted-key order, the same convention as TF's ``nest``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..feature import FeatureSet
+from ..parallel import mesh as mesh_lib
+
+__all__ = ["TensorMeta", "TFDataset"]
+
+
+class TensorMeta:
+    """Name/shape/dtype of one element slot (``tf_dataset.py:96-109`` role).
+    ``shape`` excludes the batch dimension."""
+
+    def __init__(self, dtype: Any = np.float32,
+                 shape: Sequence[int] = (),
+                 name: Optional[str] = None):
+        self.dtype = np.dtype(dtype)
+        self.shape = tuple(int(s) for s in shape)
+        self.name = name
+
+    def __repr__(self):
+        return f"TensorMeta(dtype={self.dtype}, shape={self.shape}, " \
+               f"name={self.name!r})"
+
+
+def _flatten(structure) -> Tuple[List[Any], Any]:
+    """Flatten an array / list / dict structure into (leaves, treedef).
+    Dicts flatten in sorted-key order (the TF nest convention)."""
+    if isinstance(structure, dict):
+        keys = sorted(structure)
+        return [structure[k] for k in keys], ("dict", keys)
+    if isinstance(structure, (list, tuple)):
+        return list(structure), ("list", len(structure))
+    return [structure], ("leaf",)
+
+
+def _pack(leaves: List[Any], treedef):
+    if treedef[0] == "dict":
+        return dict(zip(treedef[1], leaves))
+    if treedef[0] == "list":
+        return list(leaves)
+    return leaves[0]
+
+
+class TFDataset:
+    """Feed contract: tensor structure + batching policy + data.
+
+    Use the factories: :meth:`from_ndarrays` (in-memory arrays, the
+    ``TFNdarrayDataset`` role) or :meth:`from_feature_set` (an existing
+    FeatureSet pipeline).
+
+    ``batch_size`` (training) must be a multiple of the mesh's data-parallel
+    size — the TPU analogue of the reference's "multiple of total core num"
+    rule; ``batch_per_thread`` (inference/eval) is per-device. Exactly one
+    of the two is active, as in the reference.
+    """
+
+    def __init__(self, features, labels=None, *, batch_size: int = -1,
+                 batch_per_thread: int = -1,
+                 val_features=None, val_labels=None):
+        if batch_size > 0 and batch_per_thread > 0:
+            raise ValueError("batch_size and batch_per_thread should not be "
+                             "set simultaneously")
+        dp = mesh_lib.data_parallel_size(mesh_lib.global_mesh())
+        if batch_size > 0 and batch_size % dp != 0:
+            raise ValueError(
+                f"batch_size should be a multiple of the data-parallel "
+                f"device count, but got batch_size: {batch_size} where "
+                f"data-parallel count is {dp}")
+        self.batch_size = batch_size
+        self.batch_per_thread = batch_per_thread
+        self.has_batch = batch_size > 0 or batch_per_thread > 0
+
+        feat_leaves, self._feat_def = _flatten(features)
+        self.features = [np.asarray(a) for a in feat_leaves]
+        n = len(self.features[0])
+        for a in self.features:
+            if len(a) != n:
+                raise ValueError("feature arrays disagree on length")
+        self.labels = None
+        self._label_def = None
+        if labels is not None:
+            lab_leaves, self._label_def = _flatten(labels)
+            self.labels = [np.asarray(a) for a in lab_leaves]
+            for a in self.labels:
+                if len(a) != n:
+                    raise ValueError("label arrays disagree on length with "
+                                     "features")
+        self.val_features = self.val_labels = None
+        if val_features is not None:
+            vf, _ = _flatten(val_features)
+            self.val_features = [np.asarray(a) for a in vf]
+            if val_labels is not None:
+                vl, _ = _flatten(val_labels)
+                self.val_labels = [np.asarray(a) for a in vl]
+
+        self.tensor_structure = _pack(
+            [TensorMeta(a.dtype, a.shape[1:], name=f"input_{i}")
+             for i, a in enumerate(self.features)], self._feat_def)
+
+    # -- factories ----------------------------------------------------------
+    @classmethod
+    def from_ndarrays(cls, tensors, batch_size: int = -1,
+                      batch_per_thread: int = -1,
+                      val_tensors=None) -> "TFDataset":
+        """``TFDataset.from_ndarrays`` (``tf_dataset.py:807`` role):
+        ``tensors`` is either the feature structure, or a (features, labels)
+        tuple."""
+        feats, labels = cls._split_xy(tensors)
+        vf = vl = None
+        if val_tensors is not None:
+            vf, vl = cls._split_xy(val_tensors)
+        return cls(feats, labels, batch_size=batch_size,
+                   batch_per_thread=batch_per_thread,
+                   val_features=vf, val_labels=vl)
+
+    @classmethod
+    def from_feature_set(cls, fs: FeatureSet, batch_size: int = -1,
+                         batch_per_thread: int = -1) -> "TFDataset":
+        """Wrap an existing FeatureSet (the ``TFDataset.from_feature_set``
+        role — the reference feeds FeatureSet RDDs the same way)."""
+        return cls(fs.x, fs.y, batch_size=batch_size,
+                   batch_per_thread=batch_per_thread)
+
+    @staticmethod
+    def _split_xy(tensors):
+        """A 2-TUPLE means (features, labels); use a list for a plain
+        two-feature structure (the ambiguity is resolved the same way the
+        reference's ndarray factory does)."""
+        if isinstance(tensors, tuple) and len(tensors) == 2:
+            return tensors[0], tensors[1]
+        return tensors, None
+
+    # -- consumption --------------------------------------------------------
+    @property
+    def n_examples(self) -> int:
+        return len(self.features[0])
+
+    def feature_set(self, *, shuffle: bool = True, seed: int = 0) -> FeatureSet:
+        x = self.features if len(self.features) > 1 else self.features[0]
+        y = None
+        if self.labels is not None:
+            y = self.labels if len(self.labels) > 1 else self.labels[0]
+        return FeatureSet.array(x, y, shuffle=shuffle, seed=seed)
+
+    def feature_arrays(self):
+        """Feature payload in fit/predict form (list or single array)."""
+        return self.features if len(self.features) > 1 else self.features[0]
+
+    def label_arrays(self):
+        if self.labels is None:
+            return None
+        return self.labels if len(self.labels) > 1 else self.labels[0]
+
+    def validation_arrays(self):
+        """(val_x, val_y) in fit form, or None."""
+        if self.val_features is None or self.val_labels is None:
+            return None
+        vx = (self.val_features if len(self.val_features) > 1
+              else self.val_features[0])
+        vy = (self.val_labels if len(self.val_labels) > 1
+              else self.val_labels[0])
+        return (vx, vy)
+
+    def effective_batch(self, default: int = 32) -> int:
+        """The concrete batch size to run with: global ``batch_size`` for
+        training, ``batch_per_thread`` × data-parallel size for inference."""
+        dp = mesh_lib.data_parallel_size(mesh_lib.global_mesh())
+        if self.batch_size > 0:
+            return self.batch_size
+        if self.batch_per_thread > 0:
+            return self.batch_per_thread * dp
+        return default

@@ -1,0 +1,142 @@
+"""SavedModel import (graph + variables bundle → fine-tunable TFNet;
+reference role ``TFNetForInference.scala:412``) against REAL TensorFlow
+exports — tf generates the fixture and provides the numerical oracle, the
+importer itself never touches the TF runtime."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.net import Net
+from analytics_zoo_tpu.pipeline.api.saved_model import load_saved_model
+from analytics_zoo_tpu.utils.tensor_bundle import read_tensor_bundle
+
+tf1 = tf.compat.v1
+
+
+def _export_mlp(path, *, use_resource: bool, seed=0):
+    """TF1-style SavedModel: x → dense(relu) → dense → softmax, with a
+    ref- or resource-variable flavour."""
+    rng = np.random.default_rng(seed)
+    w1 = rng.normal(size=(6, 16)).astype(np.float32) * 0.5
+    b1 = rng.normal(size=(16,)).astype(np.float32)
+    w2 = rng.normal(size=(16, 4)).astype(np.float32) * 0.5
+    b2 = rng.normal(size=(4,)).astype(np.float32)
+    g = tf1.Graph()
+    with g.as_default():
+        x = tf1.placeholder(tf.float32, [None, 6], name="x")
+        vw1 = tf1.get_variable("d1/kernel", initializer=w1,
+                               use_resource=use_resource)
+        vb1 = tf1.get_variable("d1/bias", initializer=b1,
+                               use_resource=use_resource)
+        h = tf.nn.relu(tf1.matmul(x, vw1) + vb1)
+        vw2 = tf1.get_variable("d2/kernel", initializer=w2,
+                               use_resource=use_resource)
+        vb2 = tf1.get_variable("d2/bias", initializer=b2,
+                               use_resource=use_resource)
+        probs = tf.nn.softmax(tf1.matmul(h, vw2) + vb2, name="probs")
+        with tf1.Session(graph=g) as sess:
+            sess.run(tf1.global_variables_initializer())
+            xs = rng.normal(size=(8, 6)).astype(np.float32)
+            want = sess.run(probs, {x: xs})
+            tf1.saved_model.simple_save(sess, str(path), inputs={"x": x},
+                                        outputs={"probs": probs})
+    return xs, want
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_zoo_context()
+
+
+@pytest.mark.parametrize("use_resource", [False, True],
+                         ids=["ref_vars", "resource_vars"])
+def test_saved_model_matches_tf_session(tmp_path, use_resource):
+    sm = tmp_path / "sm"
+    xs, want = _export_mlp(sm, use_resource=use_resource)
+    net = load_saved_model(str(sm))
+    assert net.feed_names == ["x"]
+    p = net.build(None)
+    got = np.asarray(net.call(p, xs))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+    # the restored kernels/biases are TRAINABLE params
+    assert len(p) == 4, sorted(p)
+
+
+def test_net_load_tf_detects_saved_model_dir(tmp_path):
+    sm = tmp_path / "sm"
+    xs, want = _export_mlp(sm, use_resource=False, seed=1)
+    net = Net.load_tf(str(sm))
+    got = np.asarray(net.call(net.build(None), xs))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_saved_model_finetunes(tmp_path):
+    """The VERDICT done-criterion: import a SavedModel and FINE-TUNE it
+    end-to-end — the imported variables move, the loss drops."""
+    import optax
+
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+
+    sm = tmp_path / "sm"
+    _export_mlp(sm, use_resource=True, seed=2)
+    net = load_saved_model(str(sm))
+    m = Sequential([net])
+    m.compile(optimizer=optax.adam(5e-3), loss="scce")
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(6, 4))
+    x = rng.normal(size=(256, 6)).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.int32)
+    m.init_weights(sample_input=x[:2])
+    before = {k: np.asarray(v) for k, v in jax_flat(m)}
+    h = m.fit(x, y, batch_size=32, nb_epoch=6)
+    assert h["loss"][-1] < h["loss"][0]
+    moved = any(not np.allclose(np.asarray(v), before[k])
+                for k, v in jax_flat(m))
+    assert moved
+    ev = m.evaluate(x, y, batch_size=64)
+    assert ev["loss"] < 1.0
+
+
+def jax_flat(m):
+    import jax
+    leaves, _ = jax.tree_util.tree_flatten_with_path(m.params)
+    return [(jax.tree_util.keystr(k), v) for k, v in leaves]
+
+
+def test_bundle_reader_roundtrip(tmp_path):
+    """Every dtype/shape the bundle reader claims, against tf.train.Saver
+    output."""
+    vals = {
+        "f32": np.random.default_rng(0).normal(size=(3, 5)).astype(np.float32),
+        "f64": np.arange(6, dtype=np.float64).reshape(2, 3),
+        "i32": np.arange(7, dtype=np.int32),
+        "i64": np.array([[-1, 2], [3, -4]], np.int64),
+        "scalar": np.float32(3.5),
+    }
+    g = tf1.Graph()
+    with g.as_default():
+        tvars = {k: tf1.get_variable(k, initializer=v, use_resource=False)
+                 for k, v in vals.items()}
+        saver = tf1.train.Saver()
+        with tf1.Session(graph=g) as sess:
+            sess.run(tf1.global_variables_initializer())
+            saver.save(sess, str(tmp_path / "ckpt"),
+                       write_meta_graph=False)
+    out = read_tensor_bundle(str(tmp_path / "ckpt"))
+    assert set(out) == set(vals)
+    for k, v in vals.items():
+        np.testing.assert_array_equal(out[k], np.asarray(v))
+
+
+def test_saved_model_missing_signature_message(tmp_path):
+    sm = tmp_path / "sm"
+    _export_mlp(sm, use_resource=False, seed=4)
+    with pytest.raises(ValueError, match="not found; available"):
+        load_saved_model(str(sm), signature="nope")
+    # explicit node names bypass the signature entirely
+    net = load_saved_model(str(sm), signature="nope", inputs=["x"],
+                           outputs=["probs"])
+    assert net.output_names == ["probs"]

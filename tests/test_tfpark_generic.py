@@ -1,0 +1,273 @@
+"""TFPark generic surface: TFEstimator (model_fn contract,
+``pyzoo/zoo/tfpark/estimator.py:84``), KerasModel facade
+(``tfpark/model.py:30``), TFDataset feed contract
+(``pipeline/api/net/tf_dataset.py:112-212``)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.engine import Lambda
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.tfpark import (KerasModel, ModeKeys, TFDataset,
+                                      TFEstimator, TFEstimatorSpec)
+import analytics_zoo_tpu.pipeline.api.autograd as A
+
+
+def _separable(n=256, d=8, classes=2, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(d, classes))
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.int32)
+    return x, y
+
+
+def _scce(probs_var, labels_var):
+    """Sparse categorical crossentropy as a graph expression over
+    (probs, labels) Variables — the model_fn-author pattern."""
+    def f(p, y):
+        p = jnp.clip(p, 1e-7, 1.0)
+        picked = jnp.take_along_axis(
+            p, y.astype(jnp.int32).reshape(-1, 1), axis=1)[:, 0]
+        return -jnp.log(picked)
+    return A.mean(Lambda(f, name="scce_pe")([probs_var, labels_var]), axis=0)
+
+
+def model_fn(features, labels, mode, params):
+    hidden = Dense(16, activation="relu")(features)
+    probs = Dense((params or {}).get("classes", 2),
+                  activation="softmax")(hidden)
+    loss = None
+    if mode != ModeKeys.PREDICT and labels is not None:
+        loss = _scce(probs, labels)
+    return TFEstimatorSpec(mode, predictions=probs, loss=loss)
+
+
+# ---------------------------------------------------------------------------
+# TFDataset
+# ---------------------------------------------------------------------------
+
+def test_tfdataset_contract():
+    init_zoo_context()
+    x, y = _separable(64)
+    ds = TFDataset.from_ndarrays((x, y), batch_size=16)
+    assert ds.n_examples == 64
+    assert ds.batch_size == 16 and ds.effective_batch() == 16
+    assert ds.tensor_structure.shape == (8,)
+    fs = ds.feature_set()
+    assert fs.x.shape == (64, 8)
+
+    with pytest.raises(ValueError, match="simultaneously"):
+        TFDataset.from_ndarrays(x, batch_size=16, batch_per_thread=4)
+
+    # dict structures flatten in sorted-key order
+    ds2 = TFDataset.from_ndarrays(({"b": x, "a": x[:, :4]}, y),
+                                  batch_per_thread=8)
+    assert [m.shape for m in
+            [ds2.tensor_structure["a"], ds2.tensor_structure["b"]]] \
+        == [(4,), (8,)]
+    assert len(ds2.feature_arrays()) == 2
+
+    with pytest.raises(ValueError, match="length"):
+        TFDataset.from_ndarrays((x, y[:10]))
+
+
+def test_tfdataset_batch_must_divide_mesh():
+    init_zoo_context()
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+    dp = mesh_lib.data_parallel_size(mesh_lib.global_mesh())
+    if dp == 1:
+        pytest.skip("single-device mesh divides everything")
+    with pytest.raises(ValueError, match="multiple"):
+        TFDataset.from_ndarrays(_separable(64)[0], batch_size=dp + 1)
+
+
+# ---------------------------------------------------------------------------
+# TFEstimator
+# ---------------------------------------------------------------------------
+
+def test_estimator_train_evaluate_predict(tmp_path):
+    init_zoo_context()
+    x, y = _separable(256)
+    est = TFEstimator(model_fn, optimizer="adam", lr=0.01,
+                      params={"classes": 2}, model_dir=str(tmp_path))
+
+    def input_fn(mode):
+        if mode == ModeKeys.PREDICT:
+            return TFDataset(x, batch_per_thread=32)
+        return TFDataset(x, y, batch_size=32)
+
+    est.train(input_fn, steps=120)
+    metrics = est.evaluate(input_fn, ["accuracy", "loss"])
+    assert metrics["accuracy"] > 0.9, metrics
+    assert metrics["loss"] < 0.5, metrics
+
+    preds = est.predict(input_fn)
+    assert preds.shape == (256, 2)
+    np.testing.assert_allclose(np.asarray(preds).sum(1), 1.0, rtol=1e-4)
+
+    # weights were persisted: a FRESH estimator predicts identically from
+    # model_dir without training
+    est2 = TFEstimator(model_fn, params={"classes": 2},
+                       model_dir=str(tmp_path))
+    preds2 = est2.predict(input_fn)
+    np.testing.assert_allclose(np.asarray(preds2), np.asarray(preds),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_estimator_requires_optimizer_and_labels():
+    init_zoo_context()
+    x, y = _separable(64)
+    est = TFEstimator(model_fn)
+    with pytest.raises(ValueError, match="optimizer"):
+        est.train(lambda mode: TFDataset(x, y, batch_size=16))
+    est2 = TFEstimator(model_fn, optimizer="adam")
+    with pytest.raises(ValueError, match="labels"):
+        est2.train(lambda mode: TFDataset(x, batch_size=16))
+
+
+def test_estimator_model_fn_without_labels_arg():
+    init_zoo_context()
+    x, y = _separable(64)
+
+    def pred_only_fn(features, mode):
+        return TFEstimatorSpec(mode, predictions=Dense(2)(features))
+
+    est = TFEstimator(pred_only_fn, optimizer="adam")
+    with pytest.raises(ValueError, match="does not take labels"):
+        est.train(lambda mode: TFDataset(x, y, batch_size=16))
+    # predict-only flows work without labels
+    preds = est.predict(lambda mode: TFDataset(x, batch_per_thread=16))
+    assert preds.shape == (64, 2)
+
+
+def test_estimator_trains_imported_tfnet_graph(tmp_path):
+    """The VERDICT-3 capability gap: bring-your-own IMPORTED graph under the
+    generic estimator — a frozen TF GraphDef loads as a TFNet, gets a fresh
+    head, and fine-tunes end-to-end through model_fn."""
+    import test_tfnet as G  # the in-repo GraphDef builder helpers
+    from analytics_zoo_tpu.pipeline.api.tfnet import load_tf
+
+    init_zoo_context()
+    rng = np.random.default_rng(5)
+    w0 = rng.normal(size=(8, 16)).astype(np.float32)
+    b0 = np.zeros(16, np.float32)
+    path = str(tmp_path / "frozen.pb")
+    G.write_graph(
+        path,
+        G.node("x", "Placeholder"),
+        G.const("w0", w0), G.const("b0", b0),
+        G.node("mm", "MatMul", ("x", "w0")),
+        G.node("add", "BiasAdd", ("mm", "b0")),
+        G.node("relu", "Relu", ("add",)),
+    )
+    x, y = _separable(256)
+
+    def tfnet_model_fn(features, labels, mode):
+        net = load_tf(path, inputs=["x"], outputs=["relu"])
+        feats = net(features)
+        probs = Dense(2, activation="softmax")(feats)
+        loss = None
+        if labels is not None:
+            loss = _scce(probs, labels)
+        return TFEstimatorSpec(mode, predictions=probs, loss=loss)
+
+    est = TFEstimator(tfnet_model_fn, optimizer="adam", lr=0.01)
+    ds_fn = lambda mode: TFDataset(x, y, batch_size=32)  # noqa: E731
+    est.train(ds_fn, nb_epoch=6)
+    metrics = est.evaluate(ds_fn, ["accuracy"])
+    assert metrics["accuracy"] > 0.85, metrics
+
+
+# ---------------------------------------------------------------------------
+# KerasModel
+# ---------------------------------------------------------------------------
+
+def _compiled_net():
+    m = Sequential([Dense(16, activation="relu", input_shape=(8,)),
+                    Dense(2, activation="softmax")])
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"], lr=0.01)
+    return m
+
+
+def test_keras_model_requires_compiled():
+    init_zoo_context()
+    raw = Sequential([Dense(2, input_shape=(8,))])
+    with pytest.raises(ValueError, match="compiled"):
+        KerasModel(raw)
+
+
+def test_keras_model_fit_evaluate_predict_ndarrays():
+    init_zoo_context()
+    x, y = _separable(256)
+    km = KerasModel(_compiled_net())
+    km.fit(x, y, batch_size=32, epochs=8, validation_split=0.25)
+    ev = km.evaluate(x, y, batch_per_thread=32)
+    assert ev["accuracy"] > 0.9, ev
+    assert km.metrics_names[0] == "loss"
+    p = km.predict(x[:7], batch_per_thread=4)
+    assert p.shape == (7, 2)
+    # single-batch conveniences
+    l0 = km.train_on_batch(x[:32], y[:32])
+    assert np.isfinite(l0)
+    tb = km.test_on_batch(x[:32], y[:32])
+    assert "loss" in tb
+    assert km.predict_on_batch(x[:5]).shape == (5, 2)
+
+
+def test_keras_model_tfdataset_path():
+    init_zoo_context()
+    x, y = _separable(128, seed=2)
+    km = KerasModel(_compiled_net())
+    ds = TFDataset.from_ndarrays((x, y), batch_size=32,
+                                 val_tensors=(x[:32], y[:32]))
+    km.fit(ds, epochs=4)
+    ev = km.evaluate(TFDataset.from_ndarrays((x, y), batch_per_thread=16))
+    assert ev["accuracy"] > 0.8, ev
+    p = km.predict(TFDataset.from_ndarrays(x, batch_per_thread=16))
+    assert p.shape == (128, 2)
+
+
+def test_keras_model_weights_roundtrip(tmp_path):
+    init_zoo_context()
+    x, y = _separable(64, seed=3)
+    km = KerasModel(_compiled_net())
+    km.fit(x, y, batch_size=32, epochs=2)
+    ref = km.predict(x)
+
+    ws = km.get_weights()
+    km2 = KerasModel(_compiled_net())
+    km2.fit(x[:32], y[:32], batch_size=32, epochs=1)  # different weights
+    km2.set_weights(ws)
+    np.testing.assert_allclose(np.asarray(km2.predict(x)), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+    wpath = str(tmp_path / "w.npz")
+    km.save_weights(wpath)
+    km3 = KerasModel(_compiled_net())
+    km3.load_weights(wpath)
+    np.testing.assert_allclose(np.asarray(km3.predict(x)), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="shape mismatch"):
+        bad = [np.zeros((3, 3), np.float32) for _ in ws]
+        km3.set_weights(bad)
+
+
+def test_keras_model_save_load_model(tmp_path):
+    init_zoo_context()
+    x, y = _separable(64, seed=4)
+    km = KerasModel(_compiled_net())
+    km.fit(x, y, batch_size=32, epochs=2)
+    ref = km.predict(x)
+    mpath = str(tmp_path / "model.pkl")
+    km.save_model(mpath)
+    km2 = KerasModel.load_model(mpath)
+    np.testing.assert_allclose(np.asarray(km2.model.predict(x)),
+                               np.asarray(ref), rtol=1e-5, atol=1e-6)
+    # the original wrapper still trains after the save (state restored)
+    km.fit(x, y, batch_size=32, epochs=1)

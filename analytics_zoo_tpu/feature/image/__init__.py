@@ -1,13 +1,22 @@
 """Image pipeline (``feature/image`` of the reference, L2)."""
 
 from .image_set import ImageSet, LocalImageSet
-from .transforms import (Brightness, CenterCrop, ChannelNormalize,
-                         ChannelOrder, HFlip, ImageProcessing,
-                         ImageSetToSample, MatToTensor, PixelNormalizer,
-                         RandomCrop, Resize)
+from .transforms import (AspectScale, Brightness, BytesToMat, CenterCrop,
+                         ChannelNormalize, ChannelOrder,
+                         ChannelScaledNormalizer, ColorJitter, Contrast,
+                         Expand, Filler, FixedCrop, HFlip, Hue,
+                         ImageProcessing, ImageSetToSample, MatToFloats,
+                         MatToTensor, Mirror, PixelBytesToMat,
+                         PixelNormalizer, RandomAspectScale, RandomCrop,
+                         RandomPreprocessing, RandomResize, Resize,
+                         Saturation)
 
 __all__ = [
     "ImageSet", "LocalImageSet", "ImageProcessing", "Resize", "CenterCrop",
     "RandomCrop", "HFlip", "Brightness", "ChannelNormalize", "ChannelOrder",
     "PixelNormalizer", "MatToTensor", "ImageSetToSample",
+    "Hue", "Saturation", "Contrast", "ColorJitter", "Expand", "Filler",
+    "AspectScale", "RandomAspectScale", "ChannelScaledNormalizer", "Mirror",
+    "FixedCrop", "RandomResize", "RandomPreprocessing", "BytesToMat",
+    "PixelBytesToMat", "MatToFloats",
 ]

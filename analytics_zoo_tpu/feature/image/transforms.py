@@ -28,6 +28,11 @@ __all__ = [
     "ImageProcessing", "Resize", "CenterCrop", "RandomCrop", "HFlip",
     "Brightness", "ChannelNormalize", "ChannelOrder", "PixelNormalizer",
     "MatToTensor", "ImageSetToSample",
+    # augmentation family (ImageHue/Saturation/ColorJitter/Expand/... .scala)
+    "Hue", "Saturation", "Contrast", "ColorJitter", "Expand", "Filler",
+    "AspectScale", "RandomAspectScale", "ChannelScaledNormalizer", "Mirror",
+    "FixedCrop", "RandomResize", "RandomPreprocessing", "BytesToMat",
+    "PixelBytesToMat", "MatToFloats",
 ]
 
 
@@ -222,3 +227,389 @@ class ImageSetToSample(Preprocessing):
             raise ValueError(f"cannot stack ragged images {sorted(shapes)}; "
                              "Resize/Crop to a common size first")
         return np.stack(ims)
+
+
+# ---------------------------------------------------------------------------
+# color-space helpers (vectorized numpy HSV, matching colorsys per pixel)
+# ---------------------------------------------------------------------------
+
+def _rgb_to_hsv(rgb: np.ndarray) -> np.ndarray:
+    """(..., 3) float in [0,1] → HSV in [0,1] (colorsys convention)."""
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = np.max(rgb, axis=-1)
+    minc = np.min(rgb, axis=-1)
+    v = maxc
+    span = maxc - minc
+    s = np.where(maxc > 0, span / np.where(maxc == 0, 1, maxc), 0.0)
+    safe = np.where(span == 0, 1, span)
+    rc = (maxc - r) / safe
+    gc = (maxc - g) / safe
+    bc = (maxc - b) / safe
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(span == 0, 0.0, (h / 6.0) % 1.0)
+    return np.stack([h, s, v], axis=-1)
+
+
+def _hsv_to_rgb(hsv: np.ndarray) -> np.ndarray:
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    r = np.choose(i, [v, q, p, p, t, v])
+    g = np.choose(i, [t, v, v, q, p, p])
+    b = np.choose(i, [p, p, t, v, v, q])
+    return np.stack([r, g, b], axis=-1)
+
+
+class _HSVTransform(ImageProcessing):
+    """Base for HSV-space augmentations. Pixel-value convention follows the
+    reference's OpenCV ops: uint8 OR float images both hold 0-255 values
+    (``MatToFloats() >> Hue(...)`` works); rescale 0-1 float images to
+    0-255 before color jitter."""
+
+    def _hsv_op(self, hsv, delta):
+        raise NotImplementedError
+
+    def _delta(self):
+        raise NotImplementedError
+
+    def apply_one(self, im):
+        delta = self._delta()
+        if delta is None:      # no-op draw
+            return im
+        rgb = im.astype(np.float32) / 255.0
+        out = _hsv_to_rgb(self._hsv_op(_rgb_to_hsv(rgb), delta))
+        return (np.clip(out, 0.0, 1.0) * 255.0).astype(im.dtype)
+
+
+class Hue(_HSVTransform):
+    """``ImageHue.scala`` (BigDL ``augmentation.Hue``) — rotate the hue by a
+    uniform delta in [delta_low, delta_high] DEGREES. (The reference's
+    deltas are OpenCV H units = 2°; its conventional ``Hue(-18, 18)`` is
+    ``Hue(-36, 36)`` here.)"""
+
+    def __init__(self, delta_low: float = -36.0, delta_high: float = 36.0,
+                 seed: Optional[int] = None):
+        self.lo, self.hi = float(delta_low), float(delta_high)
+        self._rng = np.random.default_rng(seed)
+
+    def _delta(self):
+        return self._rng.uniform(self.lo, self.hi)
+
+    def _hsv_op(self, hsv, delta):
+        hsv = hsv.copy()
+        hsv[..., 0] = (hsv[..., 0] + delta / 360.0) % 1.0
+        return hsv
+
+
+class Saturation(_HSVTransform):
+    """``ImageSaturation.scala`` — scale the saturation channel by a uniform
+    factor in [delta_low, delta_high] (1.0 = unchanged)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5,
+                 seed: Optional[int] = None):
+        self.lo, self.hi = float(delta_low), float(delta_high)
+        self._rng = np.random.default_rng(seed)
+
+    def _delta(self):
+        d = self._rng.uniform(self.lo, self.hi)
+        return None if d == 1.0 else d
+
+    def _hsv_op(self, hsv, delta):
+        hsv = hsv.copy()
+        hsv[..., 1] = np.clip(hsv[..., 1] * delta, 0.0, 1.0)
+        return hsv
+
+
+class Contrast(ImageProcessing):
+    """BigDL ``augmentation.Contrast`` (the zoo wraps it inside
+    ``ImageColorJitter.scala``) — scale pixel values by a uniform factor in
+    [delta_low, delta_high]."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5,
+                 seed: Optional[int] = None):
+        self.lo, self.hi = float(delta_low), float(delta_high)
+        self._rng = np.random.default_rng(seed)
+
+    def apply_one(self, im):
+        delta = self._rng.uniform(self.lo, self.hi)
+        out = im.astype(np.float32) * delta
+        if im.dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out.astype(im.dtype)
+
+
+class ColorJitter(ImageProcessing):
+    """``ImageColorJitter.scala`` (BigDL ``augmentation.ColorJitter``) —
+    randomly-ordered brightness/contrast/saturation/hue jitter, each applied
+    with its own probability; the SSD training recipe's augmentation."""
+
+    def __init__(self, brightness_prob: float = 0.5,
+                 brightness_delta: float = 32.0,
+                 contrast_prob: float = 0.5, contrast_lower: float = 0.5,
+                 contrast_upper: float = 1.5,
+                 hue_prob: float = 0.5, hue_delta: float = 36.0,
+                 saturation_prob: float = 0.5,
+                 saturation_lower: float = 0.5,
+                 saturation_upper: float = 1.5,
+                 random_order_prob: float = 0.0,
+                 seed: Optional[int] = None):
+        self._rng = np.random.default_rng(seed)
+        self.random_order_prob = float(random_order_prob)
+        self.probs = dict(brightness=float(brightness_prob),
+                          contrast=float(contrast_prob),
+                          hue=float(hue_prob),
+                          saturation=float(saturation_prob))
+        self.ops = dict(
+            brightness=Brightness(-brightness_delta, brightness_delta,
+                                  seed=self._rng.integers(1 << 31)),
+            contrast=Contrast(contrast_lower, contrast_upper,
+                              seed=self._rng.integers(1 << 31)),
+            hue=Hue(-hue_delta, hue_delta, seed=self._rng.integers(1 << 31)),
+            saturation=Saturation(saturation_lower, saturation_upper,
+                                  seed=self._rng.integers(1 << 31)),
+        )
+
+    def apply_one(self, im):
+        order = list(self.ops)
+        if self._rng.random() < self.random_order_prob:
+            self._rng.shuffle(order)
+        for name in order:
+            if self._rng.random() < self.probs[name]:
+                im = self.ops[name].apply_one(im)
+        return im
+
+
+class Expand(ImageProcessing):
+    """``ImageExpand.scala`` — place the image at a random position inside a
+    larger mean-filled canvas (ratio drawn from [min_expand_ratio,
+    max_expand_ratio]); the SSD zoom-out augmentation."""
+
+    def __init__(self, means_r: float = 123.0, means_g: float = 117.0,
+                 means_b: float = 104.0, min_expand_ratio: float = 1.0,
+                 max_expand_ratio: float = 4.0, seed: Optional[int] = None):
+        self.means = (float(means_r), float(means_g), float(means_b))
+        self.lo, self.hi = float(min_expand_ratio), float(max_expand_ratio)
+        self._rng = np.random.default_rng(seed)
+
+    def apply_one(self, im):
+        ratio = self._rng.uniform(self.lo, self.hi)
+        H, W = im.shape[0], im.shape[1]
+        nh, nw = int(H * ratio), int(W * ratio)
+        y = int(self._rng.uniform(0, nh - H + 1))
+        x = int(self._rng.uniform(0, nw - W + 1))
+        fill = np.asarray(self.means[:im.shape[-1]] if im.ndim == 3 else
+                          [self.means[0]], np.float32)
+        canvas = np.broadcast_to(fill, (nh, nw) + fill.shape).astype(
+            np.float32)
+        canvas = canvas.copy()
+        canvas[y:y + H, x:x + W] = im.astype(np.float32).reshape(
+            H, W, -1)
+        canvas = canvas if im.ndim == 3 else canvas[..., 0]
+        if im.dtype == np.uint8:
+            return np.clip(canvas, 0, 255).astype(np.uint8)
+        return canvas.astype(im.dtype)
+
+
+class Filler(ImageProcessing):
+    """``ImageFiller.scala`` — fill a normalized-coordinate rectangle
+    [start_x, end_x) x [start_y, end_y) with ``value`` (random-erasing
+    style occlusion)."""
+
+    def __init__(self, start_x: float, start_y: float, end_x: float,
+                 end_y: float, value: float = 255.0):
+        for v in (start_x, start_y, end_x, end_y):
+            if not 0.0 <= v <= 1.0:
+                raise ValueError("Filler coordinates are normalized to "
+                                 "[0, 1]")
+        if end_x <= start_x or end_y <= start_y:
+            raise ValueError("Filler box must have positive area")
+        self.box = (float(start_x), float(start_y), float(end_x),
+                    float(end_y))
+        self.value = value
+
+    def apply_one(self, im):
+        H, W = im.shape[0], im.shape[1]
+        x1, y1, x2, y2 = self.box
+        out = im.copy()
+        out[int(y1 * H):int(y2 * H), int(x1 * W):int(x2 * W)] = self.value
+        return out
+
+
+class AspectScale(ImageProcessing):
+    """``ImageAspectScale.scala`` — resize so the SHORT side is
+    ``min_size`` keeping aspect ratio, long side capped at ``max_size``,
+    both dims rounded down to a multiple of ``scale_multiple_of`` (the
+    Faster-RCNN input convention)."""
+
+    def __init__(self, min_size: int, scale_multiple_of: int = 1,
+                 max_size: int = 1000):
+        self.min_size = int(min_size)
+        self.multiple = int(scale_multiple_of)
+        self.max_size = int(max_size)
+
+    def _target(self, H, W):
+        short, long = min(H, W), max(H, W)
+        scale = self.min_size / short
+        if scale * long > self.max_size:
+            scale = self.max_size / long
+        nh, nw = int(round(H * scale)), int(round(W * scale))
+        if self.multiple > 1:
+            nh = max(self.multiple, nh // self.multiple * self.multiple)
+            nw = max(self.multiple, nw // self.multiple * self.multiple)
+        return nh, nw
+
+    def apply_one(self, im):
+        nh, nw = self._target(im.shape[0], im.shape[1])
+        return Resize(nh, nw).apply_one(im)
+
+
+class RandomAspectScale(AspectScale):
+    """``ImageRandomAspectScale.scala`` — AspectScale with the short-side
+    target drawn uniformly from ``scales``."""
+
+    def __init__(self, scales: Sequence[int], scale_multiple_of: int = 1,
+                 max_size: int = 1000, seed: Optional[int] = None):
+        super().__init__(int(scales[0]), scale_multiple_of, max_size)
+        self.scales = [int(s) for s in scales]
+        self._rng = np.random.default_rng(seed)
+
+    def apply_one(self, im):
+        self.min_size = int(self._rng.choice(self.scales))
+        return super().apply_one(im)
+
+
+class ChannelScaledNormalizer(ImageProcessing):
+    """``ImageChannelScaledNormalizer.scala`` — (x - per-channel mean) *
+    scale, output float32."""
+
+    def __init__(self, mean_r: float, mean_g: float, mean_b: float,
+                 scale: float = 1.0):
+        self.mean = np.asarray([mean_r, mean_g, mean_b], np.float32)
+        self.scale = float(scale)
+
+    def apply_one(self, im):
+        mean = self.mean[:im.shape[-1]] if im.ndim == 3 else self.mean[0]
+        return (im.astype(np.float32) - mean) * self.scale
+
+    apply_batch = apply_one
+
+
+class Mirror(ImageProcessing):
+    """``ImageMirror.scala`` — DETERMINISTIC horizontal flip (``HFlip`` is
+    the probabilistic train-time variant)."""
+
+    def apply_one(self, im):
+        return im[:, ::-1]
+
+    def apply_batch(self, batch):
+        return batch[:, :, ::-1]
+
+
+class FixedCrop(ImageProcessing):
+    """``ImageFixedCrop.scala`` — crop a fixed box; coordinates are
+    normalized to [0, 1] when ``normalized=True`` (the reference's wire
+    form) else pixels."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool = True):
+        if x2 <= x1 or y2 <= y1:
+            raise ValueError("FixedCrop box must have positive area")
+        self.box = (x1, y1, x2, y2)
+        self.normalized = bool(normalized)
+
+    def apply_one(self, im):
+        H, W = im.shape[0], im.shape[1]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = x1 * W, x2 * W
+            y1, y2 = y1 * H, y2 * H
+        xi1, yi1 = max(0, int(x1)), max(0, int(y1))
+        xi2, yi2 = min(W, int(round(x2))), min(H, int(round(y2)))
+        return im[yi1:yi2, xi1:xi2]
+
+
+class RandomResize(ImageProcessing):
+    """``ImageRandomResize.scala`` — square resize to a side drawn
+    uniformly from [min_size, max_size]."""
+
+    def __init__(self, min_size: int, max_size: int,
+                 seed: Optional[int] = None):
+        self.lo, self.hi = int(min_size), int(max_size)
+        self._rng = np.random.default_rng(seed)
+
+    def apply_one(self, im):
+        size = int(self._rng.integers(self.lo, self.hi + 1))
+        return Resize(size, size).apply_one(im)
+
+
+class RandomPreprocessing(ImageProcessing):
+    """``ImageRandomPreprocessing.scala`` — apply the wrapped transform
+    with probability ``prob``, pass through otherwise."""
+
+    def __init__(self, transform: ImageProcessing, prob: float,
+                 seed: Optional[int] = None):
+        self.transform = transform
+        self.prob = float(prob)
+        self._rng = np.random.default_rng(seed)
+
+    def apply_one(self, im):
+        if self._rng.random() < self.prob:
+            return self.transform.apply_one(im)
+        return im
+
+
+class BytesToMat(ImageProcessing):
+    """``ImageBytesToMat.scala`` — decode encoded image bytes (JPEG/PNG)
+    to an (H, W, C) uint8 array (PIL replaces the OpenCV imdecode JNI)."""
+
+    def apply(self, data):
+        if isinstance(data, (bytes, bytearray)):
+            return self._decode(bytes(data))
+        if isinstance(data, (list, tuple)):
+            return [self.apply(d) for d in data]
+        return super().apply(data)
+
+    def apply_one(self, im):
+        return im  # already decoded
+
+    @staticmethod
+    def _decode(raw: bytes) -> np.ndarray:
+        import io
+
+        from PIL import Image
+        with Image.open(io.BytesIO(raw)) as img:
+            return np.asarray(img.convert("RGB"))
+
+
+class PixelBytesToMat(ImageProcessing):
+    """``ImagePixelBytesToMat.scala`` — reinterpret RAW pixel bytes as an
+    (H, W, C) uint8 array (the reference reads the shape from the
+    ImageFeature; here it is explicit)."""
+
+    def __init__(self, height: int, width: int, channels: int = 3):
+        self.shape = (int(height), int(width), int(channels))
+
+    def apply(self, data):
+        if isinstance(data, (bytes, bytearray)):
+            return np.frombuffer(bytes(data), np.uint8).reshape(self.shape)
+        if isinstance(data, (list, tuple)):
+            return [self.apply(d) for d in data]
+        return super().apply(data)
+
+    def apply_one(self, im):
+        return np.asarray(im, np.uint8).reshape(self.shape)
+
+
+class MatToFloats(ImageProcessing):
+    """``ImageMatToFloats.scala`` — to float32, keeping HWC layout (the
+    host-side form ``MatToTensor`` finalizes for the device)."""
+
+    def apply_one(self, im):
+        return im.astype(np.float32)
+
+    apply_batch = apply_one

@@ -9,18 +9,20 @@ from .convolution import (AtrousConvolution1D, AtrousConvolution2D,  # noqa: F40
                           Convolution1D, Convolution2D, Cropping1D,
                           Cropping2D, Deconvolution2D,
                           DepthwiseConvolution2D, LocallyConnected1D,
+                          SeparableConvolution1D,
                           SeparableConvolution2D, ShareConvolution2D,
                           UpSampling1D, UpSampling2D,
                           ZeroPadding1D, ZeroPadding2D)
-from .convolution3d import (ConvLSTM2D, Convolution3D, Cropping3D, LRN2D,  # noqa: F401
-                            LocallyConnected2D, MaxoutDense,
-                            SpatialDropout1D, SpatialDropout2D,
-                            SpatialDropout3D, UpSampling3D, ZeroPadding3D)
+from .convolution3d import (ConvLSTM2D, ConvLSTM3D, Convolution3D,  # noqa: F401
+                            Cropping3D, LRN2D, LocallyConnected2D,
+                            MaxoutDense, SpatialDropout1D, SpatialDropout2D,
+                            SpatialDropout3D, UpSampling3D,
+                            WithinChannelLRN, ZeroPadding3D)
 from .pooling import (AveragePooling1D, AveragePooling2D, AveragePooling3D,  # noqa: F401
                       GlobalAveragePooling1D, GlobalAveragePooling2D,
                       GlobalAveragePooling3D, GlobalMaxPooling1D,
-                      GlobalMaxPooling2D, GlobalMaxPooling3D, MaxPooling1D,
-                      MaxPooling2D, MaxPooling3D)
+                      GlobalMaxPooling2D, GlobalMaxPooling3D, KMaxPooling,
+                      MaxPooling1D, MaxPooling2D, MaxPooling3D)
 from .advanced_activations import (ELU, BinaryThreshold, HardShrink,  # noqa: F401
                                    HardTanh, LeakyReLU, PReLU, RReLU, SReLU,
                                    SoftShrink, Softmax, Threshold,

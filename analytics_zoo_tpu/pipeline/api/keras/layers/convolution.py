@@ -428,3 +428,55 @@ class ShareConvolution2D(Convolution2D):
             x = jnp.pad(x, ((0, 0), (self.pad_h, self.pad_h),
                             (self.pad_w, self.pad_w), (0, 0)))
         return super().call(params, x, training=training, rng=rng)
+
+
+class SeparableConvolution1D(Layer):
+    """``SeparableConvolution1D.scala`` — depthwise temporal conv
+    (per-channel, ``feature_group_count``) followed by a pointwise 1x1 over
+    (B, T, C)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 init: str = "glorot_uniform", activation=None,
+                 border_mode: str = "valid", subsample_length: int = 1,
+                 depth_multiplier: int = 1, bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.filter_length = int(filter_length)
+        self.init = init
+        self.activation = get_activation(activation)
+        self.border_mode = border_mode
+        self.subsample_length = int(subsample_length)
+        self.depth_multiplier = int(depth_multiplier)
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        ini = get_initializer(self.init)
+        p = {"depthwise": ini(k1, (self.filter_length, 1,
+                                   in_ch * self.depth_multiplier),
+                              param_dtype()),
+             "pointwise": ini(k2, (1, in_ch * self.depth_multiplier,
+                                   self.nb_filter), param_dtype())}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), param_dtype())
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        y = lax.conv_general_dilated(
+            x.astype(cd), params["depthwise"].astype(cd),
+            window_strides=(self.subsample_length,),
+            padding=_padding(self.border_mode),
+            dimension_numbers=("NWC", "WIO", "NWC"),
+            feature_group_count=x.shape[-1],
+            preferred_element_type=jnp.float32).astype(cd)
+        y = lax.conv_general_dilated(
+            y, params["pointwise"].astype(cd), window_strides=(1,),
+            padding="VALID", dimension_numbers=("NWC", "WIO", "NWC"),
+            preferred_element_type=jnp.float32).astype(cd)
+        if self.bias:
+            y = y + params["b"].astype(cd)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y

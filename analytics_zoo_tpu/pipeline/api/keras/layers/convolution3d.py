@@ -293,3 +293,90 @@ class LRN2D(Layer):
                   for i in range(self.n))
         denom = jnp.power(self.k + self.alpha / self.n * win, self.beta)
         return (x.astype(jnp.float32) / denom).astype(x.dtype)
+
+
+class ConvLSTM3D(Layer):
+    """``ConvLSTM3D(nb_filter, nb_kernel)`` (``ConvLSTM3D.scala``) — LSTM
+    whose gates are 'same' 3D convs. Input (B, T, D, H, W, C) →
+    (B, D, H, W, F), or the full sequence with ``return_sequences`` — the
+    volumetric sibling of :class:`ConvLSTM2D`, same ``lax.scan`` time
+    loop."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int,
+                 init: str = "glorot_uniform",
+                 inner_activation="hard_sigmoid", activation="tanh",
+                 return_sequences: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.nb_kernel = int(nb_kernel)
+        self.init = init
+        self.inner_activation = get_activation(inner_activation)
+        self.activation = get_activation(activation)
+        self.return_sequences = return_sequences
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        k = self.nb_kernel
+        kx, kh = jax.random.split(rng)
+        return {
+            "Wx": get_initializer(self.init)(
+                kx, (k, k, k, in_ch, 4 * self.nb_filter), param_dtype()),
+            "Wh": get_initializer(self.init)(
+                kh, (k, k, k, self.nb_filter, 4 * self.nb_filter),
+                param_dtype()),
+            "b": jnp.zeros((4 * self.nb_filter,), param_dtype()),
+        }
+
+    def call(self, params, x, *, training=False, rng=None):
+        cd = compute_dtype()
+        b, t, d, h, w, _ = x.shape
+        f = self.nb_filter
+
+        def conv(inp, kern):
+            return lax.conv_general_dilated(
+                inp, kern, (1, 1, 1), "SAME",
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+                preferred_element_type=jnp.float32).astype(cd)
+
+        wx = params["Wx"].astype(cd)
+        wh = params["Wh"].astype(cd)
+        bias = params["b"].astype(cd)
+
+        def step(carry, x_t):
+            h_prev, c_prev = carry
+            z = conv(x_t, wx) + conv(h_prev, wh) + bias
+            i, fgate, g, o = jnp.split(z, 4, axis=-1)
+            i = self.inner_activation(i)
+            fgate = self.inner_activation(fgate)
+            o = self.inner_activation(o)
+            c = fgate * c_prev + i * self.activation(g)
+            h_new = o * self.activation(c)
+            return (h_new, c), h_new
+
+        h0 = jnp.zeros((b, d, h, w, f), cd)
+        xs = jnp.moveaxis(x.astype(cd), 1, 0)       # (T, B, D, H, W, C)
+        (h_last, _), hs = lax.scan(step, (h0, h0), xs)
+        if self.return_sequences:
+            return jnp.moveaxis(hs, 0, 1)           # (B, T, D, H, W, F)
+        return h_last
+
+
+class WithinChannelLRN(Layer):
+    """``WithinChannelLRN2D.scala`` (caffe's WITHIN_CHANNEL LRN) — local
+    response normalization over a ``size`` x ``size`` SPATIAL window inside
+    each channel: x / (1 + alpha * avg_window(x^2)) ** beta. One avg-pool of
+    x² (SAME padding), so XLA fuses it like any pooling op."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, **kwargs):
+        super().__init__(**kwargs)
+        self.size, self.alpha, self.beta = int(size), float(alpha), float(beta)
+
+    def call(self, params, x, *, training=False, rng=None):
+        sq = jnp.square(x.astype(jnp.float32))
+        win = lax.reduce_window(
+            sq, 0.0, lax.add, (1, self.size, self.size, 1), (1, 1, 1, 1),
+            "SAME")
+        avg = win / float(self.size * self.size)
+        denom = jnp.power(1.0 + self.alpha * avg, self.beta)
+        return (x.astype(jnp.float32) / denom).astype(x.dtype)

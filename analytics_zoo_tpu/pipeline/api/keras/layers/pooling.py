@@ -165,3 +165,28 @@ class GlobalMaxPooling3D(Layer):
 class GlobalAveragePooling3D(Layer):
     def call(self, params, x, *, training=False, rng=None):
         return jnp.mean(x, axis=(1, 2, 3))
+
+
+class KMaxPooling(Layer):
+    """``KMaxPooling(k, dim)`` (``KMaxPooling.scala``) — keep the k largest
+    values along ``dim`` (default: the time axis 1) in their ORIGINAL
+    order (top-k by value, then index-sort — the order-preserving contract
+    of the reference/caffe form). Input (B, T, C) → (B, k, C)."""
+
+    def __init__(self, k: int, dim: int = 1, **kwargs):
+        super().__init__(**kwargs)
+        if k < 1:
+            raise ValueError(f"KMaxPooling needs k >= 1, got {k}")
+        self.k = int(k)
+        self.dim = int(dim)
+
+    def call(self, params, x, *, training=False, rng=None):
+        axis = self.dim % x.ndim
+        if x.shape[axis] < self.k:
+            raise ValueError(f"KMaxPooling k={self.k} exceeds dim size "
+                             f"{x.shape[axis]}")
+        moved = jnp.moveaxis(x, axis, -1)
+        _, idx = lax.top_k(moved, self.k)           # by value, desc
+        idx = jnp.sort(idx, axis=-1)                # restore original order
+        out = jnp.take_along_axis(moved, idx, axis=-1)
+        return jnp.moveaxis(out, -1, axis)

@@ -200,7 +200,9 @@ def load_saved_model(path: str, signature: str = "serving_default",
             continue
         keep.add(name)
         for raw_in in by_name[name]["inputs"]:
-            stack.append(_base(raw_in.lstrip("^")))
+            if raw_in.startswith("^"):
+                continue  # control deps don't pull Saver/init machinery in
+            stack.append(_base(raw_in))
     sliced = [n for n in new_nodes if n["name"] in keep]
     # control-dep pruning: inputs starting with ^ may point outside the
     # slice (e.g. ^init) — drop those edges

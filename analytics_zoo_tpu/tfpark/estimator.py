@@ -129,7 +129,7 @@ class TFEstimator:
 
         # ORDER MATTERS: the first Model assigns the deterministic layer
         # names every later Model over the same nodes inherits.
-        if spec.loss is not None and label_inputs is not None:
+        if spec.loss is not None:
             self._train_model = Model(feat_inputs + label_inputs, spec.loss)
         if spec.predictions is not None:
             pred_leaves, self._pred_def = _flatten(spec.predictions)
@@ -282,9 +282,8 @@ class TFEstimator:
             if self._predict_model is None:
                 raise ValueError("model_fn returned no predictions — only "
                                  "the 'loss' eval_method is available")
-            preds = self.predict(
-                lambda: TFDataset(ds.features, batch_per_thread=max(bs, 1)),
-                batch_size=bs)
+            preds = self.predict(lambda: TFDataset(ds.features),
+                                 batch_size=bs)
             flat_preds, _ = _flatten(preds)
             p = np.asarray(flat_preds[0])[:n]
             y = np.asarray(ds.labels[0])[:n]
@@ -327,7 +326,8 @@ def _host_metric(name: str, y: np.ndarray, p: np.ndarray) -> float:
         return float((cls == y.reshape(len(y), -1)[:, 0]).mean())
     if key in ("top5acc", "top5accuracy"):
         top5 = np.argsort(p, axis=-1)[:, -5:]
-        return float((top5 == y[:, None]).any(axis=1).mean())
+        y1 = y.reshape(len(y), -1)[:, 0]
+        return float((top5 == y1[:, None]).any(axis=1).mean())
     if key == "mae":
         return float(np.abs(p.reshape(len(p), -1)
                             - y.reshape(len(y), -1)).mean())

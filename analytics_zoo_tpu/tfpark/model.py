@@ -71,8 +71,10 @@ class KerasModel:
         if self.model.params is None:
             self.model.init_weights()
         leaves, _ = jax.tree_util.tree_flatten_with_path(self.model.params)
-        np.savez(filepath, **{jax.tree_util.keystr(k): np.asarray(v)
-                              for k, v in leaves})
+        arrays = {jax.tree_util.keystr(k): np.asarray(v) for k, v in leaves}
+        with open(filepath, "wb") as f:  # file handle: np.savez would
+            np.savez(f, **arrays)        # append ".npz" to a bare path
+
 
     def load_weights(self, filepath: str, by_name: bool = False):
         if self.model.params is None:

@@ -310,3 +310,97 @@ def test_transformer_layer_causality(rng):
     assert y1.shape == (2, 8, 16)
     np.testing.assert_allclose(y1[:, :-1], y2[:, :-1], rtol=RTOL, atol=ATOL)
     assert not np.allclose(y1[:, -1], y2[:, -1])
+
+
+# ---------------------------------------------------------------------------
+# r4 layer-zoo tail: KMaxPooling / WithinChannelLRN / SeparableConvolution1D
+# / ConvLSTM3D
+# ---------------------------------------------------------------------------
+
+def test_kmax_pooling_matches_torch_topk_order_preserving():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import KMaxPooling
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3, 9, 4)).astype(np.float32)
+    layer = KMaxPooling(4)
+    got = _np(layer.call({}, jnp.asarray(x)))
+    # oracle: torch topk indices, sorted ascending, gathered (the
+    # order-preserving caffe/BigDL contract)
+    t = torch.from_numpy(x).permute(0, 2, 1)        # (B, C, T)
+    _, idx = torch.topk(t, 4, dim=-1)
+    idx, _ = torch.sort(idx, dim=-1)
+    want = torch.gather(t, -1, idx).permute(0, 2, 1).numpy()
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+    # order preserved: within each output row, values appear in input order
+    assert got.shape == (3, 4, 4)
+
+
+def test_kmax_pooling_rejects_oversize_k():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import KMaxPooling
+    with pytest.raises(ValueError, match="exceeds"):
+        KMaxPooling(10).call({}, jnp.zeros((2, 5, 3)))
+
+
+def test_within_channel_lrn_matches_torch_avgpool_oracle():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import WithinChannelLRN
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 7, 7, 3)).astype(np.float32)
+    size, alpha, beta = 3, 0.8, 0.75
+    got = _np(WithinChannelLRN(size, alpha, beta).call({}, jnp.asarray(x)))
+    # oracle: caffe WITHIN_CHANNEL via torch avg_pool2d on x^2 (SAME window)
+    t = torch.from_numpy(x).permute(0, 3, 1, 2)
+    avg = F.avg_pool2d(t ** 2, size, stride=1, padding=size // 2,
+                       count_include_pad=True)
+    want = (t / (1.0 + alpha * avg) ** beta).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize("border_mode", ["valid", "same"])
+def test_separable_conv1d_matches_torch(border_mode):
+    from analytics_zoo_tpu.pipeline.api.keras.layers import \
+        SeparableConvolution1D
+
+    rng = np.random.default_rng(2)
+    B, T, C, F_, K, DM = 2, 10, 3, 5, 3, 2
+    x = rng.normal(size=(B, T, C)).astype(np.float32)
+    layer = SeparableConvolution1D(F_, K, border_mode=border_mode,
+                                   depth_multiplier=DM)
+    params = layer.build(jax.random.key(0), (None, T, C))
+    got = _np(layer.call(params, jnp.asarray(x)))
+
+    # torch oracle: grouped depthwise conv1d + pointwise conv1d
+    dw = _np(params["depthwise"])    # (K, 1, C*DM)
+    pw = _np(params["pointwise"])    # (1, C*DM, F)
+    b = _np(params["b"])
+    t_in = torch.from_numpy(x).permute(0, 2, 1)  # (B, C, T)
+    # jax WIO grouped layout: O = C*DM with per-group blocks contiguous
+    w_dw = torch.from_numpy(dw).permute(2, 1, 0)  # (C*DM, 1, K)
+    pad = 0 if border_mode == "valid" else "same"
+    y = F.conv1d(t_in, w_dw, padding=pad, groups=C)
+    w_pw = torch.from_numpy(pw).permute(2, 1, 0)  # (F, C*DM, 1)
+    y = F.conv1d(y, w_pw) + torch.from_numpy(b)[None, :, None]
+    want = y.permute(0, 2, 1).numpy()
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+def test_conv_lstm3d_depth1_equals_conv_lstm2d():
+    """ConvLSTM3D with a singleton depth axis must reproduce ConvLSTM2D
+    given the same weights restricted to the middle depth slice — the 2D
+    layer is the oracle."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (ConvLSTM2D,
+                                                             ConvLSTM3D)
+
+    rng = np.random.default_rng(3)
+    B, T, H, W, C, F_, K = 2, 3, 5, 5, 2, 4, 3
+    x = rng.normal(size=(B, T, H, W, C)).astype(np.float32)
+    l3 = ConvLSTM3D(F_, K, return_sequences=True)
+    p3 = l3.build(jax.random.key(1), (None, T, 1, H, W, C))
+    got = _np(l3.call(p3, jnp.asarray(x[:, :, None])))[:, :, 0]
+
+    l2 = ConvLSTM2D(F_, K, return_sequences=True)
+    # depth kernel index 1 is the only slice that sees the singleton depth
+    # under SAME padding
+    p2 = {"Wx": p3["Wx"][1], "Wh": p3["Wh"][1], "b": p3["b"]}
+    want = _np(l2.call(p2, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)

@@ -1,0 +1,204 @@
+"""Augmentation-family image transforms (``ImageHue/Saturation/ColorJitter/
+Expand/Filler/AspectScale/... .scala``) — golden-tested against per-pixel
+colorsys / PIL oracles like the r1 transform set."""
+
+import colorsys
+import io
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.image import (AspectScale, BytesToMat,
+                                             ChannelScaledNormalizer,
+                                             ColorJitter, Contrast, Expand,
+                                             Filler, FixedCrop, Hue,
+                                             MatToFloats, Mirror,
+                                             PixelBytesToMat,
+                                             RandomAspectScale,
+                                             RandomPreprocessing,
+                                             RandomResize, Saturation)
+
+
+def _img(h=12, w=10, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, (h, w, 3)).astype(np.uint8)
+
+
+def _hsv_oracle(im, fn):
+    """Apply ``fn(h, s, v) -> (h, s, v)`` per pixel via colorsys."""
+    out = np.zeros_like(im, np.float32)
+    for i in range(im.shape[0]):
+        for j in range(im.shape[1]):
+            r, g, b = (im[i, j].astype(np.float32) / 255.0)
+            h, s, v = colorsys.rgb_to_hsv(r, g, b)
+            h, s, v = fn(h, s, v)
+            out[i, j] = colorsys.hsv_to_rgb(h, s, v)
+    return np.clip(out * 255.0, 0, 255).astype(np.uint8)
+
+
+def test_hue_matches_colorsys_oracle():
+    im = _img()
+    t = Hue(30.0, 30.0, seed=0)  # fixed delta
+    got = t.apply_one(im)
+    want = _hsv_oracle(im, lambda h, s, v: ((h + 30 / 360.0) % 1.0, s, v))
+    assert np.abs(got.astype(int) - want.astype(int)).max() <= 1  # rounding
+
+
+def test_hue_wraps_and_identity():
+    im = _img(seed=1)
+    full = Hue(360.0, 360.0, seed=0).apply_one(im)
+    assert np.abs(full.astype(int) - im.astype(int)).max() <= 1
+
+
+def test_saturation_matches_colorsys_oracle():
+    im = _img(seed=2)
+    got = Saturation(0.5, 0.5, seed=0).apply_one(im)
+    want = _hsv_oracle(im, lambda h, s, v: (h, min(1.0, s * 0.5), v))
+    assert np.abs(got.astype(int) - want.astype(int)).max() <= 1
+
+
+def test_saturation_zero_is_grayscale():
+    im = _img(seed=3)
+    got = Saturation(0.0, 0.0, seed=0).apply_one(im)
+    assert np.abs(got.astype(int).max(-1) - got.astype(int).min(-1)).max() <= 1
+
+
+def test_contrast_scales_and_clips():
+    im = _img(seed=4)
+    got = Contrast(2.0, 2.0, seed=0).apply_one(im)
+    want = np.clip(im.astype(np.float32) * 2.0, 0, 255).astype(np.uint8)
+    np.testing.assert_array_equal(got, want)
+    assert got.dtype == np.uint8
+
+
+def test_color_jitter_composes_and_preserves_shape():
+    im = _img(seed=5)
+    t = ColorJitter(seed=7)
+    out = t.apply_one(im)
+    assert out.shape == im.shape and out.dtype == im.dtype
+    # prob=0 → identity
+    t0 = ColorJitter(brightness_prob=0, contrast_prob=0, hue_prob=0,
+                     saturation_prob=0, seed=1)
+    np.testing.assert_array_equal(t0.apply_one(im), im)
+
+
+def test_expand_places_image_on_mean_canvas():
+    im = _img(8, 6, seed=6)
+    t = Expand(10, 20, 30, min_expand_ratio=2.0, max_expand_ratio=2.0,
+               seed=0)
+    out = t.apply_one(im)
+    assert out.shape == (16, 12, 3)
+    # the original image appears intact somewhere
+    found = False
+    for y in range(out.shape[0] - 8 + 1):
+        for x in range(out.shape[1] - 6 + 1):
+            if np.array_equal(out[y:y + 8, x:x + 6], im):
+                found = True
+    assert found
+    # corners are mean-filled (canvas ratio 2 => some corner is fill)
+    corners = [out[0, 0], out[0, -1], out[-1, 0], out[-1, -1]]
+    assert any(np.array_equal(c, [10, 20, 30]) for c in corners)
+
+
+def test_filler_fills_normalized_box():
+    im = _img(10, 10, seed=7)
+    out = Filler(0.2, 0.3, 0.7, 0.8, value=0).apply_one(im)
+    np.testing.assert_array_equal(out[3:8, 2:7], 0)
+    np.testing.assert_array_equal(out[:3], im[:3])
+    with pytest.raises(ValueError, match="normalized"):
+        Filler(0, 0, 2.0, 1.0)
+    with pytest.raises(ValueError, match="area"):
+        Filler(0.5, 0.5, 0.5, 0.9)
+
+
+def test_aspect_scale_short_side_and_multiple():
+    im = _img(40, 80, seed=8)
+    out = AspectScale(20, scale_multiple_of=1, max_size=1000).apply_one(im)
+    assert out.shape[:2] == (20, 40)
+    # max_size caps the long side
+    out2 = AspectScale(20, max_size=30).apply_one(im)
+    assert max(out2.shape[:2]) <= 30
+    # rounding to a multiple
+    out3 = AspectScale(21, scale_multiple_of=8).apply_one(im)
+    assert out3.shape[0] % 8 == 0 and out3.shape[1] % 8 == 0
+
+
+def test_random_aspect_scale_draws_from_scales():
+    im = _img(40, 80, seed=9)
+    t = RandomAspectScale([16, 24], seed=0)
+    sizes = {t.apply_one(im).shape[0] for _ in range(10)}
+    assert sizes <= {16, 24} and len(sizes) >= 1
+
+
+def test_channel_scaled_normalizer():
+    im = _img(seed=10)
+    out = ChannelScaledNormalizer(10, 20, 30, scale=0.5).apply_one(im)
+    want = (im.astype(np.float32) - np.array([10, 20, 30], np.float32)) * 0.5
+    np.testing.assert_allclose(out, want)
+    assert out.dtype == np.float32
+
+
+def test_mirror_deterministic():
+    im = _img(seed=11)
+    np.testing.assert_array_equal(Mirror().apply_one(im), im[:, ::-1])
+    batch = np.stack([im, im[::-1]])
+    np.testing.assert_array_equal(Mirror().apply(batch), batch[:, :, ::-1])
+
+
+def test_fixed_crop_normalized_and_pixel():
+    im = _img(10, 20, seed=12)
+    out = FixedCrop(0.25, 0.2, 0.75, 0.9).apply_one(im)
+    np.testing.assert_array_equal(out, im[2:9, 5:15])
+    out2 = FixedCrop(5, 2, 15, 9, normalized=False).apply_one(im)
+    np.testing.assert_array_equal(out2, im[2:9, 5:15])
+
+
+def test_random_resize_in_range():
+    im = _img(seed=13)
+    t = RandomResize(6, 9, seed=0)
+    for _ in range(5):
+        out = t.apply_one(im)
+        assert 6 <= out.shape[0] <= 9 and out.shape[0] == out.shape[1]
+
+
+def test_random_preprocessing_probability():
+    im = _img(seed=14)
+    always = RandomPreprocessing(Mirror(), 1.0, seed=0)
+    never = RandomPreprocessing(Mirror(), 0.0, seed=0)
+    np.testing.assert_array_equal(always.apply_one(im), im[:, ::-1])
+    np.testing.assert_array_equal(never.apply_one(im), im)
+
+
+def test_bytes_to_mat_decodes_png():
+    from PIL import Image
+    im = _img(seed=15)
+    buf = io.BytesIO()
+    Image.fromarray(im).save(buf, format="PNG")
+    out = BytesToMat().apply(buf.getvalue())
+    np.testing.assert_array_equal(out, im)
+    outs = BytesToMat().apply([buf.getvalue(), buf.getvalue()])
+    assert len(outs) == 2
+
+
+def test_pixel_bytes_to_mat():
+    im = _img(4, 5, seed=16)
+    out = PixelBytesToMat(4, 5, 3).apply(im.tobytes())
+    np.testing.assert_array_equal(out, im)
+
+
+def test_mat_to_floats():
+    im = _img(seed=17)
+    out = MatToFloats().apply_one(im)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, im.astype(np.float32))
+
+
+def test_chain_combinator_end_to_end():
+    """The transforms ride the same >> combinator as the r1 set."""
+    im = [_img(32, 32, seed=s) for s in range(4)]
+    chain = (Hue(-18, 18, seed=0) >> Saturation(0.8, 1.2, seed=0)
+             >> Contrast(0.9, 1.1, seed=0) >> AspectScale(24)
+             >> FixedCrop(0, 0, 0.75, 0.75) >> MatToFloats())
+    out = chain.apply(im)
+    assert len(out) == 4
+    assert all(o.dtype == np.float32 for o in out)

@@ -134,6 +134,9 @@ CASES = {
     "SpatialDropout3D": (lambda: L.SpatialDropout3D(0.3), (3, 3, 3, 2),
                          "float"),
     "ConvLSTM2D": (lambda: L.ConvLSTM2D(4, 3), (3, 5, 5, 2), "float"),
+    "ConvLSTM3D": (lambda: L.ConvLSTM3D(4, 3), (3, 4, 4, 4, 2), "float"),
+    "ConvLSTM3D_seq": (lambda: L.ConvLSTM3D(4, 3, return_sequences=True),
+                       (3, 4, 4, 4, 2), "float"),
     "ConvLSTM2D_seq": (lambda: L.ConvLSTM2D(4, 3, return_sequences=True),
                        (3, 5, 5, 2), "float"),
     "LocallyConnected2D": (lambda: L.LocallyConnected2D(4, 3, 3),
@@ -143,6 +146,10 @@ CASES = {
                            (6, 6, 2), "float"),
     "MaxoutDense": (lambda: L.MaxoutDense(5, nb_feature=3), (4,), "float"),
     "LRN2D": (lambda: L.LRN2D(), (4, 4, 7), "float"),
+    "WithinChannelLRN": (lambda: L.WithinChannelLRN(3), (6, 6, 3), "float"),
+    "KMaxPooling": (lambda: L.KMaxPooling(3), (8, 4), "float"),
+    "SeparableConvolution1D": (lambda: L.SeparableConvolution1D(6, 3),
+                               (8, 4), "float"),
     "SimpleRNN": (lambda: L.SimpleRNN(5), (6, 4), "float"),
     "LSTM": (lambda: L.LSTM(5, return_sequences=True), (6, 4), "float"),
     "GRU": (lambda: L.GRU(5), (6, 4), "float"),

@@ -246,8 +246,12 @@ def test_keras_model_weights_roundtrip(tmp_path):
     np.testing.assert_allclose(np.asarray(km2.predict(x)), np.asarray(ref),
                                rtol=1e-5, atol=1e-6)
 
-    wpath = str(tmp_path / "w.npz")
+    # extensionless path: save/load must use the EXACT name (np.savez's
+    # auto-append would break the roundtrip)
+    wpath = str(tmp_path / "weights.h5")
     km.save_weights(wpath)
+    import os
+    assert os.path.exists(wpath)
     km3 = KerasModel(_compiled_net())
     km3.load_weights(wpath)
     np.testing.assert_allclose(np.asarray(km3.predict(x)), np.asarray(ref),

@@ -414,6 +414,10 @@ class Expand(ImageProcessing):
             return np.clip(canvas, 0, 255).astype(np.uint8)
         return canvas.astype(im.dtype)
 
+    def apply_batch(self, batch):
+        # per-image random canvas sizes are ragged — return a list
+        return [self.apply_one(im) for im in batch]
+
 
 class Filler(ImageProcessing):
     """``ImageFiller.scala`` — fill a normalized-coordinate rectangle
@@ -452,9 +456,9 @@ class AspectScale(ImageProcessing):
         self.multiple = int(scale_multiple_of)
         self.max_size = int(max_size)
 
-    def _target(self, H, W):
+    def _target(self, H, W, min_size=None):
         short, long = min(H, W), max(H, W)
-        scale = self.min_size / short
+        scale = (min_size or self.min_size) / short
         if scale * long > self.max_size:
             scale = self.max_size / long
         nh, nw = int(round(H * scale)), int(round(W * scale))
@@ -470,7 +474,8 @@ class AspectScale(ImageProcessing):
 
 class RandomAspectScale(AspectScale):
     """``ImageRandomAspectScale.scala`` — AspectScale with the short-side
-    target drawn uniformly from ``scales``."""
+    target drawn uniformly from ``scales`` (drawn per image, passed by
+    value — the instance stays stateless/reentrant)."""
 
     def __init__(self, scales: Sequence[int], scale_multiple_of: int = 1,
                  max_size: int = 1000, seed: Optional[int] = None):
@@ -479,8 +484,13 @@ class RandomAspectScale(AspectScale):
         self._rng = np.random.default_rng(seed)
 
     def apply_one(self, im):
-        self.min_size = int(self._rng.choice(self.scales))
-        return super().apply_one(im)
+        draw = int(self._rng.choice(self.scales))
+        nh, nw = self._target(im.shape[0], im.shape[1], draw)
+        return Resize(nh, nw).apply_one(im)
+
+    def apply_batch(self, batch):
+        # per-image random sizes are ragged — return a list, not a stack
+        return [self.apply_one(im) for im in batch]
 
 
 class ChannelScaledNormalizer(ImageProcessing):
@@ -545,6 +555,10 @@ class RandomResize(ImageProcessing):
     def apply_one(self, im):
         size = int(self._rng.integers(self.lo, self.hi + 1))
         return Resize(size, size).apply_one(im)
+
+    def apply_batch(self, batch):
+        # per-image random sizes are ragged — return a list
+        return [self.apply_one(im) for im in batch]
 
 
 class RandomPreprocessing(ImageProcessing):

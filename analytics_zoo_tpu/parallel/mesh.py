@@ -170,3 +170,29 @@ def param_shardings(model, params, mesh: Optional[Mesh] = None):
             "; ".join(f"{p} shape={s} spec={sp}" for p, s, sp in
                       fallbacks[:5]) + (" ..." if len(fallbacks) > 5 else ""))
     return out
+
+
+def zero_sharding_for(base: NamedSharding, shape,
+                      mesh: Optional[Mesh] = None) -> NamedSharding:
+    """ZeRO-1 placement for one param-shaped optimizer-state leaf (SURVEY
+    §2.4: the TPU-native replacement for the reference's sliced
+    ``AllReduceParameter``, ``wp-bigdl.md:140-160``, which shards optimizer
+    state across workers): take the leaf's existing param sharding (model/
+    expert axes intact) and partition the first still-unsharded dim whose
+    size divides the ``data`` axis. Leaves with no such dim stay on their
+    base sharding — correct, just not memory-sharded.
+
+    Under jit this annotation is all GSPMD needs: the gradient reduction
+    feeding the moment update lowers to reduce-scatter and the updated
+    params all-gather back, instead of a full all-reduce with replicated
+    moments."""
+    mesh = mesh or global_mesh()
+    dp = mesh.shape[DATA_AXIS]
+    if dp <= 1:
+        return base
+    spec = list(base.spec) + [None] * (len(shape) - len(base.spec))
+    for i, (ax, dim) in enumerate(zip(spec, shape)):
+        if ax is None and dim % dp == 0:
+            spec[i] = DATA_AXIS
+            return NamedSharding(mesh, P(*spec))
+    return base

@@ -217,6 +217,7 @@ class TrainingLoop:
                 return (l if aux is None else l + aux), ns
             (l, ns), grads = jax.value_and_grad(lfn, has_aux=True)(params)
             updates, opt_state = opt.update(grads, opt_state, params)
+            opt_state = self._pin_opt_state(opt_state)
             params = optax.apply_updates(params, updates)
             return params, opt_state, ns, l
 
@@ -243,6 +244,7 @@ class TrainingLoop:
 
             (l, ns), grads = jax.value_and_grad(lfn, has_aux=True)(params)
             updates, opt_state = opt.update(grads, opt_state, params)
+            opt_state = self._pin_opt_state(opt_state)
             params = optax.apply_updates(params, updates)
             return (params, opt_state, ns, i + 1), l
 
@@ -274,12 +276,31 @@ class TrainingLoop:
         presents identical input shardings to the jitted step — otherwise
         the first call hands uncommitted counters while later calls hand
         committed ones, and each fit() misses the jit cache and recompiles
-        the whole epoch program (~20 s on a real chip)."""
+        the whole epoch program (~20 s on a real chip).
+
+        ``zoo.train.zero_sharding``: ZeRO-1 — moments additionally shard
+        over the ``data`` axis (``mesh_lib.zero_sharding_for``); the jitted
+        step re-pins the updated state each step so GSPMD keeps the
+        reduce-scatter/all-gather form instead of drifting back to
+        replicated."""
+        zero = bool(get_zoo_context().get("zoo.train.zero_sharding", False))
+
+        def moment_sharding(leaf, base):
+            if not zero:
+                return base
+            return mesh_lib.zero_sharding_for(base, np.shape(leaf),
+                                              self.mesh)
+
         try:
-            return optax.tree_map_params(
-                self.optimizer, lambda s, sh: jax.device_put(s, sh),
+            shardings = optax.tree_map_params(
+                self.optimizer, lambda s, sh: moment_sharding(s, sh),
                 opt_state, psh,
-                transform_non_params=lambda s: jax.device_put(s, repl))
+                transform_non_params=lambda s: repl)
+            # the sharding TREE (matching opt_state's structure) doubles as
+            # the per-step constraint target under zero_sharding
+            self._opt_state_shardings = shardings if zero else None
+            return jax.tree.map(lambda s, sh: jax.device_put(s, sh),
+                                opt_state, shardings)
         except (ValueError, TypeError, AttributeError) as e:
             # structure quirks of custom/wrapped optimizers (e.g.
             # multi_transform label fns failing placeholder introspection):
@@ -287,7 +308,16 @@ class TrainingLoop:
             # but under TP they reshard every step, so say so
             log.warning("could not apply param shardings to the optimizer "
                         "state (%s); moments stay replicated", e)
+            self._opt_state_shardings = None
             return jax.device_put(opt_state, repl)
+
+    def _pin_opt_state(self, opt_state):
+        """In-step sharding constraint keeping ZeRO-sharded moments sharded
+        across scan iterations (no-op when zero_sharding is off)."""
+        sh = getattr(self, "_opt_state_shardings", None)
+        if sh is None:
+            return opt_state
+        return jax.tree.map(jax.lax.with_sharding_constraint, opt_state, sh)
 
     def build_epoch_fn(self, n: int, batch_size: int, n_steps: int,
                        shuffle: bool = True):

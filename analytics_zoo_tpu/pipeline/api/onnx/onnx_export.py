@@ -297,6 +297,13 @@ def export_onnx(model: KerasNet, path: str) -> str:
     cur = "input"
     shapes = list(getattr(model, "_shapes", [])) or [None] * len(model.layers)
     in_shape = shapes[0] if shapes and shapes[0] is not None else None
+    if in_shape is None:
+        # an untyped graph input fails onnx.checker — refuse early rather
+        # than emit a file the stated compatibility guarantee rejects
+        raise ValueError(
+            "export_onnx needs the model's input shape: build the first "
+            "layer with input_shape=... (or init_weights(input_shape=...)) "
+            "before exporting")
     # a stack starting conv-family takes NCHW input per ONNX convention
     nchw = bool(model.layers) and isinstance(
         model.layers[0], (Convolution2D, MaxPooling2D, AveragePooling2D))

@@ -267,11 +267,14 @@ class InferenceModel:
         containers; the max of colliding ranges is taken (conservative)."""
         records: Dict[str, float] = {}
 
+        shapes: Dict[str, tuple] = {}
+
         def rec(layer, p, s, x, training, rng):
             if (InferenceModel._quantizable(layer) and isinstance(p, dict)
                     and "W" in p and not isinstance(x, (list, tuple))):
                 amax = float(jnp.abs(x).max())
                 records[layer.name] = max(records.get(layer.name, 0.0), amax)
+                shapes[layer.name] = tuple(p["W"].shape)
             return None
 
         xs = [jnp.asarray(a) for a in _as_list(calibrate)]
@@ -281,20 +284,26 @@ class InferenceModel:
         if not records:
             raise ValueError("calibration found no quantizable layer "
                              "(Dense/Convolution2D) in the model")
-        return {name: max(amax, 1e-8) / 127.0
+        return {name: (max(amax, 1e-8) / 127.0, shapes[name])
                 for name, amax in records.items()}
 
     @staticmethod
     def _rewrite_quantized(params, act_scales):
         """Replace each calibrated layer's param subtree with its static-int8
-        entry, recursing through nested containers."""
+        entry, recursing through nested containers. A subtree is rewritten
+        only when BOTH the layer name and the kernel shape recorded at
+        calibration match — a non-quantizable layer in another container
+        that merely shares a calibrated layer's name keeps its float params
+        (the collision _calibrate's docstring warns about)."""
         def rewrite(tree):
             if not isinstance(tree, dict):
                 return tree
             out = {}
             for k, v in tree.items():
-                if (k in act_scales and isinstance(v, dict) and "W" in v):
-                    out[k] = _quantize_layer_entry(v, act_scales[k])
+                entry = act_scales.get(k)
+                if (entry is not None and isinstance(v, dict) and "W" in v
+                        and tuple(v["W"].shape) == entry[1]):
+                    out[k] = _quantize_layer_entry(v, entry[0])
                 else:
                     out[k] = rewrite(v)
             return out

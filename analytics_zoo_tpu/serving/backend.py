@@ -122,13 +122,21 @@ class LocalBackend:
 
 
 class RedisBackend:
-    """Same contract against a real Redis (requires the ``redis`` package);
-    keys match the reference: input stream entries + ``result:<uri>`` hashes."""
+    """Same contract against a real Redis; keys match the reference: input
+    stream entries + ``result:<uri>`` hashes
+    (``serving/ClusterServing.scala:103-134``). Uses the redis-py client
+    when installed, otherwise the in-repo RESP wire client
+    (``serving/resp.py``) — no package dependency to talk to a real
+    server."""
 
     def __init__(self, host: str = "localhost", port: int = 6379,
                  maxlen: int = 10000):
-        import redis  # gated: not part of the baked environment
-        self._r = redis.Redis(host=host, port=port)
+        try:
+            import redis
+            self._r = redis.Redis(host=host, port=port)
+        except ImportError:
+            from .resp import RespClient
+            self._r = RespClient(host=host, port=port)
         self.maxlen = maxlen
         self._last_id: Dict[str, str] = {}
 

@@ -1,0 +1,167 @@
+"""Minimal RESP (REdis Serialization Protocol) client — the wire layer under
+``RedisBackend`` when the ``redis`` package isn't installed.
+
+The reference hard-requires a Redis server plus the redis-py client
+(``pyzoo/zoo/serving/client.py:58-142``); here the backend speaks the actual
+wire protocol itself over one TCP socket, covering exactly the command
+subset the serving contract uses: XADD / XLEN / XREAD / XDEL (input
+stream), HSET / HGETALL / DEL / KEYS (``result:<uri>`` hashes), PING.
+RESP2 framing: arrays of bulk strings out, simple/bulk/integer/array
+replies in. One connection PER THREAD (like redis-py's on-demand pool):
+the serving loop's blocking XREAD must never hold up a producer thread's
+``xadd``/``set_result``.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["RespClient", "RespError"]
+
+
+class RespError(RuntimeError):
+    """Server returned an error reply (``-ERR ...``)."""
+
+
+class _Conn:
+    """One socket + read buffer (single-thread use)."""
+
+    def __init__(self, host: str, port: int, timeout: float):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+
+    def send(self, *parts) -> None:
+        out = [b"*%d\r\n" % len(parts)]
+        for p in parts:
+            if isinstance(p, str):
+                p = p.encode()
+            elif isinstance(p, (int, float)):
+                p = str(p).encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(p), p))
+        self.sock.sendall(b"".join(out))
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n + 2:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis connection closed")
+            self.buf += chunk
+        data, self.buf = self.buf[:n], self.buf[n + 2:]  # strip \r\n
+        return data
+
+    def read_reply(self):
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest
+        if kind == b"-":
+            raise RespError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            return None if n == -1 else self._read_exact(n)
+        if kind == b"*":
+            n = int(rest)
+            return None if n == -1 else [self.read_reply()
+                                         for _ in range(n)]
+        raise RespError(f"unparseable reply start {line!r}")
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RespClient:
+    def __init__(self, host: str = "localhost", port: int = 6379,
+                 timeout: float = 30.0):
+        self._host, self._port, self._timeout = host, port, timeout
+        self._local = threading.local()
+        self._conns: List[_Conn] = []
+        self._conns_lock = threading.Lock()
+        self._conn()  # connect eagerly so bad host/port fails at init
+
+    def _conn(self) -> _Conn:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = _Conn(self._host, self._port, self._timeout)
+            self._local.conn = c
+            with self._conns_lock:
+                self._conns.append(c)
+        return c
+
+    def close(self):
+        with self._conns_lock:
+            for c in self._conns:
+                c.close()
+            self._conns.clear()
+
+    def command(self, *parts):
+        c = self._conn()
+        c.send(*parts)
+        return c.read_reply()
+
+    # -- the redis-py surface RedisBackend uses ------------------------------
+    def ping(self) -> bool:
+        return self.command("PING") in (b"PONG", "PONG")
+
+    def xadd(self, stream: str, fields: Dict) -> bytes:
+        args: List = ["XADD", stream, "*"]
+        for k, v in fields.items():
+            args += [k, v]
+        return self.command(*args)
+
+    def xlen(self, stream: str) -> int:
+        return int(self.command("XLEN", stream))
+
+    def xread(self, streams: Dict[str, str], count: Optional[int] = None,
+              block: Optional[int] = None):
+        args: List = ["XREAD"]
+        if count is not None:
+            args += ["COUNT", count]
+        if block is not None:
+            args += ["BLOCK", block]
+        args += ["STREAMS"] + list(streams.keys()) + list(streams.values())
+        resp = self.command(*args)
+        if resp is None:
+            return []
+        out = []
+        for name, entries in resp:
+            decoded = []
+            for eid, kv in entries:
+                fields = {kv[i]: kv[i + 1] for i in range(0, len(kv), 2)}
+                decoded.append((eid, fields))
+            out.append((name, decoded))
+        return out
+
+    def xdel(self, stream: str, entry_id: str) -> int:
+        return int(self.command("XDEL", stream, entry_id))
+
+    def hset(self, key: str, mapping: Dict) -> int:
+        args: List = ["HSET", key]
+        for k, v in mapping.items():
+            args += [k, v]
+        return int(self.command(*args))
+
+    def hgetall(self, key: str) -> Dict[bytes, bytes]:
+        resp = self.command("HGETALL", key) or []
+        return {resp[i]: resp[i + 1] for i in range(0, len(resp), 2)}
+
+    def delete(self, key: str) -> int:
+        return int(self.command("DEL", key))
+
+    def keys(self, pattern: str) -> List[bytes]:
+        return self.command("KEYS", pattern) or []

@@ -260,11 +260,13 @@ class InferenceModel:
         return False
 
     @staticmethod
-    def _calibrate(model, params, net_state, calibrate) -> Dict[str, float]:
-        """One eager forward over the calibration batch, recording the
-        abs-max input of every container-dispatched layer that has a
-        ``quantized_call``. Layer names collide only across nested
-        containers; the max of colliding ranges is taken (conservative)."""
+    def _calibrate(model, params, net_state, calibrate
+                   ) -> Dict[str, Tuple[float, tuple]]:
+        """One eager forward over the calibration batch, recording per
+        quantizable layer the activation scale AND the kernel shape —
+        ``{name: (x_scale, W_shape)}`` — so the rewrite can refuse
+        name-colliding layers in other containers. The max of colliding
+        ranges is taken (conservative)."""
         records: Dict[str, float] = {}
 
         shapes: Dict[str, tuple] = {}

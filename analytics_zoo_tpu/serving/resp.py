@@ -7,9 +7,12 @@ wire protocol itself over one TCP socket, covering exactly the command
 subset the serving contract uses: XADD / XLEN / XREAD / XDEL (input
 stream), HSET / HGETALL / DEL / KEYS (``result:<uri>`` hashes), PING.
 RESP2 framing: arrays of bulk strings out, simple/bulk/integer/array
-replies in. One connection PER THREAD (like redis-py's on-demand pool):
-the serving loop's blocking XREAD must never hold up a producer thread's
-``xadd``/``set_result``.
+replies in. Connections come from a small shared pool (created on demand,
+bounded by peak concurrency, like redis-py's): the serving loop's blocking
+XREAD never holds up a producer thread's ``xadd``/``set_result``, and a
+connection that errors mid-command (timeout, partial read) is DISCARDED,
+never returned to the pool — a desynced socket would answer the next
+command with the previous command's late reply.
 """
 
 from __future__ import annotations
@@ -89,30 +92,49 @@ class RespClient:
     def __init__(self, host: str = "localhost", port: int = 6379,
                  timeout: float = 30.0):
         self._host, self._port, self._timeout = host, port, timeout
-        self._local = threading.local()
-        self._conns: List[_Conn] = []
-        self._conns_lock = threading.Lock()
-        self._conn()  # connect eagerly so bad host/port fails at init
+        self._pool: List[_Conn] = []
+        self._pool_lock = threading.Lock()
+        self._closed = False
+        self._release(_Conn(host, port, timeout))  # eager: bad host fails now
 
-    def _conn(self) -> _Conn:
-        c = getattr(self._local, "conn", None)
-        if c is None:
-            c = _Conn(self._host, self._port, self._timeout)
-            self._local.conn = c
-            with self._conns_lock:
-                self._conns.append(c)
-        return c
+    def _acquire(self) -> _Conn:
+        if self._closed:
+            raise RuntimeError("RespClient is closed")
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        return _Conn(self._host, self._port, self._timeout)
+
+    def _release(self, c: _Conn) -> None:
+        with self._pool_lock:
+            if self._closed:
+                c.close()
+            else:
+                self._pool.append(c)
 
     def close(self):
-        with self._conns_lock:
-            for c in self._conns:
+        with self._pool_lock:
+            self._closed = True
+            for c in self._pool:
                 c.close()
-            self._conns.clear()
+            self._pool.clear()
 
     def command(self, *parts):
-        c = self._conn()
-        c.send(*parts)
-        return c.read_reply()
+        c = self._acquire()
+        try:
+            c.send(*parts)
+            reply = c.read_reply()
+        except RespError:
+            # protocol-level error reply: the stream stayed in sync
+            self._release(c)
+            raise
+        except Exception:
+            # timeout / partial read / connection loss: the socket may hold
+            # a late reply that would answer the NEXT command — discard it
+            c.close()
+            raise
+        self._release(c)
+        return reply
 
     # -- the redis-py surface RedisBackend uses ------------------------------
     def ping(self) -> bool:

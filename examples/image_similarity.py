@@ -1,0 +1,73 @@
+"""Image similarity search (the reference's ``apps/image-similarity``
+notebook: real-estate images ranked by semantic similarity — a pretrained
+backbone's pooled features + cosine nearest neighbours, served per query).
+
+Flow (matching the notebook): build an Inception-v1 backbone → cut the
+graph at the global pooled features (``new_graph`` surgery, the same move
+the transfer-learning bench uses) → embed a gallery of images → for each
+query, return the top-k cosine neighbours. The synthetic gallery has known
+ground-truth groups (shared "scene prototype"), so retrieval quality is
+asserted, not eyeballed.
+
+Run:  python examples/image_similarity.py
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.models.image.imageclassification import ImageClassifier
+
+
+def make_gallery(n_groups=8, per_group=12, hw=112, seed=0):
+    rng = np.random.default_rng(seed)
+    protos = rng.normal(size=(n_groups, hw, hw, 3)).astype(np.float32)
+    xs, gids = [], []
+    for g in range(n_groups):
+        for _ in range(per_group):
+            xs.append(protos[g] + rng.normal(0, 0.35, protos[g].shape))
+            gids.append(g)
+    order = rng.permutation(len(xs))
+    return (np.asarray(xs, np.float32)[order],
+            np.asarray(gids, np.int32)[order])
+
+
+def main():
+    init_zoo_context()
+    x, gid = make_gallery()
+
+    m = ImageClassifier("inception-v1", num_classes=1000,
+                        input_shape=(112, 112, 3))
+    m.init_weights(sample_input=x[:2])
+    extractor = m.model.new_graph(["gap"])      # pooled 1024-d features
+
+    @jax.jit
+    def embed(params, state, xb):
+        feats, _ = extractor.apply(params, state, xb, training=False,
+                                   rng=None)
+        return feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+
+    emb = np.concatenate([
+        np.asarray(embed(m.params, m.net_state, jnp.asarray(x[i:i + 32])))
+        for i in range(0, len(x), 32)])
+
+    sims = emb @ emb.T
+    np.fill_diagonal(sims, -np.inf)
+    k = 5
+    topk = np.argsort(-sims, axis=1)[:, :k]
+    hit = (gid[topk] == gid[:, None]).mean()
+    print(f"gallery={len(x)} groups=8; top-{k} same-group precision={hit:.3f}")
+    assert hit > 0.8, hit
+
+    # per-query flow, the serving shape of the notebook
+    q = 3
+    neighbours = topk[q]
+    print(f"query {q} (group {gid[q]}): neighbour groups "
+          f"{gid[neighbours].tolist()}")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -203,6 +203,7 @@ def redis_port():
     """A live Redis-speaking TCP port: real redis-server if available, the
     mini server otherwise."""
     binary = shutil.which("redis-server")
+    started = False
     if binary:
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))
@@ -215,14 +216,17 @@ def redis_port():
                 try:
                     socket.create_connection(("127.0.0.1", port),
                                              timeout=0.1).close()
+                    started = True
                     break
                 except OSError:
                     time.sleep(0.05)
-            yield port
+            if started:
+                yield port
         finally:
             proc.terminate()
             proc.wait(timeout=10)
-    else:
+    if not started:
+        # no binary, or it failed to come up: the documented fake takes over
         srv = _MiniRedisServer(("127.0.0.1", 0))
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()

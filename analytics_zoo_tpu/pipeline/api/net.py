@@ -23,7 +23,7 @@ from .keras.layers import (Activation, BatchNormalization, Convolution2D,
                            Dense, Dropout, Embedding, Flatten, LayerNorm,
                            LeakyReLU, ZeroPadding2D)
 
-__all__ = ["Net", "TorchNet"]
+__all__ = ["Net", "TorchNet", "TorchCriterion"]
 
 
 def _np(t):
@@ -43,11 +43,23 @@ class TorchNet:
                  "Flatten, Dropout, Identity")
 
     @staticmethod
+    def _is(m, cls) -> bool:
+        """isinstance that also recognizes TorchScript RecursiveScriptModules
+        by their ``original_name`` (torch.jit.script preserves the
+        ``__constants__`` attributes the converters read; traced modules
+        lose them — see ``from_torchscript``)."""
+        if isinstance(cls, tuple):
+            return any(TorchNet._is(m, c) for c in cls)
+        if isinstance(m, cls):
+            return True
+        return getattr(m, "original_name", None) == cls.__name__
+
+    @staticmethod
     def from_module(module, input_shape: Sequence[int]) -> KerasNet:
         import torch.nn as nn
 
         mods = (list(module.children())
-                if isinstance(module, nn.Sequential) else [module])
+                if TorchNet._is(module, nn.Sequential) else [module])
         mods = TorchNet._flatten(mods, nn)
 
         shape = tuple(int(d) for d in input_shape)
@@ -72,7 +84,7 @@ class TorchNet:
     def _flatten(mods, nn) -> List[Any]:
         out = []
         for m in mods:
-            if isinstance(m, nn.Sequential):
+            if TorchNet._is(m, nn.Sequential):
                 out.extend(TorchNet._flatten(list(m.children()), nn))
             else:
                 out.append(m)
@@ -81,14 +93,14 @@ class TorchNet:
     # -- per-module conversion ---------------------------------------------
     @staticmethod
     def _convert(m, x, name, tshape, nn):
-        if isinstance(m, nn.Linear):
+        if TorchNet._is(m, nn.Linear):
             layer = Dense(m.out_features, bias=m.bias is not None, name=name)
             w = {"W": _np(m.weight).T}
             if m.bias is not None:
                 w["b"] = _np(m.bias)
             layer._pretrained = w
             return layer(x), (m.out_features,)
-        if isinstance(m, nn.Conv2d):
+        if TorchNet._is(m, nn.Conv2d):
             if m.groups != 1:
                 raise NotImplementedError(f"{name}: grouped torch Conv2d")
             if m.padding_mode != "zeros":
@@ -119,7 +131,7 @@ class TorchNet:
             else:
                 tshape = None
             return layer(x), tshape
-        if isinstance(m, (nn.BatchNorm1d, nn.BatchNorm2d)):
+        if TorchNet._is(m, (nn.BatchNorm1d, nn.BatchNorm2d)):
             if not m.track_running_stats:
                 raise NotImplementedError(
                     f"{name}: BatchNorm(track_running_stats=False) has no "
@@ -127,7 +139,7 @@ class TorchNet:
             # BatchNorm1d over a (N, C, L) stream normalizes axis 1; on a
             # 2D (N, C) stream the channel axis IS the last axis. Image
             # streams run NHWC here, so BatchNorm2d normalizes -1.
-            axis = 1 if (isinstance(m, nn.BatchNorm1d) and tshape is not None
+            axis = 1 if (TorchNet._is(m, nn.BatchNorm1d) and tshape is not None
                          and len(tshape) == 2) else -1
             layer = BatchNormalization(epsilon=m.eps, axis=axis,
                                        scale=m.affine, center=m.affine,
@@ -138,26 +150,26 @@ class TorchNet:
             layer._pretrained_state = {"moving_mean": _np(m.running_mean),
                                        "moving_var": _np(m.running_var)}
             return layer(x), tshape
-        if isinstance(m, nn.LayerNorm):
+        if TorchNet._is(m, nn.LayerNorm):
             layer = LayerNorm(epsilon=m.eps, name=name)
             if m.elementwise_affine:
                 layer._pretrained = {"gamma": _np(m.weight),
                                      "beta": _np(m.bias)}
             return layer(x), tshape
-        if isinstance(m, nn.Embedding):
+        if TorchNet._is(m, nn.Embedding):
             layer = Embedding(m.num_embeddings, m.embedding_dim, name=name)
             layer._pretrained = {"embeddings": _np(m.weight)}
             return layer(x), (tshape + (m.embedding_dim,)
                               if tshape is not None else None)
-        if isinstance(m, nn.ReLU):
+        if TorchNet._is(m, nn.ReLU):
             return Activation("relu", name=name)(x), tshape
-        if isinstance(m, nn.LeakyReLU):
+        if TorchNet._is(m, nn.LeakyReLU):
             return LeakyReLU(m.negative_slope, name=name)(x), tshape
-        if isinstance(m, nn.Sigmoid):
+        if TorchNet._is(m, nn.Sigmoid):
             return Activation("sigmoid", name=name)(x), tshape
-        if isinstance(m, nn.Tanh):
+        if TorchNet._is(m, nn.Tanh):
             return Activation("tanh", name=name)(x), tshape
-        if isinstance(m, nn.Softmax):
+        if TorchNet._is(m, nn.Softmax):
             # native softmax runs over the LAST axis; reject anything else
             last = len(tshape) if tshape is not None else None
             if m.dim not in (-1, last):
@@ -165,12 +177,12 @@ class TorchNet:
                     f"{name}: Softmax(dim={m.dim}) — only the last axis "
                     f"maps onto the native layer")
             return Activation("softmax", name=name)(x), tshape
-        if isinstance(m, nn.GELU):
+        if TorchNet._is(m, nn.GELU):
             import jax
             approx = getattr(m, "approximate", "none") == "tanh"
             return Lambda(lambda t, a=approx: jax.nn.gelu(t, approximate=a),
                           name=name)(x), tshape
-        if isinstance(m, nn.MaxPool2d) or isinstance(m, nn.AvgPool2d):
+        if TorchNet._is(m, nn.MaxPool2d) or TorchNet._is(m, nn.AvgPool2d):
             from .keras.layers import AveragePooling2D, MaxPooling2D
             k = (m.kernel_size if isinstance(m.kernel_size, tuple)
                  else (m.kernel_size, m.kernel_size))
@@ -184,14 +196,14 @@ class TorchNet:
                 raise NotImplementedError(f"{name}: dilated pooling")
             if getattr(m, "return_indices", False):
                 raise NotImplementedError(f"{name}: return_indices pooling")
-            if isinstance(m, nn.AvgPool2d) and not m.count_include_pad:
+            if TorchNet._is(m, nn.AvgPool2d) and not m.count_include_pad:
                 raise NotImplementedError(
                     f"{name}: AvgPool2d(count_include_pad=False)")
             if p != (0, 0):
                 # zero-pad + valid pool = torch floor-mode semantics with
                 # count_include_pad=True (the torch default)
                 x = ZeroPadding2D(p, name=f"{name}_pad")(x)
-            pool_cls = (MaxPooling2D if isinstance(m, nn.MaxPool2d)
+            pool_cls = (MaxPooling2D if TorchNet._is(m, nn.MaxPool2d)
                         else AveragePooling2D)
             node = pool_cls(k, strides=s, border_mode="valid", name=name)(x)
             if tshape is not None and len(tshape) == 3:
@@ -201,7 +213,7 @@ class TorchNet:
             else:
                 tshape = None
             return node, tshape
-        if isinstance(m, nn.AdaptiveAvgPool2d):
+        if TorchNet._is(m, nn.AdaptiveAvgPool2d):
             out_sz = m.output_size
             if out_sz not in (1, (1, 1)):
                 raise NotImplementedError(f"{name}: adaptive pool to "
@@ -210,7 +222,7 @@ class TorchNet:
             node = GlobalAveragePooling2D(name=name)(x)
             return node, ((tshape[0],) if tshape is not None
                           and len(tshape) == 3 else None)
-        if isinstance(m, nn.Flatten):
+        if TorchNet._is(m, nn.Flatten):
             if (m.start_dim, m.end_dim) != (1, -1):
                 raise NotImplementedError(
                     f"{name}: Flatten(start_dim={m.start_dim}, "
@@ -223,9 +235,9 @@ class TorchNet:
                            name=f"{name}_nchw")(x)
             flat = (int(np.prod(tshape)),) if tshape is not None else None
             return Flatten(name=name)(x), flat
-        if isinstance(m, nn.Dropout):
+        if TorchNet._is(m, nn.Dropout):
             return Dropout(m.p, name=name)(x), tshape
-        if isinstance(m, nn.Identity):
+        if TorchNet._is(m, nn.Identity):
             return x, tshape
         raise NotImplementedError(
             f"torch module {type(m).__name__} not supported; supported: "
@@ -281,10 +293,13 @@ class Net:
 
     @staticmethod
     def load_torch(module, input_shape: Sequence[int]) -> KerasNet:
-        """An in-memory ``torch.nn`` module (the reference loads
-        TorchScript files; in-process conversion covers the same
-        workflow without a serialization detour)."""
-        model = TorchNet.from_module(module, input_shape)
+        """An in-memory ``torch.nn`` module OR a TorchScript file path
+        (``Net.loadTorch`` / ``TorchNet.scala:39`` — the reference loads
+        serialized TorchScript; scripted files convert here too)."""
+        if isinstance(module, (str, bytes)):
+            model = TorchNet.from_torchscript(module, input_shape)
+        else:
+            model = TorchNet.from_module(module, input_shape)
         return _install_pretrained(model)
 
     @staticmethod
@@ -307,3 +322,143 @@ class Net:
         from .tfnet import load_tf
         return load_tf(path, inputs=inputs, outputs=outputs,
                        trainable=trainable)
+
+
+# TorchScript file loading (``TorchNet.scala:39``: the reference executes
+# serialized TorchScript via libtorch JNI; here the module tree converts to
+# native layers like from_module, so the import jits/shards/fine-tunes)
+def _torchnet_from_torchscript(path_or_module,
+                               input_shape: Sequence[int]) -> KerasNet:
+    """Load a ``torch.jit.save``d module file and convert it.
+
+    Works with ``torch.jit.script``-ed modules (scripting preserves the
+    ``__constants__`` attributes — kernel sizes, strides, eps — the
+    converters read). ``torch.jit.trace``-d modules drop those attributes
+    into the graph; they fail with a clear message."""
+    import torch
+
+    m = (torch.jit.load(path_or_module, map_location="cpu")
+         if isinstance(path_or_module, (str, bytes)) else path_or_module)
+    try:
+        return TorchNet.from_module(m, input_shape)
+    except AttributeError as e:
+        raise NotImplementedError(
+            f"TorchScript module is missing a converter attribute ({e}) — "
+            f"traced modules lose their __constants__; re-export with "
+            f"torch.jit.script, or pass the live nn.Module") from e
+
+
+TorchNet.from_torchscript = staticmethod(_torchnet_from_torchscript)
+
+
+class TorchCriterion:
+    """``TorchCriterion.scala`` role — bring a torch LOSS into compile().
+
+    The reference executes the torch loss via JNI each step; here the loss
+    TRANSLATES onto native jax math once (so it jits into the train step):
+    pass a ``torch.nn`` loss module, its class name, or a TorchScript file
+    of one. Supported: MSELoss, L1Loss, SmoothL1Loss, CrossEntropyLoss
+    (logits + int labels), NLLLoss (log-probs + int labels), BCELoss,
+    BCEWithLogitsLoss — ``reduction`` mean/sum matches torch exactly
+    (mean/sum over ELEMENTS for the elementwise losses, over examples for
+    the class-indexed ones).
+
+    >>> model.compile(optimizer="adam", loss=TorchCriterion(nn.MSELoss()))
+    """
+
+    def __init__(self, loss):
+        import os
+        if isinstance(loss, bytes):
+            loss = loss.decode()
+        if isinstance(loss, str) and (loss.endswith((".pt", ".pth"))
+                                      or os.path.exists(loss)):
+            import torch
+            loss = torch.jit.load(loss, map_location="cpu")
+        name = (loss if isinstance(loss, str)
+                else getattr(loss, "original_name", None)
+                or type(loss).__name__)
+        reduction = getattr(loss, "reduction", "mean")
+        if reduction not in ("mean", "sum"):
+            raise NotImplementedError(
+                f"TorchCriterion: reduction={reduction!r} (mean|sum)")
+        # options the translation does NOT carry must refuse, not silently
+        # train a different objective than the torch loss handed in
+        for attr, neutral in (("weight", None), ("pos_weight", None),
+                              ("ignore_index", -100),
+                              ("label_smoothing", 0.0)):
+            val = getattr(loss, attr, neutral)
+            non_neutral = (val is not None if neutral is None
+                           else val is not None and float(val) != neutral)
+            if non_neutral:
+                raise NotImplementedError(
+                    f"TorchCriterion: {name}({attr}={val!r}) is not "
+                    f"translated; drop the option or use a native loss")
+        table = {
+            "MSELoss": self._mse,
+            "L1Loss": self._l1,
+            "SmoothL1Loss": self._smooth_l1(getattr(loss, "beta", 1.0)),
+            "CrossEntropyLoss": self._ce_from_logits,
+            "NLLLoss": self._nll,
+            "BCELoss": self._bce,
+            "BCEWithLogitsLoss": self._bce_logits,
+        }
+        if name not in table:
+            raise NotImplementedError(
+                f"TorchCriterion: unsupported torch loss {name!r}; "
+                f"supported: {sorted(table)}")
+        self.name = name
+        self.reduction = reduction
+        self._unreduced = table[name]
+
+    # -- unreduced forms ----------------------------------------------------
+    @staticmethod
+    def _mse(yt, yp):
+        return (yp - yt.astype(yp.dtype)) ** 2
+
+    @staticmethod
+    def _l1(yt, yp):
+        import jax.numpy as jnp
+        return jnp.abs(yp - yt.astype(yp.dtype))
+
+    @staticmethod
+    def _smooth_l1(beta):
+        import jax.numpy as jnp
+
+        def fn(yt, yp):
+            d = jnp.abs(yp - yt.astype(yp.dtype))
+            return jnp.where(d < beta, 0.5 * d ** 2 / beta, d - 0.5 * beta)
+        return fn
+
+    @staticmethod
+    def _bce(yt, yp):
+        import jax.numpy as jnp
+        ytf = yt.astype(yp.dtype)
+        return -(ytf * jnp.log(jnp.clip(yp, 1e-7, 1.0))
+                 + (1 - ytf) * jnp.log(jnp.clip(1 - yp, 1e-7, 1.0)))
+
+    @staticmethod
+    def _bce_logits(yt, yp):
+        import jax.numpy as jnp
+        ytf = yt.astype(yp.dtype)
+        return (jnp.maximum(yp, 0) - yp * ytf
+                + jnp.log1p(jnp.exp(-jnp.abs(yp))))
+
+    @staticmethod
+    def _ce_from_logits(yt, yp):
+        import jax
+        import jax.numpy as jnp
+        logp = jax.nn.log_softmax(yp, axis=-1)
+        return -jnp.take_along_axis(
+            logp, yt.astype(jnp.int32).reshape(-1, 1), axis=-1)[:, 0]
+
+    @staticmethod
+    def _nll(yt, yp):
+        import jax.numpy as jnp
+        return -jnp.take_along_axis(
+            yp, yt.astype(jnp.int32).reshape(-1, 1), axis=-1)[:, 0]
+
+    def __call__(self, y_true, y_pred):
+        import jax.numpy as jnp
+        un = self._unreduced(y_true, y_pred)
+        return jnp.sum(un) if self.reduction == "sum" else jnp.mean(un)
+

@@ -1,0 +1,151 @@
+"""TorchScript FILE loading + TorchCriterion (reference
+``TorchNet.scala:39`` loads serialized TorchScript via JNI;
+``TorchCriterion.scala`` wraps torch losses) — torch itself is the
+numerical oracle."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.net import Net, TorchCriterion, TorchNet
+
+RTOL, ATOL = 2e-4, 2e-5
+
+
+@pytest.fixture(autouse=True)
+def _ctx():
+    init_zoo_context()
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(6, 16), nn.ReLU(),
+                         nn.Linear(16, 4), nn.Softmax(dim=-1))
+
+
+def test_scripted_file_matches_torch(tmp_path):
+    tm = _mlp()
+    path = str(tmp_path / "mlp.pt")
+    torch.jit.save(torch.jit.script(tm), path)
+    x = np.random.default_rng(0).normal(size=(5, 6)).astype(np.float32)
+    want = tm(torch.from_numpy(x)).detach().numpy()
+
+    net = Net.load_torch(path, input_shape=(6,))
+    got = np.asarray(net.predict(x, batch_size=5))
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+def test_scripted_cnn_matches_torch(tmp_path):
+    tm = nn.Sequential(
+        nn.Conv2d(3, 8, 3, stride=1, padding=1), nn.BatchNorm2d(8),
+        nn.ReLU(), nn.MaxPool2d(2), nn.Flatten(), nn.Linear(8 * 4 * 4, 5))
+    tm.eval()
+    path = str(tmp_path / "cnn.pt")
+    torch.jit.save(torch.jit.script(tm), path)
+    x = np.random.default_rng(1).normal(size=(3, 3, 8, 8)).astype(np.float32)
+    want = tm(torch.from_numpy(x)).detach().numpy()
+
+    net = Net.load_torch(path, input_shape=(3, 8, 8))
+    got = np.asarray(net.predict(np.transpose(x, (0, 2, 3, 1)),
+                                 batch_size=4))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_traced_module_clear_error(tmp_path):
+    tm = _mlp()
+    path = str(tmp_path / "traced.pt")
+    torch.jit.save(torch.jit.trace(tm, torch.zeros(1, 6)), path)
+    with pytest.raises(NotImplementedError, match="torch.jit.script"):
+        Net.load_torch(path, input_shape=(6,))
+
+
+def test_scripted_file_finetunes(tmp_path):
+    import optax
+    tm = _mlp()
+    path = str(tmp_path / "ft.pt")
+    torch.jit.save(torch.jit.script(tm), path)
+    net = Net.load_torch(path, input_shape=(6,))
+    net.compile(optimizer=optax.adam(1e-2), loss="scce")
+    rng = np.random.default_rng(2)
+    w = rng.normal(size=(6, 4))
+    x = rng.normal(size=(256, 6)).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.int32)
+    h = net.fit(x, y, batch_size=32, nb_epoch=5)
+    assert h["loss"][-1] < h["loss"][0]
+
+
+# ---------------------------------------------------------------------------
+# TorchCriterion vs torch
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("loss_cls,pred_kind", [
+    (nn.MSELoss, "float"), (nn.L1Loss, "float"),
+    (nn.SmoothL1Loss, "float"), (nn.BCELoss, "prob"),
+    (nn.BCEWithLogitsLoss, "float"),
+])
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_elementwise_criteria_match_torch(loss_cls, pred_kind, reduction):
+    rng = np.random.default_rng(3)
+    yp = rng.normal(size=(8, 5)).astype(np.float32)
+    if pred_kind == "prob":
+        yp = 1 / (1 + np.exp(-yp))
+    yt = (rng.random((8, 5)) > 0.5).astype(np.float32)
+    tl = loss_cls(reduction=reduction)
+    want = float(tl(torch.from_numpy(yp), torch.from_numpy(yt)))
+    crit = TorchCriterion(tl)
+    got = float(crit(jnp.asarray(yt), jnp.asarray(yp)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_class_criteria_match_torch(reduction):
+    rng = np.random.default_rng(4)
+    logits = rng.normal(size=(8, 5)).astype(np.float32)
+    y = rng.integers(0, 5, 8)
+    want_ce = float(nn.CrossEntropyLoss(reduction=reduction)(
+        torch.from_numpy(logits), torch.from_numpy(y)))
+    got_ce = float(TorchCriterion(nn.CrossEntropyLoss(reduction=reduction))(
+        jnp.asarray(y), jnp.asarray(logits)))
+    np.testing.assert_allclose(got_ce, want_ce, rtol=1e-5, atol=1e-6)
+
+    logp = F.log_softmax(torch.from_numpy(logits), dim=-1)
+    want_nll = float(nn.NLLLoss(reduction=reduction)(
+        logp, torch.from_numpy(y)))
+    got_nll = float(TorchCriterion(nn.NLLLoss(reduction=reduction))(
+        jnp.asarray(y), jnp.asarray(logp.numpy())))
+    np.testing.assert_allclose(got_nll, want_nll, rtol=1e-5, atol=1e-6)
+
+
+def test_criterion_in_compile_fit():
+    import optax
+
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(128, 6)).astype(np.float32)
+    yt = (x @ rng.normal(size=(6, 1))).astype(np.float32)
+    m = Sequential([Dense(8, activation="relu", input_shape=(6,)),
+                    Dense(1)])
+    m.compile(optimizer=optax.adam(1e-2),
+              loss=TorchCriterion(nn.SmoothL1Loss()))
+    h = m.fit(x, yt, batch_size=32, nb_epoch=6)
+    assert h["loss"][-1] < h["loss"][0] * 0.6
+
+
+def test_criterion_scripted_loss_file(tmp_path):
+    path = str(tmp_path / "loss.pt")
+    torch.jit.save(torch.jit.script(nn.MSELoss()), path)
+    crit = TorchCriterion(path)
+    assert crit.name == "MSELoss"
+    yp = jnp.asarray([[1.0, 2.0]]); yt = jnp.asarray([[0.0, 0.0]])
+    np.testing.assert_allclose(float(crit(yt, yp)), 2.5)
+
+
+def test_criterion_unknown_loss_message():
+    with pytest.raises(NotImplementedError, match="supported"):
+        TorchCriterion(nn.KLDivLoss())

@@ -27,7 +27,14 @@ class GPipe(Layer):
 
     ``stage_factory()`` builds ONE stage (e.g. ``lambda:
     TransformerBlock(8, 2)``); stages must preserve shape (input == output,
-    the transformer-stack case PP exists for) and be stateless. On a
+    the transformer-stack case PP exists for) and be stateless.
+
+    REAL models pipeline by composition: put the heterogeneous edges
+    OUTSIDE the GPipe layer — ``Sequential([Embedding, GPipe(block, S),
+    LayerNorm, head])`` — and only the homogeneous stack rides the
+    schedule while the edges replicate over ``pipe`` (the same split
+    praxis-style TPU pipelining uses; equality-tested vs pure DP in
+    ``test_pipeline_parallel.py::test_real_model_with_embedding_front_and_head_pipelines``). On a
     ``pipe=P`` mesh (``num_stages`` a multiple of P) each rank owns
     ``num_stages/P`` consecutive stages, applied back-to-back per tick,
     and microbatches flow through the GPipe schedule; on a ``pipe=1`` mesh

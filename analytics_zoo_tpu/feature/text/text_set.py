@@ -3,7 +3,7 @@ text collections) and its transformer chain:
 
 * ``read`` (``TextSet.scala:290``): per-class-subdirectory corpus or
   in-memory (text, label) pairs; ``read_csv``/``read_parquet``
-  (``TextSet.scala:345,372``) become ``from_csv``.
+  (``TextSet.scala:345,372``) become ``from_csv``/``from_parquet``.
 * ``tokenize`` (``TextSet.scala:97`` → ``Tokenizer.scala``) and
   ``normalize`` (``Normalizer.scala``): host-side string ops.
 * ``word2idx`` (``TextSet.scala:147`` → ``WordIndexer.scala``): frequency
@@ -121,6 +121,35 @@ class TextSet:
                 label = row.get(label_col)
                 feats.append(TextFeature(
                     row[text_col], int(label) if label not in (None, "") else None))
+        return TextSet(feats)
+
+    @staticmethod
+    def from_parquet(path: str, text_col: str = "text",
+                     label_col: str = "label") -> "TextSet":
+        """``readParquet`` (``TextSet.scala:372``) — columnar corpora via
+        pyarrow (present in this environment; a clear error otherwise)."""
+        try:
+            import pyarrow.parquet as pq
+        except ImportError as e:  # pragma: no cover - env without pyarrow
+            raise ImportError(
+                "TextSet.from_parquet needs pyarrow; install it or convert "
+                "the corpus to csv for TextSet.from_csv") from e
+        cols = set(pq.read_schema(path).names)
+        if text_col not in cols:
+            raise ValueError(f"{path}: no column {text_col!r} "
+                             f"(have {sorted(cols)})")
+        wanted = [text_col] + ([label_col] if label_col in cols else [])
+        table = pq.read_table(path, columns=wanted)  # skip unused columns
+        texts = table.column(text_col).to_pylist()
+        labels = (table.column(label_col).to_pylist()
+                  if label_col in cols else [None] * len(texts))
+        feats = []
+        for i, (t, l) in enumerate(zip(texts, labels)):
+            if t is None:
+                raise ValueError(
+                    f"{path}: null text at row {i} — clean the corpus or "
+                    f"drop null rows before loading")
+            feats.append(TextFeature(t, None if l is None else int(l)))
         return TextSet(feats)
 
     # ---- protocol ---------------------------------------------------------

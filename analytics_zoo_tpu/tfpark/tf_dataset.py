@@ -142,6 +142,27 @@ class TFDataset:
         return cls(fs.x, fs.y, batch_size=batch_size,
                    batch_per_thread=batch_per_thread)
 
+    @classmethod
+    def from_image_set(cls, image_set, batch_size: int = -1,
+                       batch_per_thread: int = -1) -> "TFDataset":
+        """``TFDataset.from_image_set`` role: a (possibly transformed)
+        ``feature.image.ImageSet`` becomes the feed — dense image batch +
+        labels when present."""
+        x = image_set.to_array()
+        y = getattr(image_set, "labels", None)
+        return cls(x, y, batch_size=batch_size,
+                   batch_per_thread=batch_per_thread)
+
+    @classmethod
+    def from_text_set(cls, text_set, batch_size: int = -1,
+                      batch_per_thread: int = -1) -> "TFDataset":
+        """``TFDataset.from_text_set`` role: a processed
+        ``feature.text.TextSet`` (tokenize/word2idx/shape_sequence already
+        applied) becomes the feed."""
+        x, y = text_set.to_arrays()
+        return cls(x, y, batch_size=batch_size,
+                   batch_per_thread=batch_per_thread)
+
     @staticmethod
     def _split_xy(tensors):
         """A 2-TUPLE means (features, labels); use a list for a plain

@@ -197,3 +197,24 @@ def test_bucketed_guards():
     finally:
         reset_zoo_context()
         init_zoo_context()
+
+
+def test_from_parquet_roundtrip(tmp_path):
+    """``readParquet`` parity (``TextSet.scala:372``) via pyarrow."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    from analytics_zoo_tpu.feature.text import TextSet
+
+    path = str(tmp_path / "corpus.parquet")
+    table = pa.table({"text": ["good film", "bad film", "fine film"],
+                      "label": [1, 0, 1]})
+    pq.write_table(table, path)
+    ts = TextSet.from_parquet(path)
+    assert len(ts) == 3
+    assert ts.labels.tolist() == [1, 0, 1]
+    arr, y = ts.tokenize().word2idx().shape_sequence(4).to_arrays()
+    assert arr.shape == (3, 4)
+
+    with pytest.raises(ValueError, match="no column"):
+        TextSet.from_parquet(path, text_col="nope")

@@ -275,3 +275,25 @@ def test_keras_model_save_load_model(tmp_path):
                                np.asarray(ref), rtol=1e-5, atol=1e-6)
     # the original wrapper still trains after the save (state restored)
     km.fit(x, y, batch_size=32, epochs=1)
+
+
+def test_tfdataset_from_image_and_text_sets():
+    init_zoo_context()
+    from analytics_zoo_tpu.feature.image import ImageSet, Resize
+    from analytics_zoo_tpu.feature.text import TextSet
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (6, 10, 8, 3)).astype(np.uint8)
+    iset = ImageSet.from_arrays(imgs, labels=np.arange(6) % 2)
+    iset = iset.transform(Resize(8, 8))
+    ds = TFDataset.from_image_set(iset, batch_per_thread=2)
+    assert ds.n_examples == 6
+    assert ds.tensor_structure.shape == (8, 8, 3)
+    assert ds.label_arrays() is not None
+
+    ts = (TextSet.from_texts(["a b c", "c d", "a d e"],
+                             np.asarray([0, 1, 0], np.int32))
+          .tokenize().word2idx().shape_sequence(4))
+    ds2 = TFDataset.from_text_set(ts, batch_per_thread=1)
+    assert ds2.n_examples == 3
+    assert ds2.tensor_structure.shape == (4,)

@@ -38,6 +38,20 @@ def _as_list(x) -> List[np.ndarray]:
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+def _keep(a):
+    """Host-or-device array normalization. Device-resident ``jax.Array``s
+    stay on device — ``np.asarray`` would drag them back through the
+    host (a full HBM→host readback on tunneled devices), which matters
+    for extract→fit chains where one model's jitted output feeds another
+    model's training (the reference's frozen-backbone transfer-learning
+    flow keeps features in executor RAM the same way,
+    ``FeatureSet.scala:222-322``). Host-side batch slicing converts ONCE
+    via ``_host_xs`` below, never per batch."""
+    if isinstance(a, jax.Array):
+        return a
+    return np.asarray(a)
+
+
 class FeatureSet:
     """In-memory (host-RAM) cached dataset of ``x`` (array or list of arrays)
     and optional ``y``. One instance per host process; under multi-host each
@@ -45,14 +59,14 @@ class FeatureSet:
     per-partition caches."""
 
     def __init__(self, x, y=None, shuffle: bool = True, seed: int = 0):
-        self.xs = [np.asarray(a) for a in _as_list(x)]
+        self.xs = [_keep(a) for a in _as_list(x)]
         if not self.xs:
             raise ValueError("FeatureSet needs at least one feature array")
         n = self.xs[0].shape[0]
         for a in self.xs:
             if a.shape[0] != n:
                 raise ValueError("feature arrays disagree on leading dim")
-        self.y = None if y is None else np.asarray(y)
+        self.y = None if y is None else _keep(y)
         if self.y is not None and self.y.shape[0] != n:
             raise ValueError("labels disagree with features on leading dim")
         self.shuffle = shuffle
@@ -92,11 +106,26 @@ class FeatureSet:
         ``featureSet.transform(preprocessing)`` step of the reference
         (cache-after-transform, ``FeatureSet.scala:222-322``). ``fn`` receives
         ``(x, y)`` and returns ``(x', y')``."""
-        out = fn((self.x, self.y))
+        xs, y = self._host_view()
+        out = fn((xs if len(xs) > 1 else xs[0], y))
         x2, y2 = out
         return FeatureSet(x2, y2, shuffle=self.shuffle, seed=self.seed)
 
     # ---- iterators --------------------------------------------------------
+    def _host_view(self):
+        """Numpy copies of device-resident arrays, materialized ONCE and
+        memoized — the host slicing below must not re-read HBM per batch.
+        ``xs``/``y`` are read exactly once: subclasses make them properties
+        backed by full-file disk gathers (DiskFeatureSet)."""
+        xs, y = self.xs, self.y
+        if not any(isinstance(a, jax.Array)
+                   for a in xs + ([y] if y is not None else [])):
+            return xs, y
+        if getattr(self, "_host_xs", None) is None:
+            self._host_xs = [np.asarray(a) for a in xs]
+            self._host_y = None if y is None else np.asarray(y)
+        return self._host_xs, self._host_y
+
     def _order(self, epoch: int) -> np.ndarray:
         n = len(self)
         if not self.shuffle:
@@ -104,9 +133,10 @@ class FeatureSet:
         return np.random.default_rng(self.seed + epoch).permutation(n)
 
     def _slice(self, idx) -> Tuple[Any, Any]:
-        bx = [a[idx] for a in self.xs]
+        xs, y = self._host_view()
+        bx = [a[idx] for a in xs]
         bx = bx if len(bx) > 1 else bx[0]
-        by = None if self.y is None else self.y[idx]
+        by = None if y is None else y[idx]
         return bx, by
 
     def iter_batches(self, batch_size: int, *, epoch: int = 0,
@@ -135,7 +165,7 @@ class FeatureSet:
     def sample(self, n: int):
         """First ``n`` records — shape/dtype probing (e.g. lazy weight
         init) without materializing more than ``n`` rows."""
-        bx = [a[:n] for a in self.xs]
+        bx = [np.asarray(a[:n]) for a in self.xs]
         return bx if len(bx) > 1 else bx[0]
 
 

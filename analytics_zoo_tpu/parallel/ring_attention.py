@@ -1,14 +1,25 @@
-"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+"""Ring + Ulysses attention — sequence/context parallelism over the ``seq``
+mesh axis.
 
 The reference has NO long-context mechanism (SURVEY §5: sequences are padded
 to one core's memory, attention is plain full self-attention inside
 ``TransformerLayer.scala``/``BERT.scala:66``), so this is greenfield TPU
-design: the sequence dim is sharded over the ``seq`` axis, each device holds
-its Q/K/V block, and K/V blocks rotate around the ring via ``ppermute`` while
-a numerically-stable online softmax accumulates output blocks — attention
-memory per device is O(T/seq_shards * T_block) and the ppermute rides ICI
-(the blockwise/ring attention construction of Liu et al., re-derived for
-``shard_map``).
+design. Two routings, both under ``shard_map``:
+
+* **Ring** (``ring_self_attention``): the sequence dim stays sharded, each
+  device holds its Q/K/V block, and K/V blocks rotate around the ring via
+  ``ppermute`` while a numerically-stable online softmax accumulates output
+  blocks — attention memory per device is O(T/seq_shards * T_block) and the
+  ppermute rides ICI (the blockwise/ring attention construction of Liu et
+  al., re-derived for ``shard_map``). Key-padding masks stream WITH the ring:
+  each rank's (B, T_local) mask slice rotates alongside its K/V block, so
+  BERT-shaped masked models ride the seq mesh too (VERDICT r4 missing #1).
+* **Ulysses** (``ulysses_self_attention``): an all-to-all re-shards heads
+  over the seq axis (H/n heads, FULL sequence per device), attention runs as
+  one dense local op on the MXU, and a second all-to-all restores the
+  sequence sharding. Two collectives total instead of the ring's n-1
+  ppermutes — the better trade when n_head divides over the axis and the
+  full-T score block fits HBM.
 
 Math (flash-style streaming softmax, all in float32): for each incoming K/V
 block, s = q·k/sqrt(d); m' = max(m, max_allowed(s)); o = o*exp(m-m') +
@@ -27,17 +38,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
 
-__all__ = ["ring_attention", "ring_self_attention"]
+__all__ = ["ring_attention", "ring_self_attention", "ulysses_self_attention"]
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
-                   causal: bool = False) -> jax.Array:
+                   causal: bool = False,
+                   kv_mask: Optional[jax.Array] = None) -> jax.Array:
     """Blockwise ring attention INSIDE a ``shard_map`` over ``axis_name``.
 
     q, k, v: local blocks (B, H, T_local, D) — the sequence dim is sharded
-    over ``axis_name``. Returns the local output block (B, H, T_local, D).
-    ``causal`` masks with GLOBAL positions (block i attends to block j<=i,
-    and within the diagonal block the usual triangular mask).
+    over ``axis_name``. ``kv_mask``: this rank's (B, T_local) key-padding
+    slice (True/1 = attend); it rotates with the K/V blocks. Returns the
+    local output block (B, H, T_local, D). ``causal`` masks with GLOBAL
+    positions (block i attends to block j<=i, and within the diagonal block
+    the usual triangular mask).
     """
     n_shards = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -47,8 +61,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
 
     q_pos = my_idx * t_local + jnp.arange(t_local)          # global q rows
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    mask_blk0 = (None if kv_mask is None
+                 else kv_mask.astype(jnp.bool_))
 
-    def accumulate(o, m, l, k_blk, v_blk, i):
+    def accumulate(o, m, l, k_blk, v_blk, mask_blk, i):
         src = (my_idx - i) % n_shards                       # block owner
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
         s = s * scale
@@ -58,6 +74,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
             allowed = allowed[None, None]
         else:
             allowed = jnp.ones((1, 1, t_local, t_local), jnp.bool_)
+        if mask_blk is not None:
+            allowed = allowed & mask_blk[:, None, None, :]  # (B, 1, 1, Tk)
         s_masked = jnp.where(allowed, s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s_masked, axis=-1, keepdims=True))
         # exp(-inf - finite) = 0 handles both masked entries and the
@@ -72,40 +90,100 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
         return o, m_new, l
 
     def step(carry, i):
-        o, m, l, k_blk, v_blk = carry
-        o, m, l = accumulate(o, m, l, k_blk, v_blk, i)
+        o, m, l, k_blk, v_blk, mask_blk = carry
+        o, m, l = accumulate(o, m, l, k_blk, v_blk, mask_blk, i)
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (o, m, l, k_blk, v_blk), None
+        if mask_blk is not None:
+            mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
+        return (o, m, l, k_blk, v_blk, mask_blk), None
 
     o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
     m0 = jnp.full((b, h, t_local, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
     # scan rotates K/V after each accumulation; the LAST block is folded in
     # outside the scan so the ring doesn't pay one final discarded ppermute
-    (o, m, l, k_last, v_last), _ = jax.lax.scan(
-        step, (o0, m0, l0, k, v), jnp.arange(n_shards - 1))
-    o, _, l = accumulate(o, m, l, k_last, v_last, n_shards - 1)
+    (o, m, l, k_last, v_last, mask_last), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v, mask_blk0), jnp.arange(n_shards - 1))
+    o, _, l = accumulate(o, m, l, k_last, v_last, mask_last, n_shards - 1)
     out = o / jnp.where(l == 0.0, 1.0, l)
     return out.astype(q.dtype)
 
 
+def _seq_specs(mask):
+    spec = P(mesh_lib.DATA_AXIS, None, mesh_lib.SEQ_AXIS, None)
+    mask_spec = P(mesh_lib.DATA_AXIS, mesh_lib.SEQ_AXIS)
+    in_specs = (spec, spec, spec) + ((mask_spec,) if mask is not None else ())
+    return spec, in_specs
+
+
 def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         mesh: Optional[Mesh] = None,
-                        causal: bool = False) -> jax.Array:
+                        causal: bool = False,
+                        mask: Optional[jax.Array] = None) -> jax.Array:
     """Entry point on GLOBAL arrays: q/k/v (B, H, T, D) with T sharded over
     the ``seq`` axis (and batch over ``data``); runs the ring under
-    ``shard_map``. T must divide evenly by the seq-axis size."""
+    ``shard_map``. ``mask``: global (B, T) key-padding mask (1 = attend),
+    sharded the same way — each rank streams its slice around the ring.
+    T must divide evenly by the seq-axis size."""
     mesh = mesh or mesh_lib.global_mesh()
     n_seq = mesh.shape[mesh_lib.SEQ_AXIS]
     t = q.shape[2]
     if t % max(n_seq, 1) != 0:
         raise ValueError(f"sequence length {t} not divisible by seq axis "
                          f"size {n_seq}")
-    spec = P(mesh_lib.DATA_AXIS, None, mesh_lib.SEQ_AXIS, None)
-    fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=mesh_lib.SEQ_AXIS,
-                          causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return fn(q, k, v)
+    spec, in_specs = _seq_specs(mask)
+
+    def local(*args):
+        qb, kb, vb = args[:3]
+        mb = args[3] if len(args) > 3 else None
+        return ring_attention(qb, kb, vb, axis_name=mesh_lib.SEQ_AXIS,
+                              causal=causal, kv_mask=mb)
+
+    fn = jax.shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=spec,
+                       check_vma=False)
+    return fn(q, k, v, mask) if mask is not None else fn(q, k, v)
+
+
+def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh: Optional[Mesh] = None,
+                           causal: bool = False,
+                           mask: Optional[jax.Array] = None) -> jax.Array:
+    """Ulysses-style sequence parallelism (SURVEY §5's head-vs-sequence
+    all-to-all): q/k/v (B, H, T, D) arrive sequence-sharded; an all-to-all
+    converts to head-sharded/full-sequence, attention runs as ONE dense
+    local op (the full (T, T) score block tiles straight onto the MXU), and
+    a second all-to-all restores the sequence sharding. n_head must divide
+    by the seq-axis size."""
+    mesh = mesh or mesh_lib.global_mesh()
+    n_seq = mesh.shape[mesh_lib.SEQ_AXIS]
+    t, h = q.shape[2], q.shape[1]
+    if t % max(n_seq, 1) != 0:
+        raise ValueError(f"sequence length {t} not divisible by seq axis "
+                         f"size {n_seq}")
+    if h % max(n_seq, 1) != 0:
+        raise ValueError(f"n_head {h} not divisible by seq axis size "
+                         f"{n_seq} — use ring attention instead")
+    spec, in_specs = _seq_specs(mask)
+    axis = mesh_lib.SEQ_AXIS
+
+    def local(*args):
+        qb, kb, vb = args[:3]
+        mb = args[3] if len(args) > 3 else None
+        # (B, H, T_local, D) -> (B, H_local, T, D): scatter heads, gather seq
+        a2a = functools.partial(jax.lax.all_to_all, axis_name=axis,
+                                split_axis=1, concat_axis=2, tiled=True)
+        qg, kg, vg = a2a(qb), a2a(kb), a2a(vb)
+        full_mask = None
+        if mb is not None:
+            full_mask = jax.lax.all_gather(
+                mb, axis, axis=1, tiled=True)[:, None, None, :]  # (B,1,1,T)
+        from ..ops.attention import dot_product_attention
+        og = dot_product_attention(qg, kg, vg, mask=full_mask, causal=causal)
+        # (B, H_local, T, D) -> (B, H, T_local, D): scatter seq, gather heads
+        return jax.lax.all_to_all(og, axis_name=axis, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    fn = jax.shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=spec,
+                       check_vma=False)
+    return fn(q, k, v, mask) if mask is not None else fn(q, k, v)

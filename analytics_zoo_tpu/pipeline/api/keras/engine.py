@@ -87,6 +87,17 @@ def param_dtype():
 _uid_counters: Dict[str, int] = collections.defaultdict(int)
 
 
+#: True while a layer/model shape-inference probe (``output_shape_for``'s
+#: ``eval_shape``) is running — probes use placeholder batch dims, so
+#: batch-dependent routing decisions (e.g. the seq-mesh divisibility check)
+#: must not warn or raise strict-mode errors off them.
+_in_shape_probe = False
+
+
+def in_shape_probe() -> bool:
+    return _in_shape_probe
+
+
 def unique_name(prefix: str) -> str:
     _uid_counters[prefix] += 1
     return f"{prefix}{_uid_counters[prefix]}"
@@ -218,12 +229,19 @@ class Layer:
     # ---- shape inference --------------------------------------------------
     def output_shape_for(self, params, state, input_shape):
         """Infer output shape via abstract evaluation (no FLOPs)."""
+        global _in_shape_probe
         spec = _shapes_to_specs(input_shape)
         rng = jax.random.key(0)
-        out = jax.eval_shape(
-            lambda p, s, x: self.apply(p, s, x, training=False, rng=rng)[0],
-            params, state, spec,
-        )
+        prev = _in_shape_probe
+        _in_shape_probe = True
+        try:
+            out = jax.eval_shape(
+                lambda p, s, x: self.apply(p, s, x, training=False,
+                                           rng=rng)[0],
+                params, state, spec,
+            )
+        finally:
+            _in_shape_probe = prev
         return jax.tree.map(lambda o: _spec_to_shape(o), out,
                             is_leaf=lambda o: isinstance(o, jax.ShapeDtypeStruct))
 

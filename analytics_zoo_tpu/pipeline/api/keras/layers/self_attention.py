@@ -157,13 +157,39 @@ class MultiHeadSelfAttention(Layer):
                              f" got {flag!r}")
         return bool(flag)
 
+    def _seq_fallback(self, reason: str, probe: bool = False):
+        """A seq mesh exists but this call can't ride it. Default: warn ONCE
+        — falling back to full O(T^2) attention at long-context scale is an
+        OOM surprise, not a detail. ``zoo.seq.strict=True``: raise instead
+        (VERDICT r4 weak #6 — a user who built a seq mesh must not silently
+        get zero sequence parallelism)."""
+        from .....common.context import get_zoo_context
+        try:
+            strict = bool(get_zoo_context().get("zoo.seq.strict", False))
+        except Exception:
+            strict = False
+        if strict and not probe:
+            raise RuntimeError(
+                f"{self.name}: zoo.seq.strict is set and {reason} — "
+                f"attention cannot ride the seq mesh (it would silently "
+                f"fall back to full XLA attention)")
+        if not getattr(self, "_warned_no_ring", False) and not probe:
+            import logging
+            logging.getLogger("analytics_zoo_tpu.attention").warning(
+                "%s: seq-axis mesh active but %s — full O(T^2) attention "
+                "for this layer (no sequence parallelism)", self.name,
+                reason)
+            self._warned_no_ring = True
+        return None
+
     def _ring_mesh(self, mask, drop, seq_len):
         """Sequence parallelism from the LAYER API: on a mesh with a ``seq``
-        axis, mask-free/dropout-free attention rotates KV blocks over ICI
-        (``parallel/ring_attention.py``) instead of gathering the full
-        sequence per chip — the long-context path (SURVEY §5). Padding
-        masks stay on the full XLA op (a masked ring needs per-block mask
-        rotation, not implemented)."""
+        axis, attention shards the sequence dim over ICI — KV-rotation ring
+        or Ulysses head/seq all-to-all (``parallel/ring_attention.py``) —
+        instead of gathering the full sequence per chip: the long-context
+        path (SURVEY §5). Key-padding masks (the BERT ``attention_mask``
+        form) stream with the ring / all-gather under Ulysses; genuinely
+        per-query masks and attention dropout stay on the full XLA op."""
         try:
             from .....parallel import mesh as mesh_lib
             mesh = mesh_lib.global_mesh()
@@ -172,34 +198,46 @@ class MultiHeadSelfAttention(Layer):
             return None
         if n_seq <= 1:
             return None
-        if mask is not None or drop > 0.0:
-            # a seq mesh exists but this call can't ride the ring — say so
-            # ONCE: falling back to full O(T^2) attention at long-context
-            # scale is an OOM surprise, not a detail (set attn_drop=0 /
-            # drop the padding mask to ring)
-            if not getattr(self, "_warned_no_ring", False):
-                import logging
-                logging.getLogger("analytics_zoo_tpu.attention").warning(
-                    "%s: seq-axis mesh active but %s keeps attention on the "
-                    "full XLA op (no sequence parallelism for this layer)",
-                    self.name,
-                    "a padding mask" if mask is not None else
-                    f"attn_drop={drop}")
-                self._warned_no_ring = True
-            return None
+        # shape-inference probes (placeholder batch dims) must neither warn
+        # nor raise strict errors — and must not burn the warn-once flag
+        # before the real call gets to warn
+        from ..engine import in_shape_probe
+        probe = in_shape_probe()
+        if drop > 0.0:
+            return self._seq_fallback(
+                f"attn_drop={drop} (in-ring attention dropout is not "
+                f"implemented; set attn_drop=0 to ride the seq mesh)",
+                probe=probe)
+        if mask is not None and self._kv_mask(mask) is None:
+            return self._seq_fallback(
+                "the mask is per-query (not reducible to (B, Tk) "
+                "key-padding form)", probe=probe)
         batch, t = seq_len  # (B, T): both must split over their axes
         if t % n_seq == 0 and batch % mesh.shape[mesh_lib.DATA_AXIS] == 0:
             return mesh
-        # batch > 1: the B=1 shape-inference probe is not a real call
-        if batch > 1 and not getattr(self, "_warned_no_ring", False):
-            import logging
-            logging.getLogger("analytics_zoo_tpu.attention").warning(
-                "%s: seq-axis mesh active but shapes can't split (T=%d over "
-                "seq=%d, B=%d over data=%d) — full O(T^2) attention for "
-                "this layer", self.name, t, n_seq, batch,
-                mesh.shape[mesh_lib.DATA_AXIS])
-            self._warned_no_ring = True
-        return None
+        return self._seq_fallback(
+            f"shapes can't split (T={t} over seq={n_seq}, B={batch} over "
+            f"data={mesh.shape[mesh_lib.DATA_AXIS]})", probe=probe)
+
+    def _seq_routing(self, n_seq: int) -> str:
+        """``zoo.seq.mode``: ``ring`` (default), ``ulysses``, or ``auto``
+        (ulysses when n_head divides the seq axis — two all-to-alls beat
+        n-1 ppermutes when the dense local score block fits)."""
+        from .....common.context import get_zoo_context
+        try:
+            mode = str(get_zoo_context().get("zoo.seq.mode", "ring")).lower()
+        except Exception:
+            mode = "ring"
+        if mode not in ("ring", "ulysses", "auto"):
+            raise ValueError(f"zoo.seq.mode must be ring|ulysses|auto, "
+                             f"got {mode!r}")
+        if mode == "ulysses" and self.n_head % n_seq != 0:
+            raise ValueError(
+                f"zoo.seq.mode=ulysses needs n_head ({self.n_head}) "
+                f"divisible by the seq axis ({n_seq})")
+        if mode == "auto":
+            mode = "ulysses" if self.n_head % n_seq == 0 else "ring"
+        return mode
 
     def call(self, params, x, *, training=False, rng=None):
         mask = None
@@ -215,9 +253,18 @@ class MultiHeadSelfAttention(Layer):
         drop = self.attn_drop if training else 0.0
         ring_mesh = self._ring_mesh(mask, drop, (qh.shape[0], qh.shape[2]))
         if ring_mesh is not None:
-            from .....parallel.ring_attention import ring_self_attention
-            out = ring_self_attention(qh, kh, vh, mesh=ring_mesh,
-                                      causal=self.causal)
+            from .....parallel import mesh as mesh_lib
+            from .....parallel.ring_attention import (ring_self_attention,
+                                                      ulysses_self_attention)
+            kv_mask = self._kv_mask(mask)
+            if kv_mask is not None:
+                kv_mask = kv_mask.astype(jnp.bool_)
+            n_seq = ring_mesh.shape[mesh_lib.SEQ_AXIS]
+            route = (ulysses_self_attention
+                     if self._seq_routing(n_seq) == "ulysses"
+                     else ring_self_attention)
+            out = route(qh, kh, vh, mesh=ring_mesh, causal=self.causal,
+                        mask=kv_mask)
         elif self._use_flash(mask, drop, qh.shape[2]):
             from .....ops.pallas import flash_attention
             out = flash_attention(qh, kh, vh, mask=self._kv_mask(mask),

@@ -68,10 +68,23 @@ def _take(x, idx):
     return xs if len(xs) > 1 else xs[0]
 
 
+def _host_once(x):
+    """Materialize device-resident arrays on host ONCE before a batch loop —
+    ``_take``'s per-batch ``np.asarray`` would otherwise re-read the whole
+    array from HBM every batch (FeatureSet keeps ``jax.Array`` features
+    device-resident for the extract→fit chain)."""
+    if x is None:
+        return None
+    xs = [np.asarray(a) if isinstance(a, jax.Array) else a
+          for a in _as_list(x)]
+    return xs if len(xs) > 1 else xs[0]
+
+
 def iter_batches(x, y, batch_size: int, *, shuffle: bool, seed: int,
                  drop_last: bool):
     """Host-side minibatch iterator over numpy arrays (evaluate/predict path;
     training streams through ``FeatureSet`` instead)."""
+    x, y = _host_once(x), _host_once(y)
     n = _num_examples(x)
     order = np.arange(n)
     if shuffle:
@@ -722,9 +735,17 @@ class TrainingLoop:
             n_padded = _round_up(len(fs), dp)
 
             def put(a):
+                sh = mesh_lib.batch_sharding(self.mesh)
+                if isinstance(a, jax.Array):
+                    # already device-resident (extract→fit chain): pad and
+                    # relayout ON DEVICE — no host round trip
+                    pad = n_padded - a.shape[0]
+                    if pad > 0:
+                        a = jnp.concatenate(
+                            [a, jnp.repeat(a[-1:], pad, axis=0)], axis=0)
+                    return jax.device_put(a, sh)
                 a = np.asarray(a)
-                return jax.device_put(jnp.asarray(_pad_to(a, n_padded)),
-                                      mesh_lib.batch_sharding(self.mesh))
+                return jax.device_put(jnp.asarray(_pad_to(a, n_padded)), sh)
 
             epoch_fn = self.build_epoch_fn(len(fs), batch_size, n_steps,
                                            shuffle=fs.shuffle)

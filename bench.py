@@ -199,7 +199,15 @@ def bench_transfer_learning():
     NO backbone backward pass: cut the graph at the pooled features
     (``new_graph`` surgery, ``NetUtils.scala`` role), run the backbone ONCE
     as a feature extractor, train the fresh head on the features. Reported
-    imgs/s = dataset images / (extract + 2-epoch head training) seconds."""
+    imgs/s = dataset images / (extract + 2-epoch head training) seconds,
+    median of 3 timed runs (the tunnel's dispatch latency is noisy; r4's
+    single-shot measurement swung 490-945 imgs/s on identical code).
+
+    The features stay in HBM end to end: the extractor's jitted outputs
+    feed ``FeatureSet.array`` as device arrays and the head's device-cache
+    pads/relayouts them on device — zero host round trips in the timed
+    region (16 MB of tunnel I/O in the r3/r4 version, which was what the
+    bench actually measured)."""
     import optax
 
     from analytics_zoo_tpu.feature import FeatureSet
@@ -234,17 +242,22 @@ def bench_transfer_learning():
     chunk = 512
 
     def run():
-        feats = np.concatenate(
-            [np.asarray(extract(m.params, m.net_state,
-                                jax.lax.dynamic_slice_in_dim(x_dev, i, chunk)))
+        feats = jnp.concatenate(
+            [extract(m.params, m.net_state,
+                     jax.lax.dynamic_slice_in_dim(x_dev, i, chunk))
              for i in range(0, n, chunk)])
+        # fit's final per-epoch losses are host floats — reading them fences
+        # the timing (the dispatch queue is fully drained at return)
         head.fit(FeatureSet.array(feats, y, seed=0), batch_size=64,
                  nb_epoch=2)
 
     run()                                         # compile warmup
-    t0 = time.perf_counter()
-    run()
-    return n / (time.perf_counter() - t0)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    return n / float(np.median(times))
 
 
 def bench_int8_inference():
@@ -461,6 +474,51 @@ def main():
         print(f"# FAIL: loss {loss_last:.4f} did not beat the label-marginal "
               f"entropy floor H={entropy:.4f} — correctness regression; "
               f"throughput number is void", file=sys.stderr)
+        sys.exit(1)
+    check_regressions(out)
+
+
+# higher-is-better parity metrics gated round-over-round (VERDICT r4 weak #1:
+# the 41% transfer-learning drop sailed through because nothing compared
+# against the previous round's record)
+GATED_METRICS = (
+    "value", "median_recs_per_sec", "wide_deep_train_samples_per_sec",
+    "image_infer_fp32_fps", "image_infer_int8_fps",
+    "int8_top1_agreement_pct", "transfer_learn_imgs_per_sec",
+    "bert_train_samples_per_sec", "bert_mfu",
+    "long_context_4k_tokens_per_sec", "long_context_32k_tokens_per_sec",
+)
+REGRESSION_TOLERANCE = 0.15
+
+
+def check_regressions(out):
+    """Fail (exit 1, like the loss gate) if any parity metric present in
+    both this run and the newest ``BENCH_r*.json`` dropped >15% — the
+    reference's perf harness likewise logs per-run throughput so
+    regressions are visible (``examples/vnni/openvino/Perf.scala:88-98``)."""
+    import glob
+    import re
+    prev_files = sorted(
+        glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)))
+    if not prev_files:
+        return
+    try:
+        with open(prev_files[-1]) as f:
+            prev = json.load(f).get("parsed") or {}
+    except (OSError, ValueError):
+        return
+    failures = []
+    for k in GATED_METRICS:
+        a, b = prev.get(k), out.get(k)
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)) and a > 0:
+            if b < (1.0 - REGRESSION_TOLERANCE) * a:
+                failures.append(f"{k}: {a} -> {b} ({b / a - 1:+.1%})")
+    if failures:
+        print("# FAIL: parity metric regression vs "
+              f"{os.path.basename(prev_files[-1])}: " + "; ".join(failures),
+              file=sys.stderr)
         sys.exit(1)
 
 

@@ -31,7 +31,7 @@ from .elementwise import (AddConstant, CAdd, CMul, Exp, Expand,  # noqa: F401
                           GaussianSampler, Log, Max, Mul, MulConstant,
                           Negative, Power, ResizeBilinear, Scale, Sqrt,
                           Square)
-from .gpipe import GPipe  # noqa: F401
+from .gpipe import GPipe, Pipeline  # noqa: F401
 from .moe import SparseMoE  # noqa: F401
 from .recurrent import GRU, LSTM, Bidirectional, SimpleRNN  # noqa: F401
 from .self_attention import (BERT, MultiHeadSelfAttention,  # noqa: F401

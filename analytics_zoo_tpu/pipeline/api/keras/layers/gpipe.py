@@ -18,8 +18,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .....parallel import mesh as mesh_lib
-from .....parallel.pipeline import gpipe_apply, sequential_apply
-from ..engine import Layer, compute_dtype
+from .....parallel.pipeline import (gpipe_apply, hetero_gpipe_apply,
+                                    sequential_apply)
+from ..engine import Layer, compute_dtype, param_dtype
 
 
 class GPipe(Layer):
@@ -116,3 +117,173 @@ class GPipe(Layer):
                     n_micro, S)
                 self._warned_fallback = True
         return sequential_apply(fn, params, x, self.num_stages, rng=rng)
+
+
+class Pipeline(Layer):
+    """HETEROGENEOUS pipeline parallelism: arbitrary layer cuts as stages.
+
+    ``Pipeline(stages=[[Embedding(...)], [TransformerBlock(...)], ...,
+    [LayerNorm(), Dense(...)]])`` — each stage is a list of layers (or a
+    single layer); stages may have DIFFERENT param trees and DIFFERENT
+    input/output shapes, so a real model (embedding front → blocks → head)
+    pipelines end to end as one layer (the homogeneous ``GPipe`` above
+    covers the stacked-identical-blocks case; the reference has no pipeline
+    parallelism at all, SURVEY §2.4).
+
+    Mechanics (see ``parallel/pipeline.py::hetero_gpipe_apply``): per-stage
+    params ravel into rows of one ``(S, L)`` buffer sharded over ``pipe``
+    (each rank materializes only its row), activations cross stage
+    boundaries in a common ``(B_micro, W)`` float32 wire format, and each
+    pipe rank executes its stage via ``lax.switch``. On a mesh without a
+    ``pipe`` axis (or shapes the schedule can't split, e.g. the B=1 probe)
+    the stages run sequentially — identical math, one device.
+
+    Requirements: ``len(stages)`` must EQUAL the pipe-axis size when
+    pipelined; stages must be stateless; all params share one dtype.
+    """
+
+    def __init__(self, stages, n_microbatches: Optional[int] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if not stages:
+            raise ValueError("Pipeline needs at least one stage")
+        self.stages = [list(s) if isinstance(s, (list, tuple)) else [s]
+                       for s in stages]
+        self.num_stages = len(self.stages)
+        self.n_microbatches = n_microbatches
+        self._warned_fallback = False
+
+    def build(self, rng, input_shape):
+        import numpy as np
+        pdt = param_dtype()
+        shape = tuple(input_shape)
+        keys = jax.random.split(rng, sum(len(s) for s in self.stages) + 1)
+        ki = 0
+        self._meta = []  # per stage: dict(leaves, treedef, in/out feat shape)
+        trees_flat = []
+        for si, layers in enumerate(self.stages):
+            in_shape = shape
+            stage_trees = []
+            for lyr in layers:
+                if lyr.initial_state(shape):
+                    raise ValueError(
+                        f"{self.name}: pipeline stages must be stateless "
+                        f"({lyr.name} carries state)")
+                p = lyr.build(keys[ki], shape)
+                ki += 1
+                shape = lyr.output_shape_for(p, {}, shape)
+                stage_trees.append(p)
+            leaves, treedef = jax.tree_util.tree_flatten(stage_trees)
+            for l in leaves:
+                if l.dtype != pdt:
+                    raise ValueError(
+                        f"{self.name}: all stage params must be "
+                        f"{pdt.__name__ if hasattr(pdt, '__name__') else pdt}"
+                        f", got {l.dtype}")
+            self._meta.append({
+                "treedef": treedef,
+                "shapes": [tuple(l.shape) for l in leaves],
+                "sizes": [int(np.prod(l.shape)) if l.shape else 1
+                          for l in leaves],
+                "in_feat": tuple(in_shape[1:]),
+                "out_feat": tuple(shape[1:]),
+            })
+            trees_flat.append(leaves)
+        self._out_shape = tuple(shape)
+        self._wire = max(
+            [int(np.prod(m["in_feat"])) for m in self._meta]
+            + [int(np.prod(self._meta[-1]["out_feat"]))])
+        L = max(sum(m["sizes"]) for m in self._meta)
+        rows = []
+        for leaves, m in zip(trees_flat, self._meta):
+            vec = (jnp.concatenate([jnp.ravel(l) for l in leaves])
+                   if leaves else jnp.zeros((0,), pdt))
+            rows.append(jnp.pad(vec, (0, L - vec.shape[0])))
+        return {"stack": jnp.stack(rows)}
+
+    def param_sharding(self, params):
+        return {"stack": P(mesh_lib.PIPE_AXIS)}
+
+    def output_shape_for(self, params, state, input_shape):
+        # build() already chained the per-stage shape inference
+        return (input_shape[0],) + self._out_shape[1:]
+
+    def _unpack(self, si, vec):
+        """Stage ``si``'s layer param trees out of its (L,) row — static
+        slicing, so each lax.switch branch carries only its own layout."""
+        m = self._meta[si]
+        leaves, off = [], 0
+        for shp, size in zip(m["shapes"], m["sizes"]):
+            leaves.append(jax.lax.dynamic_slice_in_dim(
+                vec, off, size).reshape(shp))
+            off += size
+        return jax.tree_util.tree_unflatten(m["treedef"], leaves)
+
+    def _stage_fn(self, si, training):
+        """Wire-format stage: unpack params, unpad+reshape the activation,
+        run the stage's layers, flatten+pad back to the wire width."""
+        m = self._meta[si]
+        import numpy as np
+        in_sz = int(np.prod(m["in_feat"]))
+        out_sz = int(np.prod(m["out_feat"]))
+        layers = self.stages[si]
+
+        def fn(vec, h_wire, rng=None):
+            trees = self._unpack(si, vec)
+            b = h_wire.shape[0]
+            h = h_wire[:, :in_sz].reshape((b,) + m["in_feat"])
+            for j, (lyr, p) in enumerate(zip(layers, trees)):
+                lrng = (jax.random.fold_in(jax.random.fold_in(rng, si), j)
+                        if rng is not None else None)
+                h = lyr.call(p, h, training=training, rng=lrng)
+            h = h.astype(jnp.float32).reshape(b, out_sz)
+            return jnp.pad(h, ((0, 0), (0, self._wire - out_sz)))
+
+        return fn
+
+    def call(self, params, x, *, training=False, rng=None):
+        mesh = mesh_lib.global_mesh()
+        S = mesh.shape[mesh_lib.PIPE_AXIS]
+        if S > 1:
+            if self.num_stages != S:
+                raise ValueError(
+                    f"{self.name}: {self.num_stages} stages on a pipe={S} "
+                    f"mesh — heterogeneous stages need exactly one stage "
+                    f"per pipe rank")
+            n_micro = self.n_microbatches or S
+            dp = mesh.shape[mesh_lib.DATA_AXIS]
+            B = x.shape[0]
+            if B % dp == 0 and (B // dp) % n_micro == 0:
+                import numpy as np
+                in_sz = int(np.prod(self._meta[0]["in_feat"]))
+                xw = x.reshape(B, in_sz).astype(jnp.float32)
+                xw = jnp.pad(xw, ((0, 0), (0, self._wire - in_sz)))
+                fns = [self._stage_fn(j, training)
+                       for j in range(self.num_stages)]
+                out = hetero_gpipe_apply(fns, params["stack"], xw, mesh=mesh,
+                                         n_micro=n_micro, rng=rng)
+                out_feat = self._meta[-1]["out_feat"]
+                out_sz = int(np.prod(out_feat))
+                return (out[:, :out_sz].reshape((B,) + out_feat)
+                        .astype(compute_dtype()))
+            if B > dp and not self._warned_fallback:
+                import logging
+                logging.getLogger("analytics_zoo_tpu.gpipe").warning(
+                    "%s: batch %d not schedulable over pipe=%d "
+                    "(n_micro=%d) — running stages SEQUENTIALLY",
+                    self.name, B, S, n_micro)
+                self._warned_fallback = True
+        # sequential path: the SAME wire-format stage fns applied in order
+        # (one shared per-stage runner, so the placements cannot diverge
+        # numerically) — also the B=1 probe path
+        import numpy as np
+        B = x.shape[0]
+        in_sz = int(np.prod(self._meta[0]["in_feat"]))
+        h = jnp.pad(x.reshape(B, in_sz).astype(jnp.float32),
+                    ((0, 0), (0, self._wire - in_sz)))
+        for si in range(self.num_stages):
+            h = self._stage_fn(si, training)(params["stack"][si], h, rng=rng)
+        out_feat = self._meta[-1]["out_feat"]
+        out_sz = int(np.prod(out_feat))
+        return (h[:, :out_sz].reshape((B,) + out_feat)
+                .astype(compute_dtype()))

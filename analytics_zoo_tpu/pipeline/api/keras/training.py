@@ -96,13 +96,17 @@ def iter_batches(x, y, batch_size: int, *, shuffle: bool, seed: int,
 
 
 def _pad_to(x, size: int):
+    """Pad the batch dim to ``size`` by repeating the last row — ONE policy
+    for both host (numpy) and device-resident (jax) arrays, so the
+    device-cache fast path pads identically to the host path."""
     xs = _as_list(x)
     out = []
     for a in xs:
-        a = np.asarray(a)
+        xp = jnp if isinstance(a, jax.Array) else np
+        a = a if isinstance(a, jax.Array) else np.asarray(a)
         pad = size - a.shape[0]
         if pad > 0:
-            a = np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0)
+            a = xp.concatenate([a, xp.repeat(a[-1:], pad, axis=0)], axis=0)
         out.append(a)
     return out if len(out) > 1 else out[0]
 
@@ -735,17 +739,10 @@ class TrainingLoop:
             n_padded = _round_up(len(fs), dp)
 
             def put(a):
-                sh = mesh_lib.batch_sharding(self.mesh)
-                if isinstance(a, jax.Array):
-                    # already device-resident (extract→fit chain): pad and
-                    # relayout ON DEVICE — no host round trip
-                    pad = n_padded - a.shape[0]
-                    if pad > 0:
-                        a = jnp.concatenate(
-                            [a, jnp.repeat(a[-1:], pad, axis=0)], axis=0)
-                    return jax.device_put(a, sh)
-                a = np.asarray(a)
-                return jax.device_put(jnp.asarray(_pad_to(a, n_padded)), sh)
+                # device-resident inputs (extract→fit chain) pad and
+                # relayout ON DEVICE — no host round trip
+                return jax.device_put(jnp.asarray(_pad_to(a, n_padded)),
+                                      mesh_lib.batch_sharding(self.mesh))
 
             epoch_fn = self.build_epoch_fn(len(fs), batch_size, n_steps,
                                            shuffle=fs.shuffle)

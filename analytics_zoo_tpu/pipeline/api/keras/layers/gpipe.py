@@ -15,6 +15,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from .....parallel import mesh as mesh_lib
@@ -154,7 +155,6 @@ class Pipeline(Layer):
         self._warned_fallback = False
 
     def build(self, rng, input_shape):
-        import numpy as np
         pdt = param_dtype()
         shape = tuple(input_shape)
         keys = jax.random.split(rng, sum(len(s) for s in self.stages) + 1)
@@ -219,11 +219,24 @@ class Pipeline(Layer):
             off += size
         return jax.tree_util.tree_unflatten(m["treedef"], leaves)
 
+    def _to_wire(self, x):
+        """Flatten + pad the batch into the common (B, W) f32 wire format."""
+        b = x.shape[0]
+        in_sz = int(np.prod(self._meta[0]["in_feat"]))
+        xw = x.reshape(b, in_sz).astype(jnp.float32)
+        return jnp.pad(xw, ((0, 0), (0, self._wire - in_sz)))
+
+    def _from_wire(self, out):
+        """Unpad + reshape the final wire buffer to the model output."""
+        out_feat = self._meta[-1]["out_feat"]
+        out_sz = int(np.prod(out_feat))
+        return (out[:, :out_sz].reshape((out.shape[0],) + out_feat)
+                .astype(compute_dtype()))
+
     def _stage_fn(self, si, training):
         """Wire-format stage: unpack params, unpad+reshape the activation,
         run the stage's layers, flatten+pad back to the wire width."""
         m = self._meta[si]
-        import numpy as np
         in_sz = int(np.prod(m["in_feat"]))
         out_sz = int(np.prod(m["out_feat"]))
         layers = self.stages[si]
@@ -254,18 +267,12 @@ class Pipeline(Layer):
             dp = mesh.shape[mesh_lib.DATA_AXIS]
             B = x.shape[0]
             if B % dp == 0 and (B // dp) % n_micro == 0:
-                import numpy as np
-                in_sz = int(np.prod(self._meta[0]["in_feat"]))
-                xw = x.reshape(B, in_sz).astype(jnp.float32)
-                xw = jnp.pad(xw, ((0, 0), (0, self._wire - in_sz)))
                 fns = [self._stage_fn(j, training)
                        for j in range(self.num_stages)]
-                out = hetero_gpipe_apply(fns, params["stack"], xw, mesh=mesh,
+                out = hetero_gpipe_apply(fns, params["stack"],
+                                         self._to_wire(x), mesh=mesh,
                                          n_micro=n_micro, rng=rng)
-                out_feat = self._meta[-1]["out_feat"]
-                out_sz = int(np.prod(out_feat))
-                return (out[:, :out_sz].reshape((B,) + out_feat)
-                        .astype(compute_dtype()))
+                return self._from_wire(out)
             if B > dp and not self._warned_fallback:
                 import logging
                 logging.getLogger("analytics_zoo_tpu.gpipe").warning(
@@ -276,14 +283,7 @@ class Pipeline(Layer):
         # sequential path: the SAME wire-format stage fns applied in order
         # (one shared per-stage runner, so the placements cannot diverge
         # numerically) — also the B=1 probe path
-        import numpy as np
-        B = x.shape[0]
-        in_sz = int(np.prod(self._meta[0]["in_feat"]))
-        h = jnp.pad(x.reshape(B, in_sz).astype(jnp.float32),
-                    ((0, 0), (0, self._wire - in_sz)))
+        h = self._to_wire(x)
         for si in range(self.num_stages):
             h = self._stage_fn(si, training)(params["stack"][si], h, rng=rng)
-        out_feat = self._meta[-1]["out_feat"]
-        out_sz = int(np.prod(out_feat))
-        return (h[:, :out_sz].reshape((B,) + out_feat)
-                .astype(compute_dtype()))
+        return self._from_wire(h)

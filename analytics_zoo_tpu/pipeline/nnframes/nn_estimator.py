@@ -141,6 +141,46 @@ class NNEstimator:
                        batch_size=self.batch_size)
 
 
+class _ZooPickler:
+    """Pickle helpers that serialize REGISTRY objects by name: layers store
+    resolved activation callables (``jax.nn.relu`` is a ``custom_jvp``
+    object, the ``hard_sigmoid``/``linear`` entries are lambdas — none
+    pickle), so identity-match them back to their ``ACTIVATIONS`` key and
+    re-resolve on load."""
+
+    @staticmethod
+    def dumps(obj) -> bytes:
+        import io
+        import pickle
+
+        from ..api.keras.layers.core import ACTIVATIONS
+
+        class P(pickle.Pickler):
+            def persistent_id(self, o):
+                for name, fn in ACTIVATIONS.items():
+                    if o is fn:
+                        return ("zoo_activation", name)
+                return None
+
+        buf = io.BytesIO()
+        P(buf).dump(obj)
+        return buf.getvalue()
+
+    @staticmethod
+    def load(f):
+        import pickle
+
+        class U(pickle.Unpickler):
+            def persistent_load(self, pid):
+                kind, name = pid
+                if kind == "zoo_activation":
+                    from ..api.keras.layers.core import ACTIVATIONS
+                    return ACTIVATIONS[name]
+                raise pickle.UnpicklingError(f"unknown persistent id {pid}")
+
+        return U(f).load()
+
+
 class NNModel:
     """Transformer: appends ``prediction_col`` to the table
     (``NNModel.transform`` → ``Predictor.scala:136-208``)."""
@@ -170,6 +210,74 @@ class NNModel:
 
     def _postprocess(self, preds: np.ndarray) -> np.ndarray:
         return preds
+
+    # ---- persistence (NNEstimator.scala:60-72 read/write region,
+    # DefaultParamsWriterWrapper.scala) ------------------------------------
+    def save(self, path: str, over_write: bool = True) -> str:
+        """Persist the FITTED transformer — weights, architecture,
+        preprocessing chain, and column config — as one file, the role of
+        the reference's ML-pipeline ``NNModel.write`` (params +
+        serialized module + sample preprocessing). A fresh process
+        ``NNModel.load(path).transform(table)``s without re-fitting.
+
+        The preprocessing callable must be picklable (a ``Preprocessing``
+        instance, named function, or functools.partial — the same
+        serializable-stages contract Spark ML imposes); lambdas raise with
+        that guidance."""
+        import copy
+        import os
+        import pickle
+
+        import jax
+
+        if os.path.exists(path) and not over_write:
+            raise FileExistsError(f"{path} exists and over_write=False")
+        clean = copy.copy(self)
+        model = copy.copy(self.model)
+        # jitted/closure state does not persist: the training loop caches
+        # compiled programs, the compile spec holds optax closures, and the
+        # optimizer state is checkpoint territory (the reference's saved
+        # NNModel likewise carries weights, not optimizer state)
+        for attr in ("_loop", "_compiled", "opt_state"):
+            if hasattr(model, attr):
+                setattr(model, attr, None)
+
+        def host(a):
+            return np.asarray(jax.device_get(a))
+
+        if model.params is not None:
+            model.params = jax.tree.map(host, model.params)
+        if getattr(model, "net_state", None):
+            model.net_state = jax.tree.map(host, model.net_state)
+        clean.model = model
+        try:
+            blob = _ZooPickler.dumps(clean)
+        except (pickle.PicklingError, AttributeError, TypeError) as e:
+            raise ValueError(
+                f"NNModel.save: the transformer is not picklable ({e}) — "
+                f"feature_preprocessing must be a Preprocessing instance, "
+                f"a module-level function, or a functools.partial, not a "
+                f"lambda/closure") from e
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "NNModel":
+        """``NNModel.read.load`` — restores the fitted transformer (the
+        concrete subclass, e.g. ``NNClassifierModel``, round-trips via the
+        pickle class tag)."""
+        with open(path, "rb") as f:
+            obj = _ZooPickler.load(f)
+        if not isinstance(obj, NNModel):
+            raise ValueError(f"{path} does not contain an NNModel "
+                             f"(got {type(obj).__name__})")
+        return obj
 
 
 class NNClassifier(NNEstimator):

@@ -380,10 +380,12 @@ def bench_int8_inference():
 
     out = {}
     tops = {}
+    models = {}
     for mode, quant in (("fp32", None), ("int8", "int8")):
         im = InferenceModel().from_keras(
             m, quantize=quant,
             calibrate=xeval[:8] if quant == "int8" else None)
+        models[mode] = im
         pred = im._predict
 
         @jax.jit
@@ -402,6 +404,100 @@ def bench_int8_inference():
         out[f"image_infer_{mode}_fps"] = round(best, 1)
     agree = float((tops["fp32"] == tops["int8"]).mean()) * 100.0
     out["int8_top1_agreement_pct"] = round(agree, 3)
+
+    # -- accuracy oracle (VERDICT r4 task #5): a TRAINED classifier scored
+    # on a labeled 512-image held-out set (deterministic seeds — the
+    # checked-in-set role without binary blobs), reporting the top-1
+    # accuracy DELTA under quantization, not just fp32-vs-int8 agreement.
+    # AlexNet rather than VGG: it trains to 100%/~75% train/eval here in
+    # seconds (BN-free, so no running-stat lag on a 512-image set), putting
+    # eval accuracy far from both chance and ceiling so quantization damage
+    # has headroom to show in either direction.
+    import optax
+    n_eval = 512
+    am = ImageClassifier("alexnet", num_classes=classes,
+                         input_shape=(hw, hw, 3))
+    am.compile(optimizer=optax.adam(3e-4), loss="scce")
+    am.fit(FeatureSet.array(x, y, seed=0), batch_size=64, nb_epoch=16)
+    y_acc = rng.integers(0, classes, n_eval).astype(np.int32)
+    x_acc = (protos[y_acc] * 0.6
+             + rng.normal(size=(n_eval, hw, hw, 3)) * 1.1).astype(np.float32)
+    for mode, quant in (("fp32", None), ("int8", "int8")):
+        aim = InferenceModel().from_keras(
+            am, quantize=quant, calibrate=x[:8] if quant == "int8" else None)
+        acc_pred = np.concatenate([
+            np.asarray(jnp.argmax(aim._predict(
+                aim._params, aim._net_state, jnp.asarray(x_acc[i:i + 64])),
+                -1))
+            for i in range(0, n_eval, 64)])
+        out[f"image_top1_{mode}_pct"] = round(
+            float((acc_pred == y_acc).mean()) * 100.0, 3)
+    out["int8_top1_delta_pct"] = round(
+        out["image_top1_fp32_pct"] - out["image_top1_int8_pct"], 3)
+
+    # -- bandwidth-bound regime (VERDICT r4 weak #2): small-batch latency,
+    # where the win is 4x-smaller WEIGHTS streaming from HBM, not MXU rate —
+    # the reference's serving regime (wp-bigdl.md:192).
+    #
+    # Timing is the DELTA method: per-iteration time = (T_long - T_short) /
+    # (reps_long - reps_short) over two lax.map dispatches — the tunnel's
+    # fixed per-dispatch cost measured at 60-100 ms here, which swamps any
+    # absolute small-batch reading (a 64-iter map of a trivial body and of
+    # a full VGG forward cost the SAME wall time), cancels exactly.
+    def per_iter_ms(pred, params, state, mk_batch, r_short=64, r_long=512):
+        def run(r):
+            xs = jax.device_put(jnp.asarray(mk_batch(r)))
+
+            @jax.jit
+            def many(p, s, stacked):
+                return jax.lax.map(
+                    lambda xb: jnp.argmax(pred(p, s, xb), -1), stacked)
+
+            np.asarray(many(params, state, xs))  # compile
+            best = 1e9
+            for _ in range(windows):
+                t0 = time.perf_counter()
+                np.asarray(many(params, state, xs))
+                best = min(best, time.perf_counter() - t0)
+            return best
+        return (run(r_long) - run(r_short)) / (r_long - r_short) * 1e3
+
+    # (a) the conv-net at batch 1: utilization-bound (weights are a minor
+    # share of b1 conv time), reported for honesty — int8 is ~neutral here
+    for mode in ("fp32", "int8"):
+        im = models[mode]
+        ms = per_iter_ms(im._predict, im._params, im._net_state,
+                         lambda r: np.stack([xeval[i % batch:][:1]
+                                             for i in range(r)]))
+        out[f"image_infer_{mode}_b1_fps"] = round(1000.0 / max(ms, 1e-6), 1)
+    out["int8_b1_speedup"] = round(out["image_infer_int8_b1_fps"]
+                                   / out["image_infer_fp32_b1_fps"], 3)
+
+    # (b) the WEIGHT-STREAMING regime int8 exists for: an fc-dominant
+    # recommender-scoring head (3x4096^2 ~ 200 MB fp32 / 50 MB int8) at
+    # batch 1 — every iteration re-reads the full weight set from HBM, so
+    # 4x-smaller weights pay directly (~2x measured on a v5e)
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    d = 4096
+    fm = Sequential([Dense(d, activation="relu", input_shape=(d,)),
+                     Dense(d, activation="relu"),
+                     Dense(d, activation="relu"),
+                     Dense(classes, activation="softmax")])
+    fm.compile(optimizer=optax.adam(1e-4), loss="scce")
+    xf = rng.normal(size=(256, d)).astype(np.float32)
+    yf = rng.integers(0, classes, 256).astype(np.int32)
+    fm.fit(FeatureSet.array(xf, yf, seed=0), batch_size=64, nb_epoch=1)
+    stream = {}
+    for mode, quant in (("fp32", None), ("int8", "int8")):
+        im = InferenceModel().from_keras(
+            fm, quantize=quant, calibrate=xf[:8] if quant else None)
+        stream[mode] = per_iter_ms(
+            im._predict, im._params, im._net_state,
+            lambda r: rng.normal(size=(r, 1, d)).astype(np.float32))
+        out[f"stream_infer_{mode}_b1_fps"] = round(
+            1000.0 / max(stream[mode], 1e-6), 1)
+    out["int8_stream_b1_speedup"] = round(stream["fp32"] / stream["int8"], 3)
     return out
 
 
@@ -564,12 +660,21 @@ GATED_METRICS = (
     "int8_top1_agreement_pct", "transfer_learn_imgs_per_sec",
     "bert_train_samples_per_sec", "bert_mfu",
     "long_context_4k_tokens_per_sec", "long_context_32k_tokens_per_sec",
+    "int8_stream_b1_speedup",
 )
 REGRESSION_TOLERANCE = 0.15
 # correctness-parity metrics get ABSOLUTE floors, not the relative throughput
 # tolerance — a 15%-relative gate would let int8 agreement fall to 85% (the
 # whitepaper's claim is <0.1% accuracy drop, wp-bigdl.md:192)
-ABSOLUTE_FLOORS = {"int8_top1_agreement_pct": 97.0}
+ABSOLUTE_FLOORS = {
+    "int8_top1_agreement_pct": 97.0,
+    # delta-method speedup swings 2.8-3.9x run to run (the subtraction
+    # amplifies tunnel noise); the meaningful gate is the >=1.5x
+    # bandwidth-regime claim, not round-over-round relative drift
+    "int8_stream_b1_speedup": 1.5,
+}
+# lower-is-better correctness metrics: fail above the ceiling
+ABSOLUTE_CEILINGS = {"int8_top1_delta_pct": 2.0}
 
 
 def check_regressions(out):
@@ -579,22 +684,30 @@ def check_regressions(out):
     regressions are visible (``examples/vnni/openvino/Perf.scala:88-98``)."""
     import glob
     import re
-    prev_files = sorted(
-        glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_r*.json")),
-        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)))
-    if not prev_files:
-        return
-    try:
-        with open(prev_files[-1]) as f:
-            prev = json.load(f).get("parsed") or {}
-    except (OSError, ValueError):
-        return
+
+    # absolute correctness gates first: they need no baseline and must run
+    # even on the first round / with a corrupt previous record
     failures = []
     for k, floor in ABSOLUTE_FLOORS.items():
         b = out.get(k)
         if isinstance(b, (int, float)) and b < floor:
             failures.append(f"{k}: {b} below the absolute floor {floor}")
+    for k, ceil in ABSOLUTE_CEILINGS.items():
+        b = out.get(k)
+        if isinstance(b, (int, float)) and b > ceil:
+            failures.append(f"{k}: {b} above the absolute ceiling {ceil}")
+
+    prev_files = sorted(
+        glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)))
+    prev = {}
+    if prev_files:
+        try:
+            with open(prev_files[-1]) as f:
+                prev = json.load(f).get("parsed") or {}
+        except (OSError, ValueError):
+            prev = {}
     for k in GATED_METRICS:
         a, b = prev.get(k), out.get(k)
         if k in ABSOLUTE_FLOORS:
@@ -603,9 +716,10 @@ def check_regressions(out):
             if b < (1.0 - REGRESSION_TOLERANCE) * a:
                 failures.append(f"{k}: {a} -> {b} ({b / a - 1:+.1%})")
     if failures:
-        print("# FAIL: parity metric regression vs "
-              f"{os.path.basename(prev_files[-1])}: " + "; ".join(failures),
-              file=sys.stderr)
+        ref = (f" vs {os.path.basename(prev_files[-1])}" if prev_files
+               else "")
+        print(f"# FAIL: parity metric regression{ref}: "
+              + "; ".join(failures), file=sys.stderr)
         sys.exit(1)
 
 

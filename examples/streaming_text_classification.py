@@ -1,0 +1,94 @@
+"""Streaming text classification — the reference's
+``examples/streaming/textclassification`` flow (a Spark DStream pulling raw
+text lines, tokenizing through the TextSet pipeline, classifying with a
+fitted TextClassifier) on the Cluster Serving stack: a producer thread
+streams raw sentences into the input queue, the serving loop batches the
+tokenized sequences through the classifier, and the consumer prints a label
+per line as results arrive (reference:
+``pyzoo/zoo/examples/streaming/textclassification/streaming_text_classification.py``).
+
+Run:  python examples/streaming_text_classification.py
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.feature.text import TextSet
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+from analytics_zoo_tpu.serving import ClusterServing, InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.backend import LocalBackend
+
+SEQ_LEN = 20
+LABELS = ["sports", "tech"]
+STREAM = [
+    "the team won the match in the final game",
+    "the new chip doubles machine learning performance",
+    "a great goal and the championship race was close",
+    "software update improves the device battery",
+]
+
+
+def make_corpus(rng, n_per_class=96):
+    sports = ["the team won the match", "a great goal in the final game",
+              "the player scored again", "championship race was close"]
+    tech = ["the new chip doubles performance", "software update improves the",
+            "machine learning model training", "the device battery lasts"]
+    texts, labels = [], []
+    for label, pool in enumerate((sports, tech)):
+        for _ in range(n_per_class):
+            words = []
+            for _ in range(3):
+                words.extend(rng.choice(pool).split())
+            texts.append(" ".join(words))
+            labels.append(label)
+    return texts, np.asarray(labels, np.int32)
+
+
+def main():
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    texts, labels = make_corpus(rng)
+    ts = (TextSet.from_texts(texts, labels)
+          .tokenize().word2idx().shape_sequence(SEQ_LEN))
+    x, y = ts.to_arrays()
+
+    model = TextClassifier(class_num=len(LABELS), token_length=32,
+                           sequence_length=SEQ_LEN, encoder="cnn",
+                           vocab_size=len(ts.word_index) + 2)
+    model.compile(optimizer="adam", loss="scce", lr=2e-3)
+    model.fit(x, y, batch_size=32, nb_epoch=8)
+
+    # serve the ZooModel itself — fit stores the trained params on it, not
+    # on the inner Sequential
+    im = InferenceModel().from_keras(model)
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4).start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+
+    def socket_stream():
+        """Producer — the socketTextStream role: each raw line is tokenized
+        with the TRAINING vocabulary and enqueued as it 'arrives'."""
+        for i, line in enumerate(STREAM):
+            seq = (TextSet.from_texts([line]).tokenize()
+                   .word2idx(existing_map=ts.word_index)
+                   .shape_sequence(SEQ_LEN).to_arrays()[0][0])
+            inq.enqueue(f"line-{i}", seq.astype(np.float32))
+            time.sleep(0.01)
+
+    producer = threading.Thread(target=socket_stream)
+    producer.start()
+    producer.join()
+
+    for i, line in enumerate(STREAM):
+        scores = outq.query(f"line-{i}", timeout=30.0)
+        print(f"{LABELS[int(np.argmax(scores))]:>7}  <-  {line}")
+    serving.stop()
+    print(f"served {serving.served} lines")
+
+
+if __name__ == "__main__":
+    main()

@@ -196,3 +196,64 @@ def test_local_estimator_array_surface():
                 validation_data=(x, y), validation_methods=["accuracy"])
     assert h["loss"][-1] < h["loss"][0]
     assert h["val_accuracy"][-1] > 0.8
+
+
+def test_nnmodel_save_load_roundtrip_fresh_process(tmp_path):
+    """fit -> save -> FRESH-PROCESS load -> transform: predictions must be
+    identical (the reference persists fitted NNModels with their
+    preprocessing as ML-pipeline stages, NNEstimator.scala:60-72)."""
+    import subprocess
+    import sys
+
+    init_zoo_context()
+    x, y = _mlp_data()
+    table = {"features": x, "label": y}
+    import optax
+    clf = (NNClassifier(_mlp()).set_optim_method(optax.adam(0.01))
+           .set_batch_size(64).set_max_epoch(15))
+    model = clf.fit(table)
+    preds = model.transform(table)["prediction"]
+    p = str(tmp_path / "fitted.nnmodel")
+    model.save(p)
+    np.save(tmp_path / "x.npy", x)
+    np.save(tmp_path / "preds.npy", preds)
+
+    worker = tmp_path / "reload.py"
+    worker.write_text(f"""
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from analytics_zoo_tpu.common import init_zoo_context
+from analytics_zoo_tpu.pipeline.nnframes import NNModel, NNClassifierModel
+
+init_zoo_context()
+m = NNModel.load({p!r})
+assert isinstance(m, NNClassifierModel), type(m).__name__
+x = np.load({str(tmp_path / 'x.npy')!r})
+out = m.transform({{"features": x}})["prediction"]
+want = np.load({str(tmp_path / 'preds.npy')!r})
+np.testing.assert_array_equal(out, want)
+print("ROUNDTRIP_OK")
+""")
+    import os
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    out = subprocess.run([sys.executable, str(worker)], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert "ROUNDTRIP_OK" in out.stdout, out.stderr[-2000:]
+
+
+def test_nnmodel_save_rejects_lambda_preprocessing(tmp_path):
+    init_zoo_context()
+    x, y = _mlp_data()
+    table = {"features": x, "label": y}
+    import optax
+    clf = (NNClassifier(_mlp(), feature_preprocessing=lambda t: t["features"])
+           .set_optim_method(optax.adam(0.01))
+           .set_batch_size(64).set_max_epoch(1))
+    model = clf.fit(table)
+    with pytest.raises(ValueError, match="picklable"):
+        model.save(str(tmp_path / "nope.nnmodel"))

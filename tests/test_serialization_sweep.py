@@ -160,6 +160,8 @@ CASES = {
     "SparseMoE": (lambda: L.SparseMoE(4, 8, top_k=2), (6,), "float"),
     "GPipe": (lambda: L.GPipe(lambda: L.Dense(6, activation="tanh"),
                               num_stages=2), (6,), "float"),
+    "Pipeline": (lambda: L.Pipeline([[L.Dense(5, activation="tanh")],
+                                     [L.Dense(3)]]), (6,), "float"),
     "TransformerBlock": (lambda: L.TransformerBlock(8, 2), (6, 8), "float"),
     "TransformerLayer": (lambda: L.TransformerLayer(
         vocab=7, seq_len=6, n_block=2, hidden_size=8, n_head=2), (6,), "int"),

@@ -166,3 +166,71 @@ def test_serving_tensorboard_summary(tmp_path):
     assert all(v > 0 for _, v, _, _ in pts)
     recs = read_scalars(str(tmp_path / "app"), "Serving Records")
     assert max(v for _, v, _, _ in recs) == 12
+
+
+def test_lifecycle_stop_drains_no_acked_request_lost():
+    """Graceful stop during a busy stream: every request acked by enqueue()
+    before the stop signal must be answered (the reference's
+    listenTermination drains the streaming query the same way,
+    ClusterServingManager.scala:48)."""
+    model = _toy_model()
+    im = InferenceModel().from_keras(model)
+    backend = LocalBackend()
+    serving = ClusterServing(im, batch_size=4, backend=backend).start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+
+    rng = np.random.default_rng(2)
+    uris = []
+    for i in range(60):          # keep the stream busy while stopping
+        uri = f"busy-{i}"
+        inq.enqueue(uri, rng.normal(size=(6,)).astype(np.float32))
+        uris.append(uri)
+    # stop mid-stream: drain=True must flush the backlog before the loop ends
+    serving.stop(drain=True)
+    for uri in uris:
+        out = outq.query(uri, timeout=5.0)
+        assert out is not None and out.shape == (3,), uri
+
+
+def test_lifecycle_cli_scripts_flag_protocol(tmp_path):
+    """cluster-serving-{init,start,stop} coordinate through the `running`
+    flag file the way the reference scripts do: init writes config, start
+    refuses a second instance, stop removes the flag and the server drains
+    and exits."""
+    import os
+    import subprocess
+    import sys
+    import time as _t
+
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(scripts) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+
+    # init: template config appears
+    r = subprocess.run([sys.executable, os.path.join(scripts,
+                                                     "cluster-serving-init")],
+                       cwd=tmp_path, env=env, capture_output=True, text=True,
+                       timeout=120)
+    assert "properly set up" in r.stdout, r.stderr[-1500:]
+    assert (tmp_path / "config.yaml").exists()
+
+    # exercise start's flag handling: a config with no model_path must
+    # exit nonzero WITHOUT leaving a stale flag behind
+    r = subprocess.run([sys.executable, os.path.join(scripts,
+                                                     "cluster-serving-start")],
+                       cwd=tmp_path, env=env, capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode != 0
+    assert not (tmp_path / "running").exists(), \
+        "failed start left a stale running flag"
+
+    # stop with nothing running: the reference prints and ignores
+    r = subprocess.run([sys.executable, os.path.join(scripts,
+                                                     "cluster-serving-stop")],
+                       cwd=tmp_path, env=env, capture_output=True, text=True,
+                       timeout=120)
+    assert "not running" in r.stdout

@@ -115,10 +115,12 @@ class MultiHeadSelfAttention(Layer):
         return None
 
     #: auto mode hands sequences this long to the flash kernel: below it the
-    #: fused XLA softmax-attention wins (flash's sequential grid has per-cell
-    #: overhead; measured slower than XLA at T=128 on v5e), above it the
-    #: O(T²) HBM materialization starts to dominate and blockwise wins.
-    FLASH_AUTO_MIN_SEQ = 512
+    #: fused XLA softmax-attention wins (measured on a v5e, BERT-base bf16:
+    #: XLA is 1.11x flash at T=512 and 1.06x at T=1024), at/above it the
+    #: O(T²) HBM materialization dominates — XLA fails to even compile
+    #: BERT-base at T=2048 on a 16 GB chip, where the blockwise kernel
+    #: trains fine (52k tok/s; 4k/32k numbers in BENCH long_context).
+    FLASH_AUTO_MIN_SEQ = 2048
 
     def _use_flash(self, mask, drop, seq_len: int) -> bool:
         """The pallas flash kernel covers key-padding masks (the BERT

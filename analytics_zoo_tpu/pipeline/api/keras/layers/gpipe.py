@@ -47,13 +47,19 @@ class GPipe(Layer):
     """
 
     def __init__(self, stage_factory: Callable, num_stages: int,
-                 n_microbatches: Optional[int] = None, **kwargs):
+                 n_microbatches: Optional[int] = None, remat: bool = False,
+                 **kwargs):
         super().__init__(**kwargs)
         if num_stages < 1:
             raise ValueError(f"num_stages={num_stages} < 1")
         self.stage_factory = stage_factory
         self.num_stages = num_stages
         self.n_microbatches = n_microbatches
+        #: the GPipe paper's memory schedule: re-materialize stage
+        #: activations in the backward pass, so only the stage-BOUNDARY
+        #: activations stay live per (tick, microbatch) instead of every
+        #: intermediate — raise n_microbatches without the activation bill
+        self.remat = remat
         self.stage = stage_factory()  # template instance: defines the math
         self._warned_fallback = False
 
@@ -82,7 +88,11 @@ class GPipe(Layer):
     def _stage_fn(self, training):
         def fn(p_stage, h, rng):
             return self.stage.call(p_stage, h, training=training, rng=rng)
-        return fn
+        # prevent_cse=False: the stage only ever runs inside lax.scan
+        # bodies, where the CSE-prevention barriers are unnecessary and
+        # cost fusion (per the jax.checkpoint docs)
+        return (jax.checkpoint(fn, prevent_cse=False) if self.remat
+                else fn)
 
     def call(self, params, x, *, training=False, rng=None):
         mesh = mesh_lib.global_mesh()
@@ -144,7 +154,7 @@ class Pipeline(Layer):
     """
 
     def __init__(self, stages, n_microbatches: Optional[int] = None,
-                 **kwargs):
+                 remat: bool = False, **kwargs):
         super().__init__(**kwargs)
         if not stages:
             raise ValueError("Pipeline needs at least one stage")
@@ -152,6 +162,7 @@ class Pipeline(Layer):
                        for s in stages]
         self.num_stages = len(self.stages)
         self.n_microbatches = n_microbatches
+        self.remat = remat  # see GPipe.remat
         self._warned_fallback = False
 
     def build(self, rng, input_shape):
@@ -252,6 +263,10 @@ class Pipeline(Layer):
             h = h.astype(jnp.float32).reshape(b, out_sz)
             return jnp.pad(h, ((0, 0), (0, self._wire - out_sz)))
 
+        if self.remat:
+            # sequential path is a python loop, not scan, but the pipelined
+            # path (the one remat exists for) is scan — skip the CSE barriers
+            return jax.checkpoint(fn, prevent_cse=False)
         return fn
 
     def call(self, params, x, *, training=False, rng=None):

@@ -43,7 +43,9 @@ __all__ = ["ring_attention", "ring_self_attention", "ulysses_self_attention"]
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
                    causal: bool = False,
-                   kv_mask: Optional[jax.Array] = None) -> jax.Array:
+                   kv_mask: Optional[jax.Array] = None,
+                   dropout_rate: float = 0.0,
+                   dropout_rng: Optional[jax.Array] = None) -> jax.Array:
     """Blockwise ring attention INSIDE a ``shard_map`` over ``axis_name``.
 
     q, k, v: local blocks (B, H, T_local, D) — the sequence dim is sharded
@@ -52,6 +54,18 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
     local output block (B, H, T_local, D). ``causal`` masks with GLOBAL
     positions (block i attends to block j<=i, and within the diagonal block
     the usual triangular mask).
+
+    ``dropout_rate``/``dropout_rng``: attention-probability dropout. Each
+    (q-block, k-block) pair draws its mask from a key folded with BOTH
+    global block indices, so the pattern is a pure function of global
+    position — self-consistent however the ring rotates (it will not
+    bitwise-match the single-chip XLA op's stream; like GPipe's
+    per-microbatch keys, dropout decorrelates across placements, not
+    across steps). The softmax normalizer ``l`` accumulates the
+    PRE-dropout probabilities while ``o`` accumulates the
+    inverted-dropout ones, so ``o/l`` is EXACTLY the reference
+    semantics — dropout applied to the normalized weights, no
+    self-normalization bias.
     """
     n_shards = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -85,6 +99,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
         p = jnp.where(allowed, jnp.exp(s - m_safe), 0.0)
         corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0 and dropout_rng is not None:
+            blk_key = jax.random.fold_in(
+                jax.random.fold_in(dropout_rng, my_idx), src)
+            keep = jax.random.bernoulli(blk_key, 1.0 - dropout_rate,
+                                        p.shape)
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p,
                                   v_blk.astype(jnp.float32))
         return o, m_new, l
@@ -120,35 +140,56 @@ def _seq_specs(mask):
 def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         mesh: Optional[Mesh] = None,
                         causal: bool = False,
-                        mask: Optional[jax.Array] = None) -> jax.Array:
+                        mask: Optional[jax.Array] = None,
+                        dropout_rate: float = 0.0,
+                        dropout_rng: Optional[jax.Array] = None) -> jax.Array:
     """Entry point on GLOBAL arrays: q/k/v (B, H, T, D) with T sharded over
     the ``seq`` axis (and batch over ``data``); runs the ring under
     ``shard_map``. ``mask``: global (B, T) key-padding mask (1 = attend),
     sharded the same way — each rank streams its slice around the ring.
-    T must divide evenly by the seq-axis size."""
+    ``dropout_rate``/``dropout_rng``: attention dropout, block-position-
+    keyed (see ``ring_attention``). T must divide evenly by the seq-axis
+    size."""
     mesh = mesh or mesh_lib.global_mesh()
     n_seq = mesh.shape[mesh_lib.SEQ_AXIS]
     t = q.shape[2]
     if t % max(n_seq, 1) != 0:
         raise ValueError(f"sequence length {t} not divisible by seq axis "
                          f"size {n_seq}")
+    if dropout_rate > 0.0 and dropout_rng is None:
+        raise ValueError("dropout_rate > 0 needs dropout_rng")
     spec, in_specs = _seq_specs(mask)
+    if dropout_rng is not None:
+        in_specs = in_specs + (P(),)          # the key is replicated
 
     def local(*args):
+        args = list(args)
         qb, kb, vb = args[:3]
+        rng_loc = args.pop() if dropout_rng is not None else None
+        if rng_loc is not None:
+            # distinct masks for the batch rows on each data shard
+            rng_loc = jax.random.fold_in(
+                rng_loc, jax.lax.axis_index(mesh_lib.DATA_AXIS))
         mb = args[3] if len(args) > 3 else None
         return ring_attention(qb, kb, vb, axis_name=mesh_lib.SEQ_AXIS,
-                              causal=causal, kv_mask=mb)
+                              causal=causal, kv_mask=mb,
+                              dropout_rate=dropout_rate,
+                              dropout_rng=rng_loc)
 
     fn = jax.shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=spec,
                        check_vma=False)
-    return fn(q, k, v, mask) if mask is not None else fn(q, k, v)
+    args = (q, k, v) + ((mask,) if mask is not None else ())
+    args = args + ((dropout_rng,) if dropout_rng is not None else ())
+    return fn(*args)
 
 
 def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            mesh: Optional[Mesh] = None,
                            causal: bool = False,
-                           mask: Optional[jax.Array] = None) -> jax.Array:
+                           mask: Optional[jax.Array] = None,
+                           dropout_rate: float = 0.0,
+                           dropout_rng: Optional[jax.Array] = None
+                           ) -> jax.Array:
     """Ulysses-style sequence parallelism (SURVEY §5's head-vs-sequence
     all-to-all): q/k/v (B, H, T, D) arrive sequence-sharded; an all-to-all
     converts to head-sharded/full-sequence, attention runs as ONE dense
@@ -164,11 +205,23 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if h % max(n_seq, 1) != 0:
         raise ValueError(f"n_head {h} not divisible by seq axis size "
                          f"{n_seq} — use ring attention instead")
+    if dropout_rate > 0.0 and dropout_rng is None:
+        raise ValueError("dropout_rate > 0 needs dropout_rng")
     spec, in_specs = _seq_specs(mask)
+    if dropout_rng is not None:
+        in_specs = in_specs + (P(),)          # the key is replicated
     axis = mesh_lib.SEQ_AXIS
 
     def local(*args):
+        args = list(args)
         qb, kb, vb = args[:3]
+        rng_loc = args.pop() if dropout_rng is not None else None
+        if rng_loc is not None:
+            # distinct masks per (data shard, head shard)
+            rng_loc = jax.random.fold_in(
+                jax.random.fold_in(
+                    rng_loc, jax.lax.axis_index(mesh_lib.DATA_AXIS)),
+                jax.lax.axis_index(axis))
         mb = args[3] if len(args) > 3 else None
         # (B, H, T_local, D) -> (B, H_local, T, D): scatter heads, gather seq
         a2a = functools.partial(jax.lax.all_to_all, axis_name=axis,
@@ -179,11 +232,15 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             full_mask = jax.lax.all_gather(
                 mb, axis, axis=1, tiled=True)[:, None, None, :]  # (B,1,1,T)
         from ..ops.attention import dot_product_attention
-        og = dot_product_attention(qg, kg, vg, mask=full_mask, causal=causal)
+        og = dot_product_attention(qg, kg, vg, mask=full_mask, causal=causal,
+                                   dropout_rate=dropout_rate,
+                                   dropout_rng=rng_loc)
         # (B, H_local, T, D) -> (B, H, T_local, D): scatter seq, gather heads
         return jax.lax.all_to_all(og, axis_name=axis, split_axis=2,
                                   concat_axis=1, tiled=True)
 
     fn = jax.shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=spec,
                        check_vma=False)
-    return fn(q, k, v, mask) if mask is not None else fn(q, k, v)
+    args = (q, k, v) + ((mask,) if mask is not None else ())
+    args = args + ((dropout_rng,) if dropout_rng is not None else ())
+    return fn(*args)

@@ -244,9 +244,14 @@ class Pipeline(Layer):
         return (out[:, :out_sz].reshape((out.shape[0],) + out_feat)
                 .astype(compute_dtype()))
 
-    def _stage_fn(self, si, training):
+    def _stage_fn(self, si, training, in_scan=True):
         """Wire-format stage: unpack params, unpad+reshape the activation,
-        run the stage's layers, flatten+pad back to the wire width."""
+        run the stage's layers, flatten+pad back to the wire width.
+        ``in_scan``: the pipelined path runs stages inside ``lax.scan``
+        where remat can skip the CSE barriers; the sequential Python-loop
+        path must KEEP them (prevent_cse=True) or XLA merges the
+        rematerialized forward with the original and the memory savings
+        silently vanish."""
         m = self._meta[si]
         in_sz = int(np.prod(m["in_feat"]))
         out_sz = int(np.prod(m["out_feat"]))
@@ -264,9 +269,7 @@ class Pipeline(Layer):
             return jnp.pad(h, ((0, 0), (0, self._wire - out_sz)))
 
         if self.remat:
-            # sequential path is a python loop, not scan, but the pipelined
-            # path (the one remat exists for) is scan — skip the CSE barriers
-            return jax.checkpoint(fn, prevent_cse=False)
+            return jax.checkpoint(fn, prevent_cse=not in_scan)
         return fn
 
     def call(self, params, x, *, training=False, rng=None):
@@ -300,5 +303,6 @@ class Pipeline(Layer):
         # numerically) — also the B=1 probe path
         h = self._to_wire(x)
         for si in range(self.num_stages):
-            h = self._stage_fn(si, training)(params["stack"][si], h, rng=rng)
+            h = self._stage_fn(si, training, in_scan=False)(
+                params["stack"][si], h, rng=rng)
         return self._from_wire(h)

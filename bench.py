@@ -143,7 +143,12 @@ def bench_wide_deep():
     records = []
     clf.model._loop.fit_feature_set(fs, batch_size=8192, nb_epoch=2,
                                     callbacks=[records.append])
-    return max(r["throughput"] for r in records)
+    # max is the headline (parity with earlier rounds); the median rides
+    # along so best-case reporting is visible, not hidden (r4 weak #4) —
+    # NB under fuse_epochs both epochs share one dispatch, so they often
+    # coincide by construction
+    ths = [r["throughput"] for r in records]
+    return max(ths), float(np.median(ths))
 
 
 def bench_bert_finetune():
@@ -203,17 +208,18 @@ def bench_bert_finetune():
                   callbacks=[records.append])
         finally:
             _reset_policy()  # the other benches stay fp32
-        best = max(r["throughput"] for r in records)
+        ths = [r["throughput"] for r in records]
+        best, med = max(ths), float(np.median(ths))
         # compute-rich MFU companion to the gather-bound flagship's:
         # BERT-base train ~= 6 * n_params * tokens FLOPs (fwd 2x + bwd 4x
         # per the usual accounting); ~110M params incl. embeddings
         m_mfu = profiling.mfu(6.0 * 110e6 * best * seq_len)
-        return best, (round(m_mfu, 4) if m_mfu is not None else None)
+        return best, (round(m_mfu, 4) if m_mfu is not None else None), med
 
-    best, m_mfu = one_config(128, 128, 4096)
-    extras = {}
+    best, m_mfu, med = one_config(128, 128, 4096)
+    extras = {"bert_median_samples_per_sec": round(med, 1)}
     try:
-        r512, mfu512 = one_config(512, 32, 1024)
+        r512, mfu512, _ = one_config(512, 32, 1024)
         extras["bert_seq512_samples_per_sec"] = round(r512, 1)
         extras["bert_seq512_mfu"] = mfu512
     except Exception as e:
@@ -250,7 +256,9 @@ def bench_long_context():
     out = {}
     set_policy(compute_dtype="bfloat16", param_dtype="float32")
     try:
-        for tag, seq_len, batch, n_seqs in (("4k", 4096, 4, 16),
+        # 4k batch 16: +10% tok/s over batch 4 (measured 221k vs 200k) and
+        # the 2 GB fp32 log-softmax still fits beside the bf16 activations
+        for tag, seq_len, batch, n_seqs in (("4k", 4096, 16, 32),
                                             ("32k", 32768, 1, 4)):
             rng = np.random.default_rng(7)
             x = rng.integers(0, vocab, (n_seqs, seq_len)).astype(np.int32)
@@ -558,8 +566,10 @@ def bench_int8_inference():
         samples = [stream] + [measure_stream() for _ in range(2)]
         valid = [s for s in samples if s["fp32"] and s["int8"]]
         if valid:
-            stream = sorted(valid,
-                            key=lambda s: s["fp32"] / s["int8"])[len(valid) // 2]
+            # LOWER median: with an even count the upper median would be
+            # best-of-N in disguise and let a lucky spike mask a regression
+            stream = sorted(valid, key=lambda s: s["fp32"] / s["int8"]
+                            )[(len(valid) - 1) // 2]
     if stream["fp32"] and stream["int8"]:
         for mode, ms in stream.items():
             out[f"stream_infer_{mode}_b1_fps"] = round(1000.0 / ms, 1)
@@ -682,7 +692,9 @@ def main():
         "median_recs_per_sec": round(median, 1),
     }
     try:
-        out["wide_deep_train_samples_per_sec"] = round(bench_wide_deep(), 1)
+        wd_best, wd_median = bench_wide_deep()
+        out["wide_deep_train_samples_per_sec"] = round(wd_best, 1)
+        out["wide_deep_median_samples_per_sec"] = round(wd_median, 1)
     except Exception as e:  # secondary metric must not sink the flagship
         print(f"# wide_deep bench failed: {e!r}", file=sys.stderr)
     try:

@@ -1,0 +1,82 @@
+"""TensorFlow model import — the reference's ``apps/tfnet`` and
+``apps/model-inference-examples`` roles (TFNet runs frozen TF graphs inside
+the zoo pipeline; reference: ``apps/tfnet/*.ipynb``,
+``pipeline/api/net/TFNet.scala``).
+
+A TF-Keras MLP is exported as a SavedModel, imported WITHOUT the TF runtime
+in the serving process (`pipeline/api/saved_model.py` parses the graph and
+restores the variables through the in-repo proto codec), verified against
+TF's own output, then fine-tuned with the native loop — the reference's
+frozen TFNet cannot do that last step.
+
+Needs tensorflow only for the EXPORT; skips gracefully without it.
+
+Run:  python examples/tfnet_import.py
+"""
+
+import tempfile
+
+import numpy as np
+
+from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.net import Net
+
+
+def export_savedmodel(path, x):
+    import tensorflow as tf
+    tf1 = tf.compat.v1
+    g = tf1.Graph()
+    rng = np.random.default_rng(1)
+    with g.as_default():
+        xin = tf1.placeholder(tf.float32, (None, 16), name="x")
+        w1 = rng.normal(size=(16, 32)).astype(np.float32) * 0.3
+        w2 = rng.normal(size=(32, 3)).astype(np.float32) * 0.3
+        vw1 = tf1.get_variable("d1/kernel", initializer=w1)
+        vb1 = tf1.get_variable("d1/bias",
+                               initializer=np.zeros(32, np.float32))
+        h = tf.nn.relu(tf1.matmul(xin, vw1) + vb1)
+        vw2 = tf1.get_variable("d2/kernel", initializer=w2)
+        vb2 = tf1.get_variable("d2/bias",
+                               initializer=np.zeros(3, np.float32))
+        probs = tf.nn.softmax(tf1.matmul(h, vw2) + vb2, name="probs")
+        with tf1.Session(graph=g) as sess:
+            sess.run(tf1.global_variables_initializer())
+            want = sess.run(probs, {xin: x})
+            tf1.saved_model.simple_save(sess, path, inputs={"x": xin},
+                                        outputs={"probs": probs})
+    return want
+
+
+def main():
+    try:
+        import tensorflow  # noqa: F401
+    except ImportError:
+        print("tensorflow not installed — skipping the export step "
+              "(the import side needs no TF runtime)")
+        return
+
+    init_zoo_context()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 16)).astype(np.float32)
+    with tempfile.TemporaryDirectory() as d:
+        want = export_savedmodel(d + "/sm", x)
+        tfnet = Net.load_tf(d + "/sm")  # no TF runtime used from here on
+    net = Sequential([tfnet])           # the imported graph is a Layer
+    net.init_weights(sample_input=x[:2])
+    got = np.asarray(net.predict(x, batch_size=32))
+    # TPU fp32 matmuls run via bf16 passes at default precision -> ~1e-3
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=2e-3)
+    print(f"TF parity OK: max |diff| = {np.abs(got - want).max():.2e}")
+
+    # the imported graph is a native trainable model — fine-tune it
+    w = rng.normal(size=(16, 3)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    net.compile(optimizer="adam", loss="scce", metrics=["accuracy"], lr=0.01)
+    net.fit(x, y, batch_size=32, nb_epoch=15)
+    acc = net.evaluate(x, y, batch_size=32)["accuracy"]
+    print(f"fine-tuned imported TF model: accuracy {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

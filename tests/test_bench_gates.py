@@ -1,0 +1,93 @@
+"""The round-over-round regression gate in ``bench.py`` (VERDICT r4 weak #1:
+the 41% transfer-learning drop sailed through because nothing compared
+against the previous round's record). These tests drive ``check_regressions``
+against the committed ``BENCH_r04.json`` so the gate's comparison, tolerance,
+and absolute-floor paths are themselves regression-tested."""
+
+import copy
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+@pytest.fixture()
+def prev_record():
+    # bench's own baseline lookup: the tests track whichever round's
+    # record the gate actually compares against, or they would fail the
+    # round after any metric improves
+    parsed, name = bench.latest_bench_record()
+    assert parsed and name, "no BENCH_r*.json record found"
+    return parsed
+
+
+def test_equal_metrics_pass(prev_record):
+    bench.check_regressions(copy.deepcopy(prev_record))  # must not exit
+
+
+def test_within_tolerance_passes(prev_record):
+    out = copy.deepcopy(prev_record)
+    # -20% is inside the dispatch-RTT-noise override (0.30) for this key
+    out["wide_deep_train_samples_per_sec"] *= 0.80
+    bench.check_regressions(out)
+
+
+def test_gated_drop_fails(prev_record):
+    out = copy.deepcopy(prev_record)
+    out["wide_deep_train_samples_per_sec"] *= 0.65   # -35% > 30% override
+    with pytest.raises(SystemExit):
+        bench.check_regressions(out)
+
+
+def test_default_tolerance_is_15pct(prev_record):
+    out = copy.deepcopy(prev_record)
+    out["bert_train_samples_per_sec"] *= 0.80   # -20% > default 15% gate
+    with pytest.raises(SystemExit):
+        bench.check_regressions(out)
+
+
+def test_noisy_metric_uses_wider_tolerance(prev_record):
+    out = copy.deepcopy(prev_record)
+    out["image_infer_fp32_fps"] *= 0.75   # -25% < its 30% override
+    bench.check_regressions(out)
+    out["image_infer_fp32_fps"] = prev_record["image_infer_fp32_fps"] * 0.65
+    with pytest.raises(SystemExit):
+        bench.check_regressions(out)
+
+
+def test_absolute_floor_is_not_relative(prev_record):
+    out = copy.deepcopy(prev_record)
+    # 86% agreement is within 15% of r4's 100% but below the 97% floor —
+    # the whitepaper's claim is <0.1% accuracy drop (wp-bigdl.md:192)
+    out["int8_top1_agreement_pct"] = 86.0
+    with pytest.raises(SystemExit):
+        bench.check_regressions(out)
+
+
+def test_absolute_ceiling(prev_record):
+    out = copy.deepcopy(prev_record)
+    out["int8_top1_delta_pct"] = 5.0     # lower-is-better metric
+    with pytest.raises(SystemExit):
+        bench.check_regressions(out)
+
+
+def test_device_step_ceiling_backstops_wall_tolerance(prev_record):
+    # the wide wall-clock tolerance on the NCF headline is backstopped by
+    # the tunnel-free device-only step time: a real compute regression
+    # fails here even if the wall number squeaks past the relative gate
+    out = copy.deepcopy(prev_record)
+    out["device_step_ms"] = 1.5
+    with pytest.raises(SystemExit):
+        bench.check_regressions(out)
+
+
+def test_new_metric_without_history_passes(prev_record):
+    out = copy.deepcopy(prev_record)
+    fresh = [k for k in bench.GATED_METRICS
+             if k not in prev_record and k not in bench.ABSOLUTE_FLOORS]
+    if not fresh:
+        pytest.skip("every gated metric already has a history record")
+    out[fresh[0]] = 1.0                 # no prior record → no relative gate
+    bench.check_regressions(out)

@@ -57,12 +57,27 @@ class ImageProcessing(Preprocessing):
 
 
 class Resize(ImageProcessing):
-    """``Resize.scala`` — bilinear resize to (height, width) via PIL."""
+    """``Resize.scala`` — bilinear (triangle-filter) resize to
+    (height, width). Fast path: the native batched C++ library
+    (``native/zoo_image.cc``, the reference's OpenCV-JNI role); falls back
+    to the per-image PIL loop when the library is unavailable."""
 
     def __init__(self, resize_h: int, resize_w: int):
         self.h, self.w = int(resize_h), int(resize_w)
 
+    def apply_batch(self, batch):
+        from analytics_zoo_tpu.native import image as native_image
+        out = native_image.resize_bilinear(batch, self.h, self.w)
+        if out is not None:
+            return out
+        return super().apply_batch(batch)
+
     def apply_one(self, im):
+        from analytics_zoo_tpu.native import image as native_image
+        if im.ndim == 3 and im.shape[-1] in (1, 3, 4):
+            out = native_image.resize_bilinear(im, self.h, self.w)
+            if out is not None:
+                return out
         from PIL import Image
         arr = im
         squeeze = arr.ndim == 3 and arr.shape[-1] == 1
@@ -173,7 +188,8 @@ class ChannelOrder(ImageProcessing):
 
 class ChannelNormalize(ImageProcessing):
     """``ChannelNormalize.scala`` — per-channel (x - mean) / std, output
-    float32."""
+    float32. Batches take the fused native convert+normalize pass
+    (``native/zoo_image.cc``) when available; numpy otherwise."""
 
     def __init__(self, mean: Sequence[float], std: Sequence[float] = (1., 1., 1.)):
         self.mean = np.asarray(mean, np.float32)
@@ -183,6 +199,12 @@ class ChannelNormalize(ImageProcessing):
         return (im.astype(np.float32) - self.mean) / self.std
 
     def apply_batch(self, batch):
+        if (batch.ndim == 4 and self.mean.shape == (batch.shape[-1],)
+                and self.std.shape == self.mean.shape):
+            from analytics_zoo_tpu.native import image as native_image
+            out = native_image.normalize(batch, self.mean, self.std)
+            if out is not None:
+                return out
         return (batch.astype(np.float32) - self.mean) / self.std
 
 

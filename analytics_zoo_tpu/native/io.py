@@ -18,7 +18,6 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
 import threading
 from typing import Optional, Tuple
 
@@ -28,8 +27,6 @@ log = logging.getLogger("analytics_zoo_tpu.native")
 
 _lib = None
 _lib_lock = threading.Lock()
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "native")
 
 
 def _configure(lib):
@@ -59,18 +56,11 @@ def load_native_io() -> Optional[ctypes.CDLL]:
     with _lib_lock:
         if _lib is not None:
             return _lib or None
-        so = os.path.join(_NATIVE_DIR, "libzoo_io.so")
-        src = os.path.join(_NATIVE_DIR, "zoo_io.cc")
+        from analytics_zoo_tpu.native._loader import build_and_load
+        lib = build_and_load("libzoo_io.so", "zoo_io.cc")
         try:
-            if (not os.path.exists(so)
-                    or os.path.getmtime(so) < os.path.getmtime(src)):
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", src,
-                     "-shared", "-pthread", "-o", so],
-                    check=True, capture_output=True, timeout=120)
-                log.info("built native IO library at %s", so)
-            _lib = _configure(ctypes.CDLL(so))
-        except Exception as e:  # noqa: BLE001 — any failure → numpy fallback
+            _lib = _configure(lib) if lib is not None else False
+        except AttributeError as e:   # stale/mismatched binary
             log.warning("native IO unavailable (%s); numpy.memmap fallback "
                         "in use", e)
             _lib = False

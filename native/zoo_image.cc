@@ -1,0 +1,210 @@
+// Native host-side image ops for the data pipeline — the TPU-native
+// equivalent of the reference's OpenCV-JNI layer (SURVEY §2.3: "OpenCV
+// image ops … C++ decode/augment library on TPU-VM hosts feeding the
+// custom loader"; reference interface feature/image/OpenCVMethod.scala,
+// transformers feature/image/*.scala running OpenCV through BigDL JNI).
+//
+// Scope: the two bandwidth-critical batch ops the Python pipeline runs per
+// training batch —
+//  * resize():    separable triangle-filter ("bilinear") resampling, the
+//                 same algorithm family PIL/OpenCV area-aware bilinear use
+//                 (filter widens by the scale factor on downscale, so
+//                 minification averages instead of aliasing);
+//  * normalize(): fused dtype-convert + per-channel (x - mean) * inv_std
+//                 in one pass over the batch.
+// Both are threaded over the batch dimension. Everything else in the
+// transformer zoo (crops, flips, color jitter) is already a cheap numpy
+// slice/arithmetic; the wins here are the per-image Python/PIL loop and
+// the double pass over a float batch.
+//
+// C ABI (ctypes-consumed; no pybind11 in the image); all return 0/-1:
+//   int zoo_image_resize(const void* src, int is_f32, long n, long h,
+//                        long w, long c, void* dst, long oh, long ow,
+//                        int nthreads);
+//   int zoo_image_normalize(const void* src, int is_f32, long n,
+//                           long hw, long c, const float* mean,
+//                           const float* inv_std, float* dst,
+//                           int nthreads);
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Coeffs {
+  // for each output index: input window [lo, lo+len) and its weights
+  std::vector<long> lo;
+  std::vector<int> len;
+  std::vector<float> w;  // ragged, max_len stride
+  int max_len = 0;
+};
+
+// Triangle-filter coefficient table, PIL-style: on downscale the filter
+// support widens by the scale factor so every source pixel contributes.
+Coeffs build_coeffs(long in, long out) {
+  Coeffs co;
+  const double scale = static_cast<double>(in) / static_cast<double>(out);
+  const double fscale = std::max(scale, 1.0);
+  const double support = fscale;  // triangle support 1.0, scaled
+  co.max_len = static_cast<int>(std::ceil(support)) * 2 + 1;
+  co.lo.resize(out);
+  co.len.resize(out);
+  co.w.assign(static_cast<size_t>(out) * co.max_len, 0.0f);
+  for (long x = 0; x < out; ++x) {
+    const double center = (x + 0.5) * scale;
+    long lo = static_cast<long>(std::floor(center - support));
+    long hi = static_cast<long>(std::ceil(center + support));
+    lo = std::max<long>(lo, 0);
+    hi = std::min<long>(hi, in);
+    double total = 0.0;
+    std::vector<double> tmp(hi - lo);
+    for (long i = lo; i < hi; ++i) {
+      const double t = std::abs((i + 0.5 - center) / fscale);
+      const double v = t < 1.0 ? 1.0 - t : 0.0;  // triangle
+      tmp[i - lo] = v;
+      total += v;
+    }
+    if (total <= 0.0) {  // degenerate window: nearest
+      lo = std::min<long>(std::max<long>(
+          static_cast<long>(center), 0), in - 1);
+      co.lo[x] = lo;
+      co.len[x] = 1;
+      co.w[static_cast<size_t>(x) * co.max_len] = 1.0f;
+      continue;
+    }
+    co.lo[x] = lo;
+    co.len[x] = static_cast<int>(hi - lo);
+    for (long i = 0; i < hi - lo; ++i)
+      co.w[static_cast<size_t>(x) * co.max_len + i] =
+          static_cast<float>(tmp[i] / total);
+  }
+  return co;
+}
+
+// One image: (h, w, c) -> (oh, ow, c), horizontal then vertical pass.
+template <typename T>
+void resize_one(const T* src, long h, long w, long c, T* dst, long oh,
+                long ow, const Coeffs& cw, const Coeffs& ch,
+                std::vector<float>& mid) {
+  mid.resize(static_cast<size_t>(h) * ow * c);
+  // horizontal: (h, w, c) -> (h, ow, c)
+  for (long y = 0; y < h; ++y) {
+    const T* row = src + static_cast<size_t>(y) * w * c;
+    float* orow = mid.data() + static_cast<size_t>(y) * ow * c;
+    for (long x = 0; x < ow; ++x) {
+      const float* wt = cw.w.data() + static_cast<size_t>(x) * cw.max_len;
+      const long lo = cw.lo[x];
+      const int len = cw.len[x];
+      for (long ch_i = 0; ch_i < c; ++ch_i) {
+        float acc = 0.0f;
+        for (int k = 0; k < len; ++k)
+          acc += wt[k] * static_cast<float>(row[(lo + k) * c + ch_i]);
+        orow[x * c + ch_i] = acc;
+      }
+    }
+  }
+  // vertical: (h, ow, c) -> (oh, ow, c)
+  for (long y = 0; y < oh; ++y) {
+    const float* wt = ch.w.data() + static_cast<size_t>(y) * ch.max_len;
+    const long lo = ch.lo[y];
+    const int len = ch.len[y];
+    T* orow = dst + static_cast<size_t>(y) * ow * c;
+    for (long xc = 0; xc < ow * c; ++xc) {
+      float acc = 0.0f;
+      for (int k = 0; k < len; ++k)
+        acc += wt[k] * mid[static_cast<size_t>(lo + k) * ow * c + xc];
+      if (std::is_same<T, uint8_t>::value) {
+        acc = acc < 0.0f ? 0.0f : (acc > 255.0f ? 255.0f : acc);
+        orow[xc] = static_cast<T>(acc + 0.5f);
+      } else {
+        orow[xc] = static_cast<T>(acc);
+      }
+    }
+  }
+}
+
+template <typename Fn>
+void parallel_over(long n, int nthreads, Fn fn) {
+  const long want = nthreads > 0
+      ? nthreads
+      : static_cast<long>(std::thread::hardware_concurrency());
+  const int workers = static_cast<int>(
+      std::max<long>(1, std::min<long>(want, n)));
+  if (workers == 1) {
+    fn(0, n, 0);
+    return;
+  }
+  std::vector<std::thread> ts;
+  ts.reserve(workers);
+  const long per = (n + workers - 1) / workers;
+  for (int t = 0; t < workers; ++t) {
+    const long b = t * per, e = std::min<long>(n, b + per);
+    if (b >= e) break;
+    ts.emplace_back([=] { fn(b, e, t); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+int zoo_image_resize(const void* src, int is_f32, long n, long h, long w,
+                     long c, void* dst, long oh, long ow, int nthreads) {
+  if (!src || !dst || n < 0 || h <= 0 || w <= 0 || c <= 0 || oh <= 0 ||
+      ow <= 0)
+    return -1;
+  if (n == 0) return 0;
+  const Coeffs cw = build_coeffs(w, ow);
+  const Coeffs ch = build_coeffs(h, oh);
+  const size_t in_px = static_cast<size_t>(h) * w * c;
+  const size_t out_px = static_cast<size_t>(oh) * ow * c;
+  parallel_over(n, nthreads, [&](long b, long e, int) {
+    std::vector<float> mid;
+    for (long i = b; i < e; ++i) {
+      if (is_f32)
+        resize_one(static_cast<const float*>(src) + i * in_px, h, w, c,
+                   static_cast<float*>(dst) + i * out_px, oh, ow, cw, ch,
+                   mid);
+      else
+        resize_one(static_cast<const uint8_t*>(src) + i * in_px, h, w, c,
+                   static_cast<uint8_t*>(dst) + i * out_px, oh, ow, cw, ch,
+                   mid);
+    }
+  });
+  return 0;
+}
+
+int zoo_image_normalize(const void* src, int is_f32, long n, long hw,
+                        long c, const float* mean, const float* inv_std,
+                        float* dst, int nthreads) {
+  if (!src || !dst || !mean || !inv_std || n < 0 || hw <= 0 || c <= 0)
+    return -1;
+  if (n == 0) return 0;
+  const size_t px = static_cast<size_t>(hw) * c;
+  parallel_over(n, nthreads, [&](long b, long e, int) {
+    for (long i = b; i < e; ++i) {
+      float* out = dst + i * px;
+      // pixel-outer / channel-inner: no per-element modulo, and the
+      // small fixed-trip inner loop vectorizes
+      if (is_f32) {
+        const float* in = static_cast<const float*>(src) + i * px;
+        for (long p = 0; p < hw; ++p, in += c, out += c)
+          for (long ch = 0; ch < c; ++ch)
+            out[ch] = (in[ch] - mean[ch]) * inv_std[ch];
+      } else {
+        const uint8_t* in = static_cast<const uint8_t*>(src) + i * px;
+        for (long p = 0; p < hw; ++p, in += c, out += c)
+          for (long ch = 0; ch < c; ++ch)
+            out[ch] = (static_cast<float>(in[ch]) - mean[ch]) * inv_std[ch];
+      }
+    }
+  });
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,136 @@
+"""Native image-ops library (``native/zoo_image.cc`` via
+``analytics_zoo_tpu/native/image.py``) — the host-side C++ component of the
+image pipeline (reference role: OpenCV through JNI,
+``feature/image/OpenCVMethod.scala``). Parity oracles: PIL's BILINEAR
+resampling (same triangle-filter family) and the numpy normalize path."""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.native import image as native_image
+
+
+pytestmark = pytest.mark.skipif(
+    not native_image.available(), reason="native image lib unavailable")
+
+
+def _pil_resize(im, oh, ow):
+    from PIL import Image
+    return np.asarray(Image.fromarray(im).resize((ow, oh), Image.BILINEAR))
+
+
+@pytest.mark.parametrize("shape,out_hw", [
+    ((40, 50, 3), (32, 36)),    # downscale
+    ((16, 16, 3), (32, 48)),    # upscale
+    ((33, 47, 3), (16, 16)),    # odd sizes
+    ((24, 24, 1), (12, 12)),    # single channel
+])
+def test_resize_matches_pil_uint8(shape, out_hw):
+    rng = np.random.default_rng(0)
+    im = rng.integers(0, 256, shape).astype(np.uint8)
+    got = native_image.resize_bilinear(im, *out_hw)
+    assert got.shape == (*out_hw, shape[-1]) and got.dtype == np.uint8
+    if shape[-1] == 1:
+        want = _pil_resize(im[..., 0], *out_hw)[..., None]
+    else:
+        want = _pil_resize(im, *out_hw)
+    # same filter family; implementations differ by fixed-point vs float
+    # rounding — at most one grey level, no structural drift
+    diff = np.abs(got.astype(int) - want.astype(int))
+    assert diff.max() <= 1, f"max diff {diff.max()}"
+    assert (diff > 0).mean() < 0.35
+
+
+def test_resize_batch_matches_per_image():
+    rng = np.random.default_rng(1)
+    batch = rng.integers(0, 256, (7, 21, 17, 3)).astype(np.uint8)
+    got = native_image.resize_bilinear(batch, 11, 13)
+    assert got.shape == (7, 11, 13, 3)
+    for i in range(7):
+        np.testing.assert_array_equal(
+            got[i], native_image.resize_bilinear(batch[i], 11, 13))
+
+
+def test_resize_float32_identity_and_interp():
+    # identity resize returns the (float) input exactly: the window
+    # degenerates to weight 1 on the source pixel
+    rng = np.random.default_rng(2)
+    im = rng.normal(size=(9, 9, 3)).astype(np.float32)
+    same = native_image.resize_bilinear(im, 9, 9)
+    np.testing.assert_allclose(same, im, rtol=1e-6, atol=1e-6)
+    # 2x upscale of a constant image stays constant
+    const = np.full((8, 8, 3), 3.25, np.float32)
+    up = native_image.resize_bilinear(const, 16, 16)
+    np.testing.assert_allclose(up, 3.25, rtol=1e-6)
+
+
+def test_resize_threading_is_deterministic():
+    rng = np.random.default_rng(3)
+    batch = rng.integers(0, 256, (33, 28, 28, 3)).astype(np.uint8)
+    a = native_image.resize_bilinear(batch, 14, 14, nthreads=1)
+    b = native_image.resize_bilinear(batch, 14, 14, nthreads=8)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_normalize_matches_numpy():
+    rng = np.random.default_rng(4)
+    mean, std = (100.0, 50.0, 25.0), (2.0, 4.0, 8.0)
+    for dtype in (np.uint8, np.float32):
+        batch = (rng.integers(0, 256, (5, 12, 10, 3))
+                 if dtype == np.uint8
+                 else rng.normal(0, 100, (5, 12, 10, 3))).astype(dtype)
+        got = native_image.normalize(batch, mean, std)
+        want = (batch.astype(np.float32) - np.asarray(mean, np.float32)) \
+            / np.asarray(std, np.float32)
+        assert got.dtype == np.float32
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-5)
+
+
+def test_unsupported_inputs_return_none():
+    assert native_image.resize_bilinear(
+        np.zeros((4, 4, 3), np.float64), 2, 2) is None
+    assert native_image.normalize(
+        np.zeros((4, 4, 3), np.uint8), (0.0,), (1.0,)) is None   # c mismatch
+    assert native_image.normalize(
+        np.zeros((4, 4, 3), np.uint8), (0.0,) * 3, (0.0,) * 3) is None
+
+
+def test_transform_classes_use_native_path():
+    """Resize/ChannelNormalize produce correct results whichever path
+    runs — and the batched outputs match the per-image fallback loop."""
+    from analytics_zoo_tpu.feature.image.transforms import (ChannelNormalize,
+                                                            Resize)
+    rng = np.random.default_rng(5)
+    batch = rng.integers(0, 256, (4, 30, 26, 3)).astype(np.uint8)
+    out = Resize(15, 13)(batch)
+    assert out.shape == (4, 15, 13, 3) and out.dtype == np.uint8
+    norm = ChannelNormalize((127.5,) * 3, (127.5,) * 3)(out)
+    assert norm.dtype == np.float32
+    want = (out.astype(np.float32) - 127.5) / 127.5
+    np.testing.assert_allclose(norm, want, rtol=1e-6, atol=1e-6)
+
+
+def test_loader_builds_atomically(tmp_path, monkeypatch):
+    """build_and_load compiles to a temp path then os.replace()s into
+    place: a missing .so is rebuilt, no *.tmp stragglers survive, and a
+    failed compile leaves nothing behind (concurrent first-use builds can
+    never publish a half-written library)."""
+    import shutil
+
+    from analytics_zoo_tpu.native import _loader
+
+    work = tmp_path / "native"
+    work.mkdir()
+    shutil.copy(os.path.join(_loader.NATIVE_DIR, "zoo_image.cc"),
+                work / "zoo_image.cc")
+    monkeypatch.setattr(_loader, "NATIVE_DIR", str(work))
+    lib = _loader.build_and_load("libzoo_image.so", "zoo_image.cc")
+    assert lib is not None and (work / "libzoo_image.so").exists()
+    assert not list(work.glob("*.tmp"))
+    # broken source: build fails, returns None, leaves no artifacts
+    (work / "broken.cc").write_text("int main( {")
+    assert _loader.build_and_load("libbroken.so", "broken.cc") is None
+    assert not (work / "libbroken.so").exists()
+    assert not list(work.glob("*.tmp"))

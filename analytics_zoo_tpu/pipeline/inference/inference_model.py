@@ -316,6 +316,22 @@ class InferenceModel:
         """Batched predict. Blocks while all ``concurrent_num`` replicas are
         busy (the reference blocks on the replica queue,
         ``InferenceModel.scala:622-656``). Thread-safe."""
+        return self.predict_async(x, batch_size)()
+
+    def predict_async(self, x, batch_size: Optional[int] = None,
+                      block: bool = True):
+        """Dispatch a predict WITHOUT blocking on readback. Returns a
+        zero-arg ``collect`` callable: the device work is enqueued here
+        (XLA dispatch is asynchronous), ``collect()`` blocks on the
+        transfer and returns the numpy result. The replica permit is held
+        until ``collect`` runs — call it exactly once.
+
+        With ``block=False`` the call returns None instead of waiting when
+        every replica permit is in flight. A single-threaded pipeline MUST
+        use this mode for its second in-flight dispatch: with
+        ``concurrent_num=1`` a blocking dispatch-before-collect would
+        deadlock on the permit its own later collect() releases. The serve
+        loop (``serving/server.py``) overlaps batches this way."""
         if self._model is None:
             raise RuntimeError("no model loaded; call load()/from_keras() first")
         xs = [np.asarray(a) for a in _as_list(x)]
@@ -327,9 +343,15 @@ class InferenceModel:
         # never exceed the user's HBM bound
         cap = max(_next_pow2(self.max_batch_size + 1) // 2, dp)
         cap = min(cap, max(_next_pow2(n), dp))
-        permit = self._permits.get()
+        if block:
+            permit = self._permits.get()
+        else:
+            try:
+                permit = self._permits.get_nowait()
+            except queue.Empty:
+                return None
+        deferred = []
         try:
-            outs = []
             for i in range(0, n, cap):
                 chunk = [a[i:i + cap] for a in xs]
                 m = chunk[0].shape[0]
@@ -343,11 +365,27 @@ class InferenceModel:
                            for a in chunk]
                 yp = self._predict(self._params, self._net_state,
                                    chunk_d if len(chunk_d) > 1 else chunk_d[0])
-                outs.append(jax.tree.map(lambda a: np.asarray(
-                    jax.device_get(a))[:m], yp))
-            return jax.tree.map(lambda *ys: np.concatenate(ys, axis=0), *outs)
-        finally:
+                deferred.append((yp, m))
+        except BaseException:
             self._permits.put(permit)
+            raise
+
+        done = [False]
+
+        def collect():
+            if done[0]:
+                raise RuntimeError("predict_async result already collected")
+            done[0] = True
+            try:
+                outs = [jax.tree.map(
+                    lambda a, mm=m: np.asarray(jax.device_get(a))[:mm], yp)
+                    for yp, m in deferred]
+                return jax.tree.map(
+                    lambda *ys: np.concatenate(ys, axis=0), *outs)
+            finally:
+                self._permits.put(permit)
+
+        return collect
 
     def predict_classes(self, x, zero_based: bool = True):
         from ...utils.prediction import probs_to_classes

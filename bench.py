@@ -614,7 +614,12 @@ def bench_serving():
                         input_shape=(hw, hw, 3))
     m.init_weights(sample_input=rng.normal(size=(2, hw, hw, 3)
                                            ).astype(np.float32))
-    im = InferenceModel().from_keras(m)
+    # concurrent_num=2 gives the serve loop a second replica permit so its
+    # two-deep pipeline can hold one batch in flight while decoding the
+    # next (serving/server.py _loop) — on the tunneled chip the in-flight
+    # batch's ~60-100 ms round trip then overlaps host work instead of
+    # serializing with it
+    im = InferenceModel(concurrent_num=2).from_keras(m)
     backend = LocalBackend()
     serving = ClusterServing(im, backend=backend, batch_size=batch).start()
     inq, outq = InputQueue(backend), OutputQueue(backend)

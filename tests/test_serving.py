@@ -234,3 +234,112 @@ def test_lifecycle_cli_scripts_flag_protocol(tmp_path):
                        cwd=tmp_path, env=env, capture_output=True, text=True,
                        timeout=120)
     assert "not running" in r.stdout
+
+
+# ---- two-deep pipeline (serving/server.py _loop + predict_async) ----------
+
+def test_predict_async_permits_and_double_collect():
+    """predict_async holds the replica permit until collect(); block=False
+    reports a busy model with None instead of deadlocking; collecting
+    twice is an error."""
+    im = InferenceModel(concurrent_num=1).from_keras(_toy_model())
+    x = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32)
+    want = im.predict(x)                      # also returns the permit
+
+    collect = im.predict_async(x, block=False)
+    assert collect is not None
+    # the single permit is in flight: a second non-blocking dispatch must
+    # refuse rather than block on the permit our own collect() releases
+    assert im.predict_async(x, block=False) is None
+    got = collect()
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    with pytest.raises(RuntimeError):
+        collect()
+    # permit released: dispatch works again
+    c2 = im.predict_async(x, block=False)
+    assert c2 is not None
+    c2()
+
+
+def test_serving_single_permit_no_deadlock():
+    """Regression: with concurrent_num=1 the serve loop must flush its
+    in-flight batch before a blocking dispatch (a dispatch-then-flush
+    order deadlocks on the one permit)."""
+    im = InferenceModel(concurrent_num=1).from_keras(_toy_model())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=2).start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    rng = np.random.default_rng(3)
+    try:
+        for i in range(10):   # 5 batches through the pipeline
+            inq.enqueue(f"p-{i}", rng.normal(size=(6,)).astype(np.float32))
+        for i in range(10):
+            out = outq.query(f"p-{i}", timeout=30.0)
+            assert out is not None and out.shape == (3,)
+    finally:
+        serving.stop(drain=False)
+
+
+def test_serving_pipeline_overlaps_dispatch_and_collect():
+    """With two permits the loop dispatches batch N+1 BEFORE collecting
+    batch N — proven by event order on a spy model, not wall clock."""
+    events = []
+
+    class SpyModel:
+        def __init__(self):
+            self._n = 0
+
+        def predict_async(self, batch, block=True):
+            i = self._n
+            self._n += 1
+            events.append(f"dispatch-{i}")
+            preds = np.zeros((batch.shape[0], 3), np.float32)
+
+            def collect():
+                events.append(f"collect-{i}")
+                return preds
+            return collect
+
+    backend = LocalBackend()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    rng = np.random.default_rng(4)
+    # both batches sit in the stream before the loop starts, so the read
+    # order is deterministic: d0, d1, c0, (drain) c1
+    for i in range(4):
+        inq.enqueue(f"o-{i}", rng.normal(size=(6,)).astype(np.float32))
+    serving = ClusterServing(SpyModel(), backend=backend, batch_size=2).start()
+    try:
+        for i in range(4):
+            assert outq.query(f"o-{i}", timeout=30.0) is not None
+    finally:
+        serving.stop()
+    assert events.index("dispatch-1") < events.index("collect-0"), events
+
+
+def test_missing_uri_record_does_not_misalign_batch():
+    """A decodable payload with no 'uri' field must be dropped whole —
+    not leave an orphan tensor that shifts every later uri onto the
+    previous record's prediction."""
+    model = _toy_model()
+    im = InferenceModel().from_keras(model)
+    backend = LocalBackend()
+    rng = np.random.default_rng(7)
+    xs = {f"m-{i}": rng.normal(size=(6,)).astype(np.float32)
+          for i in range(4)}
+    from analytics_zoo_tpu.serving.client import INPUT_STREAM
+    inq = InputQueue(backend)
+    inq.enqueue("m-0", xs["m-0"])
+    backend.xadd(INPUT_STREAM,
+                 {"data": encode_array(rng.normal(size=(6,)).astype(
+                     np.float32))})           # valid data, no uri
+    for i in range(1, 4):
+        inq.enqueue(f"m-{i}", xs[f"m-{i}"])
+    serving = ClusterServing(im, backend=backend, batch_size=8).start()
+    outq = OutputQueue(backend)
+    try:
+        for uri, x in xs.items():
+            got = outq.query(uri, timeout=30.0)
+            want = im.predict(x[None])[0]
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6), uri
+    finally:
+        serving.stop(drain=False)

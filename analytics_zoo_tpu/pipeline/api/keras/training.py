@@ -171,6 +171,23 @@ def _fired_within(trigger: Optional[Trigger], state: TrainLoopState,
     return trigger(state)
 
 
+def _write_param_histograms(tb, params, epochs, iteration) -> None:
+    """Per-layer weight histograms when the TrainSummary's "Parameters"
+    trigger fires for any epoch in ``epochs`` (reference:
+    ``TrainSummary.setSummaryTrigger("Parameters", ...)`` +
+    ``Summary.scala``'s histogram writer). Called only at boundaries where
+    the params are host-visible; under fused-epoch dispatch that is the
+    final epoch of a fused block, covering the whole block's epochs."""
+    freq = getattr(tb, "parameters_every_epochs", None)
+    if not freq or not any(e % freq == 0 for e in epochs):
+        return
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        tb.add_histogram(f"Parameters/{name}", np.asarray(leaf), iteration)
+
+
 @jax.jit
 def _copy_leaves(leaves):
     return [jnp.copy(a) for a in leaves]
@@ -832,6 +849,10 @@ class TrainingLoop:
                                           it_e)
                         elif isinstance(lr, (int, float)):
                             tb.add_scalar("LearningRate", float(lr), it_e)
+                        if last:
+                            _write_param_histograms(
+                                tb, model.params,
+                                range(epoch + 1, epoch + g + 1), it_e)
                         tb.writer.flush()
                     log.info("Epoch %d: loss=%.6f (%.1f ex/s)", e,
                              epoch_loss, thr)
@@ -967,6 +988,12 @@ class TrainingLoop:
                 elif isinstance(lr, (int, float)):
                     tb.add_scalar("LearningRate", float(lr),
                                   loop_state.iteration)
+                if completed:
+                    # a mid-epoch end_trigger stop retrains this epoch on
+                    # the next fit(); logging its partial params here
+                    # would put two histograms under one epoch number
+                    _write_param_histograms(tb, model.params, (epoch,),
+                                            loop_state.iteration)
                 tb.writer.flush()
             vtb = getattr(model, "_val_summary", None)
             if vtb is not None and val is not None:
@@ -1125,17 +1152,28 @@ def _set_checkpoint(self: KerasNet, path: str, trigger: Optional[Trigger] = None
     return self
 
 
-def _set_tensorboard(self: KerasNet, log_dir: str, app_name: str):
+def _set_tensorboard(self: KerasNet, log_dir: str, app_name: str,
+                     parameters_every_epochs: Optional[int] = None):
     """``setTensorBoard(logDir, appName)`` (``Topology.scala:204-216``):
     write train scalars (Loss per iteration, Throughput, LearningRate) to
     ``<log_dir>/<app_name>/train`` and validation metrics to
-    ``.../validation`` as TensorBoard event files."""
+    ``.../validation`` as TensorBoard event files.
+
+    ``parameters_every_epochs=N`` additionally writes per-layer weight
+    HISTOGRAMS every N epochs (the reference's
+    ``TrainSummary.setSummaryTrigger("Parameters", ...)`` +
+    ``Summary.scala`` histogram path); under fused-epoch dispatch they
+    land on the final epoch of each fused block, where the params are
+    host-visible."""
     from ....utils.tensorboard import TrainSummary, ValidationSummary
     for attr in ("_train_summary", "_val_summary"):
         old = getattr(self, attr, None)
         if old is not None:  # redirecting: release the previous file handle
             old.close()
     self._train_summary = TrainSummary(log_dir, app_name)
+    if parameters_every_epochs is not None:
+        self._train_summary.set_summary_trigger("Parameters",
+                                                parameters_every_epochs)
     self._val_summary = ValidationSummary(log_dir, app_name)
     return self
 

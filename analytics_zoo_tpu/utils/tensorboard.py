@@ -37,7 +37,7 @@ from .proto import (field_bytes as _field_bytes,
                     parse_varint as _parse_varint)
 
 __all__ = ["EventFileWriter", "TrainSummary", "ValidationSummary",
-           "read_scalars"]
+           "read_scalars", "read_histograms"]
 
 
 # ---------------------------------------------------------------------------
@@ -89,6 +89,44 @@ def _version_event(wall_time: float) -> bytes:
     return _field_double(1, wall_time) + _field_bytes(3, b"brain.Event:2")
 
 
+def _packed_doubles(xs) -> bytes:
+    return b"".join(struct.pack("<d", float(x)) for x in xs)
+
+
+def _histogram_event(wall_time: float, step: int, tag: str,
+                     values: np.ndarray) -> bytes:
+    """Event carrying a HistogramProto (the reference writes these for
+    weight/gradient distributions — ``Summary.scala`` histogram path,
+    enabled via ``setSummaryTrigger("Parameters", ...)``)."""
+    raw = np.asarray(values, np.float64).ravel()
+    # stats cover FINITE values only: np.histogram raises on NaN/inf, and
+    # a diverged run is exactly when the user needs the diagnostics — so
+    # non-finite weights degrade to a degenerate histogram rather than
+    # crash fit() from the logging path
+    v = raw[np.isfinite(raw)]
+    if v.size == 0:
+        v = np.zeros(1)
+    vmin, vmax = float(v.min()), float(v.max())
+    if vmin == vmax:
+        limits, counts = [vmax], [float(v.size)]
+    else:
+        c, edges = np.histogram(v, bins=30)
+        limits, counts = edges[1:].tolist(), c.astype(np.float64).tolist()
+    # HistogramProto{ min=1 max=2 num=3 sum=4 sum_squares=5
+    #                 bucket_limit=6 packed, bucket=7 packed }
+    histo = (_field_double(1, vmin) + _field_double(2, vmax)
+             + _field_double(3, float(v.size))
+             + _field_double(4, float(v.sum()))
+             + _field_double(5, float((v * v).sum()))
+             + _field_bytes(6, _packed_doubles(limits))
+             + _field_bytes(7, _packed_doubles(counts)))
+    # Summary.Value{ tag=1, histo=5 }
+    sv = _field_bytes(1, tag.encode("utf-8")) + _field_bytes(5, histo)
+    summary = _field_bytes(1, sv)
+    return (_field_double(1, wall_time) + _field_varint(2, int(step))
+            + _field_bytes(5, summary))
+
+
 # ---------------------------------------------------------------------------
 # writer
 # ---------------------------------------------------------------------------
@@ -117,6 +155,12 @@ class EventFileWriter:
                    wall_time: Optional[float] = None) -> None:
         self._write(_scalar_event(wall_time if wall_time is not None
                                   else time.time(), step, tag, value))
+
+    def add_histogram(self, tag: str, values, step: int,
+                      wall_time: Optional[float] = None) -> None:
+        self._write(_histogram_event(wall_time if wall_time is not None
+                                     else time.time(), step, tag,
+                                     np.asarray(values)))
 
     def flush(self) -> None:
         with self._lock:
@@ -149,11 +193,11 @@ def _read_records(path: str) -> Iterator[bytes]:
             yield data
 
 
-def read_scalars(log_dir: str, tag: Optional[str] = None
-                 ) -> List[Tuple[int, float, float, str]]:
-    """All scalar points under ``log_dir`` as ``(step, value, wall_time,
-    tag)``, sorted by step — the ``readScalar`` analogue."""
-    points = []
+def _iter_summary_values(log_dir: str):
+    """Yield ``(step, wall_time, value_payload)`` for every Summary.Value
+    in every event file under ``log_dir`` — the Event-envelope decoding
+    shared by :func:`read_scalars` and :func:`read_histograms` (one place
+    owns the TFRecord/Event framing rules)."""
     for fname in sorted(os.listdir(log_dir)):
         if "tfevents" not in fname:
             continue
@@ -169,16 +213,58 @@ def read_scalars(log_dir: str, tag: Optional[str] = None
             if summary is None:
                 continue
             for num, wt, val in _parse_fields(summary):
-                if num != 1 or wt != 2:
-                    continue
-                vtag, simple = "", None
-                for n2, w2, p2 in _parse_fields(val):
-                    if n2 == 1 and w2 == 2:
-                        vtag = p2.decode("utf-8")
-                    elif n2 == 2 and w2 == 5:
-                        (simple,) = struct.unpack("<f", p2)
-                if simple is not None and (tag is None or vtag == tag):
-                    points.append((step, simple, wall, vtag))
+                if num == 1 and wt == 2:
+                    yield step, wall, val
+
+
+def read_scalars(log_dir: str, tag: Optional[str] = None
+                 ) -> List[Tuple[int, float, float, str]]:
+    """All scalar points under ``log_dir`` as ``(step, value, wall_time,
+    tag)``, sorted by step — the ``readScalar`` analogue."""
+    points = []
+    for step, wall, val in _iter_summary_values(log_dir):
+        vtag, simple = "", None
+        for n2, w2, p2 in _parse_fields(val):
+            if n2 == 1 and w2 == 2:
+                vtag = p2.decode("utf-8")
+            elif n2 == 2 and w2 == 5:
+                (simple,) = struct.unpack("<f", p2)
+        if simple is not None and (tag is None or vtag == tag):
+            points.append((step, simple, wall, vtag))
+    points.sort(key=lambda p: (p[0], p[2]))
+    return points
+
+
+def _unpack_doubles(payload: bytes) -> List[float]:
+    return [x[0] for x in struct.iter_unpack("<d", payload)]
+
+
+def read_histograms(log_dir: str, tag: Optional[str] = None
+                    ) -> List[Tuple[int, dict, float, str]]:
+    """All histogram points under ``log_dir`` as ``(step, stats, wall_time,
+    tag)`` where ``stats`` has min/max/num/sum/sum_squares/bucket_limit/
+    bucket — the histogram-side ``readScalar`` analogue."""
+    points = []
+    for step, wall, val in _iter_summary_values(log_dir):
+        vtag, histo = "", None
+        for n2, w2, p2 in _parse_fields(val):
+            if n2 == 1 and w2 == 2:
+                vtag = p2.decode("utf-8")
+            elif n2 == 5 and w2 == 2:
+                histo = p2
+        if histo is None or (tag is not None and vtag != tag):
+            continue
+        stats = {"min": 0.0, "max": 0.0, "num": 0.0, "sum": 0.0,
+                 "sum_squares": 0.0, "bucket_limit": [], "bucket": []}
+        keys = {1: "min", 2: "max", 3: "num", 4: "sum", 5: "sum_squares"}
+        for n3, w3, p3 in _parse_fields(histo):
+            if n3 in keys and w3 == 1:
+                (stats[keys[n3]],) = struct.unpack("<d", p3)
+            elif n3 == 6 and w3 == 2:
+                stats["bucket_limit"] = _unpack_doubles(p3)
+            elif n3 == 7 and w3 == 2:
+                stats["bucket"] = _unpack_doubles(p3)
+        points.append((step, stats, wall, vtag))
     points.sort(key=lambda p: (p[0], p[2]))
     return points
 
@@ -197,6 +283,9 @@ class _Summary:
     def add_scalar(self, tag: str, value: float, step: int) -> None:
         self.writer.add_scalar(tag, value, step)
 
+    def add_histogram(self, tag: str, values, step: int) -> None:
+        self.writer.add_histogram(tag, values, step)
+
     def read_scalar(self, tag: str) -> np.ndarray:
         """(n, 3) array of ``[step, value, wall_time]`` rows for ``tag``."""
         self.writer.flush()
@@ -205,14 +294,36 @@ class _Summary:
             return np.zeros((0, 3), np.float64)
         return np.asarray([[s, v, w] for s, v, w, _ in pts], np.float64)
 
+    def read_histogram(self, tag: str):
+        """``(step, stats)`` pairs for ``tag`` (see :func:`read_histograms`)."""
+        self.writer.flush()
+        return [(s, st) for s, st, _, t in read_histograms(self.log_dir, tag)]
+
     def close(self) -> None:
         self.writer.close()
 
 
 class TrainSummary(_Summary):
     """Per-iteration Loss/Throughput (+ LearningRate when known) scalars,
-    written by ``fit`` when ``set_tensorboard`` is configured."""
+    written by ``fit`` when ``set_tensorboard`` is configured. Weight
+    histograms opt in via :meth:`set_summary_trigger` — the reference's
+    ``TrainSummary.setSummaryTrigger("Parameters", ...)`` surface."""
     sub_dir = "train"
+    parameters_every_epochs: Optional[int] = None
+
+    def set_summary_trigger(self, name: str,
+                            every_epochs: int) -> "TrainSummary":
+        """Enable an optional summary family. Supported: ``"Parameters"``
+        — per-layer weight histograms every N epochs (written at epoch
+        boundaries where the params are host-visible; under fused-epoch
+        dispatch that is the final epoch of each fused block)."""
+        if name != "Parameters":
+            raise ValueError(f"unknown summary family {name!r}; "
+                             f"supported: 'Parameters'")
+        if int(every_epochs) < 1:
+            raise ValueError("every_epochs must be >= 1")
+        self.parameters_every_epochs = int(every_epochs)
+        return self
 
 
 class ValidationSummary(_Summary):

@@ -137,3 +137,110 @@ def test_set_profile_captures_trace(tmp_path):
     # one-shot: the second fit must not require/overwrite a trace
     assert getattr(m, "_profile_dir", None) is None
     m.fit(x, y, batch_size=16, nb_epoch=1)
+
+
+def test_histogram_roundtrip_own_reader(tmp_path):
+    """add_histogram → read_histograms preserves the HistogramProto stats
+    (the reference's Summary.scala histogram path)."""
+    from analytics_zoo_tpu.utils.tensorboard import (EventFileWriter,
+                                                     read_histograms)
+    w = EventFileWriter(str(tmp_path))
+    rng = np.random.default_rng(0)
+    vals = rng.normal(2.0, 3.0, 1000)
+    w.add_histogram("weights/W", vals, step=7)
+    w.add_histogram("weights/W", vals * 2, step=8)
+    w.close()
+    pts = read_histograms(str(tmp_path), "weights/W")
+    assert [p[0] for p in pts] == [7, 8]
+    st = pts[0][1]
+    assert st["num"] == 1000
+    np.testing.assert_allclose(st["min"], vals.min())
+    np.testing.assert_allclose(st["max"], vals.max())
+    np.testing.assert_allclose(st["sum"], vals.sum())
+    np.testing.assert_allclose(st["sum_squares"], (vals * vals).sum())
+    assert len(st["bucket"]) == len(st["bucket_limit"]) == 30
+    assert sum(st["bucket"]) == 1000
+    # constant tensor: single-bucket histogram
+    w2 = EventFileWriter(str(tmp_path / "c"))
+    w2.add_histogram("b", np.full(5, 3.5), step=1)
+    w2.close()
+    st2 = read_histograms(str(tmp_path / "c"), "b")[0][1]
+    assert st2["bucket"] == [5.0] and st2["bucket_limit"] == [3.5]
+
+
+def test_histograms_readable_by_tensorboard(tmp_path):
+    """torch's TB reader (a third-party implementation of the same proto)
+    parses our histogram events."""
+    tbe = pytest.importorskip("tensorboard.backend.event_processing"
+                              ".event_accumulator")
+    from analytics_zoo_tpu.utils.tensorboard import EventFileWriter
+    w = EventFileWriter(str(tmp_path))
+    w.add_histogram("h", np.arange(100, dtype=np.float64), step=3)
+    w.close()
+    acc = tbe.EventAccumulator(str(tmp_path),
+                               size_guidance={tbe.HISTOGRAMS: 0})
+    acc.Reload()
+    hists = acc.Histograms("h")
+    assert len(hists) == 1 and hists[0].step == 3
+    assert hists[0].histogram_value.num == 100
+
+
+def test_fit_writes_parameter_histograms(tmp_path):
+    """set_tensorboard(parameters_every_epochs=1) logs per-layer weight
+    histograms from fit — including under fused-epoch dispatch, where they
+    land on the fused block's final epoch."""
+    from analytics_zoo_tpu.common.context import (init_zoo_context,
+                                                  reset_zoo_context)
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.utils.tensorboard import read_histograms
+
+    reset_zoo_context()
+    init_zoo_context()
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    m = Sequential()
+    m.add(Dense(8, activation="relu", input_shape=(4,), name="d1"))
+    m.add(Dense(2, activation="softmax", name="d2"))
+    m.init_weights(sample_input=x)
+    m.compile(optimizer="adam", loss="scce")
+    m.set_tensorboard(str(tmp_path), "app", parameters_every_epochs=1)
+    m.fit(x, y, batch_size=16, nb_epoch=2)
+    train_dir = str(tmp_path / "app" / "train")
+    pts = read_histograms(train_dir)
+    tags = {t for _, _, _, t in pts}
+    assert any(t.startswith("Parameters/") and "d1" in t for t in tags), tags
+    w_pts = [p for p in pts if "d1" in p[3] and p[3].endswith("W")]
+    assert len(w_pts) == 2          # one per epoch
+    assert w_pts[0][1]["num"] == 4 * 8
+
+    # fused-epoch dispatch: histograms land on each fused block's end
+    reset_zoo_context()
+    init_zoo_context(train_fuse_epochs=3, train_device_cache=True)
+    m2 = Sequential()
+    m2.add(Dense(8, activation="relu", input_shape=(4,), name="d1"))
+    m2.add(Dense(2, activation="softmax", name="d2"))
+    m2.init_weights(sample_input=x)
+    m2.compile(optimizer="adam", loss="scce")
+    m2.set_tensorboard(str(tmp_path / "fused"), "app",
+                       parameters_every_epochs=1)
+    m2.fit(x, y, batch_size=16, nb_epoch=3)
+    pts2 = read_histograms(str(tmp_path / "fused" / "app" / "train"))
+    assert pts2, "no histograms under fused dispatch"
+    reset_zoo_context()
+
+
+def test_histogram_nonfinite_weights_do_not_crash(tmp_path):
+    """A diverged run (NaN/inf weights) must degrade to a degenerate
+    histogram, not crash fit() from the logging path."""
+    from analytics_zoo_tpu.utils.tensorboard import (EventFileWriter,
+                                                     read_histograms)
+    w = EventFileWriter(str(tmp_path))
+    w.add_histogram("n", np.array([1.0, np.nan, 2.0, np.inf]), step=1)
+    w.add_histogram("all_bad", np.array([np.nan, np.inf]), step=1)
+    w.close()
+    st = read_histograms(str(tmp_path), "n")[0][1]
+    assert st["num"] == 2 and st["min"] == 1.0 and st["max"] == 2.0
+    st2 = read_histograms(str(tmp_path), "all_bad")[0][1]
+    assert st2["num"] == 1 and sum(st2["bucket"]) == 1

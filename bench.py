@@ -650,7 +650,9 @@ def bench_serving():
 
     try:
         run("warm")                    # compile + steady-state
-        rate = max(run("t1"), run("t2"))
+        # median of 3 timed passes, consistent with every other config
+        # (best-of reporting hides a stalled pipeline; VERDICT r4 weak #4)
+        rate = float(np.median([run("t1"), run("t2"), run("t3")]))
     finally:
         # a failed run must not leak the serve-loop poller (and its model
         # + frame buffers) into the rest of the benchmark process

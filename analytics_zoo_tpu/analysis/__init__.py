@@ -1,0 +1,21 @@
+"""zoolint — JAX/TPU-aware static analysis for the analytics_zoo_tpu stack.
+
+An AST linter (no code execution, no jax import) with a pluggable rule
+registry, targeting the staged-computation hazards runtime tests miss:
+PRNG key reuse, host side effects and hidden syncs under ``jit``, Python
+branches on traced values, import-time device/mesh construction, swallowed
+exceptions in serving retry paths, and missing buffer donation.
+
+CLI:     ``python -m analytics_zoo_tpu.analysis [paths...]``
+Gate:    ``tests/test_zoolint.py`` (tier-1) asserts zero errors.
+Docs:    ``docs/guides/STATIC_ANALYSIS.md``
+Silence: ``# zoolint: disable=ZL001`` on the flagged line.
+"""
+
+from .core import (ERROR, WARNING, Finding, ModuleContext, Rule, all_rules,
+                   lint_file, lint_paths, lint_source, register)
+from .cli import main
+
+__all__ = ["ERROR", "WARNING", "Finding", "ModuleContext", "Rule",
+           "all_rules", "lint_file", "lint_paths", "lint_source",
+           "register", "main"]

@@ -1,0 +1,14 @@
+import os
+import sys
+
+from .cli import main
+
+try:
+    code = main()
+except BrokenPipeError:     # e.g. `... | head` closed the pipe mid-print
+    os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    # findings may have been truncated before the gate could count them —
+    # the conventional SIGPIPE status keeps a piped lint run from
+    # reading as "0 errors"
+    code = 128 + 13
+sys.exit(code)

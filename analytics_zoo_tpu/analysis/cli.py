@@ -1,0 +1,90 @@
+"""``python -m analytics_zoo_tpu.analysis`` — the zoolint command line.
+
+Exit status is 1 when any ERROR-severity finding survives suppression,
+0 otherwise (warnings never gate). With no paths it scans the installed
+``analytics_zoo_tpu`` package plus the sibling ``tests/`` directory and
+``bench.py`` when they exist — exactly what the CI gate
+(`tests/test_zoolint.py`) runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .core import ERROR, all_rules, lint_paths
+
+
+def default_paths() -> List[str]:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(pkg)
+    paths = [pkg]
+    # keep in sync with tests/test_zoolint.py's gate scan — the bare CLI
+    # must agree with what CI enforces
+    for extra in (os.path.join(root, "tests"),
+                  os.path.join(root, "bench.py")):
+        if os.path.exists(extra):
+            paths.append(extra)
+    return paths
+
+
+def _split_ids(value: Optional[str]) -> Optional[List[str]]:
+    if not value:
+        return None
+    return [v.strip() for v in value.split(",") if v.strip()]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="zoolint",
+        description="JAX/TPU-aware static analysis for analytics_zoo_tpu "
+                    "(PRNG reuse, host effects under jit, hidden syncs, "
+                    "import-time device init, ...)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to scan (default: the "
+                         "analytics_zoo_tpu package, tests/ and bench.py)")
+    ap.add_argument("--select", metavar="IDS",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ignore", metavar="IDS",
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--errors-only", action="store_true",
+                    help="print (and count) only error-severity findings")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print every registered rule and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            doc = " ".join((rule.__doc__ or "").split())
+            print(f"{rule.id} [{rule.severity}] {doc}")
+        return 0
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd path would scan zero files and read as a green gate
+        ap.error(f"path does not exist: {', '.join(missing)}")
+    select, ignore = _split_ids(args.select), _split_ids(args.ignore)
+    # same green-gate hazard as a typo'd path: `--select ZL0O1` would run
+    # zero rules and exit 0 (ZL000 is the reserved unparseable-file id)
+    known = {r.id for r in all_rules()} | {"ZL000"}
+    unknown = [i for i in (select or []) + (ignore or []) if i not in known]
+    if unknown:
+        ap.error(f"unknown rule id(s): {', '.join(unknown)} "
+                 f"(see --list-rules)")
+    findings = lint_paths(args.paths or default_paths(),
+                          select=select, ignore=ignore)
+    if args.errors_only:
+        findings = [f for f in findings if f.severity == ERROR]
+    for f in findings:
+        print(f.format())
+    errors = sum(1 for f in findings if f.severity == ERROR)
+    warnings = len(findings) - errors
+    print(f"zoolint: {errors} error(s), {warnings} warning(s), "
+          f"{len(all_rules())} rule(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":    # pragma: no cover
+    sys.exit(main())

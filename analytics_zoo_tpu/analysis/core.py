@@ -1,0 +1,604 @@
+"""zoolint core — the AST machinery behind ``analytics_zoo_tpu.analysis``.
+
+The linter is pure ``ast`` (no jax import, no code execution): a
+:class:`ModuleContext` parses one file and pre-computes the facts every
+rule needs — parent links, which functions are staged by ``jit``/``pjit``/
+``pmap`` (decorator form *and* the ``fn = jax.jit(fn, ...)`` call form this
+codebase prefers), which functions are ``lax.scan``/``fori_loop`` bodies,
+and what local aliases ``jax.random`` / ``numpy`` are imported under.
+
+Rules are small classes registered via :func:`register`; each yields
+:class:`Finding` objects. Suppression is line-scoped: a finding is dropped
+when its anchor line carries ``# zoolint: disable=ZLxxx[,ZLyyy]`` (or a
+blanket ``# zoolint: disable``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*zoolint:\s*disable"
+    r"(?:\s*(?P<eq>=)\s*(?P<ids>ZL\d+(?:\s*,\s*ZL\d+)*)?)?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a source line."""
+
+    rule_id: str
+    severity: str           # ERROR | WARNING
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.severity} " \
+               f"{self.rule_id} {self.message}"
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """How a function is staged: which params are static, whether any
+    buffer donation is declared, and where the jit wrapping happens (the
+    decorator line or the ``jax.jit(fn, ...)`` call line — suppression
+    comments for staging-level rules go there)."""
+
+    fn: ast.AST                      # FunctionDef / AsyncFunctionDef
+    static_names: Set[str]
+    donates: bool
+    anchor_line: int
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_JIT_DOTTED = {"jit", "pjit", "pmap"}
+
+
+def _is_partial(node: ast.AST) -> bool:
+    d = dotted(node)
+    return d in ("partial", "functools.partial")
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    """String constants in a literal or tuple/list of literals."""
+    out: List[str] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            out.extend(_const_strs(e))
+    return out
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    out: List[int] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.append(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            out.extend(_const_ints(e))
+    return out
+
+
+def param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+
+_SCOPE_NODES = (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef,
+                ast.ClassDef)
+
+
+class ModuleContext:
+    """Parsed module + the shared facts rules query."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._zl_parent = parent  # type: ignore[attr-defined]
+        self._jitted: Optional[Dict[int, JitInfo]] = None
+        self._scan_bodies: Optional[List[ast.AST]] = None
+        self._aliases: Optional[Dict[str, Set[str]]] = None
+        self._from_imports: Dict[str, Dict[str, str]] = {}
+        self._jit_names_cache: Optional[Tuple[Set[str], Set[str]]] = None
+        self._jax_names_cache: Optional[Tuple[Set[str],
+                                              Dict[str, str]]] = None
+        self._comments: Optional[Dict[int, str]] = None
+
+    # -- generic helpers ----------------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_zl_parent", None)
+
+    def in_nested_scope(self, node: ast.AST, fn: ast.AST) -> bool:
+        """Whether ``node`` sits inside a def/lambda nested WITHIN ``fn``
+        — a separate runtime scope whose parameters shadow ``fn``'s, so
+        per-function rules must not attribute its statements to ``fn``."""
+        cur = self.parent(node)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return True
+            cur = self.parent(cur)
+        return False
+
+    def functions(self) -> Iterator[ast.AST]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    # -- import aliases -----------------------------------------------------
+    @property
+    def aliases(self) -> Dict[str, Set[str]]:
+        """Local dotted-prefix aliases for the modules rules care about:
+        ``{"jax.random": {"jax.random", "jrandom", ...},
+           "numpy": {"numpy", "np", ...},
+           "jax.numpy": {"jax.numpy", "jnp", ...}}``."""
+        if self._aliases is not None:
+            return self._aliases
+        al = {"jax.random": {"jax.random"},
+              "numpy": {"numpy"},
+              "jax.numpy": {"jax.numpy"},
+              "time": {"time"},
+              "logging": {"logging"}}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in al:
+                        al[a.name].add(a.asname or a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    full = f"{node.module}.{a.name}"
+                    if full in al:
+                        al[full].add(a.asname or a.name)
+        self._aliases = al
+        return al
+
+    def from_imported(self, module: str) -> Dict[str, str]:
+        """``local name -> original name`` for every
+        ``from <module> import x [as y]`` in this file — how rules catch
+        a bare ``perf_counter()`` that is really ``time.perf_counter``."""
+        if module in self._from_imports:
+            return self._from_imports[module]
+        out: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == module:
+                for a in node.names:
+                    out[a.asname or a.name] = a.name
+        self._from_imports[module] = out
+        return out
+
+    def is_call_to(self, call_dotted: Optional[str], module: str,
+                   names: Iterable[str]) -> Optional[str]:
+        """If ``call_dotted`` is ``<alias of module>.<one of names>``,
+        return the bare name, else None."""
+        if not call_dotted or "." not in call_dotted:
+            return None
+        prefix, leaf = call_dotted.rsplit(".", 1)
+        if leaf in names and prefix in self.aliases.get(module, ()):
+            return leaf
+        return None
+
+    @property
+    def jax_names(self) -> Tuple[Set[str], Dict[str, str]]:
+        """``(module_aliases, from_imported)`` for the jax package:
+        local names bound to a jax module (``import jax``, ``import
+        jax.numpy as jnp``, ``from jax import sharding``) and ``local ->
+        original`` for every ``from jax[.x] import name [as alias]``.
+        Rules that flag by call-name (``Mesh``, ``devices``) resolve
+        through this so a non-JAX ``trimesh.Mesh(...)`` or a local
+        ``make_mesh()`` is never mistaken for backend-pinning JAX API."""
+        if self._jax_names_cache is not None:
+            return self._jax_names_cache
+        mods: Set[str] = set()
+        froms: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" or a.name.startswith("jax."):
+                        # `import jax.numpy` binds `jax`; with an asname
+                        # the alias is the submodule itself
+                        mods.add(a.asname if a.asname else "jax")
+            elif isinstance(node, ast.ImportFrom) and node.module and (
+                    node.module == "jax"
+                    or node.module.startswith("jax.")):
+                for a in node.names:
+                    local = a.asname or a.name
+                    froms[local] = a.name
+                    # `from jax import sharding` binds a module too —
+                    # statically indistinguishable from a function import,
+                    # so the local name joins both sets
+                    mods.add(local)
+        self._jax_names_cache = (mods, froms)
+        return self._jax_names_cache
+
+    # -- jit / scan-body discovery ------------------------------------------
+    @property
+    def _jit_names(self) -> Tuple[Set[str], Set[str]]:
+        """``(prefixes, bare)`` — local names resolving to a jax module
+        that carries jit/pjit/pmap, and bare names from-imported off a jax
+        module. Import-resolved so ``@numba.jit`` or a ``self.jit(...)``
+        method is NOT mistaken for JAX staging (the under-jit rules are
+        error-severity; precision matters on arbitrary user files)."""
+        if self._jit_names_cache is not None:
+            return self._jit_names_cache
+        prefixes: Set[str] = set()
+        bare: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax":
+                        prefixes.add(a.asname or "jax")
+                    elif a.name.startswith("jax."):
+                        prefixes.add(a.asname or a.name)
+                        if a.asname is None:
+                            prefixes.add("jax")   # `import jax.x` binds jax
+            elif isinstance(node, ast.ImportFrom) and node.module and (
+                    node.module == "jax"
+                    or node.module.startswith("jax.")):
+                for a in node.names:
+                    if a.name in _JIT_DOTTED:
+                        bare.add(a.asname or a.name)
+                    else:   # e.g. `from jax.experimental import pjit`
+                        prefixes.add(a.asname or a.name)
+        self._jit_names_cache = (prefixes, bare)
+        return self._jit_names_cache
+
+    def _is_jit(self, node: ast.AST) -> bool:
+        d = dotted(node)
+        if d is None:
+            return False
+        prefixes, bare = self._jit_names
+        if "." in d:
+            prefix, leaf = d.rsplit(".", 1)
+            return leaf in _JIT_DOTTED and prefix in prefixes
+        return d in bare
+
+    def _jit_kwargs(self, keywords, fn) -> Tuple[Set[str], bool]:
+        statics: Set[str] = set()
+        donates = False
+        names = param_names(fn)
+        for kw in keywords:
+            if kw.arg in ("static_argnames",):
+                statics.update(_const_strs(kw.value))
+            elif kw.arg in ("static_argnums", "static_broadcasted_argnums"):
+                for i in _const_ints(kw.value):
+                    if 0 <= i < len(names):
+                        statics.add(names[i])
+            elif kw.arg in ("donate_argnums", "donate_argnames"):
+                donates = True
+        return statics, donates
+
+    def _enclosing_scope(self, node: ast.AST) -> ast.AST:
+        cur = self.parent(node)
+        while cur is not None and not isinstance(cur, _SCOPE_NODES):
+            cur = self.parent(cur)
+        return cur if cur is not None else self.tree
+
+    @staticmethod
+    def _scope_bound_names(scope: ast.AST) -> Set[str]:
+        """Names bound inside ``scope`` by parameters or assignment-like
+        statements (not nested defs' locals) — anything here SHADOWS a
+        same-named outer function for Name lookups in this scope."""
+        out: Set[str] = set()
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = scope.args
+            for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+                out.add(p.arg)
+            if a.vararg:
+                out.add(a.vararg.arg)
+            if a.kwarg:
+                out.add(a.kwarg.arg)
+
+        def targets(node):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+            elif isinstance(node, (ast.Tuple, ast.List)):
+                for e in node.elts:
+                    targets(e)
+            elif isinstance(node, ast.Starred):
+                targets(node.value)
+
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue    # nested scope: its locals don't shadow here
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    targets(t)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                                   ast.NamedExpr)):
+                targets(node.target)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                targets(node.target)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        targets(item.optional_vars)
+            elif isinstance(node, ast.ExceptHandler) and node.name:
+                out.add(node.name)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for al in node.names:
+                    out.add((al.asname or al.name).split(".", 1)[0])
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _resolve_local_fn(self, call: ast.Call,
+                          name: str) -> Optional[ast.AST]:
+        """The FunctionDef ``name`` refers to at ``call``, searching the
+        chain of lexically enclosing scopes. A function scope that REBINDS
+        ``name`` — parameter or local assignment — ends the search
+        unresolved: in ``def compile_step(step): return jax.jit(step)``
+        (or ``step = make(); jax.jit(step)``) the jitted thing is the
+        local value, not an unrelated same-named outer function."""
+        scope = self._enclosing_scope(call)
+        while scope is not None:
+            for node in ast.walk(scope):
+                if (isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                        and node.name == name
+                        and self._enclosing_scope(node) is scope):
+                    return node
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and name in self._scope_bound_names(scope):
+                return None
+            if isinstance(scope, ast.Module):
+                return None
+            scope = self._enclosing_scope(scope)
+        return None
+
+    @property
+    def jitted(self) -> Dict[int, JitInfo]:
+        """``id(fn_node) -> JitInfo`` for every function this module stages
+        with jit/pjit/pmap — via decorator (``@jax.jit``,
+        ``@partial(jax.jit, ...)``) or via the call form
+        (``self._step = jax.jit(step, donate_argnums=...)``)."""
+        if self._jitted is not None:
+            return self._jitted
+        out: Dict[int, JitInfo] = {}
+
+        def add(fn, keywords, anchor_line):
+            statics, donates = self._jit_kwargs(keywords, fn)
+            info = out.get(id(fn))
+            if info is None:
+                out[id(fn)] = JitInfo(fn, statics, donates, anchor_line)
+            else:   # jitted twice: merge (stay conservative on donation)
+                info.static_names |= statics
+                info.donates = info.donates or donates
+
+        for fn in self.functions():
+            for dec in fn.decorator_list:
+                if self._is_jit(dec):
+                    add(fn, [], fn.lineno)
+                elif isinstance(dec, ast.Call):
+                    if self._is_jit(dec.func):
+                        add(fn, dec.keywords, fn.lineno)
+                    elif (_is_partial(dec.func) and dec.args
+                          and self._is_jit(dec.args[0])):
+                        add(fn, dec.keywords, fn.lineno)
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Call) and self._is_jit(node.func)
+                    and node.args and isinstance(node.args[0], ast.Name)):
+                fn = self._resolve_local_fn(node, node.args[0].id)
+                if fn is not None:
+                    add(fn, node.keywords, node.lineno)
+        self._jitted = out
+        return out
+
+    @property
+    def scan_bodies(self) -> List[ast.AST]:
+        """Function/lambda nodes passed to ``lax.scan`` / ``lax.fori_loop``
+        / ``lax.while_loop`` / ``lax.map`` — their bodies are traced even
+        outside any jit, so the host-sync rules cover them too."""
+        if self._scan_bodies is not None:
+            return self._scan_bodies
+        out: List[ast.AST] = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if not d or d.rsplit(".", 1)[-1] not in (
+                    "scan", "fori_loop", "while_loop", "map", "cond"):
+                continue
+            if "lax" not in d.split("."):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    out.append(arg)
+                elif isinstance(arg, ast.Name):
+                    fn = self._resolve_local_fn(node, arg.id)
+                    if fn is not None:
+                        out.append(fn)
+        self._scan_bodies = out
+        return out
+
+    # -- suppression --------------------------------------------------------
+    @property
+    def comments(self) -> Dict[int, str]:
+        """``line -> comment text`` — tokenized so a STRING LITERAL that
+        happens to contain ``# zoolint: disable`` can never suppress a
+        real finding on its line."""
+        if self._comments is None:
+            out: Dict[int, str] = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(self.source).readline):
+                    if tok.type == tokenize.COMMENT:
+                        out[tok.start[0]] = tok.string
+            except (tokenize.TokenError, IndentationError, SyntaxError):
+                # ast.parse succeeded, so this is near-unreachable; degrade
+                # to raw lines (over-suppression beats a crashed scan)
+                out = {i + 1: ln for i, ln in enumerate(self.lines)}
+            self._comments = out
+        return self._comments
+
+    def suppressed(self, finding: Finding) -> bool:
+        comment = self.comments.get(finding.line)
+        if not comment:
+            return False
+        m = _SUPPRESS_RE.search(comment)
+        if not m:
+            return False
+        ids = m.group("ids")
+        if ids is None:
+            # bare `# zoolint: disable` is a blanket suppression, but
+            # `disable=<not-a-rule-id>` is a typo, not a blanket
+            return m.group("eq") is None
+        # trailing prose after the id list (`disable=ZL001 key reuse is
+        # fine here`) is a justification, not part of the ids
+        return finding.rule_id in {s.strip() for s in ids.split(",")}
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+
+class Rule:
+    """One check. Subclasses set ``id``/``severity``/``__doc__`` and
+    implement :meth:`check`. ``severity`` is the default — rules may emit
+    findings at a different severity (e.g. ZL007 escalates by path)."""
+
+    id: str = ""
+    severity: str = ERROR
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleContext, line: int, message: str,
+                severity: Optional[str] = None) -> Finding:
+        return Finding(self.id, severity or self.severity, ctx.path,
+                       line, message)
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding one rule instance to the global registry."""
+    if not cls.id:
+        raise ValueError(f"{cls.__name__} has no id")
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY[cls.id] = cls()
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    from . import rules  # noqa: F401  (registers on first import)
+    return sorted(_REGISTRY.values(), key=lambda r: r.id)
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def _zl000_kept(select: Optional[Iterable[str]],
+                ignore: Optional[Iterable[str]]) -> bool:
+    """select/ignore apply to ZL000 like any rule id — `--ignore ZL000`
+    must actually drop unparseable-file findings, not no-op."""
+    if select is not None and "ZL000" not in set(select):
+        return False
+    return "ZL000" not in set(ignore or ())
+
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[Iterable[str]] = None,
+                ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+    """All non-suppressed findings for one module's source text."""
+    try:
+        ctx = ModuleContext(path, source)
+    # ValueError: ast.parse rejects e.g. null bytes without a SyntaxError
+    except (SyntaxError, ValueError) as e:
+        if not _zl000_kept(select, ignore):
+            return []
+        return [Finding("ZL000", ERROR, path, getattr(e, "lineno", 1) or 1,
+                        f"syntax error: {getattr(e, 'msg', None) or e}")]
+    select = set(select) if select else None
+    ignore = set(ignore) if ignore else set()
+    out: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for rule in all_rules():
+        if select is not None and rule.id not in select:
+            continue
+        if rule.id in ignore:
+            continue
+        for f in rule.check(ctx):
+            key = (f.rule_id, f.line, f.message)
+            if key in seen or ctx.suppressed(f):
+                continue
+            seen.add(key)
+            out.append(f)
+    out.sort(key=lambda f: (f.line, f.rule_id))
+    return out
+
+
+def lint_file(path: str, **kw) -> List[Finding]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    # one unreadable/non-UTF8 file must degrade to a finding, not abort
+    # the whole gate scan with every later file unscanned
+    except (OSError, UnicodeDecodeError) as e:
+        if not _zl000_kept(kw.get("select"), kw.get("ignore")):
+            return []
+        return [Finding("ZL000", ERROR, path, 1, f"cannot read: {e}")]
+    return lint_source(source, path, **kw)
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    # overlapping arguments (`zoolint pkg/ pkg/x.py`) must not lint a file
+    # twice — every finding would print and count double
+    seen: Set[str] = set()
+
+    def fresh(p: str) -> bool:
+        rp = os.path.realpath(p)
+        if rp in seen:
+            return False
+        seen.add(rp)
+        return True
+
+    for p in paths:
+        if os.path.isfile(p):
+            if fresh(p):
+                yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(".")
+                                 and d != "__pycache__")
+                for name in sorted(files):
+                    if name.endswith(".py") and \
+                            fresh(os.path.join(root, name)):
+                        yield os.path.join(root, name)
+
+
+def lint_paths(paths: Iterable[str], **kw) -> List[Finding]:
+    out: List[Finding] = []
+    for path in iter_py_files(paths):
+        out.extend(lint_file(path, **kw))
+    return out

@@ -1,0 +1,871 @@
+"""zoolint rules ZL001–ZL008 — the JAX/TPU hazards that bite this stack.
+
+Every rule documents its rationale in the class docstring (surfaced by
+``--list-rules`` and docs/guides/STATIC_ANALYSIS.md). Severities:
+
+* ``error``   — gates CI (``tests/test_zoolint.py`` asserts zero),
+* ``warning`` — advisory only (heuristic rules ZL005/ZL008, and ZL007's
+  swallow-pass form outside the serving/inference retry paths).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import (ERROR, WARNING, Finding, ModuleContext, Rule, dotted,
+                   param_names, register)
+
+
+def _walk_skipping(root: ast.AST, skip_types=(),
+                   skip_nodes=frozenset()) -> Iterator[ast.AST]:
+    """``ast.walk`` that does not descend into the given node types or
+    specific node ids (the root itself is always yielded)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, skip_types) or id(child) in skip_nodes:
+                continue
+            stack.append(child)
+
+
+_CALLBACK_LEAVES = {"callback", "pure_callback", "io_callback"}
+
+
+def _callback_hosted_fns(ctx: ModuleContext, fn: ast.AST) -> Set[int]:
+    """ids of nested functions/lambdas passed to a host-callback API
+    (``jax.debug.callback`` / ``jax.pure_callback`` / ``io_callback``) —
+    their bodies run on the HOST at execution time, not at trace, so the
+    under-jit effect/sync rules must not flag them."""
+    out: Set[int] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if not d or d.rsplit(".", 1)[-1] not in _CALLBACK_LEAVES:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Lambda):
+                out.add(id(arg))
+            elif isinstance(arg, ast.Name):
+                target = ctx._resolve_local_fn(node, arg.id)
+                if target is not None:
+                    out.add(id(target))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ZL001 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+# jax.random callables that do NOT consume their key: ``fold_in`` derives
+# without consuming (the idiomatic per-step schedule used across parallel/
+# and the keras engine), and the constructors make fresh keys. ``split``
+# is deliberately absent — it both consumes and is checked against earlier
+# consumption, and _key_call classifies it before this set is consulted.
+_NON_CONSUMING = {"fold_in", "key", "PRNGKey", "wrap_key_data",
+                  "key_data", "clone", "key_impl"}
+
+
+@register
+class PRNGKeyReuse(Rule):
+    """A ``jax.random`` key passed to a second sampler (or re-``split``)
+    without an intervening ``split``/reassignment replays the exact same
+    random stream — dropout masks repeat, initializers correlate, and the
+    bug is invisible at runtime because every draw still *looks* random.
+    Loop bodies are scanned twice so a loop-invariant key consumed each
+    iteration is caught as well."""
+
+    id = "ZL001"
+    severity = ERROR
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for scope in [ctx.tree] + list(ctx.functions()):
+            findings: List[Finding] = []
+            self._walk(ctx, scope.body, {}, findings)
+            yield from findings
+        # lambda bodies are their own scope (params are fresh bindings, so
+        # they start with an empty consumed-set), but a key consumed twice
+        # WITHIN one body is still reuse on every call
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Lambda):
+                findings = []
+                self._scan_expr(ctx, node.body, {}, findings)
+                yield from findings
+
+    # -- statement-ordered dataflow walk ------------------------------------
+    def _key_call(self, ctx: ModuleContext,
+                  call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(kind, keyname) for a ``jax.random.X(key, ...)`` call with a
+        simple Name key, where kind is 'sampler' | 'split' | 'other'."""
+        d = dotted(call.func)
+        if not d or "." not in d:
+            return None
+        prefix, leaf = d.rsplit(".", 1)
+        if prefix not in ctx.aliases["jax.random"]:
+            return None
+        # the key rides as the first positional OR the `key=` keyword —
+        # `key` is positional-or-keyword in every jax.random sampler
+        key_node: Optional[ast.AST] = None
+        if call.args:
+            key_node = call.args[0]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "key":
+                    key_node = kw.value
+                    break
+        if not isinstance(key_node, ast.Name):
+            return None
+        name = key_node.id
+        if leaf == "split":
+            return "split", name
+        if leaf in _NON_CONSUMING:
+            return "other", name
+        return "sampler", name
+
+    _COMPS = (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+
+    def _scan_expr(self, ctx, node, consumed: Dict[str, int],
+                   findings: List[Finding],
+                   comp_bound: frozenset = frozenset()) -> None:
+        stack = [(node, comp_bound)]    # (node, names bound per-iteration)
+        while stack:
+            sub, comp_bound = stack.pop()
+            if isinstance(sub, ast.Lambda):
+                continue    # own scope: params shadow (visited separately)
+            if isinstance(sub, ast.IfExp):
+                # mutually-exclusive arms: at most one consumes at
+                # runtime — branch the consumed-set like the
+                # statement-level ast.If handling in _walk
+                self._scan_expr(ctx, sub.test, consumed, findings,
+                                comp_bound)
+                branches = []
+                for arm in (sub.body, sub.orelse):
+                    c = dict(consumed)
+                    self._scan_expr(ctx, arm, c, findings, comp_bound)
+                    branches.append(c)
+                for c in branches:
+                    consumed.update(c)
+                continue
+            if isinstance(sub, ast.BoolOp):
+                # short-circuit is sequential-PREFIX, not exclusive arms:
+                # operand i evaluates only after operands 0..i-1 already
+                # did (and consumed) — accumulate in order so reuse
+                # across `and`/`or` operands is caught
+                for v in sub.values:
+                    self._scan_expr(ctx, v, consumed, findings, comp_bound)
+                continue
+            if isinstance(sub, self._COMPS):
+                # a comprehension body runs once per element: any key it
+                # consumes that is NOT the comprehension's own loop
+                # variable is loop-invariant reuse
+                bound = set(comp_bound)
+                for gen in sub.generators:
+                    for t in ast.walk(gen.target):
+                        if isinstance(t, ast.Name):
+                            bound.add(t.id)
+                bound_f = frozenset(bound)
+                # the FIRST generator's iterable evaluates once in the
+                # enclosing scope — `for k in jax.random.split(rng, n)`
+                # is the idiomatic fix, not per-element reuse
+                for i, gen in enumerate(sub.generators):
+                    stack.append((gen.iter,
+                                  comp_bound if i == 0 else bound_f))
+                    for cond in gen.ifs:
+                        stack.append((cond, bound_f))
+                if isinstance(sub, ast.DictComp):
+                    stack.append((sub.key, bound_f))
+                    stack.append((sub.value, bound_f))
+                else:
+                    stack.append((sub.elt, bound_f))
+                continue
+            if isinstance(sub, ast.Call):
+                kc = self._key_call(ctx, sub)
+                if kc is not None and kc[0] != "other":
+                    kind, name = kc
+                    if comp_bound and name not in comp_bound:
+                        findings.append(self.finding(
+                            ctx, sub.lineno,
+                            f"PRNG key `{name}` is consumed once per "
+                            f"comprehension element — every draw is "
+                            f"identical; fold_in/split per element "
+                            f"instead"))
+                    elif name in consumed:
+                        verb = ("re-split" if kind == "split"
+                                else "passed to a sampler")
+                        findings.append(self.finding(
+                            ctx, sub.lineno,
+                            f"PRNG key `{name}` already consumed on line "
+                            f"{consumed[name]} is {verb} again — derive a "
+                            f"fresh key with jax.random.split/fold_in"))
+                    elif not comp_bound:
+                        consumed[name] = sub.lineno
+            elif isinstance(sub, ast.NamedExpr) and \
+                    isinstance(sub.target, ast.Name):
+                consumed.pop(sub.target.id, None)
+            # push reversed so the LIFO pop visits children in SOURCE
+            # order — the "already consumed on line N" message must cite
+            # the earlier call and anchor the later one, not vice versa
+            for child in reversed(list(ast.iter_child_nodes(sub))):
+                stack.append((child, comp_bound))
+
+    @staticmethod
+    def _bound_names(target) -> Iterator[str]:
+        """Names in BINDING position only — ``d[k] = v`` / ``obj.k = v``
+        assign THROUGH ``k``/``obj`` without rebinding them, so they must
+        not clear a key's consumed state."""
+        stack = [target]
+        while stack:
+            t = stack.pop()
+            if isinstance(t, ast.Name):
+                yield t.id
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            elif isinstance(t, ast.Starred):
+                stack.append(t.value)
+
+    @classmethod
+    def _terminates(cls, stmts) -> bool:
+        """Whether a statement list never falls through (its last statement
+        unconditionally leaves the block). Such a branch's consumed-set
+        must not merge into the fall-through state — the idiomatic
+        early-return `if fast: return jax.random.normal(k, ...)` does not
+        consume `k` on the path that reaches the next sampler."""
+        if not stmts:
+            return False
+        last = stmts[-1]
+        if isinstance(last, (ast.Return, ast.Raise, ast.Break,
+                             ast.Continue)):
+            return True
+        if isinstance(last, ast.If):
+            return cls._terminates(last.body) and cls._terminates(last.orelse)
+        if isinstance(last, ast.Try):
+            return (cls._terminates(last.finalbody)
+                    or (cls._terminates(last.orelse if last.orelse
+                                        else last.body)
+                        and all(cls._terminates(h.body)
+                                for h in last.handlers)))
+        if isinstance(last, (ast.With, ast.AsyncWith)):
+            return cls._terminates(last.body)
+        return False
+
+    def _walk(self, ctx, stmts, consumed: Dict[str, int],
+              findings: List[Finding]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue    # separate scope, visited on its own
+            if isinstance(st, ast.If):
+                self._scan_expr(ctx, st.test, consumed, findings)
+                c1, c2 = dict(consumed), dict(consumed)
+                self._walk(ctx, st.body, c1, findings)
+                self._walk(ctx, st.orelse, c2, findings)
+                consumed.clear()
+                if not self._terminates(st.body):
+                    consumed.update(c1)
+                if not self._terminates(st.orelse):
+                    consumed.update(c2)
+            elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                head = st.iter if isinstance(st, (ast.For, ast.AsyncFor)) \
+                    else st.test
+                self._scan_expr(ctx, head, consumed, findings)
+                # two passes over the body: the second catches a key that
+                # is consumed each iteration but only rebound outside. A
+                # body that never falls through runs at most one iteration
+                # (`for ...: return jax.random.normal(k, ...)` is not
+                # reuse), so the rescan is skipped
+                for _ in range(2):
+                    if isinstance(st, (ast.For, ast.AsyncFor)):
+                        for n in self._bound_names(st.target):
+                            consumed.pop(n, None)
+                    self._walk(ctx, st.body, consumed, findings)
+                    if self._terminates(st.body):
+                        break
+                self._walk(ctx, st.orelse, consumed, findings)
+            elif isinstance(st, (ast.Try,)):
+                # a handler runs only when the body failed — possibly
+                # before it consumed anything — so each handler branches
+                # from the PRE-body state (like ast.If arms); orelse runs
+                # only after the full body, finalbody always
+                pre = dict(consumed)
+                self._walk(ctx, st.body, consumed, findings)
+                branches = []
+                for h in st.handlers:
+                    c = dict(pre)
+                    self._walk(ctx, h.body, c, findings)
+                    branches.append(c)
+                self._walk(ctx, st.orelse, consumed, findings)
+                for h, c in zip(st.handlers, branches):
+                    if not self._terminates(h.body):
+                        consumed.update(c)
+                self._walk(ctx, st.finalbody, consumed, findings)
+            elif isinstance(st, ast.Match):
+                # case arms are mutually exclusive — branch like ast.If
+                self._scan_expr(ctx, st.subject, consumed, findings)
+                branches = []
+                for case in st.cases:
+                    c = dict(consumed)
+                    if case.guard is not None:
+                        self._scan_expr(ctx, case.guard, c, findings)
+                    self._walk(ctx, case.body, c, findings)
+                    branches.append((case, c))
+                for case, c in branches:
+                    if not self._terminates(case.body):
+                        consumed.update(c)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    self._scan_expr(ctx, item.context_expr, consumed,
+                                    findings)
+                self._walk(ctx, st.body, consumed, findings)
+            elif isinstance(st, ast.Assign):
+                self._scan_expr(ctx, st.value, consumed, findings)
+                for t in st.targets:
+                    for n in self._bound_names(t):
+                        consumed.pop(n, None)
+            elif isinstance(st, ast.AugAssign):
+                self._scan_expr(ctx, st.value, consumed, findings)
+                for n in self._bound_names(st.target):
+                    consumed.pop(n, None)
+            elif isinstance(st, ast.AnnAssign):
+                if st.value is not None:
+                    self._scan_expr(ctx, st.value, consumed, findings)
+                for n in self._bound_names(st.target):
+                    consumed.pop(n, None)
+            elif isinstance(st, ast.Delete):
+                for t in st.targets:
+                    for n in self._bound_names(t):
+                        consumed.pop(n, None)
+            else:
+                self._scan_expr(ctx, st, consumed, findings)
+
+
+# ---------------------------------------------------------------------------
+# ZL002 — host side effects under jit
+# ---------------------------------------------------------------------------
+
+_BARE_EFFECTS = {"print", "input", "breakpoint", "open", "exec", "eval"}
+_TIME_EFFECTS = {"time", "perf_counter", "perf_counter_ns", "monotonic",
+                 "monotonic_ns", "sleep", "process_time", "time_ns"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+_LOG_OBJECTS = {"log", "logger", "logging"}
+
+
+@register
+class HostEffectInJit(Rule):
+    """``print``/``time.time``/logging inside a jitted function executes
+    once at TRACE time and never again — the timestamp is the compile
+    time, the log line fires on recompiles only, and under donation the
+    printed value may alias a freed buffer. Use ``jax.debug.print`` /
+    ``jax.debug.callback`` for traced-value output."""
+
+    id = "ZL002"
+    severity = ERROR
+
+    def _banned(self, ctx: ModuleContext,
+                d: Optional[str]) -> Optional[str]:
+        if not d:
+            return None
+        if d in _BARE_EFFECTS:
+            return f"`{d}`"
+        if "." in d:
+            prefix, leaf = d.rsplit(".", 1)
+            if ctx.is_call_to(d, "time", _TIME_EFFECTS):
+                return f"`{d}`"
+            if leaf in _LOG_METHODS and (
+                    prefix.split(".")[0] in _LOG_OBJECTS
+                    or prefix in ctx.aliases["logging"]):
+                return f"`{d}`"
+        else:
+            # from-imports: `from time import perf_counter [as pc]`
+            if ctx.from_imported("time").get(d) in _TIME_EFFECTS:
+                return f"`{d}` (time.*)"
+            if ctx.from_imported("logging").get(d) in _LOG_METHODS:
+                return f"`{d}` (logging.*)"
+        return None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for info in ctx.jitted.values():
+            hosted = _callback_hosted_fns(ctx, info.fn)
+            for node in _walk_skipping(info.fn, skip_nodes=hosted):
+                if not isinstance(node, ast.Call):
+                    continue
+                what = self._banned(ctx, dotted(node.func))
+                if what:
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"host side effect {what} inside jitted "
+                        f"`{getattr(info.fn, 'name', '<fn>')}` runs at "
+                        f"trace time only — use jax.debug.print/callback")
+
+
+# ---------------------------------------------------------------------------
+# ZL003 — hidden host sync in a traced body
+# ---------------------------------------------------------------------------
+
+_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+
+
+@register
+class HostSyncInStep(Rule):
+    """``.item()`` / ``np.asarray`` / ``jax.device_get`` /
+    ``block_until_ready`` inside a jitted function or a ``lax.scan``-family
+    body forces the traced value to a concrete host value — at best a
+    ``TracerError``, at worst (on module constants) a silent
+    device→host→device round-trip baked into every step."""
+
+    id = "ZL003"
+    severity = ERROR
+
+    def _offense(self, ctx: ModuleContext,
+                 node: ast.Call) -> Optional[str]:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_METHODS:
+            return f"`.{node.func.attr}()`"
+        d = dotted(node.func)
+        if not d:
+            return None
+        # import-resolved like ZL002/ZL006: a local helper that happens to
+        # be NAMED device_get must not produce an error-severity finding
+        mods, froms = ctx.jax_names
+        if "." in d:
+            prefix, leaf = d.rsplit(".", 1)
+            if leaf == "device_get" and prefix.split(".", 1)[0] in mods:
+                return f"`{d}`"
+        elif froms.get(d) == "device_get":
+            return f"`{d}`"
+        leaf = ctx.is_call_to(d, "numpy", ("asarray", "array", "copy"))
+        if leaf:
+            return f"`{d}`"
+        return None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        bodies = [(info.fn, getattr(info.fn, "name", "<fn>"))
+                  for info in ctx.jitted.values()]
+        bodies += [(fn, getattr(fn, "name", "<lambda>"))
+                   for fn in ctx.scan_bodies]
+        seen: Set[int] = set()
+        for fn, name in bodies:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            hosted = _callback_hosted_fns(ctx, fn)
+            for node in _walk_skipping(fn, skip_nodes=hosted):
+                if isinstance(node, ast.Call):
+                    what = self._offense(ctx, node)
+                    if what:
+                        yield self.finding(
+                            ctx, node.lineno,
+                            f"{what} in traced `{name}` forces a host "
+                            f"sync/concretization — keep the value on "
+                            f"device (jnp.*) or move the readback out of "
+                            f"the traced body")
+
+
+# ---------------------------------------------------------------------------
+# ZL004 — Python control flow on a traced value
+# ---------------------------------------------------------------------------
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                 "weak_type"}
+_SAFE_FUNCS = {"len", "isinstance", "getattr", "hasattr", "callable",
+               "type", "id"}
+
+
+@register
+class TracedBranch(Rule):
+    """A Python ``if``/``while`` on a traced argument concretizes it at
+    trace time — ``TracerBoolConversionError`` at best, or (when jit
+    falls back to recompiling per value) a silent compile per distinct
+    input. Branch on static metadata (``x.shape``, ``x.ndim``), mark the
+    argument static, or use ``lax.cond``/``lax.select``/``jnp.where``."""
+
+    id = "ZL004"
+    severity = ERROR
+
+    def _test_traced_name(self, ctx: ModuleContext, test: ast.AST,
+                          traced: Set[str]) -> Optional[str]:
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Name) and node.id in traced):
+                continue
+            par = ctx.parent(node)
+            if isinstance(par, ast.Attribute) \
+                    and par.attr in _STATIC_ATTRS:
+                continue
+            if isinstance(par, ast.Call):
+                if node is par.func:
+                    continue
+                if dotted(par.func) in _SAFE_FUNCS:
+                    continue
+            if isinstance(par, ast.Compare):
+                if all(isinstance(op, (ast.Is, ast.IsNot))
+                       for op in par.ops):
+                    continue
+                operands = [par.left] + list(par.comparators)
+                if any(isinstance(o, ast.Constant) and o.value is None
+                       for o in operands):
+                    continue
+            return node.id
+        return None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for info in ctx.jitted.values():
+            fn = info.fn
+            traced = {n for n in param_names(fn)
+                      if n not in info.static_names} - {"self", "cls"}
+            traced.update(kw.arg for kw in fn.args.kwonlyargs
+                          if kw.arg not in info.static_names)
+            if not traced:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                if ctx.in_nested_scope(node, fn):   # own scope: shadows
+                    continue
+                name = self._test_traced_name(ctx, node.test, traced)
+                if name:
+                    kind = "while" if isinstance(node, ast.While) else "if"
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"Python `{kind}` on traced argument `{name}` of "
+                        f"jitted `{getattr(fn, 'name', '<fn>')}` — use "
+                        f"lax.cond/jnp.where, branch on static metadata, "
+                        f"or mark the argument static")
+
+
+# ---------------------------------------------------------------------------
+# ZL005 — per-element device work built in a Python loop (warn)
+# ---------------------------------------------------------------------------
+
+_BUILD_SINKS = {"stack", "concatenate", "array", "asarray", "vstack",
+                "hstack"}
+
+
+@register
+class LoopBuiltArray(Rule):
+    """A Python loop appending per-element ``jnp`` results that are later
+    ``jnp.stack``-ed dispatches one device op (and potentially one
+    compile) per element; ``vmap`` or a batched op does it in one fused
+    kernel. Heuristic and warn-only: loops over layers/pytrees of
+    distinct shapes are legitimate."""
+
+    id = "ZL005"
+    severity = WARNING
+
+    def _jnp_call_inside(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                d = dotted(sub.func)
+                if d and "." in d:
+                    prefix = d.rsplit(".", 1)[0]
+                    if prefix in ctx.aliases["jax.numpy"]:
+                        return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        # one lexical scope at a time: appended-list names and stack-sink
+        # names must come from the SAME function (or the module top level)
+        # — a bare-name match across unrelated scopes is meaningless, and
+        # the module pass must not re-walk every function body
+        nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        for fn in list(ctx.functions()) + [ctx.tree]:
+            scope = [n for st in fn.body if not isinstance(st, nested)
+                     for n in _walk_skipping(st, skip_types=nested)]
+            loops: List[Tuple[ast.For, Set[str]]] = []
+            for node in scope:
+                if not isinstance(node, ast.For):
+                    continue
+                appended: Set[str] = set()
+                for sub in _walk_skipping(node, skip_types=nested):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "append"
+                            and isinstance(sub.func.value, ast.Name)
+                            and sub.args
+                            and self._jnp_call_inside(ctx, sub.args[0])):
+                        appended.add(sub.func.value.id)
+                if appended:
+                    loops.append((node, appended))
+            if not loops:
+                continue
+            sinks: Set[str] = set()
+            for node in scope:
+                if isinstance(node, ast.Call):
+                    d = dotted(node.func)
+                    if d and "." in d and \
+                            d.rsplit(".", 1)[-1] in _BUILD_SINKS and \
+                            d.rsplit(".", 1)[0] in ctx.aliases["jax.numpy"]:
+                        for arg in node.args:
+                            for sub in ast.walk(arg):
+                                if isinstance(sub, ast.Name):
+                                    sinks.add(sub.id)
+            for loop, appended in loops:
+                hit = appended & sinks
+                if hit:
+                    yield self.finding(
+                        ctx, loop.lineno,
+                        f"list `{sorted(hit)[0]}` built from jnp results "
+                        f"in a Python loop then stacked — consider "
+                        f"jax.vmap or a batched op (one dispatch instead "
+                        f"of one per element)")
+
+
+# ---------------------------------------------------------------------------
+# ZL006 — import-time device/mesh init & mutable defaults
+# ---------------------------------------------------------------------------
+
+_DEVICE_LEAVES = {"devices", "local_devices", "device_count",
+                  "local_device_count", "process_count", "process_index"}
+_MESH_LEAVES = {"Mesh", "create_mesh", "make_mesh", "create_device_mesh"}
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray",
+                  "collections.defaultdict", "collections.OrderedDict"}
+
+
+@register
+class ImportTimeHazard(Rule):
+    """Module-level ``jax.devices()``/``Mesh`` construction runs at import
+    — before ``jax.distributed.initialize`` on multi-host, it pins a
+    single-process backend and every later mesh is wrong (see
+    ``parallel/mesh.py``'s lazy ``global_mesh()``). Mutable default
+    arguments are the classic shared-state bug: one instance mutates,
+    every later call sees it."""
+
+    id = "ZL006"
+    severity = ERROR
+
+    def _device_call(self, node: ast.Call,
+                     ctx: ModuleContext) -> Optional[str]:
+        """The dotted name iff this call resolves to jax device/mesh API.
+        Import-resolved (like the jit detection in core.py): a bare name
+        must be from-imported off a jax module, a dotted one must hang
+        off a local jax-module alias — so ``trimesh.Mesh(...)`` or a
+        local ``make_mesh()`` never produces an error-severity finding,
+        and ``import jax as j; j.devices()`` does."""
+        d = dotted(node.func)
+        if not d:
+            return None
+        mods, froms = ctx.jax_names
+        if "." in d:
+            prefix, leaf = d.rsplit(".", 1)
+            if prefix.split(".", 1)[0] not in mods:
+                return None
+        else:
+            leaf = froms.get(d)
+            if leaf is None:
+                return None
+        if leaf in _DEVICE_LEAVES or leaf in _MESH_LEAVES:
+            return d
+        return None
+
+    @staticmethod
+    def _not_import_time_guard(test: ast.AST) -> bool:
+        """``if __name__ == "__main__":`` bodies run as a script entry
+        point, not when the module is imported; ``if TYPE_CHECKING:``
+        bodies never run at all — neither is an import-time hazard."""
+        if dotted(test) in ("TYPE_CHECKING", "typing.TYPE_CHECKING"):
+            return True
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+                isinstance(test.ops[0], ast.Eq):
+            sides = [test.left] + list(test.comparators)
+            return ("__name__" in {dotted(s) for s in sides}
+                    and any(isinstance(s, ast.Constant)
+                            and s.value == "__main__" for s in sides))
+        return False
+
+    def _walk_import_time(self, stmts) -> Iterator[ast.AST]:
+        """Expressions evaluated at import: module/class bodies (through
+        if/try/with/loops — including their head expressions: the ``if``
+        test, the ``for`` iterable, the ``with`` context managers) plus
+        def-statement default args and decorators, and class decorators/
+        bases/keywords — but not function bodies, main-guard bodies, or
+        ``TYPE_CHECKING`` blocks."""
+        for st in stmts:
+            if isinstance(st, ast.If) and \
+                    self._not_import_time_guard(st.test):
+                # the else-branch of a guard still runs at import
+                yield from self._walk_import_time(st.orelse)
+                continue
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from st.decorator_list
+                for default in (list(st.args.defaults)
+                                + [d for d in st.args.kw_defaults if d]):
+                    yield default
+                continue
+            if isinstance(st, ast.ClassDef):
+                yield from st.decorator_list
+                yield from st.bases
+                for kw in st.keywords:
+                    yield kw.value
+                yield from self._walk_import_time(st.body)
+                continue
+            if isinstance(st, (ast.If, ast.While)):
+                yield st.test
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                yield st.iter
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    yield item.context_expr
+            for attr in ("body", "orelse", "finalbody"):
+                if hasattr(st, attr):
+                    yield from self._walk_import_time(getattr(st, attr))
+            if hasattr(st, "handlers"):
+                for h in st.handlers:
+                    yield from self._walk_import_time(h.body)
+            if not hasattr(st, "body"):
+                yield st
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in ctx.functions():
+            for default in (list(fn.args.defaults)
+                            + [d for d in fn.args.kw_defaults if d]):
+                bad = isinstance(default, (ast.List, ast.Dict, ast.Set,
+                                           ast.ListComp, ast.DictComp,
+                                           ast.SetComp))
+                if not bad and isinstance(default, ast.Call):
+                    bad = dotted(default.func) in _MUTABLE_CTORS
+                if bad:
+                    yield self.finding(
+                        ctx, fn.lineno,
+                        f"mutable default argument in "
+                        f"`{fn.name}` — use None and create inside")
+        for expr in self._walk_import_time(ctx.tree.body):
+            # lambda bodies never run at import — the lazy-accessor
+            # pattern (`get_devices = lambda: jax.devices()`) is the fix,
+            # not a violation (_walk_skipping always descends from its
+            # root, so a default arg that IS a lambda must be skipped here)
+            if isinstance(expr, ast.Lambda):
+                continue
+            for node in _walk_skipping(expr, skip_types=(ast.Lambda,)):
+                if isinstance(node, ast.Call):
+                    d = self._device_call(node, ctx)
+                    if d:
+                        yield self.finding(
+                            ctx, node.lineno,
+                            f"`{d}(...)` at import time pins the backend "
+                            f"before multi-host init — build devices/"
+                            f"meshes lazily (cf. parallel/mesh.py "
+                            f"global_mesh())")
+
+
+# ---------------------------------------------------------------------------
+# ZL007 — swallowed exceptions in retry paths
+# ---------------------------------------------------------------------------
+
+@register
+class SwallowedException(Rule):
+    """A bare ``except:`` (which also catches ``KeyboardInterrupt`` /
+    ``SystemExit``) or an ``except Exception: pass`` turns a dead model
+    replica or a poisoned request into silence — the serving loop keeps
+    accepting work it can never answer. Bare excepts are errors
+    everywhere; swallow-``pass`` is an error in the ``serving/`` and
+    ``pipeline/inference/`` retry paths and a warning elsewhere."""
+
+    id = "ZL007"
+    severity = ERROR
+
+    def _in_hot_path(self, path: str) -> bool:
+        # absolutize so severity tracks the file's real location, not how
+        # the scan path was spelled (a cwd-relative `server.py` must gate
+        # exactly like CI's absolute-path scan of the same file)
+        if os.path.exists(path):
+            path = os.path.abspath(path)
+        p = path.replace("\\", "/")
+        return ("/serving/" in p or p.startswith("serving/")
+                or "/pipeline/inference/" in p
+                or p.startswith("pipeline/inference/"))
+
+    @staticmethod
+    def _swallows(handler: ast.ExceptHandler) -> bool:
+        for st in handler.body:
+            if isinstance(st, ast.Pass) or isinstance(st, ast.Continue):
+                continue
+            if isinstance(st, ast.Expr) and \
+                    isinstance(st.value, ast.Constant):
+                continue    # docstring / Ellipsis
+            return False
+        return True
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                # a re-raise only counts in the handler's own scope — a
+                # `raise` inside a nested def/lambda does not run here
+                nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                if any(isinstance(st, ast.Raise) for sub in node.body
+                       if not isinstance(sub, nested)
+                       for st in _walk_skipping(sub, skip_types=nested)):
+                    continue    # bare except that re-raises: tolerated
+                yield self.finding(
+                    ctx, node.lineno,
+                    "bare `except:` swallows KeyboardInterrupt/SystemExit "
+                    "— catch Exception (and log) at most")
+                continue
+            if isinstance(node.type, ast.Tuple):
+                # `except (Exception,):` / `except (Exception, ...)`
+                names = [dotted(e) for e in node.type.elts]
+                d = next((n for n in names
+                          if n in ("Exception", "BaseException")), None)
+            else:
+                d = dotted(node.type)
+            if d in ("Exception", "BaseException") and self._swallows(node):
+                sev = ERROR if self._in_hot_path(ctx.path) else WARNING
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"`except {d}: pass` swallows errors"
+                    + (" in a serving/inference retry path — log and "
+                       "surface them" if sev == ERROR
+                       else " — log them at least"),
+                    severity=sev)
+
+
+# ---------------------------------------------------------------------------
+# ZL008 — missing donate_argnums on a rebinding step (warn)
+# ---------------------------------------------------------------------------
+
+@register
+class MissingDonation(Rule):
+    """A jitted step that re-binds its first argument (``params = ...``)
+    produces a new buffer while the old one stays live — double the
+    parameter HBM footprint per step. ``donate_argnums=(0,)`` lets XLA
+    reuse the input buffer in place (cf. training.py's steps). Warn-only:
+    donation is wrong when the caller keeps using the input."""
+
+    id = "ZL008"
+    severity = WARNING
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for info in ctx.jitted.values():
+            if info.donates:
+                continue
+            fn = info.fn
+            names = [n for n in param_names(fn) if n not in ("self", "cls")]
+            if not names:
+                continue
+            first = names[0]
+            rebinds = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                else:
+                    continue
+                if ctx.in_nested_scope(node, fn):
+                    continue
+                if any(isinstance(sub, ast.Name) and sub.id == first
+                       for t in targets for sub in ast.walk(t)):
+                    rebinds = True
+                    break
+            if rebinds:
+                yield self.finding(
+                    ctx, info.anchor_line,
+                    f"jitted `{getattr(fn, 'name', '<fn>')}` re-binds its "
+                    f"first argument `{first}` but declares no "
+                    f"donate_argnums — the old buffer stays live (2x param "
+                    f"HBM); add donate_argnums=(0,) if the caller discards "
+                    f"its input")

@@ -150,5 +150,7 @@ class NativeArrayFile:
     def __del__(self):  # pragma: no cover - GC timing
         try:
             self.close()
-        except Exception:  # noqa: BLE001
+        # raising from __del__ aborts interpreter shutdown mid-GC — silence
+        # is the contract here
+        except Exception:  # noqa: BLE001  # zoolint: disable=ZL007
             pass

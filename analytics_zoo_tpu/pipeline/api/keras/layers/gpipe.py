@@ -206,7 +206,9 @@ class Pipeline(Layer):
             + [int(np.prod(self._meta[-1]["out_feat"]))])
         L = max(sum(m["sizes"]) for m in self._meta)
         rows = []
-        for leaves, m in zip(trees_flat, self._meta):
+        # ragged per-stage trees (different layer shapes) — vmap does not
+        # apply; this runs once at build time, not in the step
+        for leaves, m in zip(trees_flat, self._meta):  # zoolint: disable=ZL005
             vec = (jnp.concatenate([jnp.ravel(l) for l in leaves])
                    if leaves else jnp.zeros((0,), pdt))
             rows.append(jnp.pad(vec, (0, L - vec.shape[0])))

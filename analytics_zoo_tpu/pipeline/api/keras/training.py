@@ -171,16 +171,39 @@ def _fired_within(trigger: Optional[Trigger], state: TrainLoopState,
     return trigger(state)
 
 
-def _write_param_histograms(tb, params, epochs, iteration) -> None:
+def _write_param_histograms(tb, params, epochs, iteration,
+                            n_steps: int = 0) -> None:
     """Per-layer weight histograms when the TrainSummary's "Parameters"
     trigger fires for any epoch in ``epochs`` (reference:
     ``TrainSummary.setSummaryTrigger("Parameters", ...)`` +
     ``Summary.scala``'s histogram writer). Called only at boundaries where
     the params are host-visible; under fused-epoch dispatch that is the
-    final epoch of a fused block, covering the whole block's epochs."""
-    freq = getattr(tb, "parameters_every_epochs", None)
-    if not freq or not any(e % freq == 0 for e in epochs):
-        return
+    final epoch of a fused block, covering the whole block's epochs —
+    ``n_steps`` (steps per epoch) reconstructs each covered epoch's own
+    boundary iteration, ending at ``iteration``, and an iteration-based
+    trigger is checked over that epoch's whole ``(boundary - n_steps,
+    boundary]`` window (``_fired_within`` semantics: a fire landing
+    mid-epoch is acted on at the boundary, not dropped)."""
+    epochs = list(epochs)
+    trig = getattr(tb, "parameters_trigger", None)
+    if trig is not None:
+        # Trigger-like form: evaluated per covered epoch (params are only
+        # host-visible at the block end, but the *decision* must match
+        # what per-epoch dispatch would have decided); without n_steps the
+        # window degrades to the boundary iteration itself
+        last = len(epochs) - 1
+        window = max(n_steps, 1)
+        if not any(_fired_within(
+                trig,
+                TrainLoopState(iteration=iteration - (last - k) * n_steps,
+                               epoch=e, epoch_finished=True),
+                prev_iter=iteration - (last - k) * n_steps - window)
+                   for k, e in enumerate(epochs)):
+            return
+    else:
+        freq = getattr(tb, "parameters_every_epochs", None)
+        if not freq or not any(e % freq == 0 for e in epochs):
+            return
     flat, _ = jax.tree_util.tree_flatten_with_path(params)
     for path, leaf in flat:
         name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
@@ -852,7 +875,8 @@ class TrainingLoop:
                         if last:
                             _write_param_histograms(
                                 tb, model.params,
-                                range(epoch + 1, epoch + g + 1), it_e)
+                                range(epoch + 1, epoch + g + 1), it_e,
+                                n_steps=n_steps)
                         tb.writer.flush()
                     log.info("Epoch %d: loss=%.6f (%.1f ex/s)", e,
                              epoch_loss, thr)
@@ -993,7 +1017,8 @@ class TrainingLoop:
                     # the next fit(); logging its partial params here
                     # would put two histograms under one epoch number
                     _write_param_histograms(tb, model.params, (epoch,),
-                                            loop_state.iteration)
+                                            loop_state.iteration,
+                                            n_steps=len(loss_vec))
                 tb.writer.flush()
             vtb = getattr(model, "_val_summary", None)
             if vtb is not None and val is not None:

@@ -243,8 +243,9 @@ class InferenceModel:
 
         # one shape-polymorphic jitted fn; jax.jit caches one executable per
         # padded batch size (bounded by the power-of-two bucketing below) and
-        # is itself thread-safe
-        self._predict = jax.jit(run)
+        # is itself thread-safe. `params` is rebound only to its dequantized
+        # view — self._params must survive every call, so donation is wrong
+        self._predict = jax.jit(run)  # zoolint: disable=ZL008
         return self
 
     @staticmethod

@@ -306,12 +306,12 @@ class RayContext:
         for h in self._actors:
             try:
                 h.terminate()
-            except Exception:  # noqa: BLE001 — best-effort teardown
+            except Exception:  # noqa: BLE001 — best-effort teardown  # zoolint: disable=ZL007
                 pass
         for _ in self._procs:
             try:
                 self._task_q.put(None)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — best-effort teardown  # zoolint: disable=ZL007
                 pass
         for p in self._procs:
             p.join(timeout=2)

@@ -134,6 +134,13 @@ class ClusterServing:
                     uris.append(uri)
                     tensors.append(arr)
                 if not uris:
+                    # every record in this read was undecodable: the same
+                    # drain signal applies — an empty stream means no next
+                    # batch will arrive to trigger the pending readback,
+                    # so it would otherwise park for up to block_ms
+                    if pending is not None and \
+                            self.backend.stream_len(self.stream) == 0:
+                        pending = self._flush(pending)
                     continue
                 try:
                     batch = np.stack(tensors)
@@ -150,6 +157,19 @@ class ClusterServing:
                 nxt, pending = self._dispatch(uris, batch, pending)
                 if pending is not None:
                     pending = self._flush(pending)
+                if nxt is not None and \
+                        self.backend.stream_len(self.stream) == 0:
+                    # nothing left queued: the stream is drained and there
+                    # is no next batch to overlap with, so deferring this
+                    # readback would only add up to block_ms of tail
+                    # latency under trickle load (ADVICE round 5). The
+                    # queue length is the drain signal — an under-full
+                    # read is not (xread returns on FIRST delivery, so
+                    # under sustained single-record load more work is
+                    # usually queued already and flushing would serialize
+                    # the two-deep pipeline), and a final exactly-full
+                    # batch with an empty queue must flush too
+                    nxt = self._flush(nxt)
                 pending = nxt
         finally:
             if pending is not None:

@@ -310,19 +310,67 @@ class TrainSummary(_Summary):
     ``TrainSummary.setSummaryTrigger("Parameters", ...)`` surface."""
     sub_dir = "train"
     parameters_every_epochs: Optional[int] = None
+    parameters_trigger = None   # Trigger-like alternative to the int form
 
-    def set_summary_trigger(self, name: str,
-                            every_epochs: int) -> "TrainSummary":
-        """Enable an optional summary family. Supported: ``"Parameters"``
-        — per-layer weight histograms every N epochs (written at epoch
-        boundaries where the params are host-visible; under fused-epoch
-        dispatch that is the final epoch of each fused block)."""
-        if name != "Parameters":
-            raise ValueError(f"unknown summary family {name!r}; "
-                             f"supported: 'Parameters'")
-        if int(every_epochs) < 1:
-            raise ValueError("every_epochs must be >= 1")
-        self.parameters_every_epochs = int(every_epochs)
+    # families the reference's ``setSummaryTrigger`` also accepts
+    # (``TrainSummary.scala``); Loss/Throughput/LearningRate are written
+    # unconditionally per iteration here, so their triggers are a no-op —
+    # accepted for reference-API portability instead of raising
+    _ALWAYS_ON_FAMILIES = ("Loss", "Throughput", "LearningRate")
+
+    def set_summary_trigger(self, name: str, trigger=None, *,
+                            every_epochs=None) -> "TrainSummary":
+        """Enable an optional summary family, reference-style.
+
+        ``"Parameters"`` — per-layer weight histograms. ``trigger`` is
+        either the ``every_epochs`` int shorthand (also accepted under
+        its pre-Trigger keyword spelling ``every_epochs=``) or a
+        Trigger-like callable (``common.triggers``: ``EveryEpoch()``,
+        ``SeveralIteration(n)``, ...) evaluated at epoch boundaries, where
+        the params are host-visible; under fused-epoch dispatch that is
+        the final epoch of each fused block. The reference's always-on
+        scalar families (``Loss``/``Throughput``/``LearningRate``) accept
+        any trigger as a no-op."""
+        if every_epochs is not None:
+            if trigger is not None:
+                raise TypeError(
+                    "pass either trigger or every_epochs, not both")
+            trigger = every_epochs
+        if trigger is None:
+            raise TypeError("a trigger (or every_epochs=) is required")
+        if name != "Parameters" and name not in self._ALWAYS_ON_FAMILIES:
+            raise ValueError(
+                f"unknown summary family {name!r}; supported: 'Parameters' "
+                f"(+ no-op {'/'.join(self._ALWAYS_ON_FAMILIES)})")
+        # validate BEFORE the always-on no-op return: a malformed trigger
+        # must raise identically for every accepted family, or the typo
+        # only surfaces when the call is later copied onto "Parameters"
+        if callable(trigger) and not isinstance(trigger, type):
+            every = None
+        else:
+            # the every-N-epochs shorthand: any real number (incl.
+            # np.int64 / a float epoch count, as the pre-Trigger
+            # signature coerced)
+            if isinstance(trigger, bool) or isinstance(trigger, str):
+                raise TypeError(
+                    f"trigger must be an int (every N epochs) or a "
+                    f"Trigger-like callable, got {trigger!r}")
+            try:
+                every = int(trigger)
+            except (TypeError, ValueError):
+                raise TypeError(
+                    f"trigger must be an int (every N epochs) or a "
+                    f"Trigger-like callable, got {type(trigger).__name__}")
+            if every < 1:
+                raise ValueError("every_epochs must be >= 1")
+        if name in self._ALWAYS_ON_FAMILIES:
+            return self
+        if every is None:
+            self.parameters_trigger = trigger
+            self.parameters_every_epochs = None
+        else:
+            self.parameters_every_epochs = every
+            self.parameters_trigger = None
         return self
 
 

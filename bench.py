@@ -754,7 +754,9 @@ def main():
         flops_epoch = profiling.compiled_flops(
             epoch_fn.lower(params, opt_state, net_state, base_rng, it0,
                            shuffle_rng, xs_dev, ys_dev).compile())
-    except Exception:
+    # flops/MFU are optional extras in the record; the bench must not die
+    # when XLA cost analysis is unavailable on a backend
+    except Exception:  # zoolint: disable=ZL007
         pass
     flops_per_example = (flops_epoch / (steps_per_epoch * BATCH)
                          if flops_epoch else None)
@@ -890,10 +892,16 @@ def latest_bench_record():
     import glob
     import re
 
-    files = sorted(
-        glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_r*.json")),
-        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)))
+    # only properly-numbered rounds participate: a stray BENCH_rerun.json
+    # must degrade to "no baseline", not crash the gate (ADVICE round 5)
+    pat = re.compile(r"^BENCH_r(\d+)\.json$")
+    numbered = []
+    for p in glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")):
+        m = pat.match(os.path.basename(p))
+        if m:
+            numbered.append((int(m.group(1)), p))
+    files = [p for _, p in sorted(numbered)]
     if not files:
         return {}, None
     try:

@@ -91,3 +91,19 @@ def test_new_metric_without_history_passes(prev_record):
         pytest.skip("every gated metric already has a history record")
     out[fresh[0]] = 1.0                 # no prior record → no relative gate
     bench.check_regressions(out)
+
+
+def test_latest_bench_record_ignores_non_numbered_files():
+    """A stray BENCH_r*.json without a round number (e.g. BENCH_rerun.json)
+    must be ignored, not crash the baseline lookup (ADVICE r5)."""
+    import re
+    stray = os.path.join(os.path.dirname(os.path.abspath(bench.__file__)),
+                         "BENCH_rerun.json")
+    with open(stray, "w") as f:
+        f.write("{}")
+    try:
+        parsed, name = bench.latest_bench_record()
+        assert name is not None and re.match(r"^BENCH_r\d+\.json$", name)
+        assert parsed     # still the newest numbered round's record
+    finally:
+        os.remove(stray)

@@ -343,3 +343,67 @@ def test_missing_uri_record_does_not_misalign_batch():
             np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6), uri
     finally:
         serving.stop(drain=False)
+
+
+def test_trickle_load_flushes_underfull_batch_immediately():
+    """An under-full xread means the stream is drained: the loop must
+    publish the just-dispatched batch instead of parking it behind the
+    next (up-to-``block_ms``) poll — the trickle-load tail-latency fix
+    (ADVICE r5). ``block_ms`` (3 s) is set well above the query timeout
+    (1.5 s) so the old defer-until-next-read behavior would fail this
+    test; stop() still joins inside its timeout because the loop
+    re-checks the stop flag after each ``block_ms`` park."""
+
+    class AsyncSpy:
+        def predict_async(self, batch, block=True):
+            preds = np.full((batch.shape[0], 3), 7.0, np.float32)
+            return lambda: preds
+
+    backend = LocalBackend()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    serving = ClusterServing(AsyncSpy(), backend=backend, batch_size=4,
+                             block_ms=3_000).start()
+    try:
+        for i in range(3):   # each arrives alone: every read is under-full
+            inq.enqueue(f"t-{i}", np.zeros((6,), np.float32))
+            out = outq.query(f"t-{i}", timeout=1.5)
+            assert out is not None and out.shape == (3,)
+        # an exactly-full final batch with an empty queue must flush too —
+        # the drain signal is stream_len()==0, not an under-full read
+        for i in range(4):
+            inq.enqueue(f"full-{i}", np.zeros((6,), np.float32))
+        for i in range(4):
+            out = outq.query(f"full-{i}", timeout=1.5)
+            assert out is not None and out.shape == (3,)
+    finally:
+        serving.stop(drain=False, timeout=10.0)
+
+
+def test_all_undecodable_read_flushes_parked_batch():
+    """A read whose every record is undecodable must still apply the
+    drain-flush — the previously dispatched batch cannot park behind the
+    next (up-to-``block_ms``) poll just because this read produced no
+    dispatchable work."""
+
+    class AsyncSpy:
+        def predict_async(self, batch, block=True):
+            preds = np.full((batch.shape[0], 3), 5.0, np.float32)
+            return lambda: preds
+
+    from analytics_zoo_tpu.serving.client import INPUT_STREAM
+    backend = LocalBackend()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    # both records sit in the stream before the loop starts: with
+    # batch_size=1 the good one is dispatched while the bad one is still
+    # queued (stream_len > 0, so it stays pending), then the bad-only
+    # read leaves the stream empty and must flush it within the 1.5 s
+    # query timeout (block_ms is 3 s, so the old defer path would fail)
+    inq.enqueue("parked", np.zeros((6,), np.float32))
+    backend.xadd(INPUT_STREAM, {"uri": "junk", "data": "!!notb64!!"})
+    serving = ClusterServing(AsyncSpy(), backend=backend, batch_size=1,
+                             block_ms=3_000).start()
+    try:
+        out = outq.query("parked", timeout=1.5)
+        assert out is not None and float(out[0]) == 5.0
+    finally:
+        serving.stop(drain=False, timeout=10.0)

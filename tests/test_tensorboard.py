@@ -244,3 +244,139 @@ def test_histogram_nonfinite_weights_do_not_crash(tmp_path):
     assert st["num"] == 2 and st["min"] == 1.0 and st["max"] == 2.0
     st2 = read_histograms(str(tmp_path), "all_bad")[0][1]
     assert st2["num"] == 1 and sum(st2["bucket"]) == 1
+
+
+def test_set_summary_trigger_accepts_trigger_objects(tmp_path):
+    """Reference API parity: ``setSummaryTrigger(name, trigger)`` takes a
+    Trigger object (not just the every-N-epochs int shorthand), and the
+    reference's always-on scalar families are accepted as no-ops."""
+    from analytics_zoo_tpu.common.triggers import EveryEpoch
+
+    ts = TrainSummary(str(tmp_path), "app")
+    try:
+        assert ts.set_summary_trigger("Parameters", 2) is ts
+        assert ts.parameters_every_epochs == 2
+        assert ts.parameters_trigger is None
+
+        trig = EveryEpoch()
+        ts.set_summary_trigger("Parameters", trig)
+        assert ts.parameters_trigger is trig
+        assert ts.parameters_every_epochs is None
+
+        # Loss/Throughput/LearningRate are written unconditionally here —
+        # their reference triggers must not raise
+        assert ts.set_summary_trigger("LearningRate", EveryEpoch()) is ts
+        assert ts.set_summary_trigger("Loss", 3) is ts
+
+        # ...but a MALFORMED trigger raises identically for every family:
+        # the no-op must not swallow a typo that would blow up later when
+        # the same call is made for "Parameters"
+        with pytest.raises(TypeError):
+            ts.set_summary_trigger("Loss", "weekly")
+        with pytest.raises(TypeError):
+            ts.set_summary_trigger("Throughput", EveryEpoch)  # class, no ()
+        with pytest.raises(ValueError):
+            ts.set_summary_trigger("LearningRate", 0)
+
+        # the pre-Trigger keyword spelling keeps working
+        assert ts.set_summary_trigger("Parameters", every_epochs=4) is ts
+        assert ts.parameters_every_epochs == 4
+        assert ts.parameters_trigger is None
+
+        with pytest.raises(ValueError):
+            ts.set_summary_trigger("NoSuchFamily", 1)
+        with pytest.raises(ValueError):
+            ts.set_summary_trigger("Parameters", 0)
+        with pytest.raises(TypeError):
+            ts.set_summary_trigger("Parameters", "weekly")
+        with pytest.raises(TypeError):
+            ts.set_summary_trigger("Parameters", 1, every_epochs=2)
+        with pytest.raises(TypeError):
+            ts.set_summary_trigger("Parameters")
+    finally:
+        ts.close()
+
+
+def test_parameter_histograms_honor_trigger_object(tmp_path):
+    """The histogram writer evaluates a Trigger-form "Parameters" trigger
+    at epoch boundaries (where params are host-visible)."""
+    from analytics_zoo_tpu.common.triggers import SeveralIteration
+    from analytics_zoo_tpu.pipeline.api.keras.training import (
+        _write_param_histograms)
+    from analytics_zoo_tpu.utils.tensorboard import read_histograms
+
+    ts = TrainSummary(str(tmp_path), "app")
+    params = {"d1": {"W": np.ones((4, 8), np.float32)}}
+    ts.set_summary_trigger("Parameters", SeveralIteration(10))
+    _write_param_histograms(ts, params, epochs=(1,), iteration=5)
+    _write_param_histograms(ts, params, epochs=(2,), iteration=10)
+    ts.close()
+    pts = read_histograms(str(tmp_path / "app" / "train"))
+    assert len(pts) == 1            # only the iteration-10 boundary fired
+    assert pts[0][3] == "Parameters/d1/W"
+
+
+def test_fused_block_trigger_sees_per_epoch_iterations(tmp_path):
+    """Under fused-epoch dispatch the Trigger-form check must evaluate each
+    covered epoch at its OWN boundary iteration (reconstructed via
+    n_steps), not the block-final one — a SeveralIteration trigger whose
+    boundary falls mid-block still fires."""
+    from analytics_zoo_tpu.common.triggers import SeveralIteration
+    from analytics_zoo_tpu.pipeline.api.keras.training import (
+        _write_param_histograms)
+    from analytics_zoo_tpu.utils.tensorboard import read_histograms
+
+    params = {"d1": {"W": np.ones((4, 8), np.float32)}}
+    # epochs 1-3 fused, 5 steps each: boundaries at iterations 5, 10, 15.
+    # SeveralIteration(10) fires only at the epoch-2 boundary (10) —
+    # invisible to a check that evaluates everything at iteration 15.
+    ts = TrainSummary(str(tmp_path), "app")
+    ts.set_summary_trigger("Parameters", SeveralIteration(10))
+    _write_param_histograms(ts, params, (1, 2, 3), 15, n_steps=5)
+    ts.close()
+    assert len(read_histograms(str(tmp_path / "app" / "train"))) == 1
+
+    # a block whose boundaries all miss the interval writes nothing
+    ts2 = TrainSummary(str(tmp_path / "b2"), "app")
+    ts2.set_summary_trigger("Parameters", SeveralIteration(100))
+    _write_param_histograms(ts2, params, (1, 2, 3), 15, n_steps=5)
+    ts2.close()
+    assert not read_histograms(str(tmp_path / "b2" / "app" / "train"))
+
+
+def test_trigger_fire_landing_mid_epoch_is_not_dropped(tmp_path):
+    """``_fired_within`` window semantics: a SeveralIteration fire landing
+    MID-epoch (iteration 7 with 5 steps/epoch) is acted on at that epoch's
+    boundary, like the loop's checkpoint/validation triggers — not dropped
+    because no boundary iteration is an exact multiple."""
+    from analytics_zoo_tpu.common.triggers import SeveralIteration
+    from analytics_zoo_tpu.pipeline.api.keras.training import (
+        _write_param_histograms)
+    from analytics_zoo_tpu.utils.tensorboard import read_histograms
+
+    params = {"d1": {"W": np.ones((4, 8), np.float32)}}
+    ts = TrainSummary(str(tmp_path), "app")
+    ts.set_summary_trigger("Parameters", SeveralIteration(7))
+    # boundaries 5, 10, 15: fires land at 7 (in (5,10]) and 14 (in (10,15])
+    _write_param_histograms(ts, params, (1,), 5, n_steps=5)
+    _write_param_histograms(ts, params, (2,), 10, n_steps=5)
+    _write_param_histograms(ts, params, (3,), 15, n_steps=5)
+    ts.close()
+    steps = sorted(s for s, _, _, _ in
+                   read_histograms(str(tmp_path / "app" / "train")))
+    assert steps == [10, 15], steps
+
+
+def test_set_summary_trigger_numeric_coercion(tmp_path):
+    """The pre-Trigger signature coerced with int(...): numpy integers and
+    whole floats must keep working."""
+    ts = TrainSummary(str(tmp_path), "app")
+    try:
+        ts.set_summary_trigger("Parameters", np.int64(2))
+        assert ts.parameters_every_epochs == 2
+        ts.set_summary_trigger("Parameters", 3.0)
+        assert ts.parameters_every_epochs == 3
+        with pytest.raises(TypeError):
+            ts.set_summary_trigger("Parameters", True)
+    finally:
+        ts.close()

@@ -1,0 +1,1227 @@
+"""zoolint (``analytics_zoo_tpu.analysis``) — the static-analysis tier-1
+gate plus per-rule unit coverage.
+
+Three fixtures per rule: one snippet that triggers it, one that is clean,
+and one exercising ``# zoolint: disable=ZLxxx`` suppression. The gate test
+at the bottom runs the real analyzer over the whole package and ``tests/``
+and asserts zero error-severity findings — any newly-introduced hazard
+(e.g. a reused PRNG key) fails CI mechanically.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from analytics_zoo_tpu.analysis import (ERROR, all_rules, lint_paths,
+                                        lint_source)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ids(findings, rule=None):
+    return [f.rule_id for f in findings
+            if rule is None or f.rule_id == rule]
+
+
+def errors(findings):
+    return [f for f in findings if f.severity == ERROR]
+
+
+# ---------------------------------------------------------------------------
+# registry / CLI surface
+# ---------------------------------------------------------------------------
+
+def test_at_least_eight_rules_registered():
+    rules = all_rules()
+    assert len(rules) >= 8
+    assert len({r.id for r in rules}) == len(rules)
+    for r in rules:
+        assert r.id.startswith("ZL") and r.__doc__, r.id
+
+
+def test_cli_exits_zero_on_package():
+    proc = subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.analysis",
+         os.path.join(REPO, "analytics_zoo_tpu")],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "error(s)" in proc.stdout
+
+
+def test_cli_exits_nonzero_on_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n"
+                   "def f(rng):\n"
+                   "    a = jax.random.normal(rng, (2,))\n"
+                   "    b = jax.random.normal(rng, (2,))\n"
+                   "    return a + b\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.analysis", str(bad)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1
+    assert "ZL001" in proc.stdout
+
+
+def test_syntax_error_reported_not_raised():
+    fs = lint_source("def f(:\n", "broken.py")
+    assert ids(fs) == ["ZL000"] and errors(fs)
+
+
+def test_corrupt_files_degrade_to_zl000_not_crash(tmp_path):
+    """A null byte (ValueError from ast.parse) or non-UTF8 bytes must
+    produce a ZL000 finding, not abort the whole gate scan."""
+    from analytics_zoo_tpu.analysis.core import lint_file
+
+    assert ids(lint_source("x = 1\x00", "nul.py")) == ["ZL000"]
+    bad = tmp_path / "latin1.py"
+    bad.write_bytes(b"s = '\xe9'\n")
+    assert ids(lint_file(str(bad))) == ["ZL000"]
+    # select/ignore apply to ZL000 like any other id — `--ignore ZL000`
+    # must actually drop the finding (e.g. a vendored unfixable fixture)
+    assert not lint_source("x = 1\x00", "nul.py", ignore=["ZL000"])
+    assert not lint_source("x = 1\x00", "nul.py", select=["ZL001"])
+    assert not lint_file(str(bad), ignore=["ZL000"])
+    assert ids(lint_source("x = 1\x00", "nul.py",
+                           select=["ZL000"])) == ["ZL000"]
+
+
+def test_cli_rejects_nonexistent_path():
+    """A typo'd path must fail loudly, not scan zero files and exit 0."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.analysis",
+         os.path.join(REPO, "no_such_dir_xyz")],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "does not exist" in proc.stderr
+
+
+def test_wrapper_resolves_paths_from_caller_cwd(tmp_path):
+    """scripts/zoolint run from another directory must lint the CALLER's
+    relative path — named `bench.py` here so re-resolving against the
+    repo root (which has a clean bench.py) would wrongly exit 0."""
+    (tmp_path / "bench.py").write_text(
+        "import jax\n"
+        "def f(rng):\n"
+        "    a = jax.random.normal(rng, (3,))\n"
+        "    return a + jax.random.uniform(rng, (3,))\n")
+    proc = subprocess.run(
+        [os.path.join(REPO, "scripts", "zoolint"), "bench.py"],
+        capture_output=True, text=True, cwd=str(tmp_path),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "ZL001" in proc.stdout
+
+
+def test_overlapping_paths_lint_each_file_once(tmp_path):
+    """`zoolint pkg/ pkg/x.py` must not double-count x.py's findings."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n"
+                   "def f(rng):\n"
+                   "    a = jax.random.normal(rng, (3,))\n"
+                   "    return a + jax.random.uniform(rng, (3,))\n")
+    once = lint_paths([str(tmp_path)])
+    twice = lint_paths([str(tmp_path), str(bad), str(bad)])
+    assert len(once) == 1
+    assert len(twice) == 1
+
+
+def test_cli_rejects_unknown_rule_ids(tmp_path):
+    """`--select ZL0O1` (typo) must fail loudly — running zero rules over
+    a file with a seeded violation would read as a green gate."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n"
+                   "def f(rng):\n"
+                   "    a = jax.random.normal(rng, (3,))\n"
+                   "    return a + jax.random.uniform(rng, (3,))\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    for flag in ("--select", "--ignore"):
+        proc = subprocess.run(
+            [sys.executable, "-m", "analytics_zoo_tpu.analysis",
+             flag, "ZL0O1", str(bad)],
+            capture_output=True, text=True, cwd=REPO, env=env)
+        assert proc.returncode == 2, (flag, proc.stdout + proc.stderr)
+        assert "unknown rule id" in proc.stderr, flag
+    # a valid --select still gates
+    proc = subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.analysis",
+         "--select", "ZL001", str(bad)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert proc.returncode == 1 and "ZL001" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# ZL001 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+ZL001_BAD = """
+import jax
+def f(rng):
+    a = jax.random.normal(rng, (3,))
+    b = jax.random.uniform(rng, (3,))
+    return a + b
+"""
+
+ZL001_LOOP = """
+import jax
+def f(rng, xs):
+    out = []
+    for x in xs:
+        out.append(jax.random.bernoulli(rng, 0.5))
+    return out
+"""
+
+ZL001_CLEAN = """
+import jax
+def f(rng, xs):
+    k1, k2 = jax.random.split(rng)
+    a = jax.random.normal(k1, (3,))
+    b = jax.random.uniform(k2, (3,))
+    for i, x in enumerate(xs):
+        step = jax.random.fold_in(k2, i)      # fold_in never consumes
+        a = a + jax.random.normal(step, (3,))
+    return a + b
+"""
+
+ZL001_REASSIGNED = """
+import jax
+def f(rng, n):
+    total = 0.0
+    for i in range(n):
+        rng, k = jax.random.split(rng)
+        total += jax.random.normal(k, ())
+    return total
+"""
+
+
+def test_zl001_triggers_on_reuse():
+    assert ids(lint_source(ZL001_BAD), "ZL001")
+
+
+def test_zl001_triggers_on_loop_invariant_key():
+    assert ids(lint_source(ZL001_LOOP), "ZL001")
+
+
+def test_zl001_clean_split_fold_in():
+    assert not ids(lint_source(ZL001_CLEAN), "ZL001")
+
+
+def test_zl001_clean_reassign_in_loop():
+    assert not ids(lint_source(ZL001_REASSIGNED), "ZL001")
+
+
+def test_zl001_split_after_sample_flagged():
+    src = ("import jax\n"
+           "def f(rng):\n"
+           "    a = jax.random.normal(rng, ())\n"
+           "    k1, k2 = jax.random.split(rng)\n"
+           "    return a, k1, k2\n")
+    assert ids(lint_source(src), "ZL001")
+
+
+def test_zl001_suppression():
+    src = ZL001_BAD.replace(
+        "b = jax.random.uniform(rng, (3,))",
+        "b = jax.random.uniform(rng, (3,))  # zoolint: disable=ZL001")
+    assert not ids(lint_source(src), "ZL001")
+
+
+def test_suppression_with_trailing_justification():
+    """ROADMAP tells developers to justify suppressions — prose after the
+    id list must not break the suppression, and a typo'd id must not
+    silently become a blanket disable."""
+    src = ZL001_BAD.replace(
+        "b = jax.random.uniform(rng, (3,))",
+        "b = jax.random.uniform(rng, (3,))  "
+        "# zoolint: disable=ZL001 key reuse is intended here")
+    assert not ids(lint_source(src), "ZL001")
+    src = ZL001_BAD.replace(
+        "b = jax.random.uniform(rng, (3,))",
+        "b = jax.random.uniform(rng, (3,))  # zoolint: disable=NOTARULE")
+    assert ids(lint_source(src), "ZL001")   # typo is not a blanket
+    src = ZL001_BAD.replace(
+        "b = jax.random.uniform(rng, (3,))",
+        "b = jax.random.uniform(rng, (3,))  # zoolint: disable")
+    assert not ids(lint_source(src), "ZL001")   # bare form stays blanket
+
+
+def test_suppression_marker_inside_string_literal_is_inert():
+    """Only a real COMMENT suppresses — the marker inside a string
+    constant on the flagged line must not hide a genuine finding."""
+    src = ZL001_BAD.replace(
+        "b = jax.random.uniform(rng, (3,))",
+        'b = (jax.random.uniform(rng, (3,)), "# zoolint: disable")')
+    assert ids(lint_source(src), "ZL001")
+    src = ZL001_BAD.replace(
+        "b = jax.random.uniform(rng, (3,))",
+        'b = (jax.random.uniform(rng, (3,)), "# zoolint: disable=ZL001")')
+    assert ids(lint_source(src), "ZL001")
+
+
+def test_zl001_conditional_expression_arms_are_exclusive():
+    """Exactly one arm of a ternary (or a short-circuited or-chain) ever
+    consumes the key — no reuse, like the equivalent if/else statement."""
+    src = ("import jax\n"
+           "def f(rng, c):\n"
+           "    v = (jax.random.normal(rng, (2,)) if c\n"
+           "         else jax.random.uniform(rng, (2,)))\n"
+           "    return v\n")
+    assert not ids(lint_source(src), "ZL001")
+    src = ("import jax\n"
+           "def f(rng, cached):\n"
+           "    return cached or jax.random.normal(rng, (2,))\n")
+    assert not ids(lint_source(src), "ZL001")
+    # short-circuit operands are a sequential PREFIX, not exclusive arms:
+    # whenever a later operand evaluates, the earlier one already consumed
+    src = ("import jax\n"
+           "def f(rng, c):\n"
+           "    return (c and jax.random.normal(rng, ())\n"
+           "            and jax.random.normal(rng, ()))\n")
+    assert ids(lint_source(src), "ZL001")
+    src = ("import jax\n"
+           "def f(rng):\n"
+           "    return (jax.random.bernoulli(rng, 0.5)\n"
+           "            or jax.random.bernoulli(rng, 0.5))\n")
+    assert ids(lint_source(src), "ZL001")
+    # ...but consumption BEFORE the ternary, or in both the test and an
+    # arm, is still sequential reuse
+    src = ("import jax\n"
+           "def f(rng, c):\n"
+           "    a = jax.random.normal(rng, (2,))\n"
+           "    v = jax.random.uniform(rng, (2,)) if c else a\n"
+           "    return v\n")
+    assert ids(lint_source(src), "ZL001")
+
+
+def test_zl001_except_handler_branches_from_pre_try_state():
+    """A fallback sampler in an except handler is not reuse — the handler
+    only runs when the try body failed (typically before consuming)."""
+    src = ("import jax\n"
+           "def f(rng):\n"
+           "    try:\n"
+           "        w = jax.random.normal(rng, (2,))\n"
+           "    except Exception:\n"
+           "        w = jax.random.uniform(rng, (2,))\n"
+           "        raise\n"
+           "    return w\n")
+    assert not ids(lint_source(src), "ZL001")
+    # consumption AFTER the try/except still sees both paths as consumed
+    src = ("import jax\n"
+           "def f(rng):\n"
+           "    try:\n"
+           "        w = jax.random.normal(rng, (2,))\n"
+           "    except Exception:\n"
+           "        w = jax.random.uniform(rng, (2,))\n"
+           "    return w + jax.random.normal(rng, (2,))\n")
+    assert ids(lint_source(src), "ZL001")
+
+
+def test_zl001_use_after_conditional_consumption_flagged():
+    """Either ternary arm consuming the key marks it consumed afterwards."""
+    src = ("import jax\n"
+           "def f(rng, c):\n"
+           "    v = (jax.random.normal(rng, (2,)) if c\n"
+           "         else jax.random.uniform(rng, (2,)))\n"
+           "    w = jax.random.normal(rng, (2,))\n"
+           "    return v + w\n")
+    assert ids(lint_source(src), "ZL001")
+
+
+def test_zl001_keyword_form_key_is_tracked():
+    """``jax.random.normal(key=k)`` consumes exactly like the positional
+    spelling — keyword-form reuse must not slip the gate."""
+    src = ("import jax\n"
+           "def f(k):\n"
+           "    a = jax.random.normal(key=k, shape=(2,))\n"
+           "    b = jax.random.uniform(key=k, shape=(2,))\n"
+           "    return a + b\n")
+    assert ids(lint_source(src), "ZL001")
+    src = ("import jax\n"
+           "def f(k):\n"
+           "    k1, k2 = jax.random.split(key=k)\n"
+           "    a = jax.random.normal(key=k1, shape=(2,))\n"
+           "    return a + jax.random.uniform(key=k2, shape=(2,))\n")
+    assert not ids(lint_source(src), "ZL001")
+
+
+def test_zl001_subscript_target_does_not_clear_consumption():
+    """``d[rng] = 1`` / ``obj.rng = x`` assign THROUGH the name without
+    rebinding it — the key stays consumed and later reuse is still
+    caught; a real rebinding (incl. starred unpacking) still clears."""
+    src = ("import jax\n"
+           "def f(rng, d):\n"
+           "    a = jax.random.normal(rng, (2,))\n"
+           "    d[rng] = 1\n"
+           "    return a + jax.random.normal(rng, (2,))\n")
+    assert ids(lint_source(src), "ZL001")
+    src = ("import jax\n"
+           "def f(rng, obj):\n"
+           "    a = jax.random.normal(rng, (2,))\n"
+           "    obj.rng = a\n"
+           "    return a + jax.random.normal(rng, (2,))\n")
+    assert ids(lint_source(src), "ZL001")
+    src = ("import jax\n"
+           "def f(rng):\n"
+           "    a = jax.random.normal(rng, (2,))\n"
+           "    rng, *rest = jax.random.split(rng, 3)"
+           "  # zoolint: disable=ZL001\n"
+           "    return a + jax.random.normal(rng, (2,))\n")
+    assert not ids(lint_source(src), "ZL001")
+
+
+def test_zl001_match_case_arms_are_exclusive():
+    """Only one ``case`` arm ever runs — no reuse across arms; sequential
+    reuse before/after the match, and an arm that falls through, still
+    count. The finding must also anchor the LATER call and cite the
+    earlier line."""
+    src = ("import jax\n"
+           "def f(rng, mode):\n"
+           "    match mode:\n"
+           "        case \"a\":\n"
+           "            return jax.random.normal(rng, (2,))\n"
+           "        case _:\n"
+           "            return jax.random.uniform(rng, (2,))\n")
+    assert not ids(lint_source(src), "ZL001")
+    src = ("import jax\n"
+           "def f(rng, mode):\n"
+           "    match mode:\n"
+           "        case \"a\":\n"
+           "            w = jax.random.normal(rng, (2,))\n"
+           "        case _:\n"
+           "            w = 0.0\n"
+           "    return w + jax.random.uniform(rng, (2,))\n")
+    found = [f for f in lint_source(src) if f.rule_id == "ZL001"]
+    assert len(found) == 1
+    assert found[0].line == 8 and "line 5" in found[0].message
+
+
+def test_zl001_message_cites_earlier_line_anchors_later():
+    """Within one statement the scan runs in source order: the second
+    call is flagged, citing the first."""
+    src = ("import jax\n"
+           "def f(rng):\n"
+           "    return (jax.random.normal(rng, (2,)),\n"
+           "            jax.random.uniform(rng, (2,)))\n")
+    found = [f for f in lint_source(src) if f.rule_id == "ZL001"]
+    assert len(found) == 1
+    assert found[0].line == 4 and "line 3" in found[0].message
+
+
+def test_zl001_early_return_branch_is_not_reuse():
+    """A branch that ends in return/raise never reaches the fall-through
+    sampler — the idiomatic early-return key pattern is clean."""
+    src = ("import jax\n"
+           "def f(rng, fast):\n"
+           "    if fast:\n"
+           "        return jax.random.normal(rng, (2,))\n"
+           "    return jax.random.uniform(rng, (2,))\n")
+    assert not ids(lint_source(src), "ZL001")
+    src = ("import jax\n"
+           "def f(rng, bad):\n"
+           "    if bad:\n"
+           "        raise ValueError(jax.random.normal(rng, ()))\n"
+           "    return jax.random.uniform(rng, (2,))\n")
+    assert not ids(lint_source(src), "ZL001")
+    # a nested terminating if/else still does not fall through
+    src = ("import jax\n"
+           "def f(rng, mode):\n"
+           "    if mode:\n"
+           "        if mode > 1:\n"
+           "            return jax.random.normal(rng, (2,))\n"
+           "        else:\n"
+           "            return jax.random.bernoulli(rng, 0.5)\n"
+           "    return jax.random.uniform(rng, (2,))\n")
+    assert not ids(lint_source(src), "ZL001")
+    # ...but a branch that DOES fall through still marks the key consumed
+    src = ("import jax\n"
+           "def f(rng, fast):\n"
+           "    if fast:\n"
+           "        a = jax.random.normal(rng, (2,))\n"
+           "    return jax.random.uniform(rng, (2,))\n")
+    assert ids(lint_source(src), "ZL001")
+
+
+def test_zl001_return_inside_loop_is_not_reuse():
+    """A loop body that never falls through runs at most one iteration:
+    the two-pass rescan must not flag the sampler against itself."""
+    src = ("import jax\n"
+           "def f(rng, xs):\n"
+           "    for x in xs:\n"
+           "        return jax.random.normal(rng, (2,))\n"
+           "    return None\n")
+    assert not ids(lint_source(src), "ZL001")
+    src = ("import jax\n"
+           "def f(rng, xs):\n"
+           "    for x in xs:\n"
+           "        if x:\n"
+           "            w = jax.random.normal(rng, (2,))\n"
+           "            break\n"
+           "    return w\n")
+    assert not ids(lint_source(src), "ZL001")
+    # a continue-terminated branch consumes on EVERY skipped iteration —
+    # dropping it is the documented precision/recall trade; the plain
+    # per-iteration consumption right below stays caught
+    src = ("import jax\n"
+           "def f(rng, xs):\n"
+           "    out = 0.0\n"
+           "    for x in xs:\n"
+           "        out += jax.random.normal(rng, ())\n"
+           "    return out\n")
+    assert ids(lint_source(src), "ZL001")
+
+
+# ---------------------------------------------------------------------------
+# ZL002 — host side effects under jit
+# ---------------------------------------------------------------------------
+
+ZL002_BAD = """
+import jax, time
+@jax.jit
+def f(x):
+    print("x is", x)
+    t0 = time.perf_counter()
+    log.info("traced %s", x)
+    return x * t0
+"""
+
+ZL002_CALL_FORM = """
+import jax
+def step(x):
+    print(x)
+    return x + 1
+step = jax.jit(step, donate_argnums=(0,))
+"""
+
+ZL002_CLEAN = """
+import jax
+@jax.jit
+def f(x):
+    jax.debug.print("x is {}", x)     # the staged-safe way
+    return x * 2
+
+def host_loop(xs):
+    print("not jitted, fine")
+    return [f(x) for x in xs]
+"""
+
+
+def test_zl002_triggers_decorator_form():
+    found = ids(lint_source(ZL002_BAD), "ZL002")
+    assert len(found) == 3      # print, perf_counter, log.info
+
+
+def test_zl002_triggers_call_form():
+    assert ids(lint_source(ZL002_CALL_FORM), "ZL002")
+
+
+def test_zl002_non_jax_jit_not_mistaken_for_staging():
+    """``@numba.jit`` (or any non-jax ``.jit`` attribute) is not JAX
+    staging — host effects in its body are fine; jit/pjit/pmap must
+    resolve through an actual jax import."""
+    src = ("import numba\n"
+           "import time\n"
+           "@numba.jit\n"
+           "def f(x):\n"
+           "    print('compiled by numba, host effects are fine')\n"
+           "    return x * time.time()\n")
+    assert not ids(lint_source(src), "ZL002")
+    src = ("import time\n"
+           "class Runner:\n"
+           "    def go(self):\n"
+           "        def step(x):\n"
+           "            print(x)\n"
+           "            return x\n"
+           "        self.fn = self.jit(step)\n")   # a method, not jax
+    assert not ids(lint_source(src), "ZL002")
+    # ...while the aliased and from-imported jax forms still stage
+    src = ("import jax as j\n"
+           "@j.jit\n"
+           "def f(x):\n"
+           "    print(x)\n"
+           "    return x\n")
+    assert ids(lint_source(src), "ZL002")
+    src = ("from jax import pmap\n"
+           "@pmap\n"
+           "def f(x):\n"
+           "    print(x)\n"
+           "    return x\n")
+    assert ids(lint_source(src), "ZL002")
+
+
+def test_jit_call_on_shadowing_parameter_not_resolved_outward():
+    """``def compile_step(step): return jax.jit(step)`` jits its ARGUMENT
+    — an unrelated module-level function of the same name must not be
+    marked as staged (its host effects are fine)."""
+    src = ("import jax\n"
+           "def compile_step(step):\n"
+           "    return jax.jit(step)\n"
+           "def step(x):\n"
+           "    print(x)\n"
+           "    return x\n")
+    assert not ids(lint_source(src), "ZL002")
+    # a LOCAL ASSIGNMENT shadows the same way a parameter does
+    src = ("import jax\n"
+           "def step(x):\n"
+           "    print(x)\n"
+           "    return x\n"
+           "def main(make_traced):\n"
+           "    step = make_traced()\n"
+           "    return jax.jit(step)\n")
+    assert not ids(lint_source(src), "ZL002")
+    # ...while a wrapper jitting a genuinely outer function still counts
+    src = ("import jax\n"
+           "def step(x):\n"
+           "    print(x)\n"
+           "    return x\n"
+           "def compile_step():\n"
+           "    return jax.jit(step)\n")
+    assert ids(lint_source(src), "ZL002")
+
+
+def test_zl002_clean():
+    assert not ids(lint_source(ZL002_CLEAN), "ZL002")
+
+
+def test_zl002_suppression():
+    src = ZL002_CALL_FORM.replace(
+        "print(x)", "print(x)  # zoolint: disable=ZL002")
+    assert not ids(lint_source(src), "ZL002")
+
+
+# ---------------------------------------------------------------------------
+# ZL003 — hidden host sync in a traced body
+# ---------------------------------------------------------------------------
+
+ZL003_BAD = """
+import jax
+import numpy as np
+@jax.jit
+def f(x):
+    y = np.asarray(x)          # concretizes the tracer
+    s = x.sum().item()         # host sync
+    jax.device_get(x)
+    return y * s
+"""
+
+ZL003_SCAN = """
+import jax
+def outer(xs):
+    def body(c, x):
+        return c + x.item(), x
+    return jax.lax.scan(body, 0.0, xs)
+"""
+
+ZL003_CLEAN = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+@jax.jit
+def f(x):
+    return jnp.asarray(x) * jnp.sum(x)
+
+def host_side(x):
+    return np.asarray(x).item()     # outside any traced body: fine
+"""
+
+
+def test_zl003_triggers_in_jit():
+    assert len(ids(lint_source(ZL003_BAD), "ZL003")) == 3
+
+
+def test_zl003_triggers_in_scan_body():
+    assert ids(lint_source(ZL003_SCAN), "ZL003")
+
+
+def test_zl003_clean():
+    assert not ids(lint_source(ZL003_CLEAN), "ZL003")
+
+
+def test_zl003_device_get_is_import_resolved():
+    """A LOCAL helper that happens to be named `device_get` is not jax
+    API; the from-imported and module-aliased jax forms are."""
+    src = ("import jax\n"
+           "def device_get(x):\n"
+           "    return x\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return device_get(x)\n")
+    assert not ids(lint_source(src), "ZL003")
+    src = ("import jax\n"
+           "from jax import device_get as dg\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return dg(x)\n")
+    assert ids(lint_source(src), "ZL003")
+    src = ("import jax as j\n"
+           "@j.jit\n"
+           "def f(x):\n"
+           "    return j.device_get(x)\n")
+    assert ids(lint_source(src), "ZL003")
+
+
+def test_zl003_suppression():
+    src = ZL003_SCAN.replace(
+        "return c + x.item(), x",
+        "return c + x.item(), x  # zoolint: disable=ZL003")
+    assert not ids(lint_source(src), "ZL003")
+
+
+# ---------------------------------------------------------------------------
+# ZL004 — Python control flow on a traced value
+# ---------------------------------------------------------------------------
+
+ZL004_BAD = """
+import jax
+@jax.jit
+def f(x, thresh):
+    if thresh > 0:
+        return x
+    while x:
+        x = x - 1
+    return -x
+"""
+
+ZL004_CLEAN = """
+from functools import partial
+import jax
+@partial(jax.jit, static_argnames=("n",))
+def f(x, n, rng=None):
+    if n > 2:                   # static: fine
+        return x
+    if x.ndim == 2:             # shape metadata: fine
+        return x.T
+    if rng is None:             # None-check: fine
+        return x
+    if len(x.shape) == 3:
+        return x[0]
+    return x
+"""
+
+
+def test_zl004_triggers():
+    found = ids(lint_source(ZL004_BAD), "ZL004")
+    assert len(found) == 2      # the if and the while
+
+
+def test_zl004_clean_static_and_metadata():
+    assert not ids(lint_source(ZL004_CLEAN), "ZL004")
+
+
+def test_zl004_suppression():
+    src = ZL004_BAD.replace("if thresh > 0:",
+                            "if thresh > 0:  # zoolint: disable=ZL004")
+    assert len(ids(lint_source(src), "ZL004")) == 1   # while still flagged
+
+
+# ---------------------------------------------------------------------------
+# ZL005 — array built in a Python loop (warn-only)
+# ---------------------------------------------------------------------------
+
+ZL005_BAD = """
+import jax.numpy as jnp
+def f(xs):
+    rows = []
+    for x in xs:
+        rows.append(jnp.sin(x) * 2.0)
+    return jnp.stack(rows)
+"""
+
+ZL005_CLEAN = """
+import jax
+import jax.numpy as jnp
+def f(xs):
+    return jnp.stack(jax.vmap(lambda x: jnp.sin(x) * 2.0)(xs))
+
+def host_accumulate(records):
+    out = []
+    for r in records:
+        out.append(r["name"])       # no jnp in the loop: fine
+    return out
+"""
+
+
+def test_zl005_triggers_and_is_warning():
+    fs = lint_source(ZL005_BAD)
+    assert ids(fs, "ZL005") and not errors(fs)
+
+
+def test_zl005_clean():
+    assert not ids(lint_source(ZL005_CLEAN), "ZL005")
+
+
+def test_zl005_suppression():
+    src = ZL005_BAD.replace("for x in xs:",
+                            "for x in xs:  # zoolint: disable=ZL005")
+    assert not ids(lint_source(src), "ZL005")
+
+
+def test_zl005_no_cross_scope_name_match():
+    """A loop-append in one function must not pair with a same-named
+    ``jnp.stack`` argument in a DIFFERENT function — the names are
+    unrelated locals (and the never-stacked ragged-append is legitimate)."""
+    src = ("import jax.numpy as jnp\n"
+           "def build_rows(layers):\n"
+           "    rows = []\n"
+           "    for l in layers:\n"
+           "        rows.append(jnp.ravel(l))   # ragged: never stacked\n"
+           "    return rows\n"
+           "def other(rows):\n"
+           "    return jnp.stack(rows)\n")
+    assert not ids(lint_source(src), "ZL005")
+    # ...but the same pairing within ONE function still triggers
+    assert ids(lint_source(ZL005_BAD), "ZL005")
+
+
+# ---------------------------------------------------------------------------
+# ZL006 — import-time device/mesh init & mutable defaults
+# ---------------------------------------------------------------------------
+
+ZL006_DEVICES = """
+import jax
+N_DEVICES = jax.device_count()      # pins the backend at import
+"""
+
+ZL006_MESH = """
+import jax
+import numpy as np
+from jax.sharding import Mesh
+MESH = Mesh(np.array(jax.devices()), ("data",))
+"""
+
+ZL006_DEFAULT = """
+def accumulate(x, acc=[]):
+    acc.append(x)
+    return acc
+"""
+
+ZL006_CLEAN = """
+import jax
+
+def devices():
+    return jax.devices()            # lazy: fine
+
+def accumulate(x, acc=None):
+    acc = [] if acc is None else acc
+    acc.append(x)
+    return acc
+"""
+
+
+def test_zl006_triggers_module_level_devices():
+    assert ids(lint_source(ZL006_DEVICES), "ZL006")
+
+
+def test_zl006_triggers_module_level_mesh():
+    assert ids(lint_source(ZL006_MESH), "ZL006")
+
+
+def test_zl006_triggers_mutable_default():
+    assert ids(lint_source(ZL006_DEFAULT), "ZL006")
+
+
+def test_zl006_decorators_and_class_heads_run_at_import():
+    """Decorator expressions and class bases/keywords execute at import —
+    `@deco(jax.devices())` pins the backend exactly like a bare call."""
+    src = ("import jax\n"
+           "def deco(devices):\n"
+           "    return lambda fn: fn\n"
+           "@deco(jax.devices())\n"
+           "def f(x):\n"
+           "    return x\n")
+    assert ids(lint_source(src), "ZL006")
+    src = ("import jax\n"
+           "class C(Base, n=jax.device_count()):\n"
+           "    pass\n")
+    assert ids(lint_source(src), "ZL006")
+
+
+def test_zl006_clean():
+    assert not ids(lint_source(ZL006_CLEAN), "ZL006")
+
+
+def test_zl006_main_and_type_checking_guards_not_import_time():
+    """``if __name__ == "__main__":`` runs as a script entry point, not at
+    import; ``if TYPE_CHECKING:`` never runs — device calls there are
+    fine. The else-branch of a guard still executes at import."""
+    src = ("import jax\n"
+           "if __name__ == \"__main__\":\n"
+           "    devs = jax.devices()\n")
+    assert not ids(lint_source(src), "ZL006")
+    src = ("import jax\n"
+           "from typing import TYPE_CHECKING\n"
+           "if TYPE_CHECKING:\n"
+           "    n = jax.device_count()\n")
+    assert not ids(lint_source(src), "ZL006")
+    src = ("import jax\n"
+           "if __name__ == \"__main__\":\n"
+           "    pass\n"
+           "else:\n"
+           "    devs = jax.devices()\n")
+    assert ids(lint_source(src), "ZL006")
+    src = ("import jax\n"
+           "if __name__ != \"__main__\":\n"
+           "    devs = jax.devices()\n")   # inverted guard IS import time
+    assert ids(lint_source(src), "ZL006")
+
+
+def test_zl006_non_jax_mesh_names_not_flagged():
+    """Call-name matching is import-resolved: a module-level call to a
+    function merely NAMED Mesh/make_mesh that has nothing to do with jax
+    must not produce an error-severity finding."""
+    src = ("import trimesh\n"
+           "SCENE = trimesh.Mesh([[0, 0], [1, 1]])\n"
+           "from mylib import make_mesh\n"
+           "GRID = make_mesh(8)\n")
+    assert not ids(lint_source(src), "ZL006")
+
+
+def test_zl006_resolves_jax_aliases():
+    """`import jax as j` and `from jax.sharding import Mesh as M` are
+    still jax API under their local names."""
+    src = ("import jax as j\n"
+           "N = j.device_count()\n")
+    assert ids(lint_source(src), "ZL006")
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "from jax.sharding import Mesh as M\n"
+           "MESH = M(np.array(jax.devices()), ('data',))\n")
+    assert ids(lint_source(src), "ZL006")
+
+
+def test_zl006_suppression():
+    src = ZL006_DEVICES.replace(
+        "N_DEVICES = jax.device_count()      # pins the backend at import",
+        "N_DEVICES = jax.device_count()  # zoolint: disable=ZL006")
+    assert not ids(lint_source(src), "ZL006")
+
+
+# ---------------------------------------------------------------------------
+# ZL007 — swallowed exceptions
+# ---------------------------------------------------------------------------
+
+ZL007_BARE = """
+def f():
+    try:
+        g()
+    except:
+        pass
+"""
+
+ZL007_PASS = """
+def retry():
+    try:
+        g()
+    except Exception:
+        pass
+"""
+
+ZL007_CLEAN = """
+import logging
+log = logging.getLogger(__name__)
+
+def f():
+    try:
+        g()
+    except Exception:
+        log.exception("g failed")
+    try:
+        h()
+    except:                 # re-raise: tolerated
+        cleanup()
+        raise
+"""
+
+
+def test_zl007_bare_except_is_error_everywhere():
+    fs = lint_source(ZL007_BARE, "analytics_zoo_tpu/utils/x.py")
+    assert ids(fs, "ZL007") and errors(fs)
+
+
+def test_zl007_swallow_pass_error_in_hot_path():
+    fs = lint_source(ZL007_PASS, "analytics_zoo_tpu/serving/server.py")
+    assert errors(fs) and ids(fs, "ZL007")
+    fs = lint_source(ZL007_PASS,
+                     "analytics_zoo_tpu/pipeline/inference/im.py")
+    assert errors(fs)
+
+
+def test_zl007_swallow_pass_warning_elsewhere():
+    fs = lint_source(ZL007_PASS, "analytics_zoo_tpu/utils/x.py")
+    assert ids(fs, "ZL007") and not errors(fs)
+
+
+def test_zl007_clean():
+    assert not ids(lint_source(ZL007_CLEAN, "x.py"), "ZL007")
+
+
+def test_zl007_suppression():
+    src = ZL007_BARE.replace("except:",
+                             "except:  # zoolint: disable=ZL007")
+    assert not ids(lint_source(src, "x.py"), "ZL007")
+
+
+def test_zl007_severity_tracks_real_location_not_path_spelling():
+    """A cwd-relative scan of a serving file must gate exactly like CI's
+    absolute-path scan — severity follows the file's real location."""
+    import subprocess
+    serving_dir = os.path.join(REPO, "analytics_zoo_tpu", "serving")
+    code = ("try:\n    x = 1\nexcept Exception:\n    pass\n")
+    probe = os.path.join(serving_dir, "_zl_probe_tmp.py")
+    with open(probe, "w") as f:
+        f.write(code)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "analytics_zoo_tpu.analysis",
+             "_zl_probe_tmp.py"],
+            capture_output=True, text=True, cwd=serving_dir,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")})
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "error ZL007" in proc.stdout
+    finally:
+        os.remove(probe)
+
+
+def test_zl007_raise_in_nested_scope_is_not_a_reraise():
+    """A `raise` inside a def/lambda defined in the handler body never runs
+    in the handler — the bare except still swallows and must be flagged."""
+    src = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except:\n"
+           "        def fallback():\n"
+           "            raise RuntimeError('boom')\n"
+           "        return fallback\n")
+    assert ids(lint_source(src, "x.py"), "ZL007")
+    src = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except:\n"
+           "        cb = lambda: (_ for _ in ()).throw(ValueError())\n"
+           "        return cb\n")
+    assert ids(lint_source(src, "x.py"), "ZL007")
+
+
+# ---------------------------------------------------------------------------
+# ZL008 — missing donation on a rebinding step (warn-only)
+# ---------------------------------------------------------------------------
+
+ZL008_BAD = """
+import jax
+def step(params, grads):
+    params = params - grads
+    return params
+step_fn = jax.jit(step)
+"""
+
+ZL008_CLEAN = """
+import jax
+import optax
+
+def step(params, grads):
+    params = optax.apply_updates(params, grads)
+    return params
+step_fn = jax.jit(step, donate_argnums=(0,))
+
+def predict(params, x):
+    return params @ x           # no rebinding: no donation needed
+predict_fn = jax.jit(predict)
+"""
+
+
+def test_zl008_triggers_and_is_warning():
+    fs = lint_source(ZL008_BAD)
+    assert ids(fs, "ZL008") and not errors(fs)
+
+
+def test_zl008_clean_with_donation_or_no_rebind():
+    assert not ids(lint_source(ZL008_CLEAN), "ZL008")
+
+
+def test_zl008_suppression():
+    src = ZL008_BAD.replace("step_fn = jax.jit(step)",
+                            "step_fn = jax.jit(step)  "
+                            "# zoolint: disable=ZL008")
+    assert not ids(lint_source(src), "ZL008")
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the codebase itself stays hazard-free
+# ---------------------------------------------------------------------------
+
+def test_package_and_tests_have_zero_errors():
+    """CI gate: every error-severity finding in the package or tests/ must
+    be fixed (or carry a justified ``# zoolint: disable``) before merge."""
+    findings = lint_paths([os.path.join(REPO, "analytics_zoo_tpu"),
+                           os.path.join(REPO, "tests"),
+                           os.path.join(REPO, "bench.py")])
+    errs = errors(findings)
+    assert not errs, "zoolint errors:\n" + "\n".join(
+        f.format() for f in errs)
+
+
+def test_gate_catches_a_seeded_violation(tmp_path):
+    """The acceptance check: a reused PRNG key dropped into a scanned tree
+    turns the gate red."""
+    seeded = tmp_path / "seeded.py"
+    seeded.write_text("import jax\n"
+                      "def init(rng):\n"
+                      "    w = jax.random.normal(rng, (4, 4))\n"
+                      "    b = jax.random.normal(rng, (4,))\n"
+                      "    return w, b\n")
+    findings = lint_paths([str(tmp_path)])
+    assert [f for f in findings
+            if f.rule_id == "ZL001" and f.severity == ERROR]
+
+
+# ---------------------------------------------------------------------------
+# review regressions: alias-blind ZL002, head-expression ZL006
+# ---------------------------------------------------------------------------
+
+def test_zl002_time_alias_and_from_import():
+    src_alias = ("import jax\n"
+                 "import time as t\n"
+                 "@jax.jit\n"
+                 "def f(x):\n"
+                 "    return x * t.perf_counter()\n")
+    assert ids(lint_source(src_alias), "ZL002")
+    src_from = ("import jax\n"
+                "from time import perf_counter as pc\n"
+                "@jax.jit\n"
+                "def f(x):\n"
+                "    return x * pc()\n")
+    assert ids(lint_source(src_from), "ZL002")
+    # a user-defined bare name that happens to match is NOT flagged
+    src_clean = ("import jax\n"
+                 "def perf_counter():\n"
+                 "    return 2.0\n"
+                 "@jax.jit\n"
+                 "def f(x):\n"
+                 "    return x * perf_counter()\n")
+    assert not ids(lint_source(src_clean), "ZL002")
+
+
+def test_zl006_head_expressions_of_compound_statements():
+    for src in (
+            "import jax\nif jax.device_count() > 1:\n    FLAG = True\n",
+            "import jax\nfor d in jax.devices():\n    print(d)\n",
+            "import jax\nimport numpy as np\n"
+            "from jax.sharding import Mesh\n"
+            "with Mesh(np.array(jax.devices()), ('d',)):\n    pass\n"):
+        assert ids(lint_source(src), "ZL006"), src
+    # the same calls inside a function body stay clean (lazy is the fix)
+    src_fn = ("import jax\n"
+              "def n_devices():\n"
+              "    if jax.device_count() > 1:\n"
+              "        return jax.device_count()\n"
+              "    return 1\n")
+    assert not ids(lint_source(src_fn), "ZL006")
+
+
+def test_zl001_lambda_param_shadows_outer_key():
+    """A lambda parameter named like an outer consumed key is a fresh
+    binding — no false positive."""
+    src = ("import jax\n"
+           "def f(rng):\n"
+           "    a = jax.random.normal(rng, ())\n"
+           "    g = lambda rng: jax.random.normal(rng, ())\n"
+           "    return a, g\n")
+    assert not ids(lint_source(src), "ZL001")
+
+
+def test_zl001_reuse_within_lambda_body():
+    """A key consumed twice inside ONE lambda body is reuse on every call
+    — lambda bodies are scanned as their own scope, not skipped."""
+    src = ("import jax\n"
+           "def f(rng):\n"
+           "    g = lambda: (jax.random.normal(rng, ()),\n"
+           "                 jax.random.normal(rng, ()))\n"
+           "    return g\n")
+    assert ids(lint_source(src), "ZL001")
+    # one consumption per call is fine (the key is rebound between calls
+    # is the caller's contract; within-body there is no reuse)
+    src = ("import jax\n"
+           "def f(rng):\n"
+           "    return lambda: jax.random.normal(rng, ())\n")
+    assert not ids(lint_source(src), "ZL001")
+
+
+def test_zl001_comprehension_loop_reuse():
+    """The idiomatic form of loop-invariant key reuse: a comprehension
+    consuming the same key once per element."""
+    src = ("import jax\n"
+           "def f(rng, xs):\n"
+           "    return [jax.random.normal(rng, x.shape) for x in xs]\n")
+    assert ids(lint_source(src), "ZL001")
+    clean = ("import jax\n"
+             "def f(rng, xs):\n"
+             "    keys = jax.random.split(rng, len(xs))\n"
+             "    return [jax.random.normal(k, ()) for k in keys]\n")
+    assert not ids(lint_source(clean), "ZL001")
+
+
+def test_zl007_tuple_exception_form():
+    src = ("def retry():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except (Exception,):\n"
+           "        pass\n")
+    fs = lint_source(src, "analytics_zoo_tpu/serving/x.py")
+    assert ids(fs, "ZL007") and errors(fs)
+
+
+def test_cli_default_paths_match_ci_gate():
+    """`python -m analytics_zoo_tpu.analysis` with no args must scan the
+    same tree the tests/test_zoolint.py gate enforces."""
+    from analytics_zoo_tpu.analysis.cli import default_paths
+    got = {os.path.relpath(p, REPO) for p in default_paths()}
+    assert got == {"analytics_zoo_tpu", "tests", "bench.py"}, got
+
+
+def test_zl001_inline_split_in_comprehension_generator():
+    """`for k in jax.random.split(rng, n)` — the iterable evaluates once
+    in the enclosing scope; this is the idiomatic fix, not reuse."""
+    src = ("import jax\n"
+           "def f(rng):\n"
+           "    return [jax.random.normal(k, ())\n"
+           "            for k in jax.random.split(rng, 3)]\n")
+    assert not ids(lint_source(src), "ZL001")
+
+
+def test_zl002_zl003_callback_hosted_helpers_not_flagged():
+    """A helper passed to jax.debug.callback / pure_callback runs on the
+    HOST at execution — print/np.asarray inside it are the remedy the
+    rules recommend, not violations."""
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "@jax.jit\n"
+           "def step(x):\n"
+           "    def report(v):\n"
+           "        print('saw', np.asarray(v))\n"
+           "    jax.debug.callback(report, x)\n"
+           "    jax.pure_callback(lambda v: print(v), None, x)\n"
+           "    return x * 2\n")
+    fs = lint_source(src)
+    assert not ids(fs, "ZL002") and not ids(fs, "ZL003")
+    # a plain nested def (traced, not callback-hosted) is still flagged
+    src_bad = ("import jax\n"
+               "@jax.jit\n"
+               "def step(x):\n"
+               "    def inner(v):\n"
+               "        print('traced', v)\n"
+               "        return v\n"
+               "    return inner(x)\n")
+    assert ids(lint_source(src_bad), "ZL002")
+
+
+def test_zl006_lambda_bodies_are_lazy_not_import_time():
+    src = ("import jax\n"
+           "get_devices = lambda: jax.devices()\n"
+           "def make(cb=lambda: jax.devices()):\n"
+           "    return cb\n")
+    assert not ids(lint_source(src), "ZL006")

@@ -4,7 +4,8 @@ An AST linter (no code execution, no jax import) with a pluggable rule
 registry, targeting the staged-computation hazards runtime tests miss:
 PRNG key reuse, host side effects and hidden syncs under ``jit``, Python
 branches on traced values, import-time device/mesh construction, swallowed
-exceptions in serving retry paths, and missing buffer donation.
+exceptions in serving retry paths, missing buffer donation, and
+unbatched host→device transfers in loops.
 
 CLI:     ``python -m analytics_zoo_tpu.analysis [paths...]``
 Gate:    ``tests/test_zoolint.py`` (tier-1) asserts zero errors.

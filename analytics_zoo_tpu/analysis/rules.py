@@ -1,11 +1,14 @@
-"""zoolint rules ZL001–ZL008 — the JAX/TPU hazards that bite this stack.
+"""zoolint rules ZL001–ZL009 — the JAX/TPU hazards that bite this stack.
 
 Every rule documents its rationale in the class docstring (surfaced by
 ``--list-rules`` and docs/guides/STATIC_ANALYSIS.md). Severities:
 
-* ``error``   — gates CI (``tests/test_zoolint.py`` asserts zero),
-* ``warning`` — advisory only (heuristic rules ZL005/ZL008, and ZL007's
-  swallow-pass form outside the serving/inference retry paths).
+* ``error``   — gates CI (``tests/test_zoolint.py`` asserts zero). The
+  heuristic rules ZL005/ZL008 started warn-only and were promoted once
+  the existing findings were triaged (every remaining site carries a
+  justified suppression) — see the ROADMAP follow-up.
+* ``warning`` — advisory only (ZL007's swallow-pass form outside the
+  serving/inference retry paths).
 """
 
 from __future__ import annotations
@@ -549,11 +552,12 @@ class LoopBuiltArray(Rule):
     """A Python loop appending per-element ``jnp`` results that are later
     ``jnp.stack``-ed dispatches one device op (and potentially one
     compile) per element; ``vmap`` or a batched op does it in one fused
-    kernel. Heuristic and warn-only: loops over layers/pytrees of
-    distinct shapes are legitimate."""
+    kernel. Heuristic — loops over layers/pytrees of distinct shapes are
+    legitimate and carry a justified suppression (cf. ``layers/gpipe.py``);
+    error severity since the package-wide triage (ROADMAP follow-up)."""
 
     id = "ZL005"
-    severity = WARNING
+    severity = ERROR
 
     def _jnp_call_inside(self, ctx: ModuleContext, node: ast.AST) -> bool:
         for sub in ast.walk(node):
@@ -832,11 +836,13 @@ class MissingDonation(Rule):
     """A jitted step that re-binds its first argument (``params = ...``)
     produces a new buffer while the old one stays live — double the
     parameter HBM footprint per step. ``donate_argnums=(0,)`` lets XLA
-    reuse the input buffer in place (cf. training.py's steps). Warn-only:
-    donation is wrong when the caller keeps using the input."""
+    reuse the input buffer in place (cf. training.py's steps). Donation
+    is wrong when the caller keeps using the input — such sites carry a
+    justified suppression (cf. ``pipeline/inference/inference_model.py``);
+    error severity since the package-wide triage (ROADMAP follow-up)."""
 
     id = "ZL008"
-    severity = WARNING
+    severity = ERROR
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         for info in ctx.jitted.values():
@@ -869,3 +875,148 @@ class MissingDonation(Rule):
                     f"donate_argnums — the old buffer stays live (2x param "
                     f"HBM); add donate_argnums=(0,) if the caller discards "
                     f"its input")
+
+
+# ---------------------------------------------------------------------------
+# ZL009 — unbatched host→device transfer in a loop
+# ---------------------------------------------------------------------------
+
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+@register
+class UnbatchedTransferInLoop(Rule):
+    """``jax.device_put`` (or the implicit upload in ``jnp.asarray`` /
+    ``jnp.array``) on a per-iteration value inside a Python ``for``/
+    ``while`` body issues one small host→device transfer per element,
+    each paying the full dispatch round-trip (milliseconds on a tunneled
+    device) where one stacked transfer — or ``FeatureSet``'s
+    ``prefetch_to_device`` pipeline — pays it once. Flags transfers whose
+    argument derives from the loop variable (``for``) or from a name
+    rebound each iteration (``while``); intentionally-chunked bulk
+    uploads carry a justified suppression (cf.
+    ``pipeline/inference/inference_model.py``)."""
+
+    id = "ZL009"
+    severity = ERROR
+
+    def _transfer_call(self, ctx: ModuleContext,
+                       node: ast.Call) -> Optional[str]:
+        """The dotted name iff this call uploads its first argument to
+        device — import-resolved (like ZL003's device_get) so a local
+        helper named ``device_put`` or a non-jax ``asarray`` is never
+        flagged."""
+        d = dotted(node.func)
+        if not d or not node.args:
+            return None
+        mods, froms = ctx.jax_names
+        if "." in d:
+            prefix, leaf = d.rsplit(".", 1)
+            if leaf == "device_put" and prefix.split(".", 1)[0] in mods:
+                return d
+            if leaf in ("asarray", "array") \
+                    and prefix in ctx.aliases["jax.numpy"]:
+                return d
+        else:
+            if froms.get(d) == "device_put":
+                return d
+            if ctx.from_imported("jax.numpy").get(d) in ("asarray", "array"):
+                return d
+        return None
+
+    @staticmethod
+    def _binding_names(target) -> Iterator[str]:
+        """Names in BINDING position (``x``, ``x, y = ...``, ``*rest``) —
+        ``obj.attr = v`` / ``d[k] = v`` assign THROUGH the name without
+        rebinding it, so they do not make it per-iteration state."""
+        stack = [target]
+        while stack:
+            t = stack.pop()
+            if isinstance(t, ast.Name):
+                yield t.id
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            elif isinstance(t, ast.Starred):
+                stack.append(t.value)
+
+    @staticmethod
+    def _references(node: ast.AST, names: Set[str]) -> bool:
+        return any(isinstance(sub, ast.Name) and sub.id in names
+                   for sub in ast.walk(node))
+
+    def _check_loop(self, ctx: ModuleContext, loop) -> Iterator[Finding]:
+        body = [n for st in loop.body
+                for n in _walk_skipping(st, skip_types=_NESTED_SCOPES)]
+        seeds: Set[str] = set()
+        if isinstance(loop, (ast.For, ast.AsyncFor)):
+            seeds.update(self._binding_names(loop.target))
+        else:
+            # while: anything rebound in the body is per-iteration state —
+            # and so is a walrus target in the CONDITION, the idiomatic
+            # `while (item := q.get()) is not None:` streaming form
+            for n in ast.walk(loop.test):
+                if isinstance(n, ast.NamedExpr):
+                    seeds.update(self._binding_names(n.target))
+            for n in body:
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        seeds.update(self._binding_names(t))
+                elif isinstance(n, (ast.AugAssign, ast.AnnAssign,
+                                    ast.NamedExpr)):
+                    seeds.update(self._binding_names(n.target))
+                elif isinstance(n, (ast.For, ast.AsyncFor)):
+                    seeds.update(self._binding_names(n.target))
+        # propagate derivation: `chunk = f(i)` makes `chunk` per-iteration,
+        # and a comprehension over a seed binds per-iteration targets; two
+        # passes close realistic chains without a full fixpoint
+        for _ in range(2):
+            for n in body:
+                if isinstance(n, ast.Assign) \
+                        and self._references(n.value, seeds):
+                    for t in n.targets:
+                        seeds.update(self._binding_names(t))
+                elif isinstance(n, ast.NamedExpr) \
+                        and self._references(n.value, seeds):
+                    seeds.update(self._binding_names(n.target))
+                elif isinstance(n, (ast.ListComp, ast.SetComp,
+                                    ast.GeneratorExp, ast.DictComp)):
+                    for gen in n.generators:
+                        if self._references(gen.iter, seeds):
+                            seeds.update(self._binding_names(gen.target))
+        if not seeds:
+            return
+        for n in body:
+            if not isinstance(n, ast.Call):
+                continue
+            d = self._transfer_call(ctx, n)
+            if d is None or not self._references(n.args[0], seeds):
+                continue
+            # `device_put(jnp.asarray(x), ...)` is ONE transfer: flag the
+            # outer call only
+            par = ctx.parent(n)
+            if isinstance(par, ast.Call) \
+                    and self._transfer_call(ctx, par) is not None:
+                continue
+            yield self.finding(
+                ctx, n.lineno,
+                f"`{d}(...)` on a per-iteration value inside a loop — one "
+                f"small host→device transfer (and dispatch round-trip) per "
+                f"element; stack on the host and transfer once, or stream "
+                f"through feature.prefetch_to_device")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        # loops inside jit-traced code unroll at TRACE time — jnp.asarray
+        # on a traced value is free there and device_put of a constant is
+        # baked into the program, so no per-iteration runtime transfer
+        # exists to flag
+        traced = {id(info.fn) for info in ctx.jitted.values()}
+        traced.update(id(fn) for fn in ctx.scan_bodies)
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            cur = loop
+            while cur is not None and id(cur) not in traced:
+                cur = ctx.parent(cur)
+            if cur is not None:
+                continue
+            yield from self._check_loop(ctx, loop)

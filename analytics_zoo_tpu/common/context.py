@@ -48,6 +48,7 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.train.device_cache": False,     # HBM-resident dataset, 1 dispatch/epoch
     "zoo.train.fuse_epochs": 1,          # epochs fused per dispatch (device_cache only)
     "zoo.train.zero_sharding": False,    # ZeRO-1: optimizer state sharded over data axis
+    "zoo.metrics.flops": False,          # fit(): cost-analysis pass feeding the MFU gauge
     "zoo.failure.retry_times": 5,        # ≅ bigdl.failure.retryTimes (Topology.scala:1172)
     "zoo.failure.retry_window_sec": 3600,
     "zoo.checkpoint.keep": 3,

@@ -1,0 +1,40 @@
+"""Unified metrics + tracing for the analytics_zoo_tpu stack.
+
+One process-wide :class:`MetricsRegistry` (counters, gauges, log-bucketed
+histograms — cheap enough for the serving hot path) plus span-based
+tracing, with three export sinks:
+
+* Prometheus text exposition — ``render_prometheus()`` / the
+  :class:`ScrapeServer` endpoint ``ClusterServing.serve_metrics()`` mounts,
+* structured JSON event records — :class:`JsonEventSink` (one JSON object
+  per line; spans, per-batch serving events, error records),
+* TensorBoard event files — :class:`TensorBoardSink` over the existing
+  ``utils.tensorboard.EventFileWriter`` (the reference's only channel
+  keeps working unchanged).
+
+Instrumented layers: ``serving/server.py`` (stream depth, batch size,
+queue-wait and dispatch latency, error counters), ``pipeline/inference/
+inference_model.py`` (replica-permit wait, per-batch device time), and
+``pipeline/api/keras/training.py`` ``fit`` (step-time histogram,
+records/sec, achieved MFU). ``bench.py`` snapshots the registry into each
+BENCH record. Catalog + conventions: ``docs/guides/OBSERVABILITY.md``.
+
+>>> from analytics_zoo_tpu import observability as obs
+>>> with obs.span("my.phase"):
+...     work()
+>>> print(obs.render_prometheus())
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      default_registry, reset_default_registry)
+from .tracing import current_span, span
+from .export import (JsonEventSink, ScrapeServer, TensorBoardSink, dump,
+                     parse_prometheus, read_events, render_prometheus)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "default_registry", "reset_default_registry",
+    "span", "current_span",
+    "JsonEventSink", "ScrapeServer", "TensorBoardSink",
+    "dump", "parse_prometheus", "read_events", "render_prometheus",
+]

@@ -1,0 +1,304 @@
+"""Export sinks for the metrics registry.
+
+Three ways out of the process, matching how the three audiences read:
+
+* :func:`render_prometheus` / :class:`ScrapeServer` — text exposition for
+  a scraper (or a human with ``curl``); the serving backend mounts this
+  via ``ClusterServing.serve_metrics()``.
+* :class:`JsonEventSink` — append-only JSON-lines event log (spans,
+  per-batch serving events, error records); schema-stable under
+  concurrent writers because each event is one ``json.dumps`` appended
+  under a lock.
+* :class:`TensorBoardSink` — adapter over the existing
+  ``utils.tensorboard.EventFileWriter`` so registry snapshots can land in
+  the same event files the training/serving scalars already use (the
+  reference's only export channel keeps working unchanged).
+
+:func:`parse_prometheus` is the deliberately minimal reader used by the
+round-trip tests — names, types, labels, values, enough to reconcile a
+scrape against ground truth without a client library.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import math
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional
+
+from .metrics import Histogram, MetricsRegistry, default_registry
+
+__all__ = ["render_prometheus", "parse_prometheus", "dump",
+           "JsonEventSink", "read_events", "ScrapeServer", "TensorBoardSink"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _sanitize(name: str) -> str:
+    if _NAME_OK.match(name):
+        return name
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    return out if _NAME_OK.match(out) else "_" + out
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labels_text(labels, extra: Optional[Dict[str, str]] = None) -> str:
+    pairs = list(labels) + sorted((extra or {}).items())
+    if not pairs:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape_label(str(v))}"'
+                          for k, v in pairs) + "}"
+
+
+def _fmt(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+    """The registry as Prometheus text exposition format (one ``# TYPE``
+    per family; histograms as cumulative ``_bucket{le=...}`` + ``_sum`` /
+    ``_count``)."""
+    reg = registry if registry is not None else default_registry()
+    lines: List[str] = []
+    typed = set()
+    for m in reg.metrics():
+        name = _sanitize(m.name)
+        if name not in typed:
+            typed.add(name)
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+        if isinstance(m, Histogram):
+            # one locked snapshot: the +Inf bucket must equal _count even
+            # when a producer observes mid-render
+            buckets, count, total = m.stats()
+            for le, c in buckets:
+                lines.append(f"{name}_bucket"
+                             f"{_labels_text(m.labels, {'le': _fmt(le)})}"
+                             f" {c}")
+            lines.append(f"{name}_sum{_labels_text(m.labels)} {_fmt(total)}")
+            lines.append(f"{name}_count{_labels_text(m.labels)} {count}")
+        else:
+            lines.append(f"{name}{_labels_text(m.labels)} {_fmt(m.value)}")
+    return "\n".join(lines) + "\n"
+
+
+def dump(registry: Optional[MetricsRegistry] = None,
+         compact: bool = False) -> Dict[str, Any]:
+    """Plain-dict snapshot of the registry (see
+    ``MetricsRegistry.snapshot``) — what ``bench.py`` embeds per round."""
+    reg = registry if registry is not None else default_registry()
+    return reg.snapshot(compact=compact)
+
+
+# the label block matches quoted values char-by-char (escapes allowed), so
+# a '}' INSIDE a label value does not terminate the block early
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(?:\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"\s*,?\s*)*)\})?'
+    r"\s+(?P<value>\S+)\s*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_value(s: str) -> float:
+    return {"+Inf": math.inf, "-Inf": -math.inf}.get(s) or float(s)
+
+
+def parse_prometheus(text: str) -> Dict[str, Dict[str, Any]]:
+    """Minimal exposition-format reader: ``{family: {"type": ...,
+    "samples": [(name, labels_dict, value), ...]}}``. Raises ValueError
+    on lines that are neither comments nor well-formed samples — the
+    round-trip tests lean on that strictness."""
+    out: Dict[str, Dict[str, Any]] = {}
+    last_family = None
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                last_family = parts[2]
+                out[last_family] = {"type": parts[3].strip(), "samples": []}
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name = m.group("name")
+        labels = {k: re.sub(r"\\(.)", lambda g: {"n": "\n"}.get(
+            g.group(1), g.group(1)), v)
+            for k, v in _LABEL_RE.findall(m.group("labels") or "")}
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[:-len(suffix)] if name.endswith(suffix) else None
+            if base and base in out and out[base]["type"] == "histogram":
+                family = base
+                break
+        if family not in out:
+            # sample with no TYPE line: tolerated as untyped
+            out[family] = {"type": "untyped", "samples": []}
+        out[family]["samples"].append(
+            (name, labels, _parse_value(m.group("value"))))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JSON event sink
+# ---------------------------------------------------------------------------
+
+class JsonEventSink:
+    """Append-only JSON-lines writer for structured event records.
+
+    Every line is one complete JSON object with at least ``ts`` (epoch
+    seconds) and ``kind``; producers add flat payload fields. Writes are
+    serialized under a lock so concurrent writers (serving loop + span
+    exits on producer threads) can never interleave bytes — the schema
+    stability the exposition tests assert."""
+
+    def __init__(self, path: str):
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self.path = path
+        # line-buffered: each event reaches the OS as it happens, so a
+        # crash loses at most the in-flight line — the events nearest a
+        # failure are exactly the ones diagnosis needs
+        self._f = open(path, "a", encoding="utf-8", buffering=1)
+        self._lock = threading.Lock()
+
+    def write(self, event: Dict[str, Any]) -> None:
+        line = json.dumps(event, sort_keys=True, default=str)
+        with self._lock:
+            if self._f.closed:
+                # a concurrent emitter may race close() (the registry's
+                # sink snapshot is taken before removal); dropping the
+                # event beats crashing the instrumented thread
+                return
+            self._f.write(line + "\n")
+
+    def flush(self) -> None:
+        with self._lock:
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+
+
+def read_events(path: str, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Parse a JSON-lines event log back, optionally filtered by kind."""
+    out: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            event = json.loads(line)
+            if kind is None or event.get("kind") == kind:
+                out.append(event)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scrape endpoint
+# ---------------------------------------------------------------------------
+
+class _ScrapeHandler(http.server.BaseHTTPRequestHandler):
+    registry: MetricsRegistry = None  # type: ignore[assignment]
+
+    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+        if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+            self.send_error(404)
+            return
+        body = render_prometheus(self.registry).encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrapes must not spam stderr
+        pass
+
+
+class ScrapeServer:
+    """A tiny ``/metrics`` HTTP endpoint over one registry — what a
+    Prometheus scraper (or ``curl``) reads. ``port=0`` picks a free port;
+    the bound one is on ``self.port``."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        handler = type("Handler", (_ScrapeHandler,),
+                       {"registry": registry if registry is not None
+                        else default_registry()})
+        self._httpd = http.server.ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="zoo-metrics-scrape",
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# TensorBoard sink (reuses the existing event-file writer)
+# ---------------------------------------------------------------------------
+
+class TensorBoardSink:
+    """Export registry snapshots into TensorBoard event files through the
+    in-repo ``EventFileWriter`` — counters/gauges as scalars, histograms
+    as ``_count``/``_sum``/``_mean`` scalars (bucket shapes live in the
+    Prometheus/JSON channels; TB scalars are for trend lines)."""
+
+    def __init__(self, log_dir: str):
+        from ..utils.tensorboard import EventFileWriter
+        self.writer = EventFileWriter(log_dir)
+
+    def export(self, registry: Optional[MetricsRegistry] = None,
+               step: int = 0) -> None:
+        reg = registry if registry is not None else default_registry()
+        for m in reg.metrics():
+            tag = m.name
+            if m.labels:
+                tag += "/" + "/".join(v for _, v in m.labels)
+            if isinstance(m, Histogram):
+                _, count, total = m.stats()   # one locked snapshot
+                self.writer.add_scalar(tag + "_count", float(count), step)
+                self.writer.add_scalar(tag + "_sum", float(total), step)
+                if count:
+                    self.writer.add_scalar(tag + "_mean",
+                                           float(total / count), step)
+            else:
+                self.writer.add_scalar(tag, float(m.value), step)
+        self.writer.flush()
+
+    def close(self) -> None:
+        self.writer.close()

@@ -1,0 +1,307 @@
+"""Metric primitives + the process-wide registry.
+
+The reference stack's only runtime numbers are two TensorBoard scalars and
+ad-hoc scoped timers (SURVEY §5); this module is the single place the
+serving loop, ``InferenceModel``, and ``KerasNet.fit`` report what they are
+doing. Three primitives, Prometheus-shaped:
+
+* :class:`Counter` — monotonically increasing (records served, failures),
+* :class:`Gauge`   — last-write-wins level (stream depth, records/sec),
+* :class:`Histogram` — log-bucketed distribution (latencies, batch sizes).
+
+Design constraints, in order:
+
+1. **Hot-path cheap.** One ``Histogram.observe`` is a ``math.frexp`` plus
+   three adds under a lock — no string formatting, no allocation, no
+   timestamping. The serving loop calls a handful of these per *batch*
+   (not per record), so instrumentation cost is noise even at queue rates.
+2. **Process-wide.** :func:`default_registry` is the shared registry every
+   instrumented layer writes to by default; components accept a
+   ``registry=`` override so tests can reconcile counts in isolation.
+3. **Exportable.** The registry renders to Prometheus text exposition and
+   snapshots to plain dicts (``export.py``); event-style records (spans,
+   per-batch serving events) fan out to attached sinks via :meth:`emit`.
+
+Log bucketing: bucket upper bounds are powers of two spanning
+``2**_EXP_LO .. 2**_EXP_HI`` (≈1e-8 s to ≈1.7e7), one bucket per octave —
+~26% relative resolution over 15 decades for 51 buckets, enough to tell a
+50 µs dispatch from a 5 ms one without per-metric bucket tuning.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+log = logging.getLogger("analytics_zoo_tpu.observability")
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "default_registry", "reset_default_registry"]
+
+LabelsT = Tuple[Tuple[str, str], ...]
+
+
+def _label_tuple(labels: Optional[Dict[str, str]]) -> LabelsT:
+    return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
+
+
+class _Metric:
+    """Common identity: a family ``name`` plus an optional fixed label set
+    (labels are bound at creation — there is no per-observation label
+    lookup on the hot path)."""
+
+    kind = ""
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.help = help
+        self.labels: LabelsT = _label_tuple(labels)
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    """Monotonic counter. ``inc`` only — a counter that can go down is a
+    gauge, and Prometheus ``rate()`` depends on the distinction."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        super().__init__(name, help, labels)
+        self._value = 0.0
+
+    def inc(self, n: float = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (n={n})")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge(_Metric):
+    """Last-write-wins level."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        super().__init__(name, help, labels)
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def add(self, n: float = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+# bucket upper bounds are 2**e for e in [_EXP_LO, _EXP_HI]: 2**-27 ≈ 7.5e-9
+# (sub-tick durations land in the first bucket) up to 2**24 ≈ 1.7e7
+# (records/sec, byte counts); values outside clamp to the edge buckets
+_EXP_LO, _EXP_HI = -27, 24
+
+
+class Histogram(_Metric):
+    """Log-bucketed histogram: fixed power-of-two bucket edges, cumulative
+    exposition. ``observe(v, n=k)`` records ``k`` observations of ``v`` in
+    one call — how the training loop reports a fused dispatch of ``k``
+    identical-duration steps without ``k`` lock round-trips."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        super().__init__(name, help, labels)
+        self._counts = [0] * (_EXP_HI - _EXP_LO + 1)
+        self._count = 0
+        self._sum = 0.0
+
+    @staticmethod
+    def _bucket_index(v: float) -> int:
+        if v <= 0 or v != v:            # zeros/negatives/NaN: first bucket
+            return 0
+        m, e = math.frexp(v)            # v = m * 2**e, 0.5 <= m < 1
+        if m == 0.5:                    # exact powers of two sit ON an edge
+            e -= 1
+        return min(max(e - _EXP_LO, 0), _EXP_HI - _EXP_LO)
+
+    def observe(self, v: float, n: int = 1) -> None:
+        i = self._bucket_index(v)
+        with self._lock:
+            self._counts[i] += n
+            self._count += n
+            self._sum += float(v) * n
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def stats(self) -> Tuple[List[Tuple[float, int]], int, float]:
+        """``(cumulative_buckets, count, sum)`` from ONE locked snapshot —
+        exporters must use this so a concurrent ``observe`` can never
+        produce an exposition where the ``+Inf`` bucket != ``_count``
+        (the Prometheus histogram invariant). Buckets are
+        ``(upper_bound, cumulative_count)`` pairs ending with ``(inf,
+        count)``; zero-count leading/trailing buckets are trimmed (the
+        full 52-edge ladder would dominate the exposition)."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            s = self._sum
+        nz = [i for i, c in enumerate(counts) if c]
+        out: List[Tuple[float, int]] = []
+        if nz:
+            lo, hi = nz[0], nz[-1]
+            acc = 0
+            for i in range(lo, hi + 1):
+                acc += counts[i]
+                out.append((2.0 ** (i + _EXP_LO), acc))
+        out.append((math.inf, total))
+        return out, total, s
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """The bucket series alone (see :meth:`stats`)."""
+        return self.stats()[0]
+
+
+_METRIC_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Name→metric map with get-or-create semantics and attached event
+    sinks. All methods are thread-safe; metric objects are cached by the
+    instrumented layers, so steady-state hot paths never touch the
+    registry lock."""
+
+    def __init__(self):
+        self._metrics: Dict[Tuple[str, LabelsT], _Metric] = {}
+        self._lock = threading.Lock()
+        self._sinks: List[Any] = []
+        self._broken_sinks: set = set()
+
+    # -- get-or-create -------------------------------------------------------
+    def _get(self, kind: str, name: str, help: str,
+             labels: Optional[Dict[str, str]]) -> _Metric:
+        key = (name, _label_tuple(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = _METRIC_TYPES[kind](name, help, labels)
+                self._metrics[key] = m
+            elif m.kind != kind:
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested as {kind}")
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get("counter", name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get("gauge", name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        return self._get("histogram", name, help, labels)
+
+    def metrics(self) -> List[_Metric]:
+        """All metrics, sorted by (name, labels) — the exposition order."""
+        with self._lock:
+            return [m for _, m in sorted(self._metrics.items())]
+
+    # -- snapshot ------------------------------------------------------------
+    def snapshot(self, compact: bool = False) -> Dict[str, Any]:
+        """Plain-dict view. Keys are ``name`` or ``name{k="v",...}``.
+        ``compact=True`` drops histogram buckets (count/sum/mean only) —
+        the form ``bench.py`` embeds in each BENCH record."""
+        out: Dict[str, Any] = {}
+        for m in self.metrics():
+            key = m.name
+            if m.labels:
+                key += "{" + ",".join(f'{k}="{v}"' for k, v in m.labels) + "}"
+            if isinstance(m, Histogram):
+                buckets, count, total = m.stats()
+                entry: Dict[str, Any] = {"type": m.kind, "count": count,
+                                         "sum": total}
+                if compact:
+                    entry["mean"] = total / count if count else 0.0
+                else:
+                    entry["buckets"] = [[le, c] for le, c in buckets]
+                out[key] = entry
+            else:
+                out[key] = {"type": m.kind, "value": m.value}
+        return out
+
+    # -- event sinks ---------------------------------------------------------
+    def add_event_sink(self, sink) -> None:
+        """Attach a sink (anything with ``write(event: dict)``) that
+        receives every :meth:`emit` — the JSON event log channel."""
+        with self._lock:
+            if sink not in self._sinks:
+                self._sinks.append(sink)
+
+    def remove_event_sink(self, sink) -> None:
+        with self._lock:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
+
+    def emit(self, kind: str, **fields) -> None:
+        """Fan an event record out to the attached sinks. Near-free with
+        no sinks attached (one attribute read + truth test). Sink write
+        failures (disk full, closed file) are logged and swallowed — an
+        event-log I/O error must never kill the instrumented thread
+        (e.g. the serve loop) or fail the operation being measured."""
+        sinks = self._sinks
+        if not sinks:
+            return
+        event = {"ts": time.time(), "kind": kind, **fields}
+        for sink in list(sinks):
+            try:
+                sink.write(event)
+            except Exception:
+                if id(sink) not in self._broken_sinks:   # warn once per sink
+                    self._broken_sinks.add(id(sink))
+                    log.exception("event sink %r failed; further errors "
+                                  "from it are suppressed", sink)
+
+
+_DEFAULT: Optional[MetricsRegistry] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry the instrumented layers share — one
+    scrape endpoint sees serving, inference, and training together."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = MetricsRegistry()
+        return _DEFAULT
+
+
+def reset_default_registry() -> None:
+    """Drop the process-wide registry (tests: counter isolation between
+    cases). Metric objects cached by live components keep working; they
+    just stop being visible to new scrapes."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = None

@@ -1,0 +1,104 @@
+"""Span-based tracing over the metrics registry.
+
+``with trace.span("serving.dispatch"): ...`` records the block's wall
+duration into the ``zoo_span_seconds{span=...}`` histogram and — when the
+registry has event sinks attached — emits one structured span event with
+the parent span name, so the JSON log reconstructs nesting without a
+separate trace-file format. Nesting is tracked per thread; a span opened
+on one thread never becomes the parent of a span on another (the serving
+loop, producers, and the training loop each own their stack).
+
+This deliberately is NOT a distributed tracer: no ids, no sampling, no
+context propagation across processes. It is the "which phase of the
+request spent the time" layer the reference's scoped ``timeIt`` timers
+approximated, feeding the same registry everything else reports to.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import weakref
+from typing import Dict, Iterator, Optional
+
+from .metrics import Histogram, MetricsRegistry, default_registry
+
+__all__ = ["span", "current_span", "SpanHandle"]
+
+_state = threading.local()
+
+# per-(registry, span-name) histogram cache: a span exit must not take the
+# registry lock (which a concurrent scrape holds while rendering) — the
+# lock is paid once per new span name, then exits are lock-free dict reads
+_hist_cache: "weakref.WeakKeyDictionary[MetricsRegistry, Dict[str, Histogram]]" \
+    = weakref.WeakKeyDictionary()
+
+
+def _span_histogram(reg: MetricsRegistry, name: str) -> Histogram:
+    per_reg = _hist_cache.get(reg)
+    if per_reg is None:
+        per_reg = _hist_cache.setdefault(reg, {})
+    h = per_reg.get(name)
+    if h is None:
+        h = per_reg[name] = reg.histogram(
+            "zoo_span_seconds", "wall seconds per traced span",
+            labels={"span": name})
+    return h
+
+
+def _stack() -> list:
+    st = getattr(_state, "stack", None)
+    if st is None:
+        st = _state.stack = []
+    return st
+
+
+def current_span() -> Optional[str]:
+    """Name of the innermost open span on this thread, or None."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+class SpanHandle:
+    """Yielded by :func:`span`; :meth:`discard` cancels recording — for
+    blocks that turn out to be no-ops (e.g. a refused non-blocking
+    dispatch probe) whose ~zero durations would skew the distribution."""
+
+    __slots__ = ("discarded",)
+
+    def __init__(self):
+        self.discarded = False
+
+    def discard(self) -> None:
+        self.discarded = True
+
+
+@contextlib.contextmanager
+def span(name: str, registry: Optional[MetricsRegistry] = None,
+         **attrs) -> Iterator[SpanHandle]:
+    """Time a block as a named span.
+
+    * duration → ``zoo_span_seconds{span=name}`` histogram in ``registry``
+      (default: the process-wide registry),
+    * one ``{"kind": "span", "name", "parent", "dur_s", **attrs}`` event
+      to the registry's sinks (no-op when none are attached),
+    * ``attrs`` ride along on the event only — keep them small and
+      JSON-serializable (batch sizes, record counts),
+    * yields a :class:`SpanHandle`; ``handle.discard()`` suppresses the
+      histogram observation and event for a block that did no real work.
+    """
+    reg = registry if registry is not None else default_registry()
+    st = _stack()
+    parent = st[-1] if st else None
+    st.append(name)
+    handle = SpanHandle()
+    t0 = time.perf_counter()
+    try:
+        yield handle
+    finally:
+        dur = time.perf_counter() - t0
+        st.pop()
+        if not handle.discarded:
+            _span_histogram(reg, name).observe(dur)
+            reg.emit("span", name=name, parent=parent, dur_s=dur, **attrs)

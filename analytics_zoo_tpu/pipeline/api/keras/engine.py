@@ -643,7 +643,7 @@ def install_imported_weights(model: "KerasNet", weights, states=None,
                 raise ValueError(f"{lname}.{k}: {source} weight shape "
                                  f"{np.shape(v)} vs graph "
                                  f"{np.shape(tmpl[k])}")
-        model.params[lname] = {k: jnp.asarray(v) for k, v in w.items()}
+        model.params[lname] = {k: jnp.asarray(v) for k, v in w.items()}  # zoolint: disable=ZL009 one-time load; per-layer shapes differ, nothing to batch
     for lname, s in (states or {}).items():
-        model.net_state[lname] = {k: jnp.asarray(v) for k, v in s.items()}
+        model.net_state[lname] = {k: jnp.asarray(v) for k, v in s.items()}  # zoolint: disable=ZL009 one-time load; per-layer shapes differ
     return model

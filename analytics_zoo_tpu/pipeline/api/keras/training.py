@@ -35,6 +35,7 @@ from ....common.context import get_zoo_context
 from ....common.triggers import (EveryEpoch, MaxEpoch, SeveralIteration,
                                  TrainLoopState, Trigger)
 from ....feature.feature_set import FeatureSet, prefetch_to_device
+from ....observability import default_registry, span
 from ....parallel import mesh as mesh_lib
 from ....utils.checkpoint import CheckpointManager
 from . import metrics as metrics_lib
@@ -261,6 +262,23 @@ class TrainingLoop:
         # could be reused by a new FeatureSet after GC and silently serve
         # the old dataset's arrays.
         self._data_cache: Dict[Tuple, Any] = {}
+        # observability (docs/guides/OBSERVABILITY.md): every fit updates
+        # the zoo_train_* family in the process-wide registry
+        self._registry = default_registry()
+        self._m_step_time = self._registry.histogram(
+            "zoo_train_step_seconds",
+            "optimizer-step wall time (amortized over fused dispatches)")
+        self._m_throughput = self._registry.gauge(
+            "zoo_train_records_per_sec", "training examples/sec, last epoch")
+        self._m_mfu = self._registry.gauge(
+            "zoo_train_mfu",
+            "achieved model-FLOPs utilization, last epoch "
+            "(zoo.metrics.flops + a known chip peak)")
+        self._m_steps = self._registry.counter(
+            "zoo_train_steps_total", "optimizer steps run")
+        self._m_examples = self._registry.counter(
+            "zoo_train_examples_total", "training examples consumed")
+        self._flops_per_example: Optional[float] = None
 
     # -- jitted steps -------------------------------------------------------
     def build_train_step(self):
@@ -515,6 +533,52 @@ class TrainingLoop:
         self._predict_step = jax.jit(step)
         return self._predict_step
 
+    # -- observability ------------------------------------------------------
+    def _maybe_compute_flops(self, fn, args, examples_per_dispatch) -> float:
+        """One-shot XLA cost-analysis pass caching FLOPs/example for the MFU
+        gauge. Opt-in (``zoo.metrics.flops``): the extra ``lower().compile()``
+        costs a compile, wasted on backends with no known peak — and
+        ``lower`` only reads avals/shardings, so calling it on buffers the
+        subsequent dispatch donates is safe. Returns the seconds spent so
+        callers can exclude the compile from their epoch-timing window
+        (the metrics this pass feeds must not be skewed by it)."""
+        if self._flops_per_example is not None:
+            return 0.0
+        if not get_zoo_context().get("zoo.metrics.flops", False):
+            # do NOT latch the off state: the flag is re-read per dispatch
+            # (one dict lookup) so enabling it before a later fit on the
+            # same compiled model still produces an MFU reading
+            return 0.0
+        from ....utils import profiling
+        t = time.perf_counter()
+        try:
+            flops = profiling.compiled_flops(fn.lower(*args).compile())
+        except Exception:   # backend-dependent; never fail a fit for MFU
+            flops = None
+        # 0.0 latches "tried and unavailable" so the compile isn't retried
+        self._flops_per_example = (
+            flops / examples_per_dispatch if flops else 0.0)
+        return time.perf_counter() - t
+
+    def _observe_fit_metrics(self, steps: int, dt: float,
+                             n_examples: int) -> None:
+        """Per-epoch registry update: weighted step-time histogram,
+        records/sec gauge, cumulative counters, and — when FLOPs/example
+        is known and the chip peak is published — achieved MFU via
+        ``utils/profiling.py``."""
+        if steps <= 0 or dt <= 0:
+            return
+        self._m_step_time.observe(dt / steps, n=steps)
+        thr = n_examples / dt
+        self._m_throughput.set(thr)
+        self._m_steps.inc(steps)
+        self._m_examples.inc(n_examples)
+        if self._flops_per_example:
+            from ....utils import profiling
+            m = profiling.mfu(self._flops_per_example * thr)
+            if m is not None:
+                self._m_mfu.set(m)
+
     # -- checkpoint plumbing ------------------------------------------------
     def _ckpt_manager(self) -> Optional[CheckpointManager]:
         spec = getattr(self.model, "_checkpoint", None)
@@ -593,7 +657,8 @@ class TrainingLoop:
         if profile_dir:
             self.model._profile_dir = None
         from ....utils import profiling
-        with profiling.trace(profile_dir):
+        with profiling.trace(profile_dir), span("train.fit",
+                                                registry=self._registry):
             return self._fit_with_retry(
                 fs, batch_size=batch_size, nb_epoch=nb_epoch,
                 target_holder=target_holder,
@@ -820,6 +885,10 @@ class TrainingLoop:
                 if g == 1:
                     shuffle_rng = jax.random.key(
                         fs.seed + ctx.seed + epoch + 1)
+                    t0 += self._maybe_compute_flops(
+                        epoch_fn, (params, opt_state, net_state, base_rng,
+                                   it0, shuffle_rng, xs_dev, ys_dev),
+                        n_steps * batch_size)
                     params, opt_state, net_state, L = epoch_fn(
                         params, opt_state, net_state, base_rng, it0,
                         shuffle_rng, xs_dev, ys_dev)
@@ -829,11 +898,17 @@ class TrainingLoop:
                     keys = jnp.stack(
                         [jax.random.key(fs.seed + ctx.seed + e)
                          for e in range(epoch + 1, epoch + g + 1)])
+                    t0 += self._maybe_compute_flops(
+                        mfn, (params, opt_state, net_state, base_rng, it0,
+                              keys, xs_dev, ys_dev),
+                        g * n_steps * batch_size)
                     params, opt_state, net_state, L = mfn(
                         params, opt_state, net_state, base_rng, it0, keys,
                         xs_dev, ys_dev)
                 L = np.asarray(jax.block_until_ready(L)).reshape(g, -1)
                 dt = (time.time() - t0) / g
+                self._observe_fit_metrics(g * n_steps, dt * g,
+                                          g * n_steps * batch_size)
                 loop_state.iteration += g * n_steps
                 # publish once per block: the intermediate epochs' params
                 # never materialize on the host (that is the point)
@@ -897,11 +972,15 @@ class TrainingLoop:
             if epoch_fn is not None:
                 prev_iter = loop_state.iteration
                 shuffle_rng = jax.random.key(fs.seed + ctx.seed + epoch)
-                params, opt_state, net_state, l = epoch_fn(
-                    params, opt_state, net_state, base_rng,
-                    jnp.asarray(prev_iter, jnp.int32), shuffle_rng,
-                    xs_dev, ys_dev)
+                it0 = jnp.asarray(prev_iter, jnp.int32)
                 n_steps = fs.steps_per_epoch(batch_size, drop_last=True)
+                t0 += self._maybe_compute_flops(
+                    epoch_fn, (params, opt_state, net_state, base_rng, it0,
+                               shuffle_rng, xs_dev, ys_dev),
+                    n_steps * batch_size)
+                params, opt_state, net_state, l = epoch_fn(
+                    params, opt_state, net_state, base_rng, it0, shuffle_rng,
+                    xs_dev, ys_dev)
                 losses.append(l)
                 loop_state.iteration += n_steps
                 n_seen += n_steps * batch_size
@@ -926,13 +1005,22 @@ class TrainingLoop:
                 prev_iter = loop_state.iteration
                 if scan_steps > 1:
                     k = jax.tree.leaves(bx_d)[0].shape[0]
+                    it0 = jnp.asarray(prev_iter, jnp.int32)
+                    t0 += self._maybe_compute_flops(
+                        self._scan_step,
+                        (params, opt_state, net_state, base_rng, it0,
+                         bx_d, by_d), k * batch_size)
                     params, opt_state, net_state, l = self._scan_step(
-                        params, opt_state, net_state, base_rng,
-                        jnp.asarray(prev_iter, jnp.int32), bx_d, by_d)
+                        params, opt_state, net_state, base_rng, it0,
+                        bx_d, by_d)
                     loop_state.iteration += k
                     n_seen += k * batch_size
                 else:
                     step_rng = jax.random.fold_in(base_rng, prev_iter)
+                    t0 += self._maybe_compute_flops(
+                        self._train_step,
+                        (params, opt_state, net_state, step_rng, bx_d, by_d),
+                        batch_size)
                     params, opt_state, net_state, l = self._train_step(
                         params, opt_state, net_state, step_rng, bx_d, by_d)
                     loop_state.iteration += 1
@@ -956,6 +1044,7 @@ class TrainingLoop:
             epoch_loss = (float(jnp.mean(jnp.concatenate(
                 [jnp.atleast_1d(l) for l in losses]))) if losses else float("nan"))
             dt = time.time() - t0
+            self._observe_fit_metrics(n_seen // batch_size, dt, n_seen)
             history["loss"].append(epoch_loss)
             loop_state.epoch_finished = completed
             if hasattr(end_trigger, "record"):

@@ -291,10 +291,10 @@ def _install_pretrained(model: KerasNet) -> KerasNet:
                     raise ValueError(
                         f"{lname}.{k}: torch weight shape {np.shape(v)} vs "
                         f"graph {np.shape(tmpl[k])}")
-            model.params[lname] = {k: jnp.asarray(v) for k, v in w.items()}
+            model.params[lname] = {k: jnp.asarray(v) for k, v in w.items()}  # zoolint: disable=ZL009 one-time load; per-layer shapes differ, nothing to batch
         s = getattr(layer, "_pretrained_state", None)
         if s is not None:
-            model.net_state[lname] = {k: jnp.asarray(v)
+            model.net_state[lname] = {k: jnp.asarray(v)  # zoolint: disable=ZL009 one-time load; per-layer shapes differ
                                       for k, v in s.items()}
     return model
 

@@ -26,6 +26,7 @@ reference's multi-backend ``InferenceModel``
 from __future__ import annotations
 
 import queue
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -33,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.common.zoo_model import load_model
+from ...observability import default_registry
 from ...parallel import mesh as mesh_lib
 from ..api.keras.engine import KerasNet, intercept_layer_calls
 from ...utils.checkpoint import CheckpointManager
@@ -126,11 +128,23 @@ class InferenceModel:
     """
 
     def __init__(self, concurrent_num: int = 1, *,
-                 max_batch_size: int = 4096):
+                 max_batch_size: int = 4096, registry=None):
         if concurrent_num < 1:
             raise ValueError("concurrent_num must be >= 1")
         self.concurrent_num = int(concurrent_num)
         self.max_batch_size = int(max_batch_size)
+        self.metrics = registry if registry is not None else default_registry()
+        self._m_permit_wait = self.metrics.histogram(
+            "zoo_inference_permit_wait_seconds",
+            "wait for a replica permit per predict dispatch")
+        self._m_batch_time = self.metrics.histogram(
+            "zoo_inference_batch_seconds",
+            "predict dispatch to readback completion per batch "
+            "(device time + transfer; overlapped callers hide it)")
+        self._m_batches = self.metrics.counter(
+            "zoo_inference_batches_total", "predict batches collected")
+        self._m_records = self.metrics.counter(
+            "zoo_inference_records_total", "records predicted")
         self.mesh = mesh_lib.global_mesh()
         self._permits: "queue.Queue[int]" = queue.Queue()
         for i in range(self.concurrent_num):
@@ -345,12 +359,16 @@ class InferenceModel:
         cap = max(_next_pow2(self.max_batch_size + 1) // 2, dp)
         cap = min(cap, max(_next_pow2(n), dp))
         if block:
+            t_wait = time.perf_counter()
             permit = self._permits.get()
+            self._m_permit_wait.observe(time.perf_counter() - t_wait)
         else:
             try:
                 permit = self._permits.get_nowait()
             except queue.Empty:
                 return None
+            self._m_permit_wait.observe(0.0)
+        t_dispatch = time.perf_counter()
         deferred = []
         try:
             for i in range(0, n, cap):
@@ -362,7 +380,9 @@ class InferenceModel:
                         [a, np.repeat(a[-1:], padded - m, axis=0)], axis=0)
                         for a in chunk]
                 sharding = mesh_lib.batch_sharding(self.mesh)
-                chunk_d = [jax.device_put(jnp.asarray(a), sharding)
+                # each chunk IS the batched transfer (bounded by
+                # max_batch_size so padded chunks fit the HBM budget)
+                chunk_d = [jax.device_put(jnp.asarray(a), sharding)  # zoolint: disable=ZL009
                            for a in chunk]
                 yp = self._predict(self._params, self._net_state,
                                    chunk_d if len(chunk_d) > 1 else chunk_d[0])
@@ -381,6 +401,9 @@ class InferenceModel:
                 outs = [jax.tree.map(
                     lambda a, mm=m: np.asarray(jax.device_get(a))[:mm], yp)
                     for yp, m in deferred]
+                self._m_batch_time.observe(time.perf_counter() - t_dispatch)
+                self._m_batches.inc()
+                self._m_records.inc(n)
                 return jax.tree.map(
                     lambda *ys: np.concatenate(ys, axis=0), *outs)
             finally:

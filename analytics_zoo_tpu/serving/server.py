@@ -16,10 +16,12 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
+from ..observability import default_registry, span
 from .backend import LocalBackend, default_backend
 from .client import INPUT_STREAM, decode_array, encode_array
 
@@ -29,11 +31,18 @@ __all__ = ["ClusterServing"]
 
 
 class ClusterServing:
-    """Owns the serve loop: xread → batched predict → result writes."""
+    """Owns the serve loop: xread → batched predict → result writes.
+
+    Observability (``docs/guides/OBSERVABILITY.md``): every batch updates
+    the ``zoo_serving_*`` metrics in ``registry`` (default: the
+    process-wide one) — records/batches/error counters, stream-depth
+    gauge, batch-size, queue-wait and dispatch→publish latency histograms
+    — scrapeable via :meth:`serve_metrics`; :meth:`set_json_events`
+    additionally logs one structured JSON event per flush/error."""
 
     def __init__(self, model, backend: Optional[LocalBackend] = None,
                  batch_size: int = 32, stream: str = INPUT_STREAM,
-                 block_ms: int = 50):
+                 block_ms: int = 50, registry=None):
         self.model = model          # InferenceModel (or any .predict(x))
         self.backend = backend if backend is not None else default_backend()
         self.batch_size = int(batch_size)
@@ -41,23 +50,80 @@ class ClusterServing:
         self.block_ms = int(block_ms)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self.served = 0             # records processed (visible for tests/ops)
+        self.served = 0             # this server's records (tests/ops; the
+        #                             registry counters are process-cumulative)
         self._summary = None        # InferenceSummary role (TB scalars)
         self._batches = 0
         self._t_last_flush = None   # throughput-interval anchor
+        self.metrics = registry if registry is not None else default_registry()
+        m = self.metrics
+        self._m_records = m.counter(
+            "zoo_serving_records_total", "records answered with a prediction")
+        self._m_batches = m.counter(
+            "zoo_serving_batches_total", "batches published")
+        self._m_undecodable = m.counter(
+            "zoo_serving_undecodable_total",
+            "records dropped with an undecodable-payload error")
+        self._m_failures = m.counter(
+            "zoo_serving_failures_total",
+            "records answered with an inference-failure error")
+        self._m_depth = m.gauge(
+            "zoo_serving_stream_depth", "input-stream backlog after a read")
+        self._m_batch_size = m.histogram(
+            "zoo_serving_batch_size", "records per published batch")
+        self._m_queue_wait = m.histogram(
+            "zoo_serving_queue_wait_seconds",
+            "enqueue to read-off-the-stream wait per record")
+        self._m_dispatch = m.histogram(
+            "zoo_serving_dispatch_seconds",
+            "dispatch to publish latency per batch")
+        self._events = None         # JsonEventSink (set_json_events)
+        self._scrape = None         # ScrapeServer (serve_metrics)
 
     def set_tensorboard(self, log_dir: str,
                         app_name: str = "serving") -> "ClusterServing":
         """Write per-batch "Serving Throughput" / "Serving Records" scalars
         (the reference's throughput-to-TensorBoard path,
         ``ClusterServing.scala:291-317`` + ``InferenceSummary.scala``).
-        Call before ``start()``."""
+        Call before ``start()`` — swapping the writer under a running
+        serve loop would race ``_flush`` on the closed file handle."""
         import os
         from ..utils.tensorboard import EventFileWriter
+        if self._thread is not None:    # mirrors start()'s double-start guard
+            raise RuntimeError(
+                "serving already started; call set_tensorboard() before "
+                "start() (or after stop())")
         if self._summary is not None:  # redirecting: release the old fd
             self._summary.close()
         self._summary = EventFileWriter(os.path.join(log_dir, app_name))
         return self
+
+    def set_json_events(self, path: str) -> "ClusterServing":
+        """Log one structured JSON event per published batch / error record
+        to ``path`` (JSON lines; see OBSERVABILITY.md). The sink is also
+        attached to this server's registry, so spans emit there too. Call
+        before ``start()``."""
+        from ..observability import JsonEventSink
+        if self._thread is not None:
+            raise RuntimeError(
+                "serving already started; call set_json_events() before "
+                "start() (or after stop())")
+        if self._events is not None:
+            self.metrics.remove_event_sink(self._events)
+            self._events.close()
+        self._events = JsonEventSink(path)
+        self.metrics.add_event_sink(self._events)
+        return self
+
+    def serve_metrics(self, port: int = 0):
+        """Mount a ``/metrics`` Prometheus scrape endpoint over this
+        server's registry; returns the :class:`ScrapeServer` (bound port on
+        ``.port``). Closed automatically by :meth:`stop`."""
+        from ..observability import ScrapeServer
+        if self._scrape is not None:
+            self._scrape.close()
+        self._scrape = ScrapeServer(self.metrics, port=port)
+        return self._scrape
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ClusterServing":
@@ -73,9 +139,9 @@ class ClusterServing:
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop the loop; with ``drain`` first wait for the stream to empty."""
         if self._thread is None:
+            self._close_sinks()
             return
         if drain:
-            import time
             deadline = time.monotonic() + timeout
             while (self.backend.stream_len(self.stream) > 0
                    and time.monotonic() < deadline):
@@ -89,9 +155,19 @@ class ClusterServing:
                 f"serve loop still running after {timeout}s (model dispatch "
                 f"in flight?); call stop() again to re-join")
         self._thread = None
+        self._close_sinks()
+
+    def _close_sinks(self) -> None:
         if self._summary is not None:
             self._summary.close()
             self._summary = None
+        if self._scrape is not None:
+            self._scrape.close()
+            self._scrape = None
+        if self._events is not None:
+            self.metrics.remove_event_sink(self._events)
+            self._events.close()
+            self._events = None
 
     # -- the loop -----------------------------------------------------------
     def _loop(self) -> None:
@@ -111,8 +187,16 @@ class ClusterServing:
                     if pending is not None:
                         pending = self._flush(pending)
                     continue
+                # ONE stream_len per read feeds both the gauge and the
+                # drain checks below — we are the only consumer, so the
+                # backlog can only grow between here and those checks
+                # (a stale 0 errs toward flushing, never toward parking)
+                depth = self.backend.stream_len(self.stream)
+                self._m_depth.set(depth)
+                now_s = time.time()
                 uris, tensors = [], []
-                for _, fields in entries:
+                for eid, fields in entries:
+                    self._observe_queue_wait(eid, now_s)
                     try:
                         # uri first: a decodable payload with a missing
                         # uri must not leave an orphan tensor that would
@@ -126,6 +210,9 @@ class ClusterServing:
                         # full timeout
                         log.exception("undecodable record (uri=%r)",
                                       fields.get("uri"))
+                        self._m_undecodable.inc()
+                        self.metrics.emit("serving.undecodable",
+                                          uri=fields.get("uri"))
                         if fields.get("uri"):
                             self.backend.set_result(
                                 fields["uri"],
@@ -138,8 +225,7 @@ class ClusterServing:
                     # drain signal applies — an empty stream means no next
                     # batch will arrive to trigger the pending readback,
                     # so it would otherwise park for up to block_ms
-                    if pending is not None and \
-                            self.backend.stream_len(self.stream) == 0:
+                    if pending is not None and depth == 0:
                         pending = self._flush(pending)
                     continue
                 try:
@@ -157,8 +243,7 @@ class ClusterServing:
                 nxt, pending = self._dispatch(uris, batch, pending)
                 if pending is not None:
                     pending = self._flush(pending)
-                if nxt is not None and \
-                        self.backend.stream_len(self.stream) == 0:
+                if nxt is not None and depth == 0:
                     # nothing left queued: the stream is drained and there
                     # is no next batch to overlap with, so deferring this
                     # readback would only add up to block_ms of tail
@@ -175,6 +260,15 @@ class ClusterServing:
             if pending is not None:
                 self._flush(pending)
 
+    def _observe_queue_wait(self, entry_id, now_s: float) -> None:
+        """Enqueue→read wait from the stream entry id (both backends stamp
+        ids as ``<epoch_ms>-<seq>``, the Redis-stream convention)."""
+        try:
+            enq_ms = int(str(entry_id).split("-", 1)[0])
+        except (TypeError, ValueError):
+            return    # foreign id scheme: skip, never break the loop
+        self._m_queue_wait.observe(max(now_s - enq_ms / 1000.0, 0.0))
+
     def _dispatch(self, uris, batch, pending=None):
         """Enqueue the device work; ((uris, collect, t0), leftover_pending).
         Tries a NON-blocking async dispatch first: with a single replica
@@ -186,48 +280,78 @@ class ClusterServing:
         so the pending batch is flushed BEFORE the blocking predict and
         this batch publishes immediately (deferring either one would only
         add latency). Returns (None, pending) when the dispatch failed."""
-        import time
         t0 = time.perf_counter()
         try:
+            # spans cover the MODEL calls only — flushing the previous
+            # batch has its own serving.flush span and must not inflate
+            # this batch's dispatch latency; a REFUSED non-blocking probe
+            # is discarded so its ~zero duration doesn't halve the
+            # apparent dispatch time
             async_fn = getattr(self.model, "predict_async", None)
             if async_fn is not None:
-                collect = async_fn(batch, block=False)
+                with span("serving.dispatch", registry=self.metrics,
+                          records=len(uris)) as sp:
+                    collect = async_fn(batch, block=False)
+                    if collect is None:
+                        sp.discard()
                 if collect is None:      # all replica permits in flight
                     if pending is not None:
                         pending = self._flush(pending)
-                    collect = async_fn(batch)
+                    with span("serving.dispatch", registry=self.metrics,
+                              records=len(uris)):
+                        collect = async_fn(batch)
                 return (uris, collect, t0), pending
             if pending is not None:
                 pending = self._flush(pending)
-            preds = self.model.predict(batch)
+            with span("serving.dispatch", registry=self.metrics,
+                      records=len(uris)):
+                preds = self.model.predict(batch)
             self._flush((uris, (lambda: preds), t0))
             return None, pending
         except Exception:
             log.exception("inference dispatch failed for %d records; "
                           "writing errors", len(uris))
-            for uri in uris:
-                self.backend.set_result(uri, {"error": "inference failed"})
+            self._record_failure(uris)
             return None, pending
+
+    def _record_failure(self, uris) -> None:
+        """Registry + event + addressable error records for a failed batch."""
+        self._m_failures.inc(len(uris))
+        self.metrics.emit("serving.failure", records=len(uris))
+        for uri in uris:
+            self.backend.set_result(uri, {"error": "inference failed"})
 
     def _flush(self, pending) -> None:
         """Block on a dispatched batch's readback and publish its results.
-        Returns None so callers can overwrite their pending slot."""
-        import time
+        Returns None so callers can overwrite their pending slot.
+
+        Bookkeeping is registry-backed: counters (records/batches),
+        batch-size and dispatch→publish latency histograms, plus one
+        ``serving.flush`` JSON event when a sink is attached. The
+        TensorBoard scalars derive from the same measurements."""
         uris, collect, t0 = pending
         try:
-            preds = np.asarray(collect())
+            with span("serving.flush", registry=self.metrics,
+                      records=len(uris)):
+                preds = np.asarray(collect())
         except Exception:
             log.exception("inference failed for %d records; writing errors",
                           len(uris))
-            for uri in uris:
-                self.backend.set_result(uri, {"error": "inference failed"})
+            self._record_failure(uris)
             return None
         for i, uri in enumerate(uris):
             self.backend.set_result(uri, {"value": encode_array(preds[i])})
         self.served += len(uris)
         self._batches += 1
+        now = time.perf_counter()
+        latency = max(now - t0, 0.0)
+        self._m_records.inc(len(uris))
+        self._m_batches.inc()
+        self._m_batch_size.observe(len(uris))
+        self._m_dispatch.observe(latency)
+        self.metrics.emit("serving.flush", records=len(uris), batch=self._batches,
+                          latency_s=latency)
         if self._summary is not None:
-            now = time.perf_counter()
             t_prev = self._t_last_flush
             self._t_last_flush = now
             # interval start = the later of (previous flush, this batch's

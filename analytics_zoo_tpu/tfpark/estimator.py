@@ -181,7 +181,7 @@ class TFEstimator:
             if saved.shape != np.shape(v):
                 raise ValueError(f"checkpoint {path} weight {key} shape "
                                  f"{saved.shape} != model {np.shape(v)}")
-            restored.append(jnp.asarray(saved, np.asarray(v).dtype))
+            restored.append(jnp.asarray(saved, np.asarray(v).dtype))  # zoolint: disable=ZL009 one-time checkpoint restore; leaf shapes differ
         model.params = jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(model.params), restored)
         return True
@@ -310,8 +310,10 @@ class TFEstimator:
 
         total, count = 0.0, 0
         for i in range(0, n, bs):
-            xs = [jnp.asarray(a[i:i + bs]) for a in ds.features] + \
-                 [jnp.asarray(a[i:i + bs]) for a in ds.labels]
+            # per-BATCH bulk transfers; the loop blocks on the scalar
+            # loss each batch anyway, so prefetching buys nothing here
+            xs = ([jnp.asarray(a[i:i + bs]) for a in ds.features]  # zoolint: disable=ZL009
+                  + [jnp.asarray(a[i:i + bs]) for a in ds.labels])  # zoolint: disable=ZL009
             k = len(ds.features[0][i:i + bs])
             total += float(batch_loss(m.params, m.net_state or {}, xs)) * k
             count += k

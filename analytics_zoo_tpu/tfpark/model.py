@@ -61,7 +61,7 @@ class KerasModel:
             if np.shape(t) != np.shape(w):
                 raise ValueError(f"weight shape mismatch: model {np.shape(t)}"
                                  f" vs given {np.shape(w)}")
-            leaves.append(jnp.asarray(w, np.asarray(t).dtype))
+            leaves.append(jnp.asarray(w, np.asarray(t).dtype))  # zoolint: disable=ZL009 one-time set_weights; leaf shapes differ
         self.model.params = jax.tree_util.tree_unflatten(treedef, leaves)
 
     def save_weights(self, filepath: str, overwrite: bool = True,
@@ -91,7 +91,7 @@ class KerasModel:
                     restored.append(v)
                     continue
                 raise ValueError(f"{filepath} missing weight {key}")
-            restored.append(jnp.asarray(data[key], np.asarray(v).dtype))
+            restored.append(jnp.asarray(data[key], np.asarray(v).dtype))  # zoolint: disable=ZL009 one-time load_weights; leaf shapes differ
         self.model.params = jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(self.model.params), restored)
 

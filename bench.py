@@ -810,6 +810,14 @@ def main():
         out["serving_resnet50_records_per_sec"] = round(bench_serving(), 1)
     except Exception as e:
         print(f"# serving bench failed: {e!r}", file=sys.stderr)
+    # internal-counter snapshot rides along in every BENCH record: the
+    # zoo_* registry families (serving counters/latencies, inference batch
+    # times, train step times) make the end-to-end numbers diagnosable
+    # round over round (docs/guides/OBSERVABILITY.md)
+    from analytics_zoo_tpu.observability import default_registry
+    if mfu is not None:
+        default_registry().gauge("zoo_train_mfu").set(mfu)
+    out["observability"] = default_registry().snapshot(compact=True)
     print(json.dumps(out))
     print(f"# wall={wall:.2f}s epochs={TIMED_EPOCHS} batch={BATCH} "
           f"scan_steps={SCAN_STEPS} steps/epoch={steps_per_epoch} "

@@ -25,11 +25,14 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def fresh_context():
-    """Reset global context/mesh between tests."""
+    """Reset global context/mesh (and the process-wide metrics registry —
+    cumulative counters must not leak across cases) between tests."""
     from analytics_zoo_tpu.common.context import reset_zoo_context
+    from analytics_zoo_tpu.observability import reset_default_registry
     from analytics_zoo_tpu.pipeline.api.keras.engine import reset_uids
     reset_zoo_context()
     reset_uids()
+    reset_default_registry()
     yield
     reset_zoo_context()
 

@@ -60,7 +60,7 @@ def test_every_keras2_constructor_builds():
         kind = "int" if name == "Embedding" else "float"
         x = (rng.integers(0, 7, (2,) + shape).astype(np.int32) if kind == "int"
              else rng.normal(size=(2,) + shape).astype(np.float32))
-        y, _ = layer.apply(params, state, jax.numpy.asarray(x),
+        y, _ = layer.apply(params, state, jax.numpy.asarray(x),  # zoolint: disable=ZL009 one tiny batch per distinct layer spec
                            training=False, rng=None)
         assert np.isfinite(np.asarray(
             jax.tree_util.tree_leaves(y)[0], np.float32)).all(), name

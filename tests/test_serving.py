@@ -168,6 +168,30 @@ def test_serving_tensorboard_summary(tmp_path):
     assert max(v for _, v, _, _ in recs) == 12
 
 
+def test_set_tensorboard_on_running_server_raises(tmp_path):
+    """Regression: set_tensorboard() after start() swapped/closed the
+    summary writer while the serve loop could be mid-_flush on it — now
+    it raises (mirroring start()'s double-start guard) and works again
+    once the server is stopped."""
+    model = _toy_model()
+    im = InferenceModel().from_keras(model)
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4)
+    serving.set_tensorboard(str(tmp_path), "before")   # pre-start: fine
+    serving.start()
+    try:
+        with pytest.raises(RuntimeError, match="already started"):
+            serving.set_tensorboard(str(tmp_path), "during")
+        with pytest.raises(RuntimeError, match="already started"):
+            serving.set_json_events(str(tmp_path / "ev.jsonl"))
+    finally:
+        serving.stop(drain=False)
+    # stopped: reconfiguring is allowed again
+    serving.set_tensorboard(str(tmp_path), "after")
+    serving.start()
+    serving.stop(drain=False)
+
+
 def test_lifecycle_stop_drains_no_acked_request_lost():
     """Graceful stop during a busy stream: every request acked by enqueue()
     before the stop signal must be answered (the reference's

@@ -33,9 +33,9 @@ def errors(findings):
 # registry / CLI surface
 # ---------------------------------------------------------------------------
 
-def test_at_least_eight_rules_registered():
+def test_at_least_nine_rules_registered():
     rules = all_rules()
-    assert len(rules) >= 8
+    assert len(rules) >= 9
     assert len({r.id for r in rules}) == len(rules)
     for r in rules:
         assert r.id.startswith("ZL") and r.__doc__, r.id
@@ -718,7 +718,7 @@ def test_zl004_suppression():
 
 
 # ---------------------------------------------------------------------------
-# ZL005 — array built in a Python loop (warn-only)
+# ZL005 — array built in a Python loop (error since the ROADMAP triage)
 # ---------------------------------------------------------------------------
 
 ZL005_BAD = """
@@ -744,9 +744,11 @@ def host_accumulate(records):
 """
 
 
-def test_zl005_triggers_and_is_warning():
+def test_zl005_triggers_and_is_error():
+    """Promoted from warning after the package-wide triage (ROADMAP
+    follow-up): remaining legitimate sites carry justified suppressions."""
     fs = lint_source(ZL005_BAD)
-    assert ids(fs, "ZL005") and not errors(fs)
+    assert ids(fs, "ZL005") and errors(fs)
 
 
 def test_zl005_clean():
@@ -1008,7 +1010,7 @@ def test_zl007_raise_in_nested_scope_is_not_a_reraise():
 
 
 # ---------------------------------------------------------------------------
-# ZL008 — missing donation on a rebinding step (warn-only)
+# ZL008 — missing donation on a rebinding step (error since the triage)
 # ---------------------------------------------------------------------------
 
 ZL008_BAD = """
@@ -1034,9 +1036,11 @@ predict_fn = jax.jit(predict)
 """
 
 
-def test_zl008_triggers_and_is_warning():
+def test_zl008_triggers_and_is_error():
+    """Promoted from warning after the package-wide triage (ROADMAP
+    follow-up): donation-is-wrong sites carry justified suppressions."""
     fs = lint_source(ZL008_BAD)
-    assert ids(fs, "ZL008") and not errors(fs)
+    assert ids(fs, "ZL008") and errors(fs)
 
 
 def test_zl008_clean_with_donation_or_no_rebind():
@@ -1048,6 +1052,179 @@ def test_zl008_suppression():
                             "step_fn = jax.jit(step)  "
                             "# zoolint: disable=ZL008")
     assert not ids(lint_source(src), "ZL008")
+
+
+# ---------------------------------------------------------------------------
+# ZL009 — unbatched host→device transfer in a loop
+# ---------------------------------------------------------------------------
+
+ZL009_BAD = """
+import jax
+import jax.numpy as jnp
+def upload_all(rows):
+    out = []
+    for r in rows:
+        out.append(jax.device_put(r))
+    return out
+
+def implicit(rows):
+    total = 0.0
+    for r in rows:
+        total = total + jnp.asarray(r).sum()
+    return total
+"""
+
+ZL009_DERIVED = """
+import jax
+def f(xs, sharding):
+    outs = []
+    for i in range(0, len(xs), 64):
+        row = xs[i]
+        outs.append(jax.device_put(row, sharding))
+    return outs
+"""
+
+ZL009_WHILE = """
+import jax.numpy as jnp
+def drain(q):
+    while True:
+        item = q.get()
+        if item is None:
+            break
+        handle(jnp.asarray(item))
+"""
+
+ZL009_CLEAN = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+def batched(rows, sharding):
+    stacked = np.stack(rows)            # host-side assembly
+    dev = jax.device_put(jnp.asarray(stacked), sharding)   # ONE transfer
+    out = []
+    for name in ("a", "b"):
+        out.append(name)                # host loop, no transfers
+    return dev, out
+
+def invariant(xs, table):
+    dev_table = None
+    for x in xs:
+        if dev_table is None:
+            dev_table = jax.device_put(table)   # loop-invariant value
+        consume(dev_table, x.shape)
+    return dev_table
+"""
+
+
+def test_zl009_triggers_for_and_implicit_asarray():
+    found = ids(lint_source(ZL009_BAD), "ZL009")
+    assert len(found) == 2
+    assert errors(lint_source(ZL009_BAD))
+
+
+def test_zl009_triggers_on_derived_value_and_while_body():
+    assert ids(lint_source(ZL009_DERIVED), "ZL009")
+    assert ids(lint_source(ZL009_WHILE), "ZL009")
+
+
+def test_zl009_walrus_in_while_condition_is_per_iteration():
+    """`while (item := q.get()) is not None:` rebinds item every
+    iteration exactly like an assignment in the body — the idiomatic
+    streaming spelling must not slip the rule."""
+    src = ("import jax.numpy as jnp\n"
+           "def drain(q):\n"
+           "    while (item := q.get()) is not None:\n"
+           "        handle(jnp.asarray(item))\n")
+    assert ids(lint_source(src), "ZL009")
+
+
+def test_zl009_clean_batched_and_loop_invariant():
+    assert not ids(lint_source(ZL009_CLEAN), "ZL009")
+
+
+def test_zl009_suppression():
+    src = ZL009_BAD.replace(
+        "out.append(jax.device_put(r))",
+        "out.append(jax.device_put(r))  # zoolint: disable=ZL009 ragged")
+    assert len(ids(lint_source(src), "ZL009")) == 1   # the other still flags
+
+
+def test_zl009_nested_transfer_flagged_once():
+    """`device_put(jnp.asarray(x), s)` is ONE upload — one finding, on
+    the outer call."""
+    src = ("import jax\n"
+           "import jax.numpy as jnp\n"
+           "def f(xs, s):\n"
+           "    out = []\n"
+           "    for x in xs:\n"
+           "        out.append(jax.device_put(jnp.asarray(x), s))\n"
+           "    return out\n")
+    found = [f for f in lint_source(src) if f.rule_id == "ZL009"]
+    assert len(found) == 1 and "device_put" in found[0].message
+
+
+def test_zl009_import_resolved_not_name_matched():
+    """A local helper named device_put / a non-jax asarray is not a
+    transfer; `np.asarray` in a host loop is host-side and fine."""
+    src = ("import numpy as np\n"
+           "def device_put(x):\n"
+           "    return x\n"
+           "def f(xs):\n"
+           "    out = []\n"
+           "    for x in xs:\n"
+           "        out.append(device_put(np.asarray(x)))\n"
+           "    return out\n")
+    assert not ids(lint_source(src), "ZL009")
+    # from-imported jax form still resolves
+    src = ("from jax import device_put as dp\n"
+           "def f(xs):\n"
+           "    return [v for v in xs]\n"
+           "def g(xs):\n"
+           "    out = []\n"
+           "    for x in xs:\n"
+           "        out.append(dp(x))\n"
+           "    return out\n")
+    assert ids(lint_source(src), "ZL009")
+
+
+def test_zl009_loops_in_traced_bodies_not_flagged():
+    """A loop inside a jitted function (or scan body) unrolls at TRACE
+    time — `jnp.asarray` on a traced value is free, `device_put` of a
+    constant is baked into the program; no per-iteration runtime
+    transfer exists."""
+    src = ("import jax\n"
+           "import jax.numpy as jnp\n"
+           "@jax.jit\n"
+           "def f(xs):\n"
+           "    out = []\n"
+           "    for x in xs:  # zoolint: disable=ZL005 trace-time unroll\n"
+           "        out.append(jnp.asarray(x) * 2)\n"
+           "    return jnp.stack(out)\n")
+    assert not ids(lint_source(src), "ZL009")
+    # the SAME loop outside jit is a real per-element upload
+    src_host = src.replace("@jax.jit\n", "")
+    assert ids(lint_source(src_host), "ZL009")
+    src_scan = ("import jax\n"
+                "import jax.numpy as jnp\n"
+                "def outer(xs):\n"
+                "    def body(c, x):\n"
+                "        for k in range(3):\n"
+                "            c = c + jnp.asarray(k)\n"
+                "        return c, x\n"
+                "    return jax.lax.scan(body, 0.0, xs)\n")
+    assert not ids(lint_source(src_scan), "ZL009")
+
+
+def test_zl009_nested_function_in_loop_body_not_attributed():
+    """A transfer inside a def/lambda defined in the loop body runs in its
+    own scope (maybe never, maybe batched later) — not flagged here."""
+    src = ("import jax\n"
+           "def f(xs):\n"
+           "    fns = []\n"
+           "    for x in xs:\n"
+           "        fns.append(lambda x=x: jax.device_put(x))\n"
+           "    return fns\n")
+    assert not ids(lint_source(src), "ZL009")
 
 
 # ---------------------------------------------------------------------------

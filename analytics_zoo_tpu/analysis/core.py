@@ -264,6 +264,16 @@ class ModuleContext:
                         bare.add(a.asname or a.name)
                     else:   # e.g. `from jax.experimental import pjit`
                         prefixes.add(a.asname or a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module and (
+                    node.module in ("observability", "observability.compile")
+                    or node.module.endswith(".observability")
+                    or node.module.endswith(".observability.compile")):
+                # the in-repo jit wrapper stages its argument exactly like
+                # jax.jit (observability/compile.py) — functions handed to
+                # it must stay covered by the under-jit rules
+                for a in node.names:
+                    if a.name == "instrument_jit":
+                        bare.add(a.asname or a.name)
         self._jit_names_cache = (prefixes, bare)
         return self._jit_names_cache
 

@@ -204,17 +204,21 @@ class Seq2seq(ZooModel):
         """Jitted encode/decode-step closures, built once per model instance
         (re-jitting per ``infer`` call would recompile for every request)."""
         if getattr(self, "_cached_infer_fns", None) is None:
+            from ...observability import instrument_jit
             net: _Seq2seqNet = self.model
 
-            @jax.jit
             def enc_fn(p, e):
                 return net.apply_bridge(p, net.encode(p, e))
 
-            @jax.jit
             def step_fn(p, c, carries):
                 return net.decode(p, c, carries)
 
-            self._cached_infer_fns = (enc_fn, step_fn)
+            # compile accounting: a new encoder input length or batch size
+            # is a legitimate compile; a retrace storm under steady load
+            # means callers are feeding unpadded dynamic shapes
+            self._cached_infer_fns = (
+                instrument_jit(enc_fn, name="seq2seq.encode"),
+                instrument_jit(step_fn, name="seq2seq.decode_step"))
         return self._cached_infer_fns
 
     def get_config(self) -> Dict[str, Any]:

@@ -1,23 +1,31 @@
 """Unified metrics + tracing for the analytics_zoo_tpu stack.
 
 One process-wide :class:`MetricsRegistry` (counters, gauges, log-bucketed
-histograms — cheap enough for the serving hot path) plus span-based
-tracing, with three export sinks:
+histograms, quantile summaries — cheap enough for the serving hot path)
+plus span-based tracing, per-request trace ids, and jit compile
+accounting, with three export sinks:
 
 * Prometheus text exposition — ``render_prometheus()`` / the
-  :class:`ScrapeServer` endpoint ``ClusterServing.serve_metrics()`` mounts,
+  :class:`ScrapeServer` endpoint ``ClusterServing.serve_metrics()``
+  mounts (``/metrics`` plus ``/healthz`` and ``/statusz``),
 * structured JSON event records — :class:`JsonEventSink` (one JSON object
-  per line; spans, per-batch serving events, error records),
+  per line; spans, per-batch serving events, per-request phase events,
+  jit compile/retrace events, error records),
 * TensorBoard event files — :class:`TensorBoardSink` over the existing
   ``utils.tensorboard.EventFileWriter`` (the reference's only channel
   keeps working unchanged).
 
 Instrumented layers: ``serving/server.py`` (stream depth, batch size,
-queue-wait and dispatch latency, error counters), ``pipeline/inference/
-inference_model.py`` (replica-permit wait, per-batch device time), and
-``pipeline/api/keras/training.py`` ``fit`` (step-time histogram,
-records/sec, achieved MFU). ``bench.py`` snapshots the registry into each
-BENCH record. Catalog + conventions: ``docs/guides/OBSERVABILITY.md``.
+queue-wait/dispatch/e2e latency histograms + p50/p95/p99 summaries,
+error + clock-skew counters, per-request enqueue→dequeue→dispatch→publish
+trace events), ``pipeline/inference/inference_model.py`` (replica-permit
+wait, per-batch device time), and ``pipeline/api/keras/training.py``
+``fit``/``evaluate``/``predict`` (weighted step-time histograms,
+records/sec, achieved MFU). Every hot-path jit entry point is staged
+through :func:`instrument_jit`, which counts compilations and emits
+``jit.retrace`` events on recompiles under new signatures. ``bench.py``
+snapshots the registry into each BENCH record. Catalog + conventions:
+``docs/guides/OBSERVABILITY.md``.
 
 >>> from analytics_zoo_tpu import observability as obs
 >>> with obs.span("my.phase"):
@@ -26,15 +34,17 @@ BENCH record. Catalog + conventions: ``docs/guides/OBSERVABILITY.md``.
 """
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
-                      default_registry, reset_default_registry)
-from .tracing import current_span, span
+                      QuantileDigest, Summary, default_registry,
+                      reset_default_registry)
+from .tracing import current_span, new_trace_id, span
+from .compile import instrument_jit
 from .export import (JsonEventSink, ScrapeServer, TensorBoardSink, dump,
                      parse_prometheus, read_events, render_prometheus)
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "default_registry", "reset_default_registry",
-    "span", "current_span",
+    "Counter", "Gauge", "Histogram", "QuantileDigest", "Summary",
+    "MetricsRegistry", "default_registry", "reset_default_registry",
+    "span", "current_span", "new_trace_id", "instrument_jit",
     "JsonEventSink", "ScrapeServer", "TensorBoardSink",
     "dump", "parse_prometheus", "read_events", "render_prometheus",
 ]

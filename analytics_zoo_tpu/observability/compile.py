@@ -1,0 +1,169 @@
+"""Compilation observability — the answer to "why did this step suddenly
+take 40x longer?" (a silent retrace).
+
+:func:`instrument_jit` is a drop-in ``jax.jit`` replacement used by every
+hot-path entry point (the four training dispatch paths, the eval/predict
+steps, ``InferenceModel``'s serving predict, ``Seq2seq.infer``'s
+encode/decode closures). On every call it derives the ABSTRACT signature
+of the arguments (pytree structure + per-leaf shape/dtype — the same
+identity ``jax.jit`` keys its executable cache on, minus shardings) and,
+when the signature is new:
+
+* counts the compilation in ``zoo_jit_compile_total`` (process-wide) and
+  times it into ``zoo_jit_compile_seconds{fn=...}`` — the wall time of
+  the first dispatch, which trace+compile dominate,
+* emits a ``jit.compile`` event, and — when the function had already
+  compiled under a DIFFERENT signature — a ``jit.retrace`` event plus a
+  ``zoo_jit_retrace_total{fn=...}`` increment. A retrace under load is
+  almost always a shape-discipline bug (unpadded dynamic batch, a new
+  sequence length); the event names the function so the operator can go
+  straight to the offending caller.
+
+Steady-state cost is two executable-cache size reads per call (~tens of
+nanoseconds; the signature is only derived on the rare call that actually
+compiled). On jax builds without ``_cache_size`` it degrades to one
+pytree flatten per call — the same order of work ``jax.jit``'s own cache
+lookup does. Retrace classification is deliberately sharding-blind: a
+recompile triggered purely by a resharded input counts as a compile but
+never as a retrace, so the retrace signal stays a pure shape-discipline
+alarm.
+
+``jax`` is imported lazily so the observability package stays importable
+(and the scrape/status CLI stays fast) in jax-free processes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional, Tuple
+
+from .metrics import MetricsRegistry, default_registry
+
+__all__ = ["instrument_jit", "InstrumentedJit"]
+
+_HASHABLE = (int, float, bool, str, bytes, type(None))
+
+
+class InstrumentedJit:
+    """A jitted callable with compile/retrace accounting. Behaves like the
+    underlying ``jax.jit`` result — extra attributes (``lower``,
+    ``clear_cache``, ...) forward to it, so AOT cost-analysis callers
+    (``TrainingLoop._maybe_compute_flops``) work unchanged."""
+
+    def __init__(self, fn, *, name: str,
+                 registry: Optional[MetricsRegistry] = None, **jit_kwargs):
+        import jax
+        self._jitted = jax.jit(fn, **jit_kwargs)
+        self._name = name
+        # None = resolve default_registry() per compile event, so a test's
+        # reset_default_registry() is honored (compiles are rare; the
+        # lookup never lands on the steady-state path)
+        self._registry = registry
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _signature(args, kwargs) -> Tuple[Any, ...]:
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        sig: list = [treedef]
+        for leaf in leaves:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is not None and dtype is not None:
+                # metadata only — safe on buffers the call just donated
+                sig.append((tuple(shape), str(dtype)))
+            elif isinstance(leaf, (int, float, bool)):
+                # jax traces Python numbers by dtype, not value — keying
+                # by value would report a phantom retrace per distinct
+                # value (and grow the seen-set without bound)
+                sig.append((type(leaf).__name__,))
+            elif isinstance(leaf, _HASHABLE):
+                # str/bytes/None only pass jit as static args, where the
+                # VALUE does key the executable cache
+                sig.append((type(leaf).__name__, leaf))
+            else:
+                sig.append((type(leaf).__name__,))
+        return tuple(sig)
+
+    def _registry_now(self) -> MetricsRegistry:
+        return (self._registry if self._registry is not None
+                else default_registry())
+
+    def __call__(self, *args, **kwargs):
+        cache_size = getattr(self._jitted, "_cache_size", None)
+        if cache_size is not None:
+            # fast path: one executable-cache size read (~tens of ns)
+            # before and after — the signature is only derived on the
+            # rare call that actually compiled, so the steady state pays
+            # no pytree flatten at all
+            before = cache_size()
+            t0 = time.perf_counter()
+            out = self._jitted(*args, **kwargs)
+            if cache_size() == before:
+                return out
+            dur = time.perf_counter() - t0
+            self._record_compile(self._signature(args, kwargs), dur)
+            return out
+        # fallback (no _cache_size on this jax): signature-per-call —
+        # a sharding-only recompile is invisible here, matching the
+        # documented sharding-blind contract
+        sig = self._signature(args, kwargs)
+        with self._lock:
+            known = sig in self._seen
+        if known:
+            return self._jitted(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        self._record_compile(sig, time.perf_counter() - t0)
+        return out
+
+    def _record_compile(self, sig, dur: float) -> None:
+        with self._lock:
+            fresh = sig not in self._seen
+            if fresh:
+                self._seen.add(sig)
+            n_sigs = len(self._seen)
+        reg = self._registry_now()
+        reg.counter(
+            "zoo_jit_compile_total",
+            "XLA compilations across all instrumented entry points").inc()
+        reg.histogram(
+            "zoo_jit_compile_seconds",
+            "first-dispatch wall time per compilation "
+            "(trace+compile dominated)",
+            labels={"fn": self._name}).observe(dur)
+        reg.emit("jit.compile", fn=self._name, dur_s=dur, n_signatures=n_sigs)
+        # retrace = a compile under a NEW abstract signature after the
+        # first; a compile with a KNOWN signature (resharded inputs, a
+        # concurrent first call racing this one) counts above but is not
+        # a retrace — never report a phantom shape-discipline bug
+        if fresh and n_sigs > 1:
+            reg.counter(
+                "zoo_jit_retrace_total",
+                "recompilations of an already-compiled function under a "
+                "new abstract signature",
+                labels={"fn": self._name}).inc()
+            reg.emit("jit.retrace", fn=self._name, dur_s=dur,
+                     n_signatures=n_sigs)
+
+    def __getattr__(self, attr):
+        if attr == "_jitted":
+            # only reachable when __init__ hasn't populated the instance
+            # dict (e.g. unpickling); forwarding would infinitely recurse
+            raise AttributeError(attr)
+        return getattr(self._jitted, attr)
+
+    def __repr__(self):
+        return f"InstrumentedJit({self._name!r}, {self._jitted!r})"
+
+
+def instrument_jit(fn, *, name: str,
+                   registry: Optional[MetricsRegistry] = None,
+                   **jit_kwargs) -> InstrumentedJit:
+    """``jax.jit(fn, **jit_kwargs)`` with compile observability. ``name``
+    labels the ``zoo_jit_compile_seconds``/``zoo_jit_retrace_total``
+    series and the ``jit.compile``/``jit.retrace`` events; keep it a
+    stable dotted identifier (``train.step``, ``inference.predict``)."""
+    return InstrumentedJit(fn, name=name, registry=registry, **jit_kwargs)

@@ -27,9 +27,10 @@ import math
 import os
 import re
 import threading
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Callable, Dict, List, Optional
 
-from .metrics import Histogram, MetricsRegistry, default_registry
+from .metrics import Histogram, MetricsRegistry, Summary, default_registry
 
 __all__ = ["render_prometheus", "parse_prometheus", "dump",
            "JsonEventSink", "read_events", "ScrapeServer", "TensorBoardSink"]
@@ -76,6 +77,7 @@ def _fmt(v: float) -> str:
 def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
     """The registry as Prometheus text exposition format (one ``# TYPE``
     per family; histograms as cumulative ``_bucket{le=...}`` + ``_sum`` /
+    ``_count``; summaries as ``{quantile=...}`` series + ``_sum`` /
     ``_count``)."""
     reg = registry if registry is not None else default_registry()
     lines: List[str] = []
@@ -95,6 +97,16 @@ def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
                 lines.append(f"{name}_bucket"
                              f"{_labels_text(m.labels, {'le': _fmt(le)})}"
                              f" {c}")
+            lines.append(f"{name}_sum{_labels_text(m.labels)} {_fmt(total)}")
+            lines.append(f"{name}_count{_labels_text(m.labels)} {count}")
+        elif isinstance(m, Summary):
+            # one locked pass per summary: p99 >= p50 must hold in every
+            # scrape even while producers observe concurrently
+            qs, count, total = m.stats()
+            for q, v in qs:
+                lines.append(f"{name}"
+                             f"{_labels_text(m.labels, {'quantile': repr(q)})}"
+                             f" {_fmt(v)}")
             lines.append(f"{name}_sum{_labels_text(m.labels)} {_fmt(total)}")
             lines.append(f"{name}_count{_labels_text(m.labels)} {count}")
         else:
@@ -149,7 +161,8 @@ def parse_prometheus(text: str) -> Dict[str, Dict[str, Any]]:
         family = name
         for suffix in ("_bucket", "_sum", "_count"):
             base = name[:-len(suffix)] if name.endswith(suffix) else None
-            if base and base in out and out[base]["type"] == "histogram":
+            if base and base in out and out[base]["type"] in ("histogram",
+                                                              "summary"):
                 family = base
                 break
         if family not in out:
@@ -222,35 +235,99 @@ def read_events(path: str, kind: Optional[str] = None) -> List[Dict[str, Any]]:
 # scrape endpoint
 # ---------------------------------------------------------------------------
 
+def _registry_value(reg: MetricsRegistry, name: str) -> float:
+    """Sum of a counter/gauge family's values across its label series —
+    the cheap way /statusz reads totals without a full exposition pass."""
+    total = 0.0
+    for m in reg.metrics():
+        if m.name == name and not isinstance(m, (Histogram, Summary)):
+            total += m.value
+    return total
+
+
 class _ScrapeHandler(http.server.BaseHTTPRequestHandler):
     registry: MetricsRegistry = None  # type: ignore[assignment]
+    health_fn: Optional[Callable[[], Dict[str, Any]]] = None
+    started_at: float = 0.0
 
-    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
-        if self.path.split("?", 1)[0] not in ("/", "/metrics"):
-            self.send_error(404)
-            return
-        body = render_prometheus(self.registry).encode("utf-8")
-        self.send_response(200)
-        self.send_header("Content-Type",
-                         "text/plain; version=0.0.4; charset=utf-8")
+    def _send(self, body: bytes, content_type: str, code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _health_payload(self) -> Dict[str, Any]:
+        info: Dict[str, Any] = {"status": "ok",
+                                "uptime_s": time.time() - self.started_at}
+        fn = type(self).health_fn
+        if fn is not None:
+            try:
+                info.update(fn())
+            except Exception as e:     # a dead backend must not 500 /healthz
+                info["status"] = "degraded"
+                info["error"] = f"{type(e).__name__}: {e}"
+        return info
+
+    def _status_payload(self) -> Dict[str, Any]:
+        info = self._health_payload()
+        reg = self.registry
+        info["jit"] = {
+            "compile_total": _registry_value(reg, "zoo_jit_compile_total"),
+            "retrace_total": _registry_value(reg, "zoo_jit_retrace_total"),
+        }
+        try:
+            import jax
+            info["device"] = {"platform": jax.default_backend(),
+                              "device_count": jax.device_count()}
+        except Exception as e:          # jax-free process: still report
+            info["device"] = {"platform": "unavailable",
+                              "error": f"{type(e).__name__}: {e}"}
+        return info
+
+    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+        path = self.path.split("?", 1)[0]
+        if path in ("/", "/metrics"):
+            self._send(render_prometheus(self.registry).encode("utf-8"),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            self._send(json.dumps(self._health_payload()).encode("utf-8"),
+                       "application/json")
+        elif path == "/statusz":
+            self._send(json.dumps(self._status_payload(), indent=2,
+                                  default=str).encode("utf-8"),
+                       "application/json")
+        else:
+            self.send_error(404)
 
     def log_message(self, *args):  # scrapes must not spam stderr
         pass
 
 
 class ScrapeServer:
-    """A tiny ``/metrics`` HTTP endpoint over one registry — what a
-    Prometheus scraper (or ``curl``) reads. ``port=0`` picks a free port;
-    the bound one is on ``self.port``."""
+    """A tiny HTTP endpoint over one registry: ``/metrics`` (Prometheus
+    text exposition), ``/healthz`` (liveness: status + uptime + whatever
+    ``health_fn`` reports), and ``/statusz`` (the operator page: health
+    plus jit-compile totals and device/platform info). ``port=0`` picks a
+    free port; the bound one is on ``self.port``.
+
+    ``health_fn`` is an optional zero-arg callable returning a JSON-able
+    dict merged into both payloads — ``ClusterServing.serve_metrics``
+    passes its serve-loop introspection (stream depth, last-flush age)
+    this way. It runs on the scrape thread, so it must be cheap and must
+    not take locks the serve loop holds across dispatches."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 port: int = 0, host: str = "127.0.0.1"):
-        handler = type("Handler", (_ScrapeHandler,),
-                       {"registry": registry if registry is not None
-                        else default_registry()})
+                 port: int = 0, host: str = "127.0.0.1",
+                 health_fn: Optional[Callable[[], Dict[str, Any]]] = None):
+        attrs: Dict[str, Any] = {
+            "registry": registry if registry is not None
+            else default_registry(),
+            "started_at": time.time(),
+        }
+        if health_fn is not None:
+            attrs["health_fn"] = staticmethod(health_fn)
+        handler = type("Handler", (_ScrapeHandler,), attrs)
         self._httpd = http.server.ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -296,6 +373,14 @@ class TensorBoardSink:
                 if count:
                     self.writer.add_scalar(tag + "_mean",
                                            float(total / count), step)
+            elif isinstance(m, Summary):
+                qs, count, total = m.stats()
+                self.writer.add_scalar(tag + "_count", float(count), step)
+                self.writer.add_scalar(tag + "_sum", float(total), step)
+                for q, v in qs:
+                    if v == v:     # empty digests yield NaN — skip those
+                        self.writer.add_scalar(
+                            tag + f"_p{int(round(q * 100))}", float(v), step)
             else:
                 self.writer.add_scalar(tag, float(m.value), step)
         self.writer.flush()

@@ -7,7 +7,10 @@ doing. Three primitives, Prometheus-shaped:
 
 * :class:`Counter` — monotonically increasing (records served, failures),
 * :class:`Gauge`   — last-write-wins level (stream depth, records/sec),
-* :class:`Histogram` — log-bucketed distribution (latencies, batch sizes).
+* :class:`Histogram` — log-bucketed distribution (latencies, batch sizes),
+* :class:`Summary` — accurate p50/p95/p99 from a mergeable fixed-budget
+  quantile digest (per-request latencies, where the histogram's ~26%
+  octave resolution is too coarse for an SLO).
 
 Design constraints, in order:
 
@@ -38,8 +41,8 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 log = logging.getLogger("analytics_zoo_tpu.observability")
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "default_registry", "reset_default_registry"]
+__all__ = ["Counter", "Gauge", "Histogram", "QuantileDigest", "Summary",
+           "MetricsRegistry", "default_registry", "reset_default_registry"]
 
 LabelsT = Tuple[Tuple[str, str], ...]
 
@@ -181,7 +184,163 @@ class Histogram(_Metric):
         return self.stats()[0]
 
 
-_METRIC_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+class QuantileDigest:
+    """Mergeable fixed-budget quantile sketch (merging t-digest style).
+
+    Centroids are ``(mean, weight)`` pairs; incoming observations buffer
+    and are folded in by a size-bounded merge pass whose per-centroid
+    weight limit scales with ``q * (1 - q)`` — tails keep near-singleton
+    centroids (accurate p99), the middle compresses aggressively. The
+    whole structure stays ~``budget`` centroids regardless of how many
+    observations it has absorbed, and two digests :meth:`merge` into one
+    with the same bound — the property that lets per-replica digests roll
+    up into a fleet-wide percentile without storing raw samples.
+
+    NOT thread-safe on its own; :class:`Summary` wraps it under the
+    metric lock. An ``observe`` between compressions is one list append.
+    """
+
+    __slots__ = ("budget", "_centroids", "_buf", "_count", "_sum")
+
+    def __init__(self, budget: int = 128):
+        if budget < 8:
+            raise ValueError(f"digest budget too small ({budget}); "
+                             "quantiles would be meaningless")
+        self.budget = int(budget)
+        self._centroids: List[Tuple[float, float]] = []   # sorted by mean
+        self._buf: List[Tuple[float, float]] = []
+        self._count = 0
+        self._sum = 0.0
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def add(self, v: float, n: int = 1) -> None:
+        v = float(v)
+        if v != v:                       # NaN would poison every centroid
+            return
+        self._buf.append((v, float(n)))
+        self._count += n
+        self._sum += v * n
+        if len(self._buf) >= self.budget:
+            self._compress()
+
+    def merge(self, other: "QuantileDigest") -> None:
+        """Fold ``other``'s mass into this digest (other is unchanged)."""
+        self._buf.extend(other._centroids)
+        self._buf.extend(other._buf)
+        self._count += other._count
+        self._sum += other._sum
+        self._compress()
+
+    def _compress(self) -> None:
+        pts = sorted(self._centroids + self._buf)
+        self._buf = []
+        if not pts:
+            return
+        total = sum(w for _, w in pts)
+        out: List[Tuple[float, float]] = []
+        cur_mean, cur_w = pts[0]
+        cum = 0.0                        # weight fully merged before `cur`
+        for mean, w in pts[1:]:
+            q = (cum + cur_w + w / 2.0) / total
+            # t-digest k1-style bound: centroid capacity peaks at the
+            # median, pinches to ~1 at the tails
+            limit = max(4.0 * total * q * (1.0 - q) / self.budget, 1.0)
+            if cur_w + w <= limit:
+                cur_mean += (mean - cur_mean) * (w / (cur_w + w))
+                cur_w += w
+            else:
+                out.append((cur_mean, cur_w))
+                cum += cur_w
+                cur_mean, cur_w = mean, w
+        out.append((cur_mean, cur_w))
+        self._centroids = out
+
+    def quantile(self, q: float) -> float:
+        """Estimated value at quantile ``q`` in [0, 1]; NaN when empty.
+        Monotone in ``q`` (centroid means are sorted), so p99 >= p50 by
+        construction."""
+        if self._buf:
+            self._compress()
+        cs = self._centroids
+        if not cs:
+            return float("nan")
+        q = min(max(float(q), 0.0), 1.0)
+        target = q * self._count
+        cum = 0.0
+        prev_mid: Optional[float] = None
+        prev_mean = cs[0][0]
+        for mean, w in cs:
+            mid = cum + w / 2.0
+            if target < mid:
+                if prev_mid is None or mid == prev_mid:
+                    return mean
+                frac = (target - prev_mid) / (mid - prev_mid)
+                return prev_mean + frac * (mean - prev_mean)
+            prev_mid, prev_mean = mid, mean
+            cum += w
+        return cs[-1][0]
+
+
+class Summary(_Metric):
+    """Prometheus summary: accurate client-side quantiles over a
+    :class:`QuantileDigest`, exposed as ``name{quantile="0.5"}`` series
+    plus ``_sum``/``_count``. Complements :class:`Histogram` (which keeps
+    the full shape but only ~26% relative resolution): the summary
+    answers "what IS p99" exactly enough to hold an SLO against."""
+
+    kind = "summary"
+
+    DEFAULT_QUANTILES = (0.5, 0.95, 0.99)
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None,
+                 quantiles: Iterable[float] = DEFAULT_QUANTILES,
+                 budget: int = 128):
+        super().__init__(name, help, labels)
+        self.quantiles: Tuple[float, ...] = tuple(sorted(quantiles))
+        self._digest = QuantileDigest(budget)
+
+    def observe(self, v: float, n: int = 1) -> None:
+        with self._lock:
+            self._digest.add(v, n)
+
+    @property
+    def count(self) -> int:
+        return self._digest.count
+
+    @property
+    def sum(self) -> float:
+        return self._digest.sum
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            return self._digest.quantile(q)
+
+    def merge_from(self, other: "Summary") -> None:
+        """Absorb another summary's digest (fleet roll-up)."""
+        with other._lock:
+            snap = QuantileDigest(other._digest.budget)
+            snap.merge(other._digest)
+        with self._lock:
+            self._digest.merge(snap)
+
+    def stats(self) -> Tuple[List[Tuple[float, float]], int, float]:
+        """``([(q, value), ...], count, sum)`` from ONE locked pass, so a
+        concurrent ``observe`` can never yield a scrape where p99 < p50."""
+        with self._lock:
+            return ([(q, self._digest.quantile(q)) for q in self.quantiles],
+                    self._digest.count, self._digest.sum)
+
+
+_METRIC_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram,
+                 "summary": Summary}
 
 
 class MetricsRegistry:
@@ -223,6 +382,13 @@ class MetricsRegistry:
                   labels: Optional[Dict[str, str]] = None) -> Histogram:
         return self._get("histogram", name, help, labels)
 
+    def summary(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Summary:
+        """Quantile summary (p50/p95/p99 by default). Quantile set and
+        digest budget are fixed at first creation — the family must
+        expose one consistent series set."""
+        return self._get("summary", name, help, labels)
+
     def metrics(self) -> List[_Metric]:
         """All metrics, sorted by (name, labels) — the exposition order."""
         with self._lock:
@@ -247,6 +413,15 @@ class MetricsRegistry:
                 else:
                     entry["buckets"] = [[le, c] for le, c in buckets]
                 out[key] = entry
+            elif isinstance(m, Summary):
+                # quantiles survive BOTH forms — the compact snapshot is
+                # what bench.py embeds, and p50/p95/p99 are its point.
+                # NaNs (empty digest) are dropped: json.dumps would emit
+                # bare `NaN`, which strict JSON parsers reject
+                qs, count, total = m.stats()
+                out[key] = {"type": m.kind, "count": count, "sum": total,
+                            "quantiles": {repr(q): v for q, v in qs
+                                          if v == v}}
             else:
                 out[key] = {"type": m.kind, "value": m.value}
         return out

@@ -8,15 +8,21 @@ separate trace-file format. Nesting is tracked per thread; a span opened
 on one thread never becomes the parent of a span on another (the serving
 loop, producers, and the training loop each own their stack).
 
-This deliberately is NOT a distributed tracer: no ids, no sampling, no
-context propagation across processes. It is the "which phase of the
-request spent the time" layer the reference's scoped ``timeIt`` timers
-approximated, feeding the same registry everything else reports to.
+Spans are phase-level ("which phase of the request spent the time" — the
+reference's scoped ``timeIt`` role): per-thread nesting, no sampling, no
+cross-process context. REQUEST-level tracing is the thin Dapper-style
+layer on top: :func:`new_trace_id` mints the 64-bit hex id the serving
+client stamps on each enqueued record, and the serve loop emits
+parent-linked per-request phase events (enqueue → dequeue → dispatch →
+publish) carrying that id into the JSON event log — the id is the join
+key, the log is the trace store, and there is still no in-band context
+to thread through the hot path.
 """
 
 from __future__ import annotations
 
 import contextlib
+import secrets
 import threading
 import time
 import weakref
@@ -24,7 +30,16 @@ from typing import Dict, Iterator, Optional
 
 from .metrics import Histogram, MetricsRegistry, default_registry
 
-__all__ = ["span", "current_span", "SpanHandle"]
+__all__ = ["span", "current_span", "SpanHandle", "new_trace_id"]
+
+
+def new_trace_id() -> str:
+    """A fresh Dapper-style trace id: 16 lowercase hex chars (64 random
+    bits — collision-free at any realistic request volume). This exact
+    format is the serving wire contract (docs/guides/SERVING.md): the
+    client stamps it into the stream record's ``trace`` field and every
+    per-request event carries it verbatim."""
+    return secrets.token_hex(8)
 
 _state = threading.local()
 

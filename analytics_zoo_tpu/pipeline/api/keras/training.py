@@ -35,7 +35,7 @@ from ....common.context import get_zoo_context
 from ....common.triggers import (EveryEpoch, MaxEpoch, SeveralIteration,
                                  TrainLoopState, Trigger)
 from ....feature.feature_set import FeatureSet, prefetch_to_device
-from ....observability import default_registry, span
+from ....observability import default_registry, instrument_jit, span
 from ....parallel import mesh as mesh_lib
 from ....utils.checkpoint import CheckpointManager
 from . import metrics as metrics_lib
@@ -278,6 +278,19 @@ class TrainingLoop:
             "zoo_train_steps_total", "optimizer steps run")
         self._m_examples = self._registry.counter(
             "zoo_train_examples_total", "training examples consumed")
+        # evaluate/predict get the same treatment fit got (ROADMAP
+        # eval/predict instrumentation pass): weighted step-time
+        # histograms + record counters, spans around the whole pass
+        self._m_eval_step_time = self._registry.histogram(
+            "zoo_eval_step_seconds",
+            "evaluate step wall time (amortized over the streamed batches)")
+        self._m_eval_records = self._registry.counter(
+            "zoo_eval_examples_total", "examples evaluated (pad rows excluded)")
+        self._m_predict_step_time = self._registry.histogram(
+            "zoo_predict_step_seconds",
+            "predict step wall time (amortized over the streamed batches)")
+        self._m_predict_records = self._registry.counter(
+            "zoo_predict_examples_total", "examples predicted")
         self._flops_per_example: Optional[float] = None
 
     # -- jitted steps -------------------------------------------------------
@@ -296,7 +309,12 @@ class TrainingLoop:
             params = optax.apply_updates(params, updates)
             return params, opt_state, ns, l
 
-        self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
+        # instrument_jit == jax.jit + compile accounting: every first
+        # compile lands in zoo_jit_compile_*, every recompile under a new
+        # batch shape emits a jit.retrace event naming the path
+        self._train_step = instrument_jit(step, name="train.step",
+                                          registry=self._registry,
+                                          donate_argnums=(0, 1, 2))
         return self._train_step
 
     def _make_scan_body(self, base_rng):
@@ -341,7 +359,9 @@ class TrainingLoop:
                 (params, opt_state, net_state, iter0), (xs, ys))
             return params, opt_state, net_state, losses
 
-        self._scan_step = jax.jit(chunk, donate_argnums=(0, 1, 2))
+        self._scan_step = instrument_jit(chunk, name="train.scan_chunk",
+                                         registry=self._registry,
+                                         donate_argnums=(0, 1, 2))
         return self._scan_step
 
     def _shard_opt_state(self, opt_state, psh, repl):
@@ -420,7 +440,9 @@ class TrainingLoop:
                 xs, ys)
             return params, opt_state, net_state, losses
 
-        fn = jax.jit(epoch, donate_argnums=(0, 1, 2))
+        fn = instrument_jit(epoch, name="train.epoch",
+                            registry=self._registry,
+                            donate_argnums=(0, 1, 2))
         self._epoch_fns[key] = fn
         return fn
 
@@ -473,7 +495,9 @@ class TrainingLoop:
                 shuffle_rngs)
             return params, opt_state, net_state, L  # (n_epochs, n_steps)
 
-        fn = jax.jit(multi, donate_argnums=(0, 1, 2))
+        fn = instrument_jit(multi, name="train.multi_epoch",
+                            registry=self._registry,
+                            donate_argnums=(0, 1, 2))
         self._epoch_fns[key] = fn
         return fn
 
@@ -511,7 +535,8 @@ class TrainingLoop:
                                  "count": jnp.sum(mask)}
             return stats
 
-        self._eval_step = jax.jit(step)
+        self._eval_step = instrument_jit(step, name="train.eval_step",
+                                         registry=self._registry)
         return self._eval_step
 
     def build_predict_step(self):
@@ -530,7 +555,8 @@ class TrainingLoop:
                     lambda a: jax.lax.with_sharding_constraint(a, repl), yp)
             return yp
 
-        self._predict_step = jax.jit(step)
+        self._predict_step = instrument_jit(step, name="train.predict_step",
+                                            registry=self._registry)
         return self._predict_step
 
     # -- observability ------------------------------------------------------
@@ -1160,17 +1186,29 @@ class TrainingLoop:
         eff_bs = _round_up(max(batch_size, dp), dp)
         # stream through the same prefetch pipeline as training; keep the
         # running totals on device so no step blocks on a host sync
-        stream = prefetch_to_device(
-            self._padded_batches(x, y, eff_bs, dp, with_mask=True), self.mesh)
-        for bx_d, by_d, mask_d in stream:
-            stats = self._eval_step(model.params, model.net_state, bx_d, by_d,
-                                    mask_d)
-            totals = stats if totals is None else jax.tree.map(
-                lambda a, b: a + b, totals, stats)
-        out = {}
-        if totals is None:
-            return out
-        totals = jax.device_get(totals)
+        steps = 0
+        with span("train.evaluate", registry=self._registry):
+            t0 = time.perf_counter()
+            stream = prefetch_to_device(
+                self._padded_batches(x, y, eff_bs, dp, with_mask=True),
+                self.mesh)
+            for bx_d, by_d, mask_d in stream:
+                stats = self._eval_step(model.params, model.net_state, bx_d,
+                                        by_d, mask_d)
+                totals = stats if totals is None else jax.tree.map(
+                    lambda a, b: a + b, totals, stats)
+                steps += 1
+            out = {}
+            if totals is None:
+                return out
+            totals = jax.device_get(totals)
+            # registry update (the eval twin of _observe_fit_metrics): one
+            # weighted observation per streamed step, record count from the
+            # mask sum so pad rows never inflate it
+            dt = time.perf_counter() - t0
+            if steps and dt > 0:
+                self._m_eval_step_time.observe(dt / steps, n=steps)
+            self._m_eval_records.inc(int(totals["loss"]["count"]))
         for m in self.metrics:
             out[m.name] = float(m.finalize(totals[m.name]))
         out["loss"] = float(totals["loss"]["sum"] / max(totals["loss"]["count"], 1.0))
@@ -1197,16 +1235,25 @@ class TrainingLoop:
             yp, n = pending.popleft()
             outs.append(jax.tree.map(lambda a: a[:n], jax.device_get(yp)))
 
-        stream = prefetch_to_device(
-            self._padded_batches(x, None, eff_bs, dp, with_mask=False),
-            self.mesh)
-        for i, bx_d in enumerate(stream):
-            pending.append((self._predict_step(model.params, model.net_state,
-                                               bx_d), sizes[i]))
-            if len(pending) > window:
+        with span("train.predict", registry=self._registry):
+            t0 = time.perf_counter()
+            stream = prefetch_to_device(
+                self._padded_batches(x, None, eff_bs, dp, with_mask=False),
+                self.mesh)
+            for i, bx_d in enumerate(stream):
+                pending.append((self._predict_step(
+                    model.params, model.net_state, bx_d), sizes[i]))
+                if len(pending) > window:
+                    drain_one()
+            while pending:
                 drain_one()
-        while pending:
-            drain_one()
+            # registry update mirrors evaluate's: weighted per-batch step
+            # time + the REAL example count (pads excluded by `sizes`)
+            dt = time.perf_counter() - t0
+            if sizes and dt > 0:
+                self._m_predict_step_time.observe(dt / len(sizes),
+                                                  n=len(sizes))
+            self._m_predict_records.inc(n_total)
         if not outs:
             return None
         return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *outs)

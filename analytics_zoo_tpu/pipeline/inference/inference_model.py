@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.common.zoo_model import load_model
-from ...observability import default_registry
+from ...observability import default_registry, instrument_jit
 from ...parallel import mesh as mesh_lib
 from ..api.keras.engine import KerasNet, intercept_layer_calls
 from ...utils.checkpoint import CheckpointManager
@@ -258,8 +258,12 @@ class InferenceModel:
         # one shape-polymorphic jitted fn; jax.jit caches one executable per
         # padded batch size (bounded by the power-of-two bucketing below) and
         # is itself thread-safe. `params` is rebound only to its dequantized
-        # view — self._params must survive every call, so donation is wrong
-        self._predict = jax.jit(run)  # zoolint: disable=ZL008
+        # view — self._params must survive every call, so donation is wrong.
+        # instrument_jit: each new padded batch size is an expected compile
+        # (bucketing bounds them); a retrace storm here means a caller is
+        # bypassing the bucketing
+        self._predict = instrument_jit(  # zoolint: disable=ZL008
+            run, name="inference.predict", registry=self.metrics)
         return self
 
     @staticmethod

@@ -12,12 +12,13 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..observability import new_trace_id
 from .backend import LocalBackend, default_backend
 
 INPUT_STREAM = "tensor_stream"
 
 __all__ = ["InputQueue", "OutputQueue", "ServingError", "encode_array",
-           "decode_array"]
+           "decode_array", "new_trace_id"]
 
 
 class ServingError(RuntimeError):
@@ -47,9 +48,21 @@ class InputQueue:
         self.stream = stream
         self.timeout = timeout
 
-    def enqueue(self, uri: str, data: np.ndarray) -> str:
+    def enqueue(self, uri: str, data: np.ndarray,
+                trace: Optional[str] = None) -> str:
+        """Enqueue one record. Every record is stamped with a Dapper-style
+        ``trace`` id (16 hex chars; pass ``trace=`` to adopt a caller's
+        id, e.g. an upstream request id) — the serve loop carries it
+        through batch assembly, dispatch, and publish, emitting
+        per-request phase events under that id so the JSON event log
+        holds each request's exact latency breakdown. Records enqueued by
+        foreign producers without the field still serve; they just have
+        no trace."""
+        # falsy trace ("" from an unset upstream header) mints too —
+        # stamping "" would merge unrelated requests into one bogus trace
         return self.backend.xadd(
-            self.stream, {"uri": uri, "data": encode_array(np.asarray(data))},
+            self.stream, {"uri": uri, "data": encode_array(np.asarray(data)),
+                          "trace": trace or new_trace_id()},
             timeout=self.timeout)
 
 

@@ -14,6 +14,7 @@
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -29,6 +30,14 @@ log = logging.getLogger("analytics_zoo_tpu.serving")
 
 __all__ = ["ClusterServing"]
 
+#: per-request carry-through from stream read to publish: the client's
+#: trace id plus the two timestamps later phases diff against. ``t_enq``
+#: is WALL epoch seconds (parsed from the ``<epoch_ms>-<seq>`` entry id,
+#: the only clock the producer and server share); ``t_deq`` is this
+#: process's ``perf_counter`` at read time (monotonic — server-side phase
+#: durations must not jump on an NTP step).
+_Rec = collections.namedtuple("_Rec", ("uri", "trace", "t_enq", "t_deq"))
+
 
 class ClusterServing:
     """Owns the serve loop: xread → batched predict → result writes.
@@ -37,8 +46,12 @@ class ClusterServing:
     the ``zoo_serving_*`` metrics in ``registry`` (default: the
     process-wide one) — records/batches/error counters, stream-depth
     gauge, batch-size, queue-wait and dispatch→publish latency histograms
-    — scrapeable via :meth:`serve_metrics`; :meth:`set_json_events`
-    additionally logs one structured JSON event per flush/error."""
+    plus p50/p95/p99 quantile summaries (queue-wait, dispatch, and
+    end-to-end) — scrapeable via :meth:`serve_metrics`, which also mounts
+    ``/healthz`` and ``/statusz``; :meth:`set_json_events` additionally
+    logs one structured JSON event per flush/error and, for every record
+    the client stamped with a trace id, parent-linked per-request phase
+    events (enqueue→dequeue→dispatch→publish) under that id."""
 
     def __init__(self, model, backend: Optional[LocalBackend] = None,
                  batch_size: int = 32, stream: str = INPUT_STREAM,
@@ -77,6 +90,24 @@ class ClusterServing:
         self._m_dispatch = m.histogram(
             "zoo_serving_dispatch_seconds",
             "dispatch to publish latency per batch")
+        self._m_skew = m.counter(
+            "zoo_serving_clock_skew_total",
+            "queue-wait observations clamped to zero because the client "
+            "clock ran ahead of the server's")
+        # quantile digests alongside the histograms: the octave buckets
+        # keep the shape, the summaries answer "what IS p99" exactly
+        # enough to hold an SLO against (and merge across replicas)
+        self._q_queue_wait = m.summary(
+            "zoo_serving_queue_wait_quantiles_seconds",
+            "queue-wait p50/p95/p99 per record (quantile digest)")
+        self._q_dispatch = m.summary(
+            "zoo_serving_dispatch_quantiles_seconds",
+            "dispatch to publish p50/p95/p99 per batch (quantile digest)")
+        self._q_e2e = m.summary(
+            "zoo_serving_e2e_quantiles_seconds",
+            "enqueue to publish end-to-end p50/p95/p99 per record "
+            "(quantile digest)")
+        self._last_flush_wall = None   # epoch s of the newest publish
         self._events = None         # JsonEventSink (set_json_events)
         self._scrape = None         # ScrapeServer (serve_metrics)
 
@@ -115,15 +146,41 @@ class ClusterServing:
         self.metrics.add_event_sink(self._events)
         return self
 
-    def serve_metrics(self, port: int = 0):
-        """Mount a ``/metrics`` Prometheus scrape endpoint over this
-        server's registry; returns the :class:`ScrapeServer` (bound port on
-        ``.port``). Closed automatically by :meth:`stop`."""
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
+        """Mount the observability HTTP endpoint over this server's
+        registry — ``/metrics`` (Prometheus exposition), ``/healthz``
+        (liveness + serve-loop state), ``/statusz`` (operator page:
+        uptime, stream depth, last-flush age, jit-compile totals,
+        device info). Returns the :class:`ScrapeServer` (bound port on
+        ``.port``); closed automatically by :meth:`stop`. Pretty-print
+        it from a shell with ``scripts/cluster-serving-status``.
+        ``host="0.0.0.0"`` exposes it to an off-host Prometheus scraper
+        (the default binds loopback only)."""
         from ..observability import ScrapeServer
         if self._scrape is not None:
             self._scrape.close()
-        self._scrape = ScrapeServer(self.metrics, port=port)
+        self._scrape = ScrapeServer(self.metrics, port=port, host=host,
+                                    health_fn=self._health_info)
         return self._scrape
+
+    def _health_info(self) -> dict:
+        """Serve-loop introspection for /healthz and /statusz. Runs on
+        the scrape thread — reads only cheap fields and the backend's
+        stream length (its lock is held per operation, never across a
+        dispatch)."""
+        age = (None if self._last_flush_wall is None
+               else max(time.time() - self._last_flush_wall, 0.0))
+        thread = self._thread
+        return {"serving": {
+            # is_alive, not a None check: a serve loop killed by an
+            # escaped exception must read as down — a liveness endpoint
+            # that says ok over a dead loop is worse than none
+            "running": thread is not None and thread.is_alive(),
+            "stream_depth": self.backend.stream_len(self.stream),
+            "served": self.served,
+            "batches": self._batches,
+            "last_flush_age_s": age,
+        }}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ClusterServing":
@@ -178,7 +235,7 @@ class ClusterServing:
         the batch budget, so overlapping it with host work roughly
         doubles sustainable throughput; one batch in flight + one being
         assembled keeps the memory bound."""
-        pending = None   # (uris, collect) — dispatched, readback deferred
+        pending = None   # (recs, collect, t0) — dispatched, readback deferred
         try:
             while not self._stop.is_set():
                 entries = self.backend.xread(self.stream, self.batch_size,
@@ -194,9 +251,10 @@ class ClusterServing:
                 depth = self.backend.stream_len(self.stream)
                 self._m_depth.set(depth)
                 now_s = time.time()
-                uris, tensors = [], []
+                now_p = time.perf_counter()
+                recs, tensors = [], []
                 for eid, fields in entries:
-                    self._observe_queue_wait(eid, now_s)
+                    wait, t_enq = self._observe_queue_wait(eid, now_s)
                     try:
                         # uri first: a decodable payload with a missing
                         # uri must not leave an orphan tensor that would
@@ -212,15 +270,27 @@ class ClusterServing:
                                       fields.get("uri"))
                         self._m_undecodable.inc()
                         self.metrics.emit("serving.undecodable",
-                                          uri=fields.get("uri"))
+                                          uri=fields.get("uri"),
+                                          trace=fields.get("trace"))
                         if fields.get("uri"):
                             self.backend.set_result(
                                 fields["uri"],
                                 {"error": "undecodable payload"})
                         continue
-                    uris.append(uri)
+                    rec = _Rec(uri, fields.get("trace"), t_enq, now_p)
+                    if rec.trace is not None:
+                        # the request's first two phase events; later
+                        # phases (dispatch, publish) link back via the
+                        # trace id + parent-phase field
+                        self.metrics.emit("request", phase="enqueue",
+                                          trace=rec.trace, uri=uri,
+                                          parent=None, at_s=t_enq)
+                        self.metrics.emit("request", phase="dequeue",
+                                          trace=rec.trace, uri=uri,
+                                          parent="enqueue", dur_s=wait)
+                    recs.append(rec)
                     tensors.append(arr)
-                if not uris:
+                if not recs:
                     # every record in this read was undecodable: the same
                     # drain signal applies — an empty stream means no next
                     # batch will arrive to trigger the pending readback,
@@ -235,12 +305,12 @@ class ClusterServing:
                     # serve one by one (rare path, keep it simple)
                     if pending is not None:
                         pending = self._flush(pending)
-                    for uri, t in zip(uris, tensors):
-                        nxt, _ = self._dispatch([uri], t[None])
+                    for rec, t in zip(recs, tensors):
+                        nxt, _ = self._dispatch([rec], t[None])
                         if nxt is not None:
                             self._flush(nxt)
                     continue
-                nxt, pending = self._dispatch(uris, batch, pending)
+                nxt, pending = self._dispatch(recs, batch, pending)
                 if pending is not None:
                     pending = self._flush(pending)
                 if nxt is not None and depth == 0:
@@ -260,17 +330,29 @@ class ClusterServing:
             if pending is not None:
                 self._flush(pending)
 
-    def _observe_queue_wait(self, entry_id, now_s: float) -> None:
+    def _observe_queue_wait(self, entry_id, now_s: float):
         """Enqueue→read wait from the stream entry id (both backends stamp
-        ids as ``<epoch_ms>-<seq>``, the Redis-stream convention)."""
+        ids as ``<epoch_ms>-<seq>``, the Redis-stream convention).
+        Returns ``(wait_s, enqueue_epoch_s)`` for the per-request trace
+        events, ``(None, None)`` on a foreign id scheme. A negative wait
+        (client clock ahead of the server) clamps to zero and counts in
+        ``zoo_serving_clock_skew_total`` instead of polluting the
+        distribution with a bogus near-zero-or-negative sample."""
         try:
             enq_ms = int(str(entry_id).split("-", 1)[0])
         except (TypeError, ValueError):
-            return    # foreign id scheme: skip, never break the loop
-        self._m_queue_wait.observe(max(now_s - enq_ms / 1000.0, 0.0))
+            return None, None   # foreign id scheme: skip, never break loop
+        t_enq = enq_ms / 1000.0
+        wait = now_s - t_enq
+        if wait < 0:
+            self._m_skew.inc()
+            wait = 0.0
+        self._m_queue_wait.observe(wait)
+        self._q_queue_wait.observe(wait)
+        return wait, t_enq
 
-    def _dispatch(self, uris, batch, pending=None):
-        """Enqueue the device work; ((uris, collect, t0), leftover_pending).
+    def _dispatch(self, recs, batch, pending=None):
+        """Enqueue the device work; ((recs, collect, t0), leftover_pending).
         Tries a NON-blocking async dispatch first: with a single replica
         permit (``concurrent_num=1``) dispatching before collecting our
         own pending batch would deadlock, so on a busy model the pending
@@ -290,7 +372,7 @@ class ClusterServing:
             async_fn = getattr(self.model, "predict_async", None)
             if async_fn is not None:
                 with span("serving.dispatch", registry=self.metrics,
-                          records=len(uris)) as sp:
+                          records=len(recs)) as sp:
                     collect = async_fn(batch, block=False)
                     if collect is None:
                         sp.discard()
@@ -298,28 +380,50 @@ class ClusterServing:
                     if pending is not None:
                         pending = self._flush(pending)
                     with span("serving.dispatch", registry=self.metrics,
-                              records=len(uris)):
+                              records=len(recs)):
                         collect = async_fn(batch)
-                return (uris, collect, t0), pending
+                self._emit_dispatch(recs, t0)
+                return (recs, collect, t0), pending
             if pending is not None:
                 pending = self._flush(pending)
             with span("serving.dispatch", registry=self.metrics,
-                      records=len(uris)):
+                      records=len(recs)):
                 preds = self.model.predict(batch)
-            self._flush((uris, (lambda: preds), t0))
+            self._emit_dispatch(recs, t0)
+            self._flush((recs, (lambda: preds), t0))
             return None, pending
         except Exception:
             log.exception("inference dispatch failed for %d records; "
-                          "writing errors", len(uris))
-            self._record_failure(uris)
+                          "writing errors", len(recs))
+            self._record_failure(recs, parent="dequeue")
             return None, pending
 
-    def _record_failure(self, uris) -> None:
-        """Registry + event + addressable error records for a failed batch."""
-        self._m_failures.inc(len(uris))
-        self.metrics.emit("serving.failure", records=len(uris))
-        for uri in uris:
-            self.backend.set_result(uri, {"error": "inference failed"})
+    def _emit_dispatch(self, recs, t0: float) -> None:
+        """Per-request dispatch phase events: ``dur_s`` is the batch
+        assembly+decode time from this record's dequeue to the moment its
+        batch entered the model (``t0``), ``batch`` the co-dispatched
+        record count — the field that explains a latency outlier caused
+        by riding in a large batch."""
+        n = len(recs)
+        for rec in recs:
+            if rec.trace is not None:
+                self.metrics.emit("request", phase="dispatch",
+                                  trace=rec.trace, uri=rec.uri,
+                                  parent="dequeue",
+                                  dur_s=max(t0 - rec.t_deq, 0.0), batch=n)
+
+    def _record_failure(self, recs, parent: str = "dequeue") -> None:
+        """Registry + event + addressable error records for a failed batch.
+        Every traced record also gets a TERMINAL ``failed`` phase event
+        (``parent`` = the last phase that did complete), so a by-trace
+        reconstruction never shows a failed request as forever in-flight."""
+        self._m_failures.inc(len(recs))
+        self.metrics.emit("serving.failure", records=len(recs))
+        for rec in recs:
+            if rec.trace is not None:
+                self.metrics.emit("request", phase="failed", trace=rec.trace,
+                                  uri=rec.uri, parent=parent)
+            self.backend.set_result(rec.uri, {"error": "inference failed"})
 
     def _flush(self, pending) -> None:
         """Block on a dispatched batch's readback and publish its results.
@@ -329,28 +433,44 @@ class ClusterServing:
         batch-size and dispatch→publish latency histograms, plus one
         ``serving.flush`` JSON event when a sink is attached. The
         TensorBoard scalars derive from the same measurements."""
-        uris, collect, t0 = pending
+        recs, collect, t0 = pending
         try:
             with span("serving.flush", registry=self.metrics,
-                      records=len(uris)):
+                      records=len(recs)):
                 preds = np.asarray(collect())
         except Exception:
             log.exception("inference failed for %d records; writing errors",
-                          len(uris))
-            self._record_failure(uris)
+                          len(recs))
+            self._record_failure(recs, parent="dispatch")
             return None
-        for i, uri in enumerate(uris):
-            self.backend.set_result(uri, {"value": encode_array(preds[i])})
-        self.served += len(uris)
+        for i, rec in enumerate(recs):
+            self.backend.set_result(rec.uri,
+                                    {"value": encode_array(preds[i])})
+        self.served += len(recs)
         self._batches += 1
         now = time.perf_counter()
+        now_wall = time.time()
+        self._last_flush_wall = now_wall
         latency = max(now - t0, 0.0)
-        self._m_records.inc(len(uris))
+        self._m_records.inc(len(recs))
         self._m_batches.inc()
-        self._m_batch_size.observe(len(uris))
+        self._m_batch_size.observe(len(recs))
         self._m_dispatch.observe(latency)
-        self.metrics.emit("serving.flush", records=len(uris), batch=self._batches,
-                          latency_s=latency)
+        self._q_dispatch.observe(latency)
+        for rec in recs:
+            if rec.t_enq is not None:
+                # end-to-end = producer enqueue (wall, from the entry id)
+                # to publish (wall); clamped — the skew was already
+                # counted once at the queue-wait clamp
+                self._q_e2e.observe(max(now_wall - rec.t_enq, 0.0))
+            if rec.trace is not None:
+                self.metrics.emit(
+                    "request", phase="publish", trace=rec.trace,
+                    uri=rec.uri, parent="dispatch", dur_s=latency,
+                    e2e_s=(max(now_wall - rec.t_enq, 0.0)
+                           if rec.t_enq is not None else None))
+        self.metrics.emit("serving.flush", records=len(recs),
+                          batch=self._batches, latency_s=latency)
         if self._summary is not None:
             t_prev = self._t_last_flush
             self._t_last_flush = now
@@ -362,7 +482,7 @@ class ClusterServing:
             # a throughput collapse)
             start = t0 if t_prev is None else max(t_prev, t0)
             dt = max(now - start, 1e-9)
-            self._summary.add_scalar("Serving Throughput", len(uris) / dt,
+            self._summary.add_scalar("Serving Throughput", len(recs) / dt,
                                      self._batches)
             self._summary.add_scalar("Serving Records", self.served,
                                      self._batches)

@@ -818,6 +818,24 @@ def main():
     if mfu is not None:
         default_registry().gauge("zoo_train_mfu").set(mfu)
     out["observability"] = default_registry().snapshot(compact=True)
+    # serving latency percentiles, promoted out of the snapshot into ONE
+    # top-level record (ms): p50/p95/p99 for queue-wait, dispatch, and
+    # end-to-end are the numbers an SLO discussion actually quotes. Kept
+    # out of out["observability"] itself — that dict is keyed by metric
+    # family and consumers iterate it expecting snapshot entries
+    quantile_ms = {}
+    for fam, short in (("zoo_serving_queue_wait_quantiles_seconds",
+                        "queue_wait"),
+                       ("zoo_serving_dispatch_quantiles_seconds",
+                        "dispatch"),
+                       ("zoo_serving_e2e_quantiles_seconds", "e2e")):
+        entry = out["observability"].get(fam)
+        if entry and entry.get("count"):
+            quantile_ms[short] = {
+                f"p{int(round(float(q) * 100))}": round(v * 1000.0, 3)
+                for q, v in entry["quantiles"].items() if v == v}
+    if quantile_ms:
+        out["serving_latency_quantiles_ms"] = quantile_ms
     print(json.dumps(out))
     print(f"# wall={wall:.2f}s epochs={TIMED_EPOCHS} batch={BATCH} "
           f"scan_steps={SCAN_STEPS} steps/epoch={steps_per_epoch} "

@@ -72,6 +72,78 @@ def test_histogram_extremes_clamp_not_crash():
     assert h.cumulative()[0][0] == pytest.approx(2.0 ** _EXP_LO)
 
 
+def test_quantile_digest_accuracy_and_merge():
+    """The fixed-budget digest stays within ~2% of true quantiles on a
+    known distribution, merges losslessly enough to keep that bound, and
+    its quantile function is monotone (p99 >= p50 by construction)."""
+    import random
+
+    rnd = random.Random(7)
+    vals = [rnd.random() for _ in range(20000)]
+    d = obs.QuantileDigest(budget=128)
+    for v in vals:
+        d.add(v)
+    svals = sorted(vals)
+    for q in (0.5, 0.95, 0.99):
+        true = svals[int(q * len(svals))]
+        assert abs(d.quantile(q) - true) < 0.02, q
+    assert d.quantile(0.5) <= d.quantile(0.95) <= d.quantile(0.99)
+    assert d.count == len(vals)
+    assert d.sum == pytest.approx(sum(vals))
+
+    # merge: two half-digests rejoin to the same answers
+    a, b = obs.QuantileDigest(128), obs.QuantileDigest(128)
+    for v in vals[:10000]:
+        a.add(v)
+    for v in vals[10000:]:
+        b.add(v)
+    a.merge(b)
+    assert a.count == len(vals)
+    for q in (0.5, 0.99):
+        assert abs(a.quantile(q) - d.quantile(q)) < 0.02
+
+    empty = obs.QuantileDigest()
+    assert math.isnan(empty.quantile(0.5))
+
+
+def test_summary_metric_and_prometheus_roundtrip():
+    """Summary → exposition → parse: quantile series carry the
+    {quantile=...} label, _sum/_count reconcile, and p99 >= p50 holds in
+    the scrape."""
+    r = obs.MetricsRegistry()
+    s = r.summary("zoo_lat_quantiles_seconds", "latency quantiles")
+    for i in range(1, 101):
+        s.observe(i / 1000.0)
+    with pytest.raises(TypeError):
+        r.histogram("zoo_lat_quantiles_seconds")   # kind clash still raises
+    parsed = obs.parse_prometheus(obs.render_prometheus(r))
+    fam = parsed["zoo_lat_quantiles_seconds"]
+    assert fam["type"] == "summary"
+    qs = {lab["quantile"]: v for name, lab, v in fam["samples"]
+          if name == "zoo_lat_quantiles_seconds"}
+    assert set(qs) == {"0.5", "0.95", "0.99"}
+    assert qs["0.5"] <= qs["0.95"] <= qs["0.99"]
+    assert qs["0.5"] == pytest.approx(0.0505, rel=0.05)
+    count = next(v for name, _, v in fam["samples"]
+                 if name.endswith("_count"))
+    total = next(v for name, _, v in fam["samples"]
+                 if name.endswith("_sum"))
+    assert count == 100
+    assert total == pytest.approx(sum(i / 1000.0 for i in range(1, 101)))
+    # snapshot keeps the quantiles in BOTH forms (bench embeds compact)
+    snap = r.snapshot(compact=True)["zoo_lat_quantiles_seconds"]
+    assert snap["type"] == "summary" and set(snap["quantiles"]) == \
+        {"0.5", "0.95", "0.99"}
+    # an EMPTY summary must snapshot to strict JSON (no bare NaN): the
+    # BENCH record embeds this dict and jq/JSON.parse reject NaN
+    r2 = obs.MetricsRegistry()
+    r2.summary("zoo_empty_quantiles_seconds")
+    empty = r2.snapshot(compact=True)["zoo_empty_quantiles_seconds"]
+    assert empty["count"] == 0 and empty["quantiles"] == {}
+    json.loads(json.dumps(r2.snapshot(compact=True),
+                          allow_nan=False))   # raises on any NaN leak
+
+
 def test_labeled_metrics_are_distinct_series():
     r = obs.MetricsRegistry()
     a = r.counter("zoo_ops_total", labels={"op": "read"})
@@ -363,6 +435,178 @@ def test_serving_smoke_counters_reconcile_exactly(tmp_path):
         {e["name"] for e in spans}
 
 
+def test_serving_per_request_traces_reconcile_exactly(tmp_path):
+    """Tier-1 acceptance: every served record emits exactly four
+    parent-linked request events (enqueue→dequeue→dispatch→publish)
+    sharing ONE trace id; trace count == N with zero orphans; and the
+    scrape exposes p50/p95/p99 quantile series with p99 >= p50 for
+    queue-wait and dispatch."""
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                           LocalBackend, OutputQueue)
+
+    n = 24
+    reg = obs.MetricsRegistry()
+    im = InferenceModel(registry=reg).from_keras(_toy_model())
+    backend = LocalBackend()
+    events_path = str(tmp_path / "trace_events.jsonl")
+    serving = (ClusterServing(im, backend=backend, batch_size=8,
+                              registry=reg)
+               .set_json_events(events_path))
+    scrape = serving.serve_metrics(port=0)
+    serving.start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    rng = np.random.default_rng(11)
+    for i in range(n):
+        inq.enqueue(f"t-{i}", rng.normal(size=(6,)).astype(np.float32))
+    for i in range(n):
+        assert outq.query(f"t-{i}", timeout=30.0) is not None
+    # the final batch's publish events land just after its results do
+    import time
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if len(obs.read_events(events_path, kind="request")) >= 4 * n:
+            break
+        time.sleep(0.05)
+    with urllib.request.urlopen(scrape.url, timeout=10.0) as resp:
+        text = resp.read().decode("utf-8")
+    serving.stop()
+
+    # ---- event-log reconciliation against ground truth ----
+    events = obs.read_events(events_path, kind="request")
+    assert len(events) == 4 * n, "phase-event count != 4 per record"
+    by_trace = {}
+    for e in events:
+        assert set("0123456789abcdef") >= set(e["trace"]) and \
+            len(e["trace"]) == 16, "trace id format (16 hex chars)"
+        by_trace.setdefault(e["trace"], {})[e["phase"]] = e
+    assert len(by_trace) == n, "one trace id per served record, no orphans"
+    expected_parent = {"enqueue": None, "dequeue": "enqueue",
+                       "dispatch": "dequeue", "publish": "dispatch"}
+    uris = set()
+    for trace, phases in by_trace.items():
+        assert set(phases) == set(expected_parent), trace
+        for phase, e in phases.items():
+            assert e["parent"] == expected_parent[phase]
+        # one uri per trace, consistent across all four phases
+        assert len({e["uri"] for e in phases.values()}) == 1
+        uris.add(phases["publish"]["uri"])
+        assert phases["publish"]["e2e_s"] >= phases["publish"]["dur_s"] >= 0
+        assert phases["dequeue"]["dur_s"] >= 0
+    assert uris == {f"t-{i}" for i in range(n)}
+
+    # ---- scrape-side quantiles ----
+    parsed = obs.parse_prometheus(text)
+    for fam in ("zoo_serving_queue_wait_quantiles_seconds",
+                "zoo_serving_dispatch_quantiles_seconds",
+                "zoo_serving_e2e_quantiles_seconds"):
+        assert parsed[fam]["type"] == "summary", fam
+        qs = {lab["quantile"]: v for name, lab, v in
+              parsed[fam]["samples"] if name == fam}
+        assert set(qs) == {"0.5", "0.95", "0.99"}, fam
+        assert qs["0.5"] <= qs["0.95"] <= qs["0.99"], fam
+        assert all(v == v and v >= 0 for v in qs.values()), fam
+    count = next(v for name, _, v in
+                 parsed["zoo_serving_queue_wait_quantiles_seconds"]["samples"]
+                 if name.endswith("_count"))
+    assert count == n
+
+
+def test_serving_healthz_statusz_live(tmp_path):
+    """/healthz reports ok (with running=True serve-loop state) while the
+    loop runs; /statusz adds stream depth, last-flush age, jit totals,
+    and device info; both flip to running=False after stop()."""
+    import json as _json
+
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                           LocalBackend, OutputQueue)
+
+    reg = obs.MetricsRegistry()
+    im = InferenceModel(registry=reg).from_keras(_toy_model())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4, registry=reg)
+    scrape = serving.serve_metrics(port=0)
+    base = f"http://{scrape.host}:{scrape.port}"
+    serving.start()
+    try:
+        inq, outq = InputQueue(backend), OutputQueue(backend)
+        inq.enqueue("h-0", np.zeros(6, np.float32))
+        assert outq.query("h-0", timeout=30.0) is not None
+        with urllib.request.urlopen(base + "/healthz", timeout=10.0) as r:
+            health = _json.loads(r.read())
+        assert health["status"] == "ok"
+        assert health["uptime_s"] >= 0
+        assert health["serving"]["running"] is True
+        with urllib.request.urlopen(base + "/statusz", timeout=10.0) as r:
+            status = _json.loads(r.read())
+        assert status["serving"]["stream_depth"] == 0
+        assert status["serving"]["served"] == 1
+        assert status["serving"]["last_flush_age_s"] >= 0
+        assert status["jit"]["compile_total"] >= 1   # the predict compile
+        assert status["device"]["platform"] == "cpu"
+        assert status["device"]["device_count"] >= 1
+    finally:
+        # read running=False through a still-open endpoint: close the
+        # scrape AFTER stop() (stop() would close it, so detach first)
+        serving._scrape = None
+        serving.stop(drain=False)
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=10.0) as r:
+            health = _json.loads(r.read())
+        assert health["serving"]["running"] is False
+    finally:
+        scrape.close()
+
+
+def test_scrape_server_concurrent_scrape_while_serving():
+    """Scrape-while-observe torture: producer threads hammer a histogram,
+    a summary, and a counter while scrapes run — every exposition parses
+    cleanly (no torn output) and histogram bucket monotonicity + the
+    +Inf==count invariant hold mid-flight."""
+    reg = obs.MetricsRegistry()
+    h = reg.histogram("zoo_load_seconds", "under fire")
+    s = reg.summary("zoo_load_quantiles_seconds", "under fire")
+    c = reg.counter("zoo_load_total")
+    srv = obs.ScrapeServer(reg, port=0)
+    stop = threading.Event()
+
+    def producer(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            v = float(rng.random())
+            h.observe(v)
+            s.observe(v)
+            c.inc()
+
+    threads = [threading.Thread(target=producer, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(20):
+            with urllib.request.urlopen(srv.url, timeout=10.0) as resp:
+                text = resp.read().decode("utf-8")
+            parsed = obs.parse_prometheus(text)   # raises on torn lines
+            samples = parsed["zoo_load_seconds"]["samples"]
+            buckets = [v for name, lab, v in samples
+                       if name.endswith("_bucket")]
+            assert buckets == sorted(buckets), "bucket monotonicity"
+            count = next(v for name, _, v in samples
+                         if name.endswith("_count"))
+            assert buckets[-1] == count, "+Inf bucket == count"
+            qs = {lab["quantile"]: v for name, lab, v in
+                  parsed["zoo_load_quantiles_seconds"]["samples"]
+                  if "quantile" in lab}
+            if qs and all(v == v for v in qs.values()):
+                assert qs["0.5"] <= qs["0.95"] <= qs["0.99"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        srv.close()
+
+
 def test_serving_error_paths_counted(tmp_path):
     """Undecodable payloads and inference failures land in their counters
     and the event log — not just in text logs."""
@@ -396,6 +640,13 @@ def test_serving_error_paths_counted(tmp_path):
     assert len(obs.read_events(events_path, kind="serving.undecodable")) == 1
     assert sum(e["records"] for e in
                obs.read_events(events_path, kind="serving.failure")) == 1
+    # the failed record's trace chain terminates in a `failed` phase —
+    # it must not read as forever in-flight
+    reqs = obs.read_events(events_path, kind="request")
+    x1 = [e for e in reqs if e["uri"] == "x1"]
+    phases = {e["phase"] for e in x1}
+    assert "failed" in phases and "publish" not in phases
+    assert len({e["trace"] for e in x1}) == 1
 
 
 def test_scrape_server_404_on_unknown_path():
@@ -499,6 +750,68 @@ def test_fit_metrics_off_by_default_do_not_compute_flops():
     snap = obs.default_registry().snapshot()
     assert snap["zoo_train_mfu"]["value"] == 0
     assert snap["zoo_train_step_seconds"]["count"] > 0
+
+
+def test_fit_counts_jit_compiles_and_forced_retrace_emits_one_event():
+    """Tier-1 acceptance: after one fit, zoo_jit_compile_total is nonzero;
+    a forced re-trace (changed input batch shape) emits exactly ONE
+    jit.retrace event (for train.step) and bumps the labeled retrace
+    counter."""
+    obs.reset_default_registry()
+    init_zoo_context()
+    events = []
+
+    class ListSink:
+        def write(self, e):
+            events.append(e)
+
+    obs.default_registry().add_event_sink(ListSink())
+    m, _ = _xor_fit(nb_epoch=1)               # batch_size=8 inside
+    snap = obs.default_registry().snapshot()
+    assert snap["zoo_jit_compile_total"]["value"] >= 1
+    assert snap['zoo_jit_compile_seconds{fn="train.step"}']["count"] == 1
+    assert not [e for e in events if e["kind"] == "jit.retrace"]
+    compiles_before = [e for e in events if e["kind"] == "jit.compile"]
+    assert compiles_before, "first compile must emit a jit.compile event"
+
+    x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]] * 8, np.float32)
+    y = (x[:, 0].astype(np.int32) ^ x[:, 1].astype(np.int32))
+    m.fit(x, y, batch_size=16, nb_epoch=1)    # new shape → exactly 1 retrace
+    retraces = [e for e in events if e["kind"] == "jit.retrace"]
+    assert len(retraces) == 1
+    assert retraces[0]["fn"] == "train.step"
+    assert retraces[0]["n_signatures"] == 2
+    snap = obs.default_registry().snapshot()
+    assert snap['zoo_jit_retrace_total{fn="train.step"}']["value"] == 1
+    # a third fit on an ALREADY-SEEN shape must not count again
+    m.fit(x, y, batch_size=16, nb_epoch=1)
+    assert len([e for e in events if e["kind"] == "jit.retrace"]) == 1
+
+
+def test_evaluate_and_predict_report_step_time_and_records():
+    """The ROADMAP eval/predict instrumentation pass: both paths fill
+    their weighted step-time histograms, record counters, and spans —
+    mirroring what fit got in PR 2."""
+    obs.reset_default_registry()
+    init_zoo_context()
+    m, _ = _xor_fit(nb_epoch=1)
+    x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]] * 8, np.float32)
+    y = (x[:, 0].astype(np.int32) ^ x[:, 1].astype(np.int32))
+    m.evaluate(x, y, batch_size=8)
+    preds = m.predict(x, batch_size=8)
+    assert preds.shape == (32, 2)
+    snap = obs.default_registry().snapshot()
+    assert snap["zoo_eval_step_seconds"]["count"] == 4     # 32/8 batches
+    assert snap["zoo_eval_step_seconds"]["sum"] > 0
+    assert snap["zoo_eval_examples_total"]["value"] == 32  # pads excluded
+    assert snap["zoo_predict_step_seconds"]["count"] == 4
+    assert snap["zoo_predict_examples_total"]["value"] == 32
+    assert snap['zoo_span_seconds{span="train.evaluate"}']["count"] == 1
+    assert snap['zoo_span_seconds{span="train.predict"}']["count"] == 1
+    # eval/predict compiles are visible to the compile counter too
+    assert snap['zoo_jit_compile_seconds{fn="train.eval_step"}']["count"] == 1
+    assert snap['zoo_jit_compile_seconds{fn="train.predict_step"}']["count"] \
+        == 1
 
 
 def test_bench_snapshot_shape():

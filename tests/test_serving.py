@@ -216,6 +216,98 @@ def test_lifecycle_stop_drains_no_acked_request_lost():
         assert out is not None and out.shape == (3,), uri
 
 
+def test_clock_skew_clamped_and_counted():
+    """A client clock ahead of the server yields a negative queue-wait:
+    it must clamp to zero (not pollute the histogram with garbage) and
+    count in zoo_serving_clock_skew_total."""
+    import time as _t
+
+    from analytics_zoo_tpu import observability as obs
+
+    reg = obs.MetricsRegistry()
+    serving = ClusterServing(object(), backend=LocalBackend(), registry=reg)
+    now = _t.time()
+    ahead_id = f"{int((now + 5.0) * 1000)}-0"       # stamped 5s in the future
+    wait, t_enq = serving._observe_queue_wait(ahead_id, now)
+    assert wait == 0.0 and t_enq == pytest.approx(now + 5.0, abs=0.01)
+    behind_id = f"{int((now - 1.0) * 1000)}-1"      # normal 1s wait
+    wait2, _ = serving._observe_queue_wait(behind_id, now)
+    assert wait2 == pytest.approx(1.0, abs=0.01)
+    assert serving._observe_queue_wait("garbage-id", now) == (None, None)
+    snap = reg.snapshot()
+    assert snap["zoo_serving_clock_skew_total"]["value"] == 1
+    assert snap["zoo_serving_queue_wait_seconds"]["count"] == 2
+    # the clamped zero lands in the first bucket, not as a negative
+    assert snap["zoo_serving_queue_wait_quantiles_seconds"]["count"] == 2
+
+
+def test_enqueue_stamps_trace_id_and_accepts_custom_one():
+    """Every enqueued record carries a 16-hex-char trace field; a caller
+    may adopt an upstream id via enqueue(trace=...)."""
+    from analytics_zoo_tpu.serving.client import INPUT_STREAM
+
+    backend = LocalBackend()
+    inq = InputQueue(backend)
+    inq.enqueue("a", np.zeros(3, np.float32))
+    inq.enqueue("b", np.zeros(3, np.float32), trace="fedcba9876543210")
+    entries = backend.xread(INPUT_STREAM, 10, block_ms=100)
+    fields = {f["uri"]: f for _, f in entries}
+    auto = fields["a"]["trace"]
+    assert len(auto) == 16 and set(auto) <= set("0123456789abcdef")
+    assert fields["b"]["trace"] == "fedcba9876543210"
+
+
+def test_status_cli_pretty_prints_live_endpoint(tmp_path):
+    """cluster-serving-status scrapes /healthz + /statusz + /metrics and
+    pretty-prints health, serve-loop state, and the p50/p95/p99 table;
+    exit 0 on a healthy endpoint, 1 on an unreachable one."""
+    import os
+    import subprocess
+    import sys
+
+    from analytics_zoo_tpu import observability as obs
+
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(scripts) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+
+    model = _toy_model()
+    im = InferenceModel().from_keras(model)
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4)
+    scrape = serving.serve_metrics(port=0)
+    serving.start()
+    try:
+        inq, outq = InputQueue(backend), OutputQueue(backend)
+        rng = np.random.default_rng(5)
+        for i in range(8):
+            inq.enqueue(f"c-{i}", rng.normal(size=(6,)).astype(np.float32))
+        for i in range(8):
+            assert outq.query(f"c-{i}", timeout=30.0) is not None
+        r = subprocess.run(
+            [sys.executable, os.path.join(scripts, "cluster-serving-status"),
+             f"{scrape.host}:{scrape.port}"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert ": ok" in r.stdout
+        assert "running" in r.stdout
+        assert "zoo_serving_queue_wait_quantiles_seconds" in r.stdout
+        assert "zoo_serving_records_total" in r.stdout
+        assert "p50" in r.stdout and "p99" in r.stdout
+    finally:
+        serving.stop(drain=False)
+    # endpoint gone with stop(): unreachable → exit 1, not a traceback dump
+    r = subprocess.run(
+        [sys.executable, os.path.join(scripts, "cluster-serving-status"),
+         f"{scrape.host}:{scrape.port}"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 1
+    assert "unreachable" in r.stderr
+
+
 def test_lifecycle_cli_scripts_flag_protocol(tmp_path):
     """cluster-serving-{init,start,stop} coordinate through the `running`
     flag file the way the reference scripts do: init writes config, start

@@ -1054,6 +1054,34 @@ def test_zl008_suppression():
     assert not ids(lint_source(src), "ZL008")
 
 
+INSTRUMENT_JIT_BAD = """
+import jax
+from analytics_zoo_tpu.observability import instrument_jit
+
+def build():
+    def step(params, x):
+        params = jax.tree.map(lambda p: p - x, params)
+        return params
+    return instrument_jit(step, name="train.step")
+"""
+
+
+def test_instrument_jit_is_recognized_as_jit_staging():
+    """The in-repo jit wrapper (observability/compile.py) stages its
+    argument exactly like jax.jit — functions behind it must keep
+    under-jit rule coverage (here: ZL008 missing donation), and its
+    donate_argnums kwarg must clear the finding like jax.jit's."""
+    assert ids(lint_source(INSTRUMENT_JIT_BAD), "ZL008")
+    clean = INSTRUMENT_JIT_BAD.replace(
+        'name="train.step"', 'name="train.step", donate_argnums=(0,)')
+    assert not ids(lint_source(clean), "ZL008")
+    # relative-import spelling (how the package itself imports it)
+    rel = INSTRUMENT_JIT_BAD.replace(
+        "from analytics_zoo_tpu.observability import instrument_jit",
+        "from ...observability import instrument_jit")
+    assert ids(lint_source(rel), "ZL008")
+
+
 # ---------------------------------------------------------------------------
 # ZL009 — unbatched host→device transfer in a loop
 # ---------------------------------------------------------------------------

@@ -103,6 +103,18 @@ class LocalBackend:
             self._results[uri] = dict(fields)
             self._lock.notify_all()
 
+    def set_results(self, results: Dict[str, dict]) -> None:
+        """Publish a whole batch of result records under ONE lock
+        acquisition / wakeup — the async publisher's batched write path
+        (per-record ``set_result`` costs a lock round-trip and a
+        ``notify_all`` each)."""
+        if not results:
+            return
+        with self._lock:
+            for uri, fields in results.items():
+                self._results[uri] = dict(fields)
+            self._lock.notify_all()
+
     def pop_result(self, uri: str,
                    timeout: Optional[float] = None) -> Optional[dict]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -121,13 +133,20 @@ class LocalBackend:
             return out
 
 
+#: wire fields carried as binary end to end (Redis streams/hashes are
+#: binary-safe): the v2 tensor payloads. Every other field (uri, trace,
+#: dtype, shape, error text) is utf-8 text.
+_BINARY_FIELDS = frozenset({"data", "value"})
+
+
 class RedisBackend:
     """Same contract against a real Redis; keys match the reference: input
     stream entries + ``result:<uri>`` hashes
     (``serving/ClusterServing.scala:103-134``). Uses the redis-py client
     when installed, otherwise the in-repo RESP wire client
     (``serving/resp.py``) — no package dependency to talk to a real
-    server."""
+    server. The ``data``/``value`` payload fields round-trip as raw
+    bytes (wire-format v2); all other fields are text."""
 
     def __init__(self, host: str = "localhost", port: int = 6379,
                  maxlen: int = 10000):
@@ -157,10 +176,19 @@ class RedisBackend:
         for _, entries in resp or []:
             for eid, fields in entries:
                 eid = eid.decode()
-                out.append((eid, {k.decode(): v.decode()
-                                  for k, v in fields.items()}))
+                out.append((eid, self._decode_fields(fields)))
                 self._last_id[stream] = eid
                 self._r.xdel(stream, eid)
+        return out
+
+    @staticmethod
+    def _decode_fields(fields: Dict[bytes, bytes]) -> dict:
+        """Field decode for stream entries / result hashes: keys are
+        always text; payload fields stay bytes (see ``_BINARY_FIELDS``)."""
+        out = {}
+        for k, v in fields.items():
+            key = k.decode()
+            out[key] = v if key in _BINARY_FIELDS else v.decode()
         return out
 
     def stream_len(self, stream: str) -> int:
@@ -168,6 +196,18 @@ class RedisBackend:
 
     def set_result(self, uri: str, fields: dict) -> None:
         self._r.hset(f"result:{uri}", mapping=fields)
+
+    def set_results(self, results: Dict[str, dict]) -> None:
+        """Batched result publish: ONE pipelined round trip for the whole
+        batch (both redis-py and the in-repo RESP client expose the
+        ``pipeline()`` surface) instead of one HSET round trip per
+        record — the async publisher's write path."""
+        if not results:
+            return
+        pipe = self._r.pipeline()
+        for uri, fields in results.items():
+            pipe.hset(f"result:{uri}", mapping=fields)
+        pipe.execute()
 
     def pop_result(self, uri: str,
                    timeout: Optional[float] = None) -> Optional[dict]:
@@ -177,7 +217,7 @@ class RedisBackend:
             vals = self._r.hgetall(key)
             if vals:
                 self._r.delete(key)
-                return {k.decode(): v.decode() for k, v in vals.items()}
+                return self._decode_fields(vals)
             if deadline is not None and time.monotonic() > deadline:
                 return None
             time.sleep(0.01)

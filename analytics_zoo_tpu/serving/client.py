@@ -1,7 +1,19 @@
 """Cluster Serving client — ``InputQueue`` / ``OutputQueue`` parity with
 ``pyzoo/zoo/serving/client.py:58-142``, ndarray-native instead of
-image-file-native: payloads are base64-wrapped ``.npy`` bytes (dtype+shape
-self-describing), so any tensor model serves, not just jpeg classifiers.
+image-file-native: any tensor model serves, not just jpeg classifiers.
+
+Wire formats (``docs/guides/SERVING.md``):
+
+* **v2 (current)** — raw little-endian tensor bytes in ``data`` plus
+  explicit self-describing ``dtype`` / ``shape`` / ``v`` fields.  Encode
+  is ONE memcpy (``tobytes``); decode is a zero-copy ``np.frombuffer``
+  view over the wire bytes.  Both queue backends carry the ``data`` /
+  ``value`` fields as binary (Redis streams and hashes are binary-safe),
+  so no base64 inflation and no ``.npy`` header parse on the hot path.
+* **v1 (legacy)** — base64-wrapped ``.npy`` bytes in ``data`` alone.
+  :func:`decode_payload` falls back to it transparently (no ``dtype`` /
+  ``shape`` fields present), and the server answers a v1 request in v1,
+  so old producers AND old consumers keep working against a new server.
 """
 
 from __future__ import annotations
@@ -17,8 +29,14 @@ from .backend import LocalBackend, default_backend
 
 INPUT_STREAM = "tensor_stream"
 
+#: wire-format version stamped into v2 records; detection keys off the
+#: ``dtype``/``shape`` fields (a v1 record has neither), the ``v`` field
+#: is there for humans reading a stream dump and for future versions
+WIRE_VERSION = "2"
+
 __all__ = ["InputQueue", "OutputQueue", "ServingError", "encode_array",
-           "decode_array", "new_trace_id"]
+           "decode_array", "encode_tensor", "decode_payload", "is_v2",
+           "validate_v2", "new_trace_id", "WIRE_VERSION"]
 
 
 class ServingError(RuntimeError):
@@ -26,15 +44,102 @@ class ServingError(RuntimeError):
     undecodable request payload)."""
 
 
+# ---------------------------------------------------------------------------
+# v1 codec (legacy): base64-wrapped .npy string
+# ---------------------------------------------------------------------------
+
 def encode_array(arr: np.ndarray) -> str:
     buf = io.BytesIO()
     np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
     return base64.b64encode(buf.getvalue()).decode("ascii")
 
 
-def decode_array(payload: str) -> np.ndarray:
+def decode_array(payload) -> np.ndarray:
+    # b64decode accepts str or bytes — a binary-safe backend hands the
+    # legacy field back as bytes, a text transport as str
     return np.load(io.BytesIO(base64.b64decode(payload)),
                    allow_pickle=False)
+
+
+# ---------------------------------------------------------------------------
+# v2 codec: raw little-endian bytes + dtype/shape fields
+# ---------------------------------------------------------------------------
+
+def encode_tensor(arr: np.ndarray, key: str = "data") -> Dict[str, object]:
+    """Wire-format v2 fields for one tensor: ``{key: <raw bytes>,
+    "dtype": "<f4", "shape": "3,224,224", "v": "2"}``.
+
+    Bytes are C-contiguous little-endian (big-endian inputs are byte-
+    swapped once here so the decode side is always a straight view);
+    ``dtype`` is the numpy dtype spec string, ``shape`` comma-joined.
+    ``key`` selects the payload field name — ``data`` on the request
+    stream, ``value`` on result hashes."""
+    a = np.asarray(arr)
+    if not a.flags.c_contiguous:
+        # ascontiguousarray unconditionally would also promote 0-d
+        # arrays to 1-d and lose the scalar shape on the wire
+        a = np.ascontiguousarray(a)
+    if a.dtype.hasobject:
+        raise ValueError(
+            f"cannot encode dtype {a.dtype} — object arrays have no raw "
+            f"byte representation (and never decoded under v1 either: "
+            f"np.save(allow_pickle=False) rejects them)")
+    if a.dtype.byteorder == ">":
+        a = a.astype(a.dtype.newbyteorder("<"))
+    return {key: a.tobytes(), "dtype": a.dtype.str,
+            "shape": ",".join(str(d) for d in a.shape), "v": WIRE_VERSION}
+
+
+def is_v2(fields: Dict) -> bool:
+    """True when a stream/result record carries the v2 header fields."""
+    return "dtype" in fields and "shape" in fields
+
+
+def parse_v2_header(fields: Dict):
+    """``(np.dtype, shape_tuple)`` from a v2 record's header fields.
+    Raises on malformed specs."""
+    dt = np.dtype(str(fields["dtype"]))
+    shape = tuple(int(s) for s in str(fields["shape"]).split(",") if s)
+    return dt, shape
+
+
+def validate_v2(fields: Dict, key: str = "data"):
+    """Fully validate a v2 record WITHOUT touching the payload bytes:
+    ``(payload_bytes, np.dtype, shape)``. Parses the header, normalizes a
+    text-transport payload, and rejects dtypes with no raw byte
+    representation (object, zero-itemsize flexible types) and
+    header/byte-length mismatches — after this, ``np.frombuffer`` cannot
+    fail. The ONE definition of what the wire accepts: both
+    :func:`decode_payload` and the server's cheap pre-copy check use it,
+    so the accept rule cannot diverge between client and server."""
+    dt, shape = parse_v2_header(fields)
+    if dt.hasobject or dt.itemsize == 0:
+        raise ValueError(
+            f"v2 dtype {dt.str} has no raw byte representation")
+    payload = fields[key]
+    if isinstance(payload, str):
+        # a text-only transport: latin-1 is the lossless byte<->str map
+        payload = payload.encode("latin-1")
+    expect = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+    if len(payload) != expect:
+        raise ValueError(
+            f"v2 payload is {len(payload)} bytes but dtype={dt.str} "
+            f"shape={shape} needs {expect}")
+    return payload, dt, shape
+
+
+def decode_payload(fields: Dict, key: str = "data") -> np.ndarray:
+    """Decode one record's tensor payload, v2 or v1.
+
+    v2 (``dtype``/``shape`` fields present) returns a ZERO-COPY read-only
+    ``np.frombuffer`` view over the wire bytes; v1 falls back to the
+    base64 ``.npy`` decode. Raises on malformed payloads (bad base64,
+    unrepresentable dtype, header/byte-length mismatch) — the server
+    converts that into an addressable error record."""
+    if is_v2(fields):
+        payload, dt, shape = validate_v2(fields, key)
+        return np.frombuffer(payload, dtype=dt).reshape(shape)
+    return decode_array(fields[key])
 
 
 class InputQueue:
@@ -50,27 +155,29 @@ class InputQueue:
 
     def enqueue(self, uri: str, data: np.ndarray,
                 trace: Optional[str] = None) -> str:
-        """Enqueue one record. Every record is stamped with a Dapper-style
-        ``trace`` id (16 hex chars; pass ``trace=`` to adopt a caller's
-        id, e.g. an upstream request id) — the serve loop carries it
-        through batch assembly, dispatch, and publish, emitting
-        per-request phase events under that id so the JSON event log
-        holds each request's exact latency breakdown. Records enqueued by
-        foreign producers without the field still serve; they just have
-        no trace."""
+        """Enqueue one record (wire-format v2: raw bytes + dtype/shape).
+        Every record is stamped with a Dapper-style ``trace`` id (16 hex
+        chars; pass ``trace=`` to adopt a caller's id, e.g. an upstream
+        request id) — the serve loop carries it through batch assembly,
+        dispatch, and publish, emitting per-request phase events under
+        that id so the JSON event log holds each request's exact latency
+        breakdown. Records enqueued by foreign producers without the
+        field still serve; they just have no trace."""
+        fields = encode_tensor(np.asarray(data))
+        fields["uri"] = uri
         # falsy trace ("" from an unset upstream header) mints too —
         # stamping "" would merge unrelated requests into one bogus trace
-        return self.backend.xadd(
-            self.stream, {"uri": uri, "data": encode_array(np.asarray(data)),
-                          "trace": trace or new_trace_id()},
-            timeout=self.timeout)
+        fields["trace"] = trace or new_trace_id()
+        return self.backend.xadd(self.stream, fields, timeout=self.timeout)
 
 
 class OutputQueue:
     """Consumer side: ``query(uri)`` one result (raises ``ServingError`` if
     the server recorded a failure for that uri), ``dequeue()`` everything
     successful (failures land in ``last_errors``, they never crash the
-    drain or lose other clients' results)."""
+    drain or lose other clients' results). Results decode via
+    :func:`decode_payload` — v2 values come back as zero-copy read-only
+    views over the result bytes; copy before mutating in place."""
 
     def __init__(self, backend: Optional[LocalBackend] = None):
         self.backend = backend if backend is not None else default_backend()
@@ -83,14 +190,14 @@ class OutputQueue:
             return None
         if "value" not in res:
             raise ServingError(f"{uri}: {res.get('error', 'unknown error')}")
-        return decode_array(res["value"])
+        return decode_payload(res, "value")
 
     def dequeue(self) -> Dict[str, np.ndarray]:
         out: Dict[str, np.ndarray] = {}
         self.last_errors = {}
         for uri, res in self.backend.pop_all_results().items():
             if "value" in res:
-                out[uri] = decode_array(res["value"])
+                out[uri] = decode_payload(res, "value")
             else:
                 self.last_errors[uri] = res.get("error", "unknown error")
         return out

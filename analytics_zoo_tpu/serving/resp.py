@@ -21,11 +21,26 @@ import socket
 import threading
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["RespClient", "RespError"]
+__all__ = ["RespClient", "RespError", "RespPipeline"]
 
 
 class RespError(RuntimeError):
     """Server returned an error reply (``-ERR ...``)."""
+
+
+def _frame(parts) -> bytes:
+    """One RESP command frame: an array of bulk strings. Values may be
+    str (utf-8 encoded), int/float (decimal text), or bytes (sent raw —
+    RESP bulk strings are length-prefixed, so binary payloads like the
+    v2 tensor bytes pass untouched)."""
+    out = [b"*%d\r\n" % len(parts)]
+    for p in parts:
+        if isinstance(p, str):
+            p = p.encode()
+        elif isinstance(p, (int, float)):
+            p = str(p).encode()
+        out.append(b"$%d\r\n%s\r\n" % (len(p), p))
+    return b"".join(out)
 
 
 class _Conn:
@@ -36,14 +51,7 @@ class _Conn:
         self.buf = b""
 
     def send(self, *parts) -> None:
-        out = [b"*%d\r\n" % len(parts)]
-        for p in parts:
-            if isinstance(p, str):
-                p = p.encode()
-            elif isinstance(p, (int, float)):
-                p = str(p).encode()
-            out.append(b"$%d\r\n%s\r\n" % (len(p), p))
-        self.sock.sendall(b"".join(out))
+        self.sock.sendall(_frame(parts))
 
     def _read_line(self) -> bytes:
         while b"\r\n" not in self.buf:
@@ -136,6 +144,44 @@ class RespClient:
         self._release(c)
         return reply
 
+    def execute_many(self, commands):
+        """Pipelined execution: write every command frame in ONE socket
+        send, then read the replies back in order — one network round
+        trip for the whole batch (how the async publisher lands a
+        batch's result hashes). An error REPLY keeps the stream in sync
+        (remaining replies are still read, the first error raises after
+        the pass); a transport error discards the connection like
+        :meth:`command` does."""
+        commands = list(commands)
+        if not commands:
+            return []
+        c = self._acquire()
+        replies, first_err = [], None
+        try:
+            c.sock.sendall(b"".join(_frame(parts) for parts in commands))
+            for _ in commands:
+                try:
+                    replies.append(c.read_reply())
+                except RespError as e:
+                    replies.append(e)
+                    if first_err is None:
+                        first_err = e
+        except Exception:
+            # timeout / partial read / connection loss mid-batch: the
+            # socket may hold late replies that would answer the NEXT
+            # command — discard it, never return it to the pool
+            c.close()
+            raise
+        self._release(c)
+        if first_err is not None:
+            raise first_err
+        return replies
+
+    def pipeline(self) -> "RespPipeline":
+        """A command buffer matching the slice of redis-py's pipeline
+        surface ``RedisBackend`` uses (``hset`` + ``execute``)."""
+        return RespPipeline(self)
+
     # -- the redis-py surface RedisBackend uses ------------------------------
     def ping(self) -> bool:
         return self.command("PING") in (b"PONG", "PONG")
@@ -187,3 +233,24 @@ class RespClient:
 
     def keys(self, pattern: str) -> List[bytes]:
         return self.command("KEYS", pattern) or []
+
+
+class RespPipeline:
+    """Buffered commands flushed through :meth:`RespClient.execute_many`
+    in one round trip. Only the commands ``RedisBackend.set_results``
+    queues are implemented; extend as the backend grows."""
+
+    def __init__(self, client: RespClient):
+        self._client = client
+        self._commands: List[tuple] = []
+
+    def hset(self, key: str, mapping: Dict) -> "RespPipeline":
+        args: List = ["HSET", key]
+        for k, v in mapping.items():
+            args += [k, v]
+        self._commands.append(tuple(args))
+        return self
+
+    def execute(self) -> List:
+        commands, self._commands = self._commands, []
+        return self._client.execute_many(commands)

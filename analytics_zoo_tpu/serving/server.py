@@ -10,21 +10,43 @@
   recompile per unique size),
 * backpressure comes from the bounded stream (``LocalBackend.xadd`` blocks),
   replacing the reference's Redis-memory watermark polling.
+
+The host path is pipelined three ways (the Clipper / TF-Serving lineage:
+codec and publish work stay off the dispatch critical path):
+
+* **batch arena assembly** — wire-format v2 records (raw little-endian
+  bytes + dtype/shape header) are validated cheaply, then a small decode
+  worker pool memcpys each record straight into a row of a preallocated,
+  pooled batch buffer: no per-record array allocation, no ``np.stack``
+  copy. Legacy v1 (base64 ``.npy``) records fall back to a decode-then-
+  stack path.
+* **dispatch window** — up to ``max_inflight`` batches are dispatched
+  with readback deferred (``predict_async``), so a batch's device time +
+  round trip overlaps the next batch's read+decode. Default 2 preserves
+  the previous two-deep pipeline's memory bound; the permit-deadlock
+  handling (flush-oldest before a blocking dispatch) is unchanged.
+* **async publisher** — a dedicated thread with a bounded queue performs
+  result encode + backend writes (batched via ``set_results``) plus the
+  publish-side bookkeeping, so the serve loop never blocks on per-record
+  encode or result-store round trips.
 """
 
 from __future__ import annotations
 
 import collections
 import logging
+import queue
 import threading
 import time
-from typing import Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..observability import default_registry, span
 from .backend import LocalBackend, default_backend
-from .client import INPUT_STREAM, decode_array, encode_array
+from .client import (INPUT_STREAM, decode_payload, encode_array,
+                     encode_tensor, is_v2, validate_v2)
 
 log = logging.getLogger("analytics_zoo_tpu.serving")
 
@@ -35,8 +57,56 @@ __all__ = ["ClusterServing"]
 #: is WALL epoch seconds (parsed from the ``<epoch_ms>-<seq>`` entry id,
 #: the only clock the producer and server share); ``t_deq`` is this
 #: process's ``perf_counter`` at read time (monotonic — server-side phase
-#: durations must not jump on an NTP step).
-_Rec = collections.namedtuple("_Rec", ("uri", "trace", "t_enq", "t_deq"))
+#: durations must not jump on an NTP step). ``v2`` records the request's
+#: wire version so the publisher answers in the same format.
+_Rec = collections.namedtuple("_Rec", ("uri", "trace", "t_enq", "t_deq",
+                                       "v2"))
+
+#: a dispatched batch whose readback is deferred: ``collect`` blocks on
+#: the device transfer, ``arena`` (may be None) returns to the pool after
+#: readback proves the device consumed the input buffer.
+_Pending = collections.namedtuple("_Pending", ("recs", "collect", "t0",
+                                               "arena"))
+
+#: one read-time candidate: the record, its raw fields, its queue wait,
+#: and — for a validated v2 record — the (payload, dtype, shape) header.
+_Item = collections.namedtuple("_Item", ("rec", "fields", "wait", "hdr"))
+
+_PUB_STOP = object()    # publisher-queue sentinel: drain, then exit
+
+
+class _ArenaPool:
+    """Reusable preallocated batch buffers keyed by (shape, dtype).
+
+    Decode workers write each record's tensor straight into its row, so
+    batch assembly costs one memcpy per record — no per-record array
+    allocation, no ``np.stack`` copy. A buffer stays checked out for the
+    whole dispatch (the device upload reads from it) and is returned by
+    the flush after readback; at most ``cap`` free buffers per key are
+    kept so a payload-shape change cannot strand unbounded memory."""
+
+    def __init__(self, batch_size: int, cap: int = 4):
+        self.batch_size = int(batch_size)
+        self.cap = int(cap)
+        self._free: Dict[Tuple, List[np.ndarray]] = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, shape: Tuple[int, ...], dtype) -> np.ndarray:
+        key = (tuple(shape), np.dtype(dtype).str)
+        with self._lock:
+            free = self._free.get(key)
+            if free:
+                return free.pop()
+        return np.empty((self.batch_size,) + tuple(shape), np.dtype(dtype))
+
+    def release(self, arena: Optional[np.ndarray]) -> None:
+        if arena is None:
+            return
+        key = (arena.shape[1:], arena.dtype.str)
+        with self._lock:
+            free = self._free.setdefault(key, [])
+            if len(free) < self.cap:
+                free.append(arena)
 
 
 class ClusterServing:
@@ -44,9 +114,10 @@ class ClusterServing:
 
     Observability (``docs/guides/OBSERVABILITY.md``): every batch updates
     the ``zoo_serving_*`` metrics in ``registry`` (default: the
-    process-wide one) — records/batches/error counters, stream-depth
-    gauge, batch-size, queue-wait and dispatch→publish latency histograms
-    plus p50/p95/p99 quantile summaries (queue-wait, dispatch, and
+    process-wide one) — records/batches/error counters, stream-depth and
+    publish-backlog gauges, batch-size, queue-wait, codec
+    (decode/encode) and dispatch→publish latency histograms plus
+    p50/p95/p99 quantile summaries (queue-wait, dispatch, and
     end-to-end) — scrapeable via :meth:`serve_metrics`, which also mounts
     ``/healthz`` and ``/statusz``; :meth:`set_json_events` additionally
     logs one structured JSON event per flush/error and, for every record
@@ -55,12 +126,27 @@ class ClusterServing:
 
     def __init__(self, model, backend: Optional[LocalBackend] = None,
                  batch_size: int = 32, stream: str = INPUT_STREAM,
-                 block_ms: int = 50, registry=None):
+                 block_ms: int = 50, registry=None, decode_workers: int = 2,
+                 max_inflight: int = 2, publish_queue: int = 8):
         self.model = model          # InferenceModel (or any .predict(x))
         self.backend = backend if backend is not None else default_backend()
         self.batch_size = int(batch_size)
         self.stream = stream
         self.block_ms = int(block_ms)
+        #: decode worker threads for batch assembly (0 = decode inline on
+        #: the serve loop); v1 base64+.npy decodes and large-arena memcpys
+        #: release the GIL, so a small pool overlaps them
+        self.decode_workers = max(int(decode_workers), 0)
+        #: dispatched-but-unpublished batch window; 2 = the previous
+        #: two-deep pipeline's memory bound (one in flight, one being
+        #: assembled)
+        self.max_inflight = max(int(max_inflight), 1)
+        self._arena_pool = _ArenaPool(self.batch_size,
+                                      cap=self.max_inflight + 2)
+        self._pub_maxsize = max(int(publish_queue), 1)
+        self._pub_queue: Optional["queue.Queue"] = None
+        self._pub_thread: Optional[threading.Thread] = None
+        self._pool: Optional[ThreadPoolExecutor] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.served = 0             # this server's records (tests/ops; the
@@ -82,11 +168,23 @@ class ClusterServing:
             "records answered with an inference-failure error")
         self._m_depth = m.gauge(
             "zoo_serving_stream_depth", "input-stream backlog after a read")
+        self._m_backlog = m.gauge(
+            "zoo_serving_publish_backlog",
+            "batches queued for the async publisher (encode + result "
+            "writes pending)")
         self._m_batch_size = m.histogram(
             "zoo_serving_batch_size", "records per published batch")
         self._m_queue_wait = m.histogram(
             "zoo_serving_queue_wait_seconds",
             "enqueue to read-off-the-stream wait per record")
+        self._m_decode = m.histogram(
+            "zoo_serving_decode_seconds",
+            "payload decode + batch assembly wall time per read "
+            "(across all decode workers)")
+        self._m_encode = m.histogram(
+            "zoo_serving_encode_seconds",
+            "result encode wall time per published batch (publisher "
+            "thread)")
         self._m_dispatch = m.histogram(
             "zoo_serving_dispatch_seconds",
             "dispatch to publish latency per batch")
@@ -117,7 +215,7 @@ class ClusterServing:
         (the reference's throughput-to-TensorBoard path,
         ``ClusterServing.scala:291-317`` + ``InferenceSummary.scala``).
         Call before ``start()`` — swapping the writer under a running
-        serve loop would race ``_flush`` on the closed file handle."""
+        publisher would race its bookkeeping on the closed file handle."""
         import os
         from ..utils.tensorboard import EventFileWriter
         if self._thread is not None:    # mirrors start()'s double-start guard
@@ -171,6 +269,7 @@ class ClusterServing:
         age = (None if self._last_flush_wall is None
                else max(time.time() - self._last_flush_wall, 0.0))
         thread = self._thread
+        pub = self._pub_queue
         return {"serving": {
             # is_alive, not a None check: a serve loop killed by an
             # escaped exception must read as down — a liveness endpoint
@@ -179,6 +278,7 @@ class ClusterServing:
             "stream_depth": self.backend.stream_len(self.stream),
             "served": self.served,
             "batches": self._batches,
+            "publish_backlog": 0 if pub is None else pub.qsize(),
             "last_flush_age_s": age,
         }}
 
@@ -188,14 +288,26 @@ class ClusterServing:
             raise RuntimeError("serving already started")
         self._stop.clear()
         self._t_last_flush = None   # a restart must not span the downtime
+        if self.decode_workers > 0:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.decode_workers,
+                thread_name_prefix="serving-decode")
+        self._pub_queue = queue.Queue(maxsize=self._pub_maxsize)
+        self._pub_thread = threading.Thread(
+            target=self._publisher_loop, daemon=True,
+            name="cluster-serving-publish")
+        self._pub_thread.start()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="cluster-serving")
         self._thread.start()
         return self
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
-        """Stop the loop; with ``drain`` first wait for the stream to empty."""
+        """Stop the loop; with ``drain`` first wait for the stream to
+        empty. The publisher always drains: every batch the serve loop
+        handed it is published before the sinks close."""
         if self._thread is None:
+            self._shutdown_workers(timeout)
             self._close_sinks()
             return
         if drain:
@@ -212,7 +324,26 @@ class ClusterServing:
                 f"serve loop still running after {timeout}s (model dispatch "
                 f"in flight?); call stop() again to re-join")
         self._thread = None
+        self._shutdown_workers(timeout)
         self._close_sinks()
+
+    def _shutdown_workers(self, timeout: float = 30.0) -> None:
+        """Join the publisher (after a drain-everything sentinel) and the
+        decode pool. Safe to call when neither was started."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        t, q = self._pub_thread, self._pub_queue
+        if t is None:
+            return
+        q.put(_PUB_STOP)
+        t.join(timeout=timeout)
+        if t.is_alive():
+            raise TimeoutError(
+                f"publisher still draining after {timeout}s (result "
+                f"backend stalled?); call stop() again to re-join")
+        self._pub_thread = None
+        self._pub_queue = None
 
     def _close_sinks(self) -> None:
         if self._summary is not None:
@@ -228,21 +359,20 @@ class ClusterServing:
 
     # -- the loop -----------------------------------------------------------
     def _loop(self) -> None:
-        """Two-deep software pipeline: batch N's device time + dispatch
-        round-trip runs while batch N+1 is read and decoded on the host
-        (``predict_async`` enqueues the XLA work and defers only the
-        readback). On a tunneled/remote device the round-trip dominates
-        the batch budget, so overlapping it with host work roughly
-        doubles sustainable throughput; one batch in flight + one being
-        assembled keeps the memory bound."""
-        pending = None   # (recs, collect, t0) — dispatched, readback deferred
+        """The dispatch pipeline: up to ``max_inflight`` batches run their
+        device time + dispatch round-trip while the next batch is read
+        and decoded on the host (``predict_async`` enqueues the XLA work
+        and defers only the readback). On a tunneled/remote device the
+        round-trip dominates the batch budget, so overlapping it with
+        host work roughly doubles sustainable throughput; the window
+        bounds how many batches can be in flight (memory bound)."""
+        pendings: "collections.deque[_Pending]" = collections.deque()
         try:
             while not self._stop.is_set():
                 entries = self.backend.xread(self.stream, self.batch_size,
                                              block_ms=self.block_ms)
                 if not entries:
-                    if pending is not None:
-                        pending = self._flush(pending)
+                    self._drain(pendings)
                     continue
                 # ONE stream_len per read feeds both the gauge and the
                 # drain checks below — we are the only consumer, so the
@@ -250,85 +380,180 @@ class ClusterServing:
                 # (a stale 0 errs toward flushing, never toward parking)
                 depth = self.backend.stream_len(self.stream)
                 self._m_depth.set(depth)
-                now_s = time.time()
-                now_p = time.perf_counter()
-                recs, tensors = [], []
-                for eid, fields in entries:
-                    wait, t_enq = self._observe_queue_wait(eid, now_s)
-                    try:
-                        # uri first: a decodable payload with a missing
-                        # uri must not leave an orphan tensor that would
-                        # misalign every later uri with the wrong
-                        # prediction
-                        uri = fields["uri"]
-                        arr = decode_array(fields["data"])
-                    except Exception:
-                        # write an addressable error so the producer's
-                        # query() fails fast instead of blocking out its
-                        # full timeout
-                        log.exception("undecodable record (uri=%r)",
-                                      fields.get("uri"))
-                        self._m_undecodable.inc()
-                        self.metrics.emit("serving.undecodable",
-                                          uri=fields.get("uri"),
-                                          trace=fields.get("trace"))
-                        if fields.get("uri"):
-                            self.backend.set_result(
-                                fields["uri"],
-                                {"error": "undecodable payload"})
-                        continue
-                    rec = _Rec(uri, fields.get("trace"), t_enq, now_p)
-                    if rec.trace is not None:
-                        # the request's first two phase events; later
-                        # phases (dispatch, publish) link back via the
-                        # trace id + parent-phase field
-                        self.metrics.emit("request", phase="enqueue",
-                                          trace=rec.trace, uri=uri,
-                                          parent=None, at_s=t_enq)
-                        self.metrics.emit("request", phase="dequeue",
-                                          trace=rec.trace, uri=uri,
-                                          parent="enqueue", dur_s=wait)
-                    recs.append(rec)
-                    tensors.append(arr)
-                if not recs:
+                recs, batch, arena, ragged = self._assemble(entries)
+                if not recs and not ragged:
                     # every record in this read was undecodable: the same
                     # drain signal applies — an empty stream means no next
-                    # batch will arrive to trigger the pending readback,
-                    # so it would otherwise park for up to block_ms
-                    if pending is not None and depth == 0:
-                        pending = self._flush(pending)
+                    # batch will arrive to trigger the pending readbacks,
+                    # so they would otherwise park for up to block_ms
+                    if pendings and depth == 0:
+                        self._drain(pendings)
                     continue
-                try:
-                    batch = np.stack(tensors)
-                except ValueError:
+                if ragged:
                     # ragged shapes can't batch: drain the pipeline, then
                     # serve one by one (rare path, keep it simple)
-                    if pending is not None:
-                        pending = self._flush(pending)
-                    for rec, t in zip(recs, tensors):
-                        nxt, _ = self._dispatch([rec], t[None])
-                        if nxt is not None:
-                            self._flush(nxt)
-                    continue
-                nxt, pending = self._dispatch(recs, batch, pending)
-                if pending is not None:
-                    pending = self._flush(pending)
-                if nxt is not None and depth == 0:
-                    # nothing left queued: the stream is drained and there
-                    # is no next batch to overlap with, so deferring this
-                    # readback would only add up to block_ms of tail
-                    # latency under trickle load (ADVICE round 5). The
-                    # queue length is the drain signal — an under-full
-                    # read is not (xread returns on FIRST delivery, so
-                    # under sustained single-record load more work is
-                    # usually queued already and flushing would serialize
-                    # the two-deep pipeline), and a final exactly-full
-                    # batch with an empty queue must flush too
-                    nxt = self._flush(nxt)
-                pending = nxt
+                    self._drain(pendings)
+                    for rec, tensor in ragged:
+                        self._dispatch([rec], tensor[None], pendings)
+                        self._drain(pendings)
+                if recs:
+                    self._dispatch(recs, batch, pendings, arena)
+                    while len(pendings) >= self.max_inflight:
+                        # the dispatch window: publish the oldest batch
+                        # once max_inflight are dispatched-but-unread
+                        self._flush(pendings.popleft())
+                    if pendings and depth == 0:
+                        # nothing left queued: the stream is drained and
+                        # there is no next batch to overlap with, so
+                        # deferring these readbacks would only add up to
+                        # block_ms of tail latency under trickle load
+                        # (ADVICE round 5). The queue length is the drain
+                        # signal — an under-full read is not (xread
+                        # returns on FIRST delivery, so under sustained
+                        # single-record load more work is usually queued
+                        # already and flushing would serialize the
+                        # pipeline), and a final exactly-full batch with
+                        # an empty queue must flush too
+                        self._drain(pendings)
         finally:
-            if pending is not None:
-                self._flush(pending)
+            self._drain(pendings)
+
+    def _drain(self, pendings) -> None:
+        """Flush every pending batch, oldest first."""
+        while pendings:
+            self._flush(pendings.popleft())
+
+    # -- batch assembly ------------------------------------------------------
+    def _assemble(self, entries):
+        """Decode one read into ``(recs, batch, arena, ragged)``.
+
+        Fast path (every record wire-format v2 with one (shape, dtype)):
+        headers are validated inline — cheap string parses and a byte-
+        length check, so nothing can fail mid-copy — then the decode
+        workers memcpy each payload into its row of a pooled arena;
+        ``batch`` is a view of the filled rows. Fallback (any v1 record
+        or mixed shapes): decode every payload to an array (worker pool
+        for the base64+.npy work) and ``np.stack``; shape misfits come
+        back in ``ragged`` for one-by-one serving. Undecodable records
+        are dropped here with an addressable error record, BEFORE their
+        enqueue/dequeue trace events are emitted — a dropped record
+        leaves no dangling trace."""
+        now_s = time.time()
+        now_p = time.perf_counter()
+        items: List[_Item] = []
+        for eid, fields in entries:
+            wait, t_enq = self._observe_queue_wait(eid, now_s)
+            uri = fields.get("uri")
+            if not uri:
+                # a decodable payload with a missing uri must be dropped
+                # whole — an orphan tensor would misalign every later
+                # uri with the wrong prediction, and there is no address
+                # to write an error record to
+                log.error("record with no uri dropped (entry id %s)", eid)
+                self._drop_undecodable(fields)
+                continue
+            hdr = None
+            if is_v2(fields):
+                try:
+                    # the shared accept rule (client.validate_v2): after
+                    # it passes, the row copy is a pure memcpy that
+                    # cannot fail — nothing can kill the serve loop
+                    # mid-arena
+                    hdr = validate_v2(fields)
+                except Exception:
+                    log.exception("undecodable record (uri=%r)", uri)
+                    self._drop_undecodable(fields)
+                    continue
+            items.append(_Item(
+                _Rec(uri, fields.get("trace"), t_enq, now_p,
+                     hdr is not None), fields, wait, hdr))
+        recs: List[_Rec] = []
+        batch = arena = None
+        ragged: List[Tuple[_Rec, np.ndarray]] = []
+        if items and all(i.hdr is not None for i in items) and len(
+                {(i.hdr[2], i.hdr[1].str) for i in items}) == 1:
+            _, dt, shape = items[0].hdr
+            arena = self._arena_pool.acquire(shape, dt)
+            self._copy_rows(arena, [i.hdr for i in items])
+            recs = [i.rec for i in items]
+            batch = arena[:len(recs)]
+            self._emit_read_events(items)
+        elif items:
+            decoded = self._decode_all(items)
+            good = [(i, a) for i, a in zip(items, decoded) if a is not None]
+            if good:
+                self._emit_read_events([i for i, _ in good])
+                try:
+                    batch = np.stack([a for _, a in good])
+                    recs = [i.rec for i, _ in good]
+                except ValueError:
+                    ragged = [(i.rec, a) for i, a in good]
+        self._m_decode.observe(time.perf_counter() - now_p)
+        return recs, batch, arena, ragged
+
+    def _copy_rows(self, arena: np.ndarray, hdrs) -> None:
+        """Memcpy each validated v2 payload into its arena row, split
+        across the decode workers in contiguous slices (numpy releases
+        the GIL for the copies)."""
+        def copy_slice(lo: int, hi: int) -> None:
+            for row in range(lo, hi):
+                payload, dt, shape = hdrs[row]
+                arena[row] = np.frombuffer(payload, dtype=dt).reshape(shape)
+
+        k = len(hdrs)
+        if self._pool is not None and self.decode_workers > 1 \
+                and k >= 2 * self.decode_workers:
+            step = -(-k // self.decode_workers)
+            futs = [self._pool.submit(copy_slice, lo, min(lo + step, k))
+                    for lo in range(0, k, step)]
+            for f in futs:
+                f.result()
+        else:
+            copy_slice(0, k)
+
+    def _decode_all(self, items):
+        """Legacy/mixed path: decode every payload to its own array, in
+        parallel on the worker pool (the base64 + ``.npy`` work releases
+        the GIL). Failures are dropped with an addressable error record
+        and come back as None."""
+        def one(item: _Item):
+            try:
+                if item.hdr is not None:   # v2: already validated, no re-parse
+                    payload, dt, shape = item.hdr
+                    return np.frombuffer(payload, dtype=dt).reshape(shape)
+                return decode_payload(item.fields)
+            except Exception:
+                log.exception("undecodable record (uri=%r)", item.rec.uri)
+                self._drop_undecodable(item.fields)
+                return None
+
+        if self._pool is not None and len(items) > 1:
+            return list(self._pool.map(one, items))
+        return [one(i) for i in items]
+
+    def _drop_undecodable(self, fields) -> None:
+        """Registry + event + (when addressable) an error record so the
+        producer's ``query()`` fails fast instead of blocking out its
+        full timeout."""
+        self._m_undecodable.inc()
+        self.metrics.emit("serving.undecodable", uri=fields.get("uri"),
+                          trace=fields.get("trace"))
+        if fields.get("uri"):
+            self.backend.set_result(fields["uri"],
+                                    {"error": "undecodable payload"})
+
+    def _emit_read_events(self, items) -> None:
+        """The first two phase events per traced record; later phases
+        (dispatch, publish) link back via the trace id + parent field."""
+        for item in items:
+            rec = item.rec
+            if rec.trace is not None:
+                self.metrics.emit("request", phase="enqueue",
+                                  trace=rec.trace, uri=rec.uri,
+                                  parent=None, at_s=rec.t_enq)
+                self.metrics.emit("request", phase="dequeue",
+                                  trace=rec.trace, uri=rec.uri,
+                                  parent="enqueue", dur_s=item.wait)
 
     def _observe_queue_wait(self, entry_id, now_s: float):
         """Enqueue→read wait from the stream entry id (both backends stamp
@@ -351,52 +576,66 @@ class ClusterServing:
         self._q_queue_wait.observe(wait)
         return wait, t_enq
 
-    def _dispatch(self, recs, batch, pending=None):
-        """Enqueue the device work; ((recs, collect, t0), leftover_pending).
-        Tries a NON-blocking async dispatch first: with a single replica
-        permit (``concurrent_num=1``) dispatching before collecting our
-        own pending batch would deadlock, so on a busy model the pending
-        batch is flushed (releasing its permit) and the dispatch retried
-        blocking. Models without predict_async (the server accepts any
-        ``.predict``) compute synchronously — there is nothing to overlap,
-        so the pending batch is flushed BEFORE the blocking predict and
-        this batch publishes immediately (deferring either one would only
-        add latency). Returns (None, pending) when the dispatch failed."""
+    # -- dispatch ------------------------------------------------------------
+    def _dispatch(self, recs, batch, pendings, arena=None) -> None:
+        """Enqueue the device work; appends a ``_Pending`` to ``pendings``
+        (async models) or publishes immediately (sync models). Tries a
+        NON-blocking async dispatch first: with a single replica permit
+        (``concurrent_num=1``) dispatching before collecting our own
+        pending batches would deadlock, so on a busy model pending
+        batches are flushed oldest-first (releasing their permits) and
+        the dispatch retried, blocking only once the window is empty.
+        Models without predict_async (the server accepts any
+        ``.predict``) compute synchronously — there is nothing to
+        overlap, so the window is drained BEFORE the blocking predict
+        and this batch publishes immediately (deferring either would
+        only add latency)."""
         t0 = time.perf_counter()
+        arena_owned = True
         try:
-            # spans cover the MODEL calls only — flushing the previous
-            # batch has its own serving.flush span and must not inflate
-            # this batch's dispatch latency; a REFUSED non-blocking probe
-            # is discarded so its ~zero duration doesn't halve the
-            # apparent dispatch time
             async_fn = getattr(self.model, "predict_async", None)
             if async_fn is not None:
-                with span("serving.dispatch", registry=self.metrics,
-                          records=len(recs)) as sp:
-                    collect = async_fn(batch, block=False)
-                    if collect is None:
-                        sp.discard()
-                if collect is None:      # all replica permits in flight
-                    if pending is not None:
-                        pending = self._flush(pending)
+                collect = self._probe_dispatch(async_fn, batch, len(recs))
+                while collect is None and pendings:
+                    # all replica permits in flight: publish the oldest
+                    # pending batch (releasing its permit) and retry
+                    self._flush(pendings.popleft())
+                    collect = self._probe_dispatch(async_fn, batch,
+                                                   len(recs))
+                if collect is None:
                     with span("serving.dispatch", registry=self.metrics,
                               records=len(recs)):
                         collect = async_fn(batch)
                 self._emit_dispatch(recs, t0)
-                return (recs, collect, t0), pending
-            if pending is not None:
-                pending = self._flush(pending)
+                arena_owned = False
+                pendings.append(_Pending(recs, collect, t0, arena))
+                return
+            self._drain(pendings)
             with span("serving.dispatch", registry=self.metrics,
                       records=len(recs)):
                 preds = self.model.predict(batch)
             self._emit_dispatch(recs, t0)
-            self._flush((recs, (lambda: preds), t0))
-            return None, pending
+            arena_owned = False
+            self._flush(_Pending(recs, (lambda: preds), t0, arena))
         except Exception:
             log.exception("inference dispatch failed for %d records; "
                           "writing errors", len(recs))
             self._record_failure(recs, parent="dequeue")
-            return None, pending
+            if arena_owned:
+                self._arena_pool.release(arena)
+
+    def _probe_dispatch(self, async_fn, batch, n: int):
+        """Non-blocking dispatch probe. Spans cover the MODEL calls only —
+        flushing a previous batch has its own serving.flush span and must
+        not inflate this batch's dispatch latency; a REFUSED probe is
+        discarded so its ~zero duration doesn't halve the apparent
+        dispatch time."""
+        with span("serving.dispatch", registry=self.metrics,
+                  records=n) as sp:
+            collect = async_fn(batch, block=False)
+            if collect is None:
+                sp.discard()
+        return collect
 
     def _emit_dispatch(self, recs, t0: float) -> None:
         """Per-request dispatch phase events: ``dur_s`` is the batch
@@ -425,27 +664,84 @@ class ClusterServing:
                                   uri=rec.uri, parent=parent)
             self.backend.set_result(rec.uri, {"error": "inference failed"})
 
-    def _flush(self, pending) -> None:
-        """Block on a dispatched batch's readback and publish its results.
-        Returns None so callers can overwrite their pending slot.
-
-        Bookkeeping is registry-backed: counters (records/batches),
-        batch-size and dispatch→publish latency histograms, plus one
-        ``serving.flush`` JSON event when a sink is attached. The
-        TensorBoard scalars derive from the same measurements."""
-        recs, collect, t0 = pending
+    # -- readback + publish --------------------------------------------------
+    def _flush(self, pending: _Pending) -> None:
+        """Block on a dispatched batch's readback, then hand the results
+        to the async publisher — encode + result-store writes + publish
+        bookkeeping happen off the serve loop's critical path. The batch
+        arena returns to the pool here: after readback the device has
+        fully consumed the input buffer. The publisher queue is bounded,
+        so a stalled result backend backpressures the loop instead of
+        buffering unboundedly."""
+        recs, collect, t0, arena = pending
         try:
             with span("serving.flush", registry=self.metrics,
                       records=len(recs)):
                 preds = np.asarray(collect())
+            if arena is not None and np.may_share_memory(preds, arena):
+                # a sync model may answer with a VIEW of its input (the
+                # server accepts any .predict) — the publisher encodes
+                # after this arena is recycled, so aliased predictions
+                # must be copied out before release
+                preds = preds.copy()
         except Exception:
             log.exception("inference failed for %d records; writing errors",
                           len(recs))
             self._record_failure(recs, parent="dispatch")
-            return None
+            return
+        finally:
+            self._arena_pool.release(arena)
+        self._pub_queue.put((recs, preds, t0))
+        self._m_backlog.set(self._pub_queue.qsize())
+
+    def _publisher_loop(self) -> None:
+        """The dedicated publisher thread: drains the bounded queue in
+        order, publishing each batch. Exits only on the stop sentinel —
+        which ``stop()`` enqueues AFTER the serve loop has flushed every
+        pending batch, so acked work is never dropped."""
+        q = self._pub_queue
+        while True:
+            item = q.get()
+            if item is _PUB_STOP:
+                return
+            recs, preds, t0 = item
+            try:
+                self._publish(recs, preds, t0)
+            except Exception:
+                # a publish failure must not kill the drain thread —
+                # answer the batch with addressable error records so
+                # producers fail fast instead of timing out
+                log.exception("publish failed for %d records; writing "
+                              "error records", len(recs))
+                try:
+                    self._record_failure(recs, parent="dispatch")
+                except Exception:
+                    log.exception("error records could not be written "
+                                  "either (backend down?)")
+            self._m_backlog.set(q.qsize())
+
+    def _publish(self, recs, preds, t0: float) -> None:
+        """Encode + write one batch's results and do the publish-side
+        bookkeeping: counters (records/batches), batch-size, encode and
+        dispatch→publish latency histograms, per-record publish phase
+        events and e2e quantiles, one ``serving.flush`` JSON event, and
+        the TensorBoard scalars. Each result echoes its request's wire
+        version — v2 requests get raw-bytes results, v1 requests get the
+        base64 ``.npy`` form old consumers decode."""
+        t_enc = time.perf_counter()
+        results = {}
         for i, rec in enumerate(recs):
-            self.backend.set_result(rec.uri,
-                                    {"value": encode_array(preds[i])})
+            if rec.v2:
+                results[rec.uri] = encode_tensor(preds[i], key="value")
+            else:
+                results[rec.uri] = {"value": encode_array(preds[i])}
+        self._m_encode.observe(time.perf_counter() - t_enc)
+        set_results = getattr(self.backend, "set_results", None)
+        if set_results is not None:
+            set_results(results)
+        else:   # foreign backend without the batched write
+            for uri, fields in results.items():
+                self.backend.set_result(uri, fields)
         self.served += len(recs)
         self._batches += 1
         now = time.perf_counter()
@@ -487,4 +783,3 @@ class ClusterServing:
             self._summary.add_scalar("Serving Records", self.served,
                                      self._batches)
             self._summary.flush()
-        return None

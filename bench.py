@@ -590,16 +590,57 @@ def bench_int8_inference():
     return out
 
 
+def bench_codec():
+    """Serving wire-codec microbench: encode+decode round-trip throughput
+    (MB/s of tensor payload) for the v2 raw little-endian format vs the
+    legacy v1 base64 ``.npy`` format, on the serving bench's 112x112x3
+    float32 frame. The v2/v1 ratio is the host-path codec win that
+    ``serving_resnet50_records_per_sec`` realizes end to end."""
+    from analytics_zoo_tpu.serving.client import (decode_array,
+                                                  decode_payload,
+                                                  encode_array,
+                                                  encode_tensor)
+
+    frame = np.random.default_rng(9).normal(
+        size=(112, 112, 3)).astype(np.float32)
+    mb = frame.nbytes / 1e6
+    reps, windows = 40, 3
+
+    def v1_roundtrip():
+        decode_array(encode_array(frame))
+
+    def v2_roundtrip():
+        decode_payload(encode_tensor(frame))
+
+    out = {}
+    rates = {}
+    for tag, roundtrip in (("v1", v1_roundtrip), ("v2", v2_roundtrip)):
+        roundtrip()                                   # warmup
+        best = 0.0
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                roundtrip()
+            best = max(best, reps * mb / (time.perf_counter() - t0))
+        rates[tag] = best
+        out[f"serving_codec_{tag}_mb_per_s"] = round(best, 1)
+    out["serving_codec_v2_speedup"] = round(rates["v2"] / rates["v1"], 2)
+    return out
+
+
 def bench_serving():
     """Parity config #5: Cluster Serving ResNet-50 batch inference — the
     reference's runtime "Serving Throughput" TensorBoard scalar
     (``ClusterServing.scala:296-304``; no published absolute value).
     Measures the REAL stack end to end: producer threads enqueue encoded
     images into the queue backend, the serve loop batches them through an
-    ``InferenceModel``, and the consumer drains results. On the tunneled
-    chip the number is dispatch-latency-bound (one ~60-100 ms round trip
-    per batch), so it reports the serving STACK's sustainable rate here,
-    not the chip's raw FPS (``image_infer_*`` covers that)."""
+    ``InferenceModel``, and the consumer drains results. The host path is
+    the wire-format-v2 pipeline (raw-bytes codec, arena batch assembly,
+    async publisher) — the r05 number (98.9 rec/s) was host-codec-bound;
+    with that work off the critical path the rate should be bounded by
+    dispatch round trips (one ~60-100 ms RTT per in-flight batch window
+    on the tunneled chip), so it reports the serving STACK's sustainable
+    rate here, not the chip's raw FPS (``image_infer_*`` covers that)."""
     import threading
 
     from analytics_zoo_tpu.models.image.imageclassification import (
@@ -807,6 +848,10 @@ def main():
     except Exception as e:
         print(f"# long-context bench failed: {e!r}", file=sys.stderr)
     try:
+        out.update(bench_codec())
+    except Exception as e:
+        print(f"# serving codec bench failed: {e!r}", file=sys.stderr)
+    try:
         out["serving_resnet50_records_per_sec"] = round(bench_serving(), 1)
     except Exception as e:
         print(f"# serving bench failed: {e!r}", file=sys.stderr)
@@ -883,8 +928,17 @@ TOLERANCE_OVERRIDES = {"image_infer_fp32_fps": 0.30,
                        # A genuine COMPUTE regression is still caught
                        # tightly by the device_step_ms ceiling below, which
                        # excludes the tunnel by construction.
-                       "value": 0.30,
-                       "wide_deep_train_samples_per_sec": 0.30}
+                       # Re-tightened 0.30 -> 0.25 (ADVICE r5): the 0.30
+                       # was temporary cover for the headline-statistic
+                       # change (max -> median of 3 dispatch maxima) landing
+                       # against r04's max-based record; r05 is the first
+                       # baseline RECORDED under the median statistic, so
+                       # only the measured tunnel spread above (worst
+                       # observed -23.5% between identical-code runs) still
+                       # needs headroom. See BASELINE.md "Headline
+                       # statistic".
+                       "value": 0.25,
+                       "wide_deep_train_samples_per_sec": 0.25}
 # correctness-parity metrics get ABSOLUTE floors, not the relative throughput
 # tolerance — a 15%-relative gate would let int8 agreement fall to 85% (the
 # whitepaper's claim is <0.1% accuracy drop, wp-bigdl.md:192)

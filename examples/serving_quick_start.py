@@ -2,12 +2,26 @@
 wrap a trained model in the inference runtime, start the serving loop,
 push requests through the input queue and read predictions back.
 
+Also demonstrates end-to-end trace-id propagation (the ROADMAP follow-up
+for RedisBackend-facing deployments): the caller mints one trace id per
+request — in production this is the upstream request id — and passes it
+to ``enqueue(trace=...)``. The id rides the stream record as a plain
+field, so it survives the Redis hop in a multi-process deployment
+unchanged, and the server emits four parent-linked phase events
+(enqueue→dequeue→dispatch→publish) under it. Reading the JSON event log
+back by trace id reconciles each request's exact latency breakdown even
+when producer and server are different processes.
+
 Run:  python examples/serving_quick_start.py
 """
+
+import os
+import tempfile
 
 import numpy as np
 
 from analytics_zoo_tpu import init_zoo_context
+from analytics_zoo_tpu import observability as obs
 from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
 from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
 from analytics_zoo_tpu.pipeline.inference import InferenceModel
@@ -23,19 +37,40 @@ def main():
     model.init_weights()
 
     im = InferenceModel(concurrent_num=2).from_keras(model)
-    backend = LocalBackend()  # swap for RedisBackend(...) in production
-    serving = ClusterServing(im, backend=backend, batch_size=16).start()
+    backend = LocalBackend()  # swap for RedisBackend(...) in production —
+    #                           the trace field rides the stream verbatim
+    events_path = os.path.join(tempfile.mkdtemp(), "serving_events.jsonl")
+    serving = (ClusterServing(im, backend=backend, batch_size=16)
+               .set_json_events(events_path)       # before start()
+               .start())
 
     inq, outq = InputQueue(backend), OutputQueue(backend)
     rng = np.random.default_rng(0)
-    for i in range(8):
-        inq.enqueue(f"req-{i}", rng.normal(size=(8,)).astype(np.float32))
-    for i in range(8):
-        probs = outq.query(f"req-{i}", timeout=60.0)
+    # adopt explicit trace ids: in a real deployment this is the upstream
+    # request id (any non-empty string); minting via new_trace_id() keeps
+    # the documented 16-hex-char wire format
+    traces = {f"req-{i}": obs.new_trace_id() for i in range(8)}
+    for uri, trace in traces.items():
+        inq.enqueue(uri, rng.normal(size=(8,)).astype(np.float32),
+                    trace=trace)
+    for uri in traces:
+        probs = outq.query(uri, timeout=60.0)
         if probs is None:
-            raise TimeoutError(f"req-{i}: no prediction within 60s")
-        print(f"req-{i}: class={int(np.argmax(probs))}")
+            raise TimeoutError(f"{uri}: no prediction within 60s")
+        print(f"{uri}: class={int(np.argmax(probs))}")
     serving.stop()
+
+    # cross-process reconciliation: group the event log by OUR ids —
+    # every request shows its four phases with per-phase durations
+    by_trace = {}
+    for e in obs.read_events(events_path, kind="request"):
+        by_trace.setdefault(e["trace"], {})[e["phase"]] = e
+    for uri, trace in traces.items():
+        phases = by_trace[trace]
+        assert set(phases) == {"enqueue", "dequeue", "dispatch", "publish"}
+        print(f"{uri} trace={trace}: queue-wait "
+              f"{phases['dequeue']['dur_s'] * 1e3:.2f} ms, e2e "
+              f"{phases['publish']['e2e_s'] * 1e3:.2f} ms")
 
 
 if __name__ == "__main__":

@@ -239,22 +239,26 @@ def redis_port():
 
 def test_redis_backend_stream_and_result_contract(redis_port):
     b = RedisBackend(port=redis_port, maxlen=100)
-    eid = b.xadd("serving_stream", {"uri": "a", "data": "payload"})
+    # the `data`/`value` payload fields are BINARY on the wire (raw v2
+    # tensor bytes must survive); every other field round-trips as text
+    eid = b.xadd("serving_stream", {"uri": "a", "data": b"\x00raw\xff"})
     assert isinstance(eid, str) and "-" in eid
     assert b.stream_len("serving_stream") == 1
     entries = b.xread("serving_stream", 10, block_ms=100)
-    assert entries and entries[0][1] == {"uri": "a", "data": "payload"}
+    assert entries and entries[0][1] == {"uri": "a", "data": b"\x00raw\xff"}
     # consume-on-read: drained
     assert b.stream_len("serving_stream") == 0
 
-    b.set_result("a", {"value": "42"})
-    assert b.pop_result("a", timeout=1.0) == {"value": "42"}
+    b.set_result("a", {"value": "42", "dtype": "<f4"})
+    assert b.pop_result("a", timeout=1.0) == {"value": b"42",
+                                              "dtype": "<f4"}
     assert b.pop_result("a", timeout=0.05) is None
 
-    b.set_result("x", {"value": "1"})
-    b.set_result("y", {"value": "2"})
+    # batched publish (the async publisher's path): one pipelined round
+    # trip writes every result hash
+    b.set_results({"x": {"value": "1"}, "y": {"value": "2"}})
     allres = b.pop_all_results()
-    assert allres == {"x": {"value": "1"}, "y": {"value": "2"}}
+    assert allres == {"x": {"value": b"1"}, "y": {"value": b"2"}}
 
 
 def test_redis_backend_backpressure(redis_port):

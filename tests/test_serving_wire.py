@@ -1,0 +1,443 @@
+"""Serving host path: wire-format v2 codec + v1 interop, the async
+publisher (drain-on-stop, backlog, batched writes), concurrent-publish
+trace reconciliation, worker-thread lifecycle, and the status CLI's p99
+SLO gate."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                       LocalBackend, OutputQueue)
+from analytics_zoo_tpu.serving.client import (INPUT_STREAM, decode_array,
+                                              decode_payload, encode_array,
+                                              encode_tensor, is_v2)
+
+
+def _toy_model():
+    init_zoo_context()
+    m = Sequential()
+    m.add(Dense(4, input_shape=(6,), activation="relu"))
+    m.add(Dense(3, activation="softmax"))
+    m.init_weights()
+    return m
+
+
+# ---------------------------------------------------------------------------
+# v2 codec
+# ---------------------------------------------------------------------------
+
+def test_v2_codec_roundtrip_dtypes_and_shapes():
+    rng = np.random.default_rng(0)
+    for arr in (rng.normal(size=(3, 4)).astype(np.float32),
+                np.array([1, -2, 3], np.int64),
+                np.array(7.5, np.float64),              # 0-d scalar
+                np.array([True, False]),
+                rng.normal(size=(2, 5, 5)).astype(np.float16),
+                np.empty((0, 4), np.float32)):          # empty batch axis
+        fields = encode_tensor(arr)
+        assert is_v2(fields) and isinstance(fields["data"], bytes)
+        out = decode_payload(fields)
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        np.testing.assert_array_equal(out, arr)
+
+
+def test_v2_codec_big_endian_normalized_and_text_transport():
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    fields = encode_tensor(arr.astype(">f4"))
+    # wire bytes are always little-endian, whatever the producer held
+    assert np.dtype(fields["dtype"]).byteorder in ("<", "=", "|")
+    np.testing.assert_array_equal(decode_payload(fields), arr)
+    # a text-only transport degrades the payload to latin-1 str: decode
+    # still recovers the exact bytes
+    fields["data"] = fields["data"].decode("latin-1")
+    np.testing.assert_array_equal(decode_payload(fields), arr)
+
+
+def test_v2_codec_rejects_length_mismatch_and_objects():
+    fields = encode_tensor(np.zeros((2, 2), np.float32))
+    fields["data"] = fields["data"][:-1]
+    with pytest.raises(ValueError):
+        decode_payload(fields)
+    with pytest.raises(ValueError):
+        encode_tensor(np.array([object()]))
+    # dtypes with no raw byte representation are rejected at VALIDATION,
+    # not by a frombuffer failure mid-copy
+    with pytest.raises(ValueError):
+        decode_payload({"data": b"\x00" * 8, "dtype": "|O8", "shape": "1"})
+    with pytest.raises(ValueError):
+        decode_payload({"data": b"", "dtype": "<U0", "shape": "1"})
+
+
+def test_v1_fallback_decode():
+    arr = np.arange(4, dtype=np.float32)
+    # no dtype/shape fields => the base64 .npy path, str or bytes payload
+    np.testing.assert_array_equal(
+        decode_payload({"data": encode_array(arr)}), arr)
+    np.testing.assert_array_equal(
+        decode_payload({"data": encode_array(arr).encode("ascii")}), arr)
+
+
+# ---------------------------------------------------------------------------
+# v1 <-> v2 interop through the live server (version echo)
+# ---------------------------------------------------------------------------
+
+def test_v1_producer_served_and_answered_in_v1():
+    """An OLD producer (base64 .npy, no dtype/shape fields) must be served
+    by the new server AND answered in v1, so an old consumer's
+    ``decode_array(res["value"])`` keeps working."""
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4).start()
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(6,)).astype(np.float32)
+    try:
+        backend.xadd(INPUT_STREAM, {"uri": "old-1", "data": encode_array(x)})
+        res = backend.pop_result("old-1", timeout=30.0)
+    finally:
+        serving.stop(drain=False)
+    assert res is not None and set(res) == {"value"}, "v1 echo: bare value"
+    assert isinstance(res["value"], str)
+    np.testing.assert_allclose(decode_array(res["value"]),
+                               im.predict(x[None])[0], rtol=1e-5, atol=1e-6)
+
+
+def test_v2_producer_answered_in_v2():
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4).start()
+    inq = InputQueue(backend)
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(6,)).astype(np.float32)
+    try:
+        inq.enqueue("new-1", x)
+        res = backend.pop_result("new-1", timeout=30.0)
+    finally:
+        serving.stop(drain=False)
+    assert res is not None and is_v2(res)
+    assert isinstance(res["value"], bytes)
+    np.testing.assert_allclose(decode_payload(res, "value"),
+                               im.predict(x[None])[0], rtol=1e-5, atol=1e-6)
+
+
+def test_mixed_v1_v2_batch_interop():
+    """One read containing BOTH wire versions: all records served with the
+    right predictions, each answered in its own request's format (the
+    mixed read exercises the legacy decode fallback, not the arena)."""
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    rng = np.random.default_rng(3)
+    xs = {f"i-{i}": rng.normal(size=(6,)).astype(np.float32)
+          for i in range(6)}
+    inq = InputQueue(backend)
+    for i, (uri, x) in enumerate(xs.items()):
+        if i % 2 == 0:
+            backend.xadd(INPUT_STREAM, {"uri": uri,
+                                        "data": encode_array(x)})   # v1
+        else:
+            inq.enqueue(uri, x)                                     # v2
+    serving = ClusterServing(im, backend=backend, batch_size=8).start()
+    outq = OutputQueue(backend)
+    try:
+        got = {uri: outq.query(uri, timeout=30.0) for uri in xs}
+    finally:
+        serving.stop(drain=False)
+    direct = np.asarray(im.predict(np.stack(list(xs.values()))))
+    for i, uri in enumerate(xs):
+        np.testing.assert_allclose(got[uri], direct[i], rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_malformed_v2_header_cannot_kill_serve_loop():
+    """A v2 record whose header passes shape/length arithmetic but names
+    an unrepresentable dtype (object, zero-itemsize) must become an
+    addressable undecodable error — and the loop must keep serving."""
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4).start()
+    outq = OutputQueue(backend)
+    try:
+        backend.xadd(INPUT_STREAM, {"uri": "obj", "data": b"\x00" * 8,
+                                    "dtype": "|O8", "shape": "1", "v": "2"})
+        from analytics_zoo_tpu.serving import ServingError
+        with pytest.raises(ServingError):
+            outq.query("obj", timeout=10.0)
+        # the loop survived: a well-formed record still serves
+        InputQueue(backend).enqueue("ok", np.zeros(6, np.float32))
+        assert outq.query("ok", timeout=30.0) is not None
+    finally:
+        serving.stop(drain=False)
+
+
+def test_sync_passthrough_model_view_results_not_corrupted():
+    """The server accepts any ``.predict``; one answering with a VIEW of
+    its input must not publish bytes that a recycled arena has since
+    overwritten (the publisher encodes after the arena returns to the
+    pool)."""
+
+    class Passthrough:
+        def predict(self, batch):
+            return batch       # a view of the arena rows
+
+    backend = LocalBackend()
+    serving = ClusterServing(Passthrough(), backend=backend,
+                             batch_size=4).start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    rng = np.random.default_rng(9)
+    xs = {f"v-{i}": rng.normal(size=(6,)).astype(np.float32)
+          for i in range(20)}      # several batches through the same pool
+    try:
+        for uri, x in xs.items():
+            inq.enqueue(uri, x)
+        for uri, x in xs.items():
+            np.testing.assert_array_equal(outq.query(uri, timeout=30.0), x)
+    finally:
+        serving.stop(drain=False)
+
+
+def test_arena_reuse_across_batches_keeps_results_correct():
+    """Consecutive uniform-v2 batches reuse pooled arena buffers; a stale
+    row must never leak into a later batch's prediction."""
+    im = InferenceModel(concurrent_num=2).from_keras(_toy_model())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4,
+                             decode_workers=2).start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    rng = np.random.default_rng(4)
+    try:
+        for round_i in range(5):        # many batches through the pool
+            xs = {f"b{round_i}-{i}": rng.normal(size=(6,)).astype(np.float32)
+                  for i in range(4)}
+            for uri, x in xs.items():
+                inq.enqueue(uri, x)
+            for uri, x in xs.items():
+                got = outq.query(uri, timeout=30.0)
+                want = im.predict(x[None])[0]
+                np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    finally:
+        serving.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# async publisher
+# ---------------------------------------------------------------------------
+
+class _SlowResultBackend(LocalBackend):
+    """LocalBackend whose batched result writes stall — builds a real
+    publisher backlog so drain-on-stop is actually exercised."""
+
+    def __init__(self, delay_s: float = 0.05, **kw):
+        super().__init__(**kw)
+        self.delay_s = delay_s
+        self.batched_writes = 0
+
+    def set_results(self, results):
+        time.sleep(self.delay_s)
+        self.batched_writes += 1
+        super().set_results(results)
+
+
+def test_publisher_drains_backlog_on_stop():
+    """Every batch the serve loop handed the publisher must be published
+    before stop() returns, even when the result backend is slow enough
+    that a backlog exists at stop time — and the results must have gone
+    through the BATCHED write path."""
+    im = InferenceModel(concurrent_num=2).from_keras(_toy_model())
+    backend = _SlowResultBackend(delay_s=0.05)
+    serving = ClusterServing(im, backend=backend, batch_size=4).start()
+    inq = InputQueue(backend)
+    rng = np.random.default_rng(5)
+    n = 24
+    for i in range(n):
+        inq.enqueue(f"d-{i}", rng.normal(size=(6,)).astype(np.float32))
+    serving.stop(drain=True)
+    # after stop: publisher thread gone, every record answered
+    assert serving.served == n
+    assert backend.batched_writes >= 1
+    outq = OutputQueue(backend)
+    got = outq.dequeue()
+    assert set(got) == {f"d-{i}" for i in range(n)}
+    assert not outq.last_errors
+
+
+def test_concurrent_publish_trace_reconciliation(tmp_path):
+    """Producers enqueue concurrently while the publisher emits publish
+    events from its own thread: the event log must still show EXACTLY
+    four parent-linked phase events per record, one trace per record,
+    zero orphans."""
+    from analytics_zoo_tpu import observability as obs
+
+    reg = obs.MetricsRegistry()
+    im = InferenceModel(concurrent_num=2, registry=reg).from_keras(
+        _toy_model())
+    backend = LocalBackend()
+    events_path = str(tmp_path / "events.jsonl")
+    serving = (ClusterServing(im, backend=backend, batch_size=8,
+                              registry=reg, decode_workers=2)
+               .set_json_events(events_path).start())
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    rng = np.random.default_rng(6)
+    data = {f"c{t}-{i}": rng.normal(size=(6,)).astype(np.float32)
+            for t in range(4) for i in range(12)}
+
+    def produce(t):
+        for i in range(12):
+            inq.enqueue(f"c{t}-{i}", data[f"c{t}-{i}"])
+
+    threads = [threading.Thread(target=produce, args=(t,)) for t in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    for uri in data:
+        assert outq.query(uri, timeout=30.0) is not None
+    serving.stop()          # joins the publisher: all events flushed
+
+    events = obs.read_events(events_path, kind="request")
+    n = len(data)
+    assert len(events) == 4 * n, "exactly 4 events per record"
+    by_trace = {}
+    for e in events:
+        by_trace.setdefault(e["trace"], {})[e["phase"]] = e
+    assert len(by_trace) == n, "one trace per record, zero orphans"
+    expected_parent = {"enqueue": None, "dequeue": "enqueue",
+                       "dispatch": "dequeue", "publish": "dispatch"}
+    uris = set()
+    for trace, phases in by_trace.items():
+        assert set(phases) == set(expected_parent), trace
+        for phase, e in phases.items():
+            assert e["parent"] == expected_parent[phase]
+        assert len({e["uri"] for e in phases.values()}) == 1
+        uris.add(phases["publish"]["uri"])
+    assert uris == set(data)
+    # registry agrees with the log
+    snap = reg.snapshot()
+    assert snap["zoo_serving_records_total"]["value"] == n
+    assert snap["zoo_serving_failures_total"]["value"] == 0
+    assert snap["zoo_serving_undecodable_total"]["value"] == 0
+    # the codec histograms saw every read/publish
+    assert snap["zoo_serving_decode_seconds"]["count"] >= 1
+    assert snap["zoo_serving_encode_seconds"]["count"] == \
+        snap["zoo_serving_batches_total"]["value"]
+
+
+def test_no_leaked_threads_after_stop():
+    """The serve loop, decode workers, publisher, and scrape endpoint must
+    all be joined by stop() — a restartable server cannot shed threads."""
+    im = InferenceModel().from_keras(_toy_model())
+    x = np.zeros((1, 6), np.float32)
+    im.predict(x)           # warm the backend's own lazy thread pools
+    before = set(threading.enumerate())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4,
+                             decode_workers=2)
+    serving.serve_metrics(port=0)
+    serving.start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    inq.enqueue("t-0", x[0])
+    assert outq.query("t-0", timeout=30.0) is not None
+    serving.stop()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t not in before and t.is_alive()]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, f"threads survived stop(): {leaked}"
+    # belt and braces: none of OUR named workers linger even if some
+    # unrelated library thread appeared mid-test
+    names = [t.name for t in threading.enumerate()]
+    for prefix in ("cluster-serving", "serving-decode", "zoo-metrics"):
+        assert not any(n.startswith(prefix) for n in names), names
+
+
+def test_restart_after_stop_serves_again():
+    """start() after a full stop() rebuilds the publisher + decode pool."""
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4)
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    for round_i in range(2):
+        serving.start()
+        inq.enqueue(f"r-{round_i}", np.zeros(6, np.float32))
+        assert outq.query(f"r-{round_i}", timeout=30.0) is not None
+        serving.stop()
+
+
+# ---------------------------------------------------------------------------
+# bounded in-flight chunks (ADVICE r5)
+# ---------------------------------------------------------------------------
+
+def test_predict_async_many_chunks_matches_unchunked():
+    """A many-chunk predict (outputs read back incrementally to bound
+    HBM) must equal the single-chunk result, ragged final chunk
+    included."""
+    model = _toy_model()
+    chunked = InferenceModel(max_batch_size=4).from_keras(model)
+    whole = InferenceModel().from_keras(model)
+    rng = np.random.default_rng(7)
+    for n in (3, 8, 19):     # 1 chunk, 2 chunks, 5 chunks (ragged tail)
+        x = rng.normal(size=(n, 6)).astype(np.float32)
+        np.testing.assert_allclose(chunked.predict(x), whole.predict(x),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# status CLI: p99 SLO thresholds
+# ---------------------------------------------------------------------------
+
+def test_status_cli_slo_threshold_flags():
+    """--slo-p99-ms: generous thresholds pass (exit 0); a sub-microsecond
+    e2e threshold and a threshold on an absent family both breach (exit
+    2, breaching rows flagged)."""
+    import os
+    import subprocess
+    import sys
+
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(scripts) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4)
+    scrape = serving.serve_metrics(port=0)
+    serving.start()
+    try:
+        inq, outq = InputQueue(backend), OutputQueue(backend)
+        rng = np.random.default_rng(8)
+        for i in range(8):
+            inq.enqueue(f"s-{i}", rng.normal(size=(6,)).astype(np.float32))
+        for i in range(8):
+            assert outq.query(f"s-{i}", timeout=30.0) is not None
+        cli = [sys.executable, os.path.join(scripts,
+                                            "cluster-serving-status"),
+              f"{scrape.host}:{scrape.port}"]
+        # generous thresholds on every family: healthy exit
+        r = subprocess.run(
+            cli + ["--slo-p99-ms", "1e9", "--slo-p99-ms", "queue_wait=1e9",
+                   "--slo-p99-ms", "dispatch=1e9"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "BREACH" not in r.stdout
+        # an impossible e2e threshold breaches; so does a threshold on a
+        # family with no samples
+        r = subprocess.run(
+            cli + ["--slo-p99-ms", "e2e=0.000001",
+                   "--slo-p99-ms", "zoo_absent_quantiles_seconds=5"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert r.returncode == 2, (r.stdout[-2000:], r.stderr[-2000:])
+        assert "BREACH" in r.stdout
+        assert "no samples" in r.stderr
+    finally:
+        serving.stop(drain=False)

@@ -34,9 +34,25 @@ INPUT_STREAM = "tensor_stream"
 #: is there for humans reading a stream dump and for future versions
 WIRE_VERSION = "2"
 
+#: hard ceiling on the payload bytes (and on any single dimension) a v2
+#: header may describe — headers are attacker-controlled strings, and
+#: arrays get allocated from them, so a bound must hold BEFORE anything
+#: is allocated; 2 GiB is far above any real serving tensor (the server
+#: additionally bounds its batch-arena preallocation, which multiplies
+#: the row size by ``batch_size``)
+MAX_PAYLOAD_BYTES = 1 << 31
+
+#: ceiling on the number of dimensions a v2 header may describe — numpy
+#: refuses ndarrays beyond 64 dims, and the server's batch arena (and
+#: the ragged one-by-one path) prepend a batch dimension, so an
+#: unbounded ndim would turn np.empty/reshape into a loop-killing raise;
+#: 32 is far above any real tensor rank
+MAX_DIMS = 32
+
 __all__ = ["InputQueue", "OutputQueue", "ServingError", "encode_array",
            "decode_array", "encode_tensor", "decode_payload", "is_v2",
-           "validate_v2", "new_trace_id", "WIRE_VERSION"]
+           "validate_v2", "new_trace_id", "WIRE_VERSION",
+           "MAX_PAYLOAD_BYTES", "MAX_DIMS"]
 
 
 class ServingError(RuntimeError):
@@ -54,11 +70,56 @@ def encode_array(arr: np.ndarray) -> str:
     return base64.b64encode(buf.getvalue()).decode("ascii")
 
 
+def _check_header_bounds(dt: np.dtype, shape) -> int:
+    """Shared bound checks for ANY attacker-controlled tensor header —
+    v2 ``dtype``/``shape`` fields or a v1 ``.npy`` header: every
+    dimension in range, rank capped at :data:`MAX_DIMS`, total bytes
+    capped at :data:`MAX_PAYLOAD_BYTES`. Returns the expected payload
+    byte count, computed with Python ints — ``np.prod`` would wrap
+    silently on overflow, and a wrapped 0 validates a huge-shape header
+    against an empty payload."""
+    if len(shape) > MAX_DIMS:
+        raise ValueError(
+            f"tensor header has {len(shape)} dimensions (max {MAX_DIMS})")
+    expect = dt.itemsize
+    for d in shape:
+        if d < 0 or d > MAX_PAYLOAD_BYTES:
+            raise ValueError(f"tensor shape {tuple(shape)} has an "
+                             f"out-of-range dimension {d}")
+        expect *= d
+    if expect > MAX_PAYLOAD_BYTES:
+        raise ValueError(f"tensor header describes {expect} payload "
+                         f"bytes (max {MAX_PAYLOAD_BYTES})")
+    return expect
+
+
 def decode_array(payload) -> np.ndarray:
     # b64decode accepts str or bytes — a binary-safe backend hands the
     # legacy field back as bytes, a text transport as str
-    return np.load(io.BytesIO(base64.b64decode(payload)),
-                   allow_pickle=False)
+    buf = io.BytesIO(base64.b64decode(payload))
+    # the .npy header is attacker-controlled like a v2 header, and
+    # np.load preallocates the FULL array from it before reading any
+    # payload bytes — bound it the same way first (tiny records
+    # claiming multi-GiB shapes are a memory-pressure DoS otherwise)
+    version = np.lib.format.read_magic(buf)
+    read_header = getattr(
+        np.lib.format, "read_array_header_%d_%d" % version, None)
+    if read_header is not None:
+        shape, _, dt = read_header(buf)
+    else:
+        # no public reader for this version (3.0: utf-8 field names);
+        # np.load accepts it, so the bounds check must too
+        shape, _, dt = np.lib.format._read_array_header(buf, version)
+    expect = _check_header_bounds(np.dtype(dt), shape)
+    present = buf.getbuffer().nbytes - buf.tell()
+    if present != expect:
+        # np.load would preallocate the CLAIMED size before noticing the
+        # payload is short — a 100-byte record claiming a (capped but
+        # still multi-GiB) shape must be rejected before any allocation
+        raise ValueError(f".npy payload is {present} bytes but its "
+                         f"header claims {expect}")
+    buf.seek(0)
+    return np.load(buf, allow_pickle=False)
 
 
 # ---------------------------------------------------------------------------
@@ -96,11 +157,20 @@ def is_v2(fields: Dict) -> bool:
 
 
 def parse_v2_header(fields: Dict):
-    """``(np.dtype, shape_tuple)`` from a v2 record's header fields.
-    Raises on malformed specs."""
+    """``(np.dtype, shape_tuple, payload_bytes)`` from a v2 record's
+    header fields. Raises on malformed specs, including any dimension
+    that is negative or above :data:`MAX_PAYLOAD_BYTES` — np.empty on
+    such a shape raises (or allocates absurdly), and the server's arena
+    path relies on a validated header never failing allocation."""
     dt = np.dtype(str(fields["dtype"]))
+    if dt.subdtype is not None:
+        # "(2,2)<f4" would smuggle extra dims past every shape check:
+        # frombuffer expands them and the reshape/arena paths blow up
+        raise ValueError(
+            f"v2 dtype {fields['dtype']!r} is a subarray dtype — dims "
+            f"belong in the shape field")
     shape = tuple(int(s) for s in str(fields["shape"]).split(",") if s)
-    return dt, shape
+    return dt, shape, _check_header_bounds(dt, shape)
 
 
 def validate_v2(fields: Dict, key: str = "data"):
@@ -112,7 +182,7 @@ def validate_v2(fields: Dict, key: str = "data"):
     fail. The ONE definition of what the wire accepts: both
     :func:`decode_payload` and the server's cheap pre-copy check use it,
     so the accept rule cannot diverge between client and server."""
-    dt, shape = parse_v2_header(fields)
+    dt, shape, expect = parse_v2_header(fields)
     if dt.hasobject or dt.itemsize == 0:
         raise ValueError(
             f"v2 dtype {dt.str} has no raw byte representation")
@@ -120,7 +190,6 @@ def validate_v2(fields: Dict, key: str = "data"):
     if isinstance(payload, str):
         # a text-only transport: latin-1 is the lossless byte<->str map
         payload = payload.encode("latin-1")
-    expect = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
     if len(payload) != expect:
         raise ValueError(
             f"v2 payload is {len(payload)} bytes but dtype={dt.str} "

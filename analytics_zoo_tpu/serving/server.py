@@ -74,6 +74,14 @@ _Item = collections.namedtuple("_Item", ("rec", "fields", "wait", "hdr"))
 
 _PUB_STOP = object()    # publisher-queue sentinel: drain, then exit
 
+#: arena fast-path ceiling: the pool preallocates ``batch_size`` rows
+#: from ONE validated header, so a single max-size hostile record would
+#: otherwise drive a batch_size-times-larger np.empty (a MemoryError on
+#: the unguarded serve loop). Reads whose arena would exceed this
+#: assemble via the decode+stack fallback instead, whose allocation is
+#: proportional to the bytes actually received off the stream.
+_MAX_ARENA_BYTES = 1 << 31
+
 
 class _ArenaPool:
     """Reusable preallocated batch buffers keyed by (shape, dtype).
@@ -82,13 +90,20 @@ class _ArenaPool:
     batch assembly costs one memcpy per record — no per-record array
     allocation, no ``np.stack`` copy. A buffer stays checked out for the
     whole dispatch (the device upload reads from it) and is returned by
-    the flush after readback; at most ``cap`` free buffers per key are
-    kept so a payload-shape change cannot strand unbounded memory."""
+    the flush after readback. Pooled memory is doubly bounded: at most
+    ``cap`` free buffers per key, and at most ``max_bytes`` TOTAL across
+    keys (least-recently-used shapes evicted first) — shape-rotating
+    traffic must not pin one pool entry per shape forever."""
 
-    def __init__(self, batch_size: int, cap: int = 4):
+    def __init__(self, batch_size: int, cap: int = 4,
+                 max_bytes: int = None):
         self.batch_size = int(batch_size)
         self.cap = int(cap)
-        self._free: Dict[Tuple, List[np.ndarray]] = {}
+        self.max_bytes = (_MAX_ARENA_BYTES if max_bytes is None
+                          else int(max_bytes))
+        self._free: "collections.OrderedDict[Tuple, List[np.ndarray]]" \
+            = collections.OrderedDict()
+        self._bytes = 0
         self._lock = threading.Lock()
 
     def acquire(self, shape: Tuple[int, ...], dtype) -> np.ndarray:
@@ -96,7 +111,13 @@ class _ArenaPool:
         with self._lock:
             free = self._free.get(key)
             if free:
-                return free.pop()
+                arena = free.pop()
+                self._bytes -= arena.nbytes
+                if free:
+                    self._free.move_to_end(key)
+                else:
+                    del self._free[key]
+                return arena
         return np.empty((self.batch_size,) + tuple(shape), np.dtype(dtype))
 
     def release(self, arena: Optional[np.ndarray]) -> None:
@@ -105,8 +126,16 @@ class _ArenaPool:
         key = (arena.shape[1:], arena.dtype.str)
         with self._lock:
             free = self._free.setdefault(key, [])
-            if len(free) < self.cap:
-                free.append(arena)
+            if len(free) >= self.cap:
+                return
+            free.append(arena)
+            self._bytes += arena.nbytes
+            self._free.move_to_end(key)
+            while self._bytes > self.max_bytes:
+                k, lst = next(iter(self._free.items()))
+                self._bytes -= lst.pop().nbytes
+                if not lst:
+                    del self._free[k]
 
 
 class ClusterServing:
@@ -336,7 +365,15 @@ class ClusterServing:
         t, q = self._pub_thread, self._pub_queue
         if t is None:
             return
-        q.put(_PUB_STOP)
+        try:
+            # bounded: with the queue full and the publisher wedged on a
+            # stalled backend, a plain put() would block forever and the
+            # TimeoutError below could never fire
+            q.put(_PUB_STOP, timeout=timeout)
+        except queue.Full:
+            raise TimeoutError(
+                f"publisher still draining after {timeout}s (result "
+                f"backend stalled?); call stop() again to re-join")
         t.join(timeout=timeout)
         if t.is_alive():
             raise TimeoutError(
@@ -427,12 +464,14 @@ class ClusterServing:
     def _assemble(self, entries):
         """Decode one read into ``(recs, batch, arena, ragged)``.
 
-        Fast path (every record wire-format v2 with one (shape, dtype)):
+        Fast path (every record wire-format v2 with one (shape, dtype),
+        and ``batch_size`` rows of it within ``_MAX_ARENA_BYTES``):
         headers are validated inline — cheap string parses and a byte-
         length check, so nothing can fail mid-copy — then the decode
         workers memcpy each payload into its row of a pooled arena;
-        ``batch`` is a view of the filled rows. Fallback (any v1 record
-        or mixed shapes): decode every payload to an array (worker pool
+        ``batch`` is a view of the filled rows. Fallback (any v1 record,
+        mixed shapes, or an oversized arena): decode every payload to an
+        array (worker pool
         for the base64+.npy work) and ``np.stack``; shape misfits come
         back in ``ragged`` for one-by-one serving. Undecodable records
         are dropped here with an addressable error record, BEFORE their
@@ -470,8 +509,10 @@ class ClusterServing:
         recs: List[_Rec] = []
         batch = arena = None
         ragged: List[Tuple[_Rec, np.ndarray]] = []
-        if items and all(i.hdr is not None for i in items) and len(
-                {(i.hdr[2], i.hdr[1].str) for i in items}) == 1:
+        if (items and all(i.hdr is not None for i in items)
+                and len({(i.hdr[2], i.hdr[1].str) for i in items}) == 1
+                and len(items[0].hdr[0]) * self.batch_size
+                <= _MAX_ARENA_BYTES):
             _, dt, shape = items[0].hdr
             arena = self._arena_pool.acquire(shape, dt)
             self._copy_rows(arena, [i.hdr for i in items])
@@ -534,13 +575,18 @@ class ClusterServing:
     def _drop_undecodable(self, fields) -> None:
         """Registry + event + (when addressable) an error record so the
         producer's ``query()`` fails fast instead of blocking out its
-        full timeout."""
+        full timeout. Runs on the serve loop: a result store refusing
+        the write must not escalate a dropped record into loop death."""
         self._m_undecodable.inc()
         self.metrics.emit("serving.undecodable", uri=fields.get("uri"),
                           trace=fields.get("trace"))
         if fields.get("uri"):
-            self.backend.set_result(fields["uri"],
-                                    {"error": "undecodable payload"})
+            try:
+                self.backend.set_result(fields["uri"],
+                                        {"error": "undecodable payload"})
+            except Exception:
+                log.exception("undecodable-error record for %r could not "
+                              "be written (backend down?)", fields["uri"])
 
     def _emit_read_events(self, items) -> None:
         """The first two phase events per traced record; later phases
@@ -651,18 +697,39 @@ class ClusterServing:
                                   parent="dequeue",
                                   dur_s=max(t0 - rec.t_deq, 0.0), batch=n)
 
-    def _record_failure(self, recs, parent: str = "dequeue") -> None:
+    def _record_failure(self, recs, parent: str = "dequeue",
+                        error: str = "inference failed") -> None:
         """Registry + event + addressable error records for a failed batch.
         Every traced record also gets a TERMINAL ``failed`` phase event
         (``parent`` = the last phase that did complete), so a by-trace
-        reconstruction never shows a failed request as forever in-flight."""
+        reconstruction never shows a failed request as forever in-flight.
+        ``error`` is what the producer's ``query()`` sees AND the event's
+        error field — a publish failure must not read as a model error.
+        Runs on the serve loop AND the publisher: a result store
+        refusing the error write must not kill either thread, and every
+        record still gets its terminal event (emitted BEFORE the write,
+        so a mid-batch write failure cannot leave later records
+        forever in-flight in a by-trace reconstruction)."""
         self._m_failures.inc(len(recs))
-        self.metrics.emit("serving.failure", records=len(recs))
+        # error-labeled breakdown in its OWN family (a labeled series
+        # under zoo_serving_failures_total would double-count every
+        # failure in a sum() over the family): the scrape must let an
+        # operator tell a backend outage from a broken model without
+        # falling back to the event log
+        self.metrics.counter(
+            "zoo_serving_failure_errors_total",
+            "failed records by error kind (model vs result-store)",
+            labels={"error": error}).inc(len(recs))
+        self.metrics.emit("serving.failure", records=len(recs), error=error)
         for rec in recs:
             if rec.trace is not None:
                 self.metrics.emit("request", phase="failed", trace=rec.trace,
-                                  uri=rec.uri, parent=parent)
-            self.backend.set_result(rec.uri, {"error": "inference failed"})
+                                  uri=rec.uri, parent=parent, error=error)
+            try:
+                self.backend.set_result(rec.uri, {"error": error})
+            except Exception:
+                log.exception("error record for %r could not be written "
+                              "(backend down?)", rec.uri)
 
     # -- readback + publish --------------------------------------------------
     def _flush(self, pending: _Pending) -> None:
@@ -713,11 +780,8 @@ class ClusterServing:
                 # producers fail fast instead of timing out
                 log.exception("publish failed for %d records; writing "
                               "error records", len(recs))
-                try:
-                    self._record_failure(recs, parent="dispatch")
-                except Exception:
-                    log.exception("error records could not be written "
-                                  "either (backend down?)")
+                self._record_failure(recs, parent="dispatch",
+                                     error="result publish failed")
             self._m_backlog.set(q.qsize())
 
     def _publish(self, recs, preds, t0: float) -> None:

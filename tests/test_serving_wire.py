@@ -75,6 +75,77 @@ def test_v2_codec_rejects_length_mismatch_and_objects():
         decode_payload({"data": b"", "dtype": "<U0", "shape": "1"})
 
 
+def test_v2_codec_rejects_hostile_shape_headers():
+    """Headers are untrusted strings: negative dims, int64-wrapping
+    products, and absurd dimensions must all fail VALIDATION — the
+    server allocates a batch arena from a validated header, so any of
+    these reaching np.empty would raise (or allocate gigabytes) on the
+    unguarded serve loop."""
+    from analytics_zoo_tpu.serving.client import (MAX_PAYLOAD_BYTES,
+                                                  validate_v2)
+    hostile = [
+        "0,-1",                      # negative dim; product 0 matches b""
+        "-4",                        # plainly negative
+        "4294967296,4294967296",     # 2^64 wraps int64 prod to 0
+        "0,99999999999999999999",    # 0 bytes but a >ssize_t dim
+        str(MAX_PAYLOAD_BYTES + 1),  # over the single-tensor byte cap
+    ]
+    for shape in hostile:
+        with pytest.raises(ValueError):
+            validate_v2({"data": b"", "dtype": "|u1", "shape": shape})
+        with pytest.raises(ValueError):
+            decode_payload({"data": b"", "dtype": "|u1", "shape": shape})
+    # the cap is on bytes, not elements: a big-itemsize dtype at the
+    # element count that would pass as |u1 must still be rejected
+    with pytest.raises(ValueError):
+        validate_v2({"data": b"", "dtype": "<f8",
+                     "shape": str(MAX_PAYLOAD_BYTES // 2)})
+    # dimension COUNT is bounded too: 100 ones with a length-correct
+    # 1-byte payload passes every per-dim/byte check, but np.empty caps
+    # ndim at 64 (and the batch arena prepends a dim) — must fail at
+    # validation, not at a loop-killing allocation
+    with pytest.raises(ValueError):
+        validate_v2({"data": b"\x00", "dtype": "|u1",
+                     "shape": ",".join("1" * 100)})
+    # subarray dtypes smuggle dims past every shape check: frombuffer
+    # expands "(2,2)<f4" and the reshape/arena paths blow up mid-copy
+    with pytest.raises(ValueError):
+        validate_v2({"data": b"\x00" * 48, "dtype": "(2,2)<f4",
+                     "shape": "3"})
+
+
+def test_v1_npy_header_bounded_before_allocation():
+    """The v1 fallback's .npy header is attacker-controlled too, and
+    np.load preallocates the whole array from it before reading any
+    payload — a ~100-byte record claiming a multi-GiB shape must be
+    rejected at header validation, not at allocation."""
+    import base64
+    import io
+
+    from analytics_zoo_tpu.serving.client import decode_array
+    buf = io.BytesIO()
+    np.lib.format.write_array_header_1_0(
+        buf, {"descr": "<f8", "fortran_order": False,
+              "shape": (2 ** 34,)})
+    hostile = base64.b64encode(buf.getvalue()).decode("ascii")
+    with pytest.raises(ValueError):
+        decode_array(hostile)
+    with pytest.raises(ValueError):
+        decode_payload({"data": hostile})    # the v1 fallback path
+    # a claimed size UNDER the byte cap but absent from the payload must
+    # also fail before np.load preallocates the claimed gigabyte
+    buf2 = io.BytesIO()
+    np.lib.format.write_array_header_1_0(
+        buf2, {"descr": "<f8", "fortran_order": False,
+               "shape": (2 ** 27,)})
+    with pytest.raises(ValueError):
+        decode_array(base64.b64encode(buf2.getvalue()).decode("ascii"))
+    # legit v1 payloads still round-trip through the bounded decode
+    arr = np.arange(4, dtype=np.float32)
+    np.testing.assert_array_equal(
+        decode_payload({"data": encode_array(arr)}), arr)
+
+
 def test_v1_fallback_decode():
     arr = np.arange(4, dtype=np.float32)
     # no dtype/shape fields => the base64 .npy path, str or bytes payload
@@ -175,6 +246,101 @@ def test_malformed_v2_header_cannot_kill_serve_loop():
         serving.stop(drain=False)
 
 
+def test_hostile_v2_shape_header_dropped_not_loop_killing():
+    """The review repro: a v2 header whose length arithmetic passes
+    (negative-dim / wrapped product = 0 against an empty payload) used
+    to reach ``np.empty`` in the arena pool and kill the serve loop.
+    It must be dropped as an addressable undecodable error, and the
+    loop must keep serving."""
+    from analytics_zoo_tpu.serving import ServingError
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4).start()
+    outq = OutputQueue(backend)
+    try:
+        for uri, payload, dtype, shape in (
+                ("neg", b"", "<f4", "0,-1"),
+                ("wrap", b"", "<f4", "4294967296,4294967296"),
+                ("ndim", b"\x00" * 4, "<f4", ",".join("1" * 100)),
+                ("subarr", b"\x00" * 48, "(2,2)<f4", "3")):
+            backend.xadd(INPUT_STREAM, {"uri": uri, "data": payload,
+                                        "dtype": dtype, "shape": shape,
+                                        "v": "2"})
+            with pytest.raises(ServingError):
+                outq.query(uri, timeout=10.0)
+        InputQueue(backend).enqueue("ok", np.zeros(6, np.float32))
+        assert outq.query("ok", timeout=30.0) is not None
+    finally:
+        serving.stop(drain=False)
+
+
+def test_arena_pool_total_bytes_bounded_lru():
+    """Shape-rotating traffic must not pin one pool entry per shape
+    forever: the pool bounds TOTAL free bytes, evicting least-recently-
+    used shapes first while the hot shape keeps its buffer."""
+    from analytics_zoo_tpu.serving.server import _ArenaPool
+    pool = _ArenaPool(batch_size=4, cap=4, max_bytes=192)  # a tight budget
+    arenas = {}
+    for n in (3, 5, 7, 9, 11):           # five distinct row shapes
+        a = pool.acquire((n,), np.float32)
+        arenas[n] = a
+        pool.release(a)
+    assert pool._bytes <= pool.max_bytes
+    assert sum(len(v) for v in pool._free.values()) <= 2
+    # the most recently released shape survived and is reused
+    assert pool.acquire((11,), np.float32) is arenas[11]
+
+
+def test_serve_loop_survives_error_record_write_failure():
+    """An undecodable record while the result store refuses writes: the
+    failure handler's own set_result raising must not kill the serve
+    loop (the error record is lost, the loop keeps serving)."""
+    class NoErrorWrites(LocalBackend):
+        def set_result(self, uri, fields):
+            raise RuntimeError("result store down")
+        # set_results (the publisher's batched write) still works
+
+    im = InferenceModel().from_keras(_toy_model())
+    backend = NoErrorWrites()
+    serving = ClusterServing(im, backend=backend, batch_size=4).start()
+    try:
+        backend.xadd(INPUT_STREAM, {"uri": "bad", "data": b"",
+                                    "dtype": "<f4", "shape": "0,-1",
+                                    "v": "2"})
+        InputQueue(backend).enqueue("good", np.zeros(6, np.float32))
+        assert OutputQueue(backend).query("good", timeout=30.0) is not None
+        assert serving._thread.is_alive()
+    finally:
+        serving.stop(drain=False)
+
+
+def test_oversized_rows_fall_back_to_stack_not_giant_arena(monkeypatch):
+    """The arena preallocates ``batch_size`` rows from ONE header, so a
+    large validated row must not drive a batch_size-times-larger
+    np.empty — reads over ``_MAX_ARENA_BYTES`` must assemble via the
+    stack fallback and still serve correctly."""
+    from analytics_zoo_tpu.serving import server as server_mod
+    monkeypatch.setattr(server_mod, "_MAX_ARENA_BYTES", 64)
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4).start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    rng = np.random.default_rng(7)
+    xs = {f"big-{i}": rng.normal(size=(6,)).astype(np.float32)  # 24 B rows:
+          for i in range(8)}                                    # 4x24 > 64
+    try:
+        for uri, x in xs.items():
+            inq.enqueue(uri, x)
+        got = {uri: outq.query(uri, timeout=30.0) for uri in xs}
+    finally:
+        serving.stop(drain=False)
+    assert not serving._arena_pool._free, "no arena may have been pooled"
+    direct = np.asarray(im.predict(np.stack(list(xs.values()))))
+    for i, uri in enumerate(xs):
+        np.testing.assert_allclose(got[uri], direct[i], rtol=1e-5,
+                                   atol=1e-6)
+
+
 def test_sync_passthrough_model_view_results_not_corrupted():
     """The server accepts any ``.predict``; one answering with a VIEW of
     its input must not publish bytes that a recycled arena has since
@@ -264,6 +430,71 @@ def test_publisher_drains_backlog_on_stop():
     got = outq.dequeue()
     assert set(got) == {f"d-{i}" for i in range(n)}
     assert not outq.last_errors
+
+
+class _BrokenResultBackend(LocalBackend):
+    """Batched result writes always fail; single-record error writes
+    still work — models a result store rejecting the bulk op."""
+
+    def set_results(self, results):
+        raise RuntimeError("bulk write refused")
+
+
+def test_publish_failure_answers_with_distinct_error():
+    """When inference succeeded but the result write failed, producers
+    must see a PUBLISH error, not 'inference failed' — the two need
+    different operator responses (backend vs model)."""
+    from analytics_zoo_tpu import observability as obs
+    from analytics_zoo_tpu.serving import ServingError
+    reg = obs.MetricsRegistry()
+    im = InferenceModel(registry=reg).from_keras(_toy_model())
+    backend = _BrokenResultBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=4,
+                             registry=reg).start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    try:
+        inq.enqueue("pub-fail", np.zeros(6, np.float32))
+        with pytest.raises(ServingError, match="result publish failed"):
+            outq.query("pub-fail", timeout=10.0)
+    finally:
+        serving.stop(drain=False)
+    # the scrape separates publish failures from model failures, in a
+    # family of its own so sum() over zoo_serving_failures_total stays 1
+    text = obs.render_prometheus(reg)
+    assert ('zoo_serving_failure_errors_total'
+            '{error="result publish failed"} 1') in text
+    assert 'zoo_serving_failures_total 1' in text
+
+
+def test_stop_times_out_instead_of_hanging_when_publisher_wedged():
+    """Publisher wedged mid-write on a stalled backend with the publish
+    queue full: stop() must raise its TimeoutError (the stop sentinel
+    put is bounded), not block forever — and a second stop() after the
+    backend recovers must drain everything cleanly."""
+    gate = threading.Event()
+
+    class Wedged(LocalBackend):
+        def set_results(self, results):
+            gate.wait()      # a dead-but-open connection
+            super().set_results(results)
+
+    im = InferenceModel().from_keras(_toy_model())
+    backend = Wedged()
+    serving = ClusterServing(im, backend=backend, batch_size=1,
+                             publish_queue=2).start()
+    inq = InputQueue(backend)
+    for i in range(3):       # 1 wedged in the publisher + 2 filling the queue
+        inq.enqueue(f"w-{i}", np.zeros(6, np.float32))
+    deadline = time.monotonic() + 10
+    while serving._pub_queue.qsize() < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(TimeoutError):
+        serving.stop(drain=True, timeout=0.5)
+    gate.set()
+    serving.stop(timeout=30.0)
+    assert serving.served == 3
+    got = OutputQueue(backend).dequeue()
+    assert set(got) == {f"w-{i}" for i in range(3)}
 
 
 def test_concurrent_publish_trace_reconciliation(tmp_path):

@@ -1,4 +1,4 @@
-"""zoolint rules ZL001–ZL009 — the JAX/TPU hazards that bite this stack.
+"""zoolint rules ZL001–ZL010 — the JAX/TPU hazards that bite this stack.
 
 Every rule documents its rationale in the class docstring (surfaced by
 ``--list-rules`` and docs/guides/STATIC_ANALYSIS.md). Severities:
@@ -758,6 +758,20 @@ class ImportTimeHazard(Rule):
 # ZL007 — swallowed exceptions in retry paths
 # ---------------------------------------------------------------------------
 
+def _in_serving_hot_path(path: str) -> bool:
+    """Whether a file lives in the serving / inference retry paths (the
+    rules that escalate there: ZL007's swallow-pass, ZL010's unbounded
+    spins). Absolutized so severity tracks the file's real location, not
+    how the scan path was spelled (a cwd-relative `server.py` must gate
+    exactly like CI's absolute-path scan of the same file)."""
+    if os.path.exists(path):
+        path = os.path.abspath(path)
+    p = path.replace("\\", "/")
+    return ("/serving/" in p or p.startswith("serving/")
+            or "/pipeline/inference/" in p
+            or p.startswith("pipeline/inference/"))
+
+
 @register
 class SwallowedException(Rule):
     """A bare ``except:`` (which also catches ``KeyboardInterrupt`` /
@@ -771,15 +785,7 @@ class SwallowedException(Rule):
     severity = ERROR
 
     def _in_hot_path(self, path: str) -> bool:
-        # absolutize so severity tracks the file's real location, not how
-        # the scan path was spelled (a cwd-relative `server.py` must gate
-        # exactly like CI's absolute-path scan of the same file)
-        if os.path.exists(path):
-            path = os.path.abspath(path)
-        p = path.replace("\\", "/")
-        return ("/serving/" in p or p.startswith("serving/")
-                or "/pipeline/inference/" in p
-                or p.startswith("pipeline/inference/"))
+        return _in_serving_hot_path(path)
 
     @staticmethod
     def _swallows(handler: ast.ExceptHandler) -> bool:
@@ -1020,3 +1026,71 @@ class UnbatchedTransferInLoop(Rule):
             if cur is not None:
                 continue
             yield from self._check_loop(ctx, loop)
+
+
+# ---------------------------------------------------------------------------
+# ZL010 — unbounded time.sleep retry spin
+# ---------------------------------------------------------------------------
+
+_CLOCK_LEAVES = {"monotonic", "monotonic_ns", "time", "time_ns",
+                 "perf_counter", "perf_counter_ns"}
+
+
+@register
+class UnboundedRetrySpin(Rule):
+    """A ``while`` loop that ``time.sleep``-polls with no deadline — no
+    clock read anywhere in the loop's test or body — waits forever when
+    the condition never comes true: a dead backend turns the caller into
+    a silently hung thread (the pre-reliability ``InputQueue.enqueue``
+    full-stream spin). Route the wait through
+    ``common.reliability.RetryPolicy`` (``delays()`` / ``wait_for`` with
+    a deadline, a bounded ``for`` — never flagged) or check a
+    ``time.monotonic()`` deadline in the loop. Error severity in the
+    ``serving/`` and ``pipeline/inference/`` paths, warning elsewhere
+    (an intentional forever-guard like ``ray/raycontext.py``'s
+    parent-watch carries the warning knowingly)."""
+
+    id = "ZL010"
+    severity = ERROR
+
+    def _is_sleep(self, ctx: ModuleContext, node: ast.Call) -> bool:
+        d = dotted(node.func)
+        if not d:
+            return False
+        if ctx.is_call_to(d, "time", ("sleep",)):
+            return True
+        return "." not in d and ctx.from_imported("time").get(d) == "sleep"
+
+    def _is_clock_read(self, ctx: ModuleContext, node: ast.Call) -> bool:
+        d = dotted(node.func)
+        if not d:
+            return False
+        if ctx.is_call_to(d, "time", _CLOCK_LEAVES):
+            return True
+        return "." not in d and \
+            ctx.from_imported("time").get(d) in _CLOCK_LEAVES
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, ast.While):
+                continue
+            scope = list(ast.walk(loop.test)) \
+                + [n for st in loop.body if not isinstance(st, nested)
+                   for n in _walk_skipping(st, skip_types=nested)]
+            sleeps = [n for n in scope if isinstance(n, ast.Call)
+                      and self._is_sleep(ctx, n)]
+            if not sleeps:
+                continue
+            if any(isinstance(n, ast.Call) and self._is_clock_read(ctx, n)
+                   for n in scope):
+                continue        # a clock read implies a deadline check
+            sev = ERROR if _in_serving_hot_path(ctx.path) else WARNING
+            yield self.finding(
+                ctx, sleeps[0].lineno,
+                "time.sleep retry spin with no deadline in a `while` loop"
+                + (" in a serving/inference path" if sev == ERROR else "")
+                + " — bound it through common.reliability.RetryPolicy "
+                  "(delays()/wait_for) or check a time.monotonic() "
+                  "deadline",
+                severity=sev)

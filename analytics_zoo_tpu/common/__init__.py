@@ -1,3 +1,5 @@
 from .context import ZooContext, init_zoo_context, get_zoo_context, reset_zoo_context  # noqa: F401
+from .reliability import (CircuitBreaker, CircuitOpenError,  # noqa: F401
+                          RetryPolicy)
 from .triggers import (EveryEpoch, SeveralIteration, MaxEpoch, MaxIteration,  # noqa: F401
                        MinLoss, TrainLoopState, Trigger)

@@ -51,6 +51,7 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.metrics.flops": False,          # fit(): cost-analysis pass feeding the MFU gauge
     "zoo.failure.retry_times": 5,        # ≅ bigdl.failure.retryTimes (Topology.scala:1172)
     "zoo.failure.retry_window_sec": 3600,
+    "zoo.faults.enabled": False,         # gate for common.faults.activate (chaos tests)
     "zoo.checkpoint.keep": 3,
     "zoo.log.level": "INFO",
 }

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...common.reliability import RetryPolicy
 from ...models.common.zoo_model import load_model
 from ...observability import default_registry, instrument_jit
 from ...parallel import mesh as mesh_lib
@@ -136,11 +137,20 @@ class InferenceModel:
     """
 
     def __init__(self, concurrent_num: int = 1, *,
-                 max_batch_size: int = 4096, registry=None):
+                 max_batch_size: int = 4096, registry=None,
+                 readback_retry: Optional[RetryPolicy] = None):
         if concurrent_num < 1:
             raise ValueError("concurrent_num must be >= 1")
         self.concurrent_num = int(concurrent_num)
         self.max_batch_size = int(max_batch_size)
+        #: chunk readbacks cross the device link (a tunneled/remote
+        #: transport on some deployments) — transient transport errors
+        #: retry under this policy instead of failing the whole predict;
+        #: non-transport errors (shape bugs, OOM) propagate immediately
+        self._readback_retry = readback_retry if readback_retry \
+            is not None else RetryPolicy(
+                max_attempts=3, base_delay=0.05, max_delay=0.5,
+                retryable=(ConnectionError, OSError))
         self.metrics = registry if registry is not None else default_registry()
         self._m_permit_wait = self.metrics.histogram(
             "zoo_inference_permit_wait_seconds",
@@ -388,9 +398,15 @@ class InferenceModel:
         outs = []       # host results, in chunk order
 
         def readback_oldest():
+            # device_get rides the device transport: retried under the
+            # readback policy so one dropped link round-trip does not
+            # fail a predict whose compute already succeeded
             yp, m = deferred.pop(0)
-            outs.append(jax.tree.map(
-                lambda a, mm=m: np.asarray(jax.device_get(a))[:mm], yp))
+            host = self._readback_retry.call(
+                lambda: jax.tree.map(lambda a: np.asarray(
+                    jax.device_get(a)), yp),
+                op="inference.readback", registry=self.metrics)
+            outs.append(jax.tree.map(lambda a, mm=m: a[:mm], host))
 
         try:
             for i in range(0, n, cap):

@@ -13,6 +13,15 @@ is an interface with two implementations:
 
 Backpressure is explicit: a bounded input stream makes ``xadd`` block (up to
 a timeout) instead of the reference's used_memory-threshold polling.
+
+Reliability (``docs/guides/RELIABILITY.md``): every wait is bounded — a
+``timeout=None`` falls back to the backend's ``default_timeout`` instead
+of spinning forever, and the Redis-side polls (full-stream wait, result
+wait) back off through ``common.reliability.RetryPolicy`` rather than a
+fixed 10 ms spin. Both backends carry named fault-injection sites
+(``common.faults``: ``backend.xadd`` / ``backend.xread`` /
+``backend.stream_len`` / ``backend.set_result`` / ``backend.set_results``)
+so the chaos tests can kill a "connection" deterministically mid-serve.
 """
 
 from __future__ import annotations
@@ -22,8 +31,15 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..common import faults
+from ..common.reliability import RetryPolicy
+
 __all__ = ["LocalBackend", "RedisBackend", "QueueFullError",
            "default_backend"]
+
+#: bound applied when a caller passes ``timeout=None`` — an unbounded
+#: producer/consumer wait turns a dead serve loop into a hung client
+_DEFAULT_TIMEOUT = 30.0
 
 
 class QueueFullError(RuntimeError):
@@ -47,10 +63,17 @@ def default_backend() -> "LocalBackend":
 
 
 class LocalBackend:
-    """In-process stream + result store with Redis-stream-like semantics."""
+    """In-process stream + result store with Redis-stream-like semantics.
 
-    def __init__(self, maxlen: int = 10000):
+    Waits are condition-based (no polling) and BOUNDED: ``timeout=None``
+    means ``default_timeout``, not forever — ``xadd`` raises
+    ``QueueFullError`` and ``pop_result`` returns None once it elapses.
+    """
+
+    def __init__(self, maxlen: int = 10000,
+                 default_timeout: float = _DEFAULT_TIMEOUT):
         self.maxlen = maxlen
+        self.default_timeout = default_timeout
         self._streams: Dict[str, List[Tuple[str, dict]]] = {}
         self._results: Dict[str, dict] = {}
         self._lock = threading.Condition()
@@ -60,6 +83,8 @@ class LocalBackend:
     def xadd(self, stream: str, fields: dict,
              timeout: Optional[float] = None) -> str:
         """Append; blocks while the stream holds ``maxlen`` unread entries."""
+        faults.inject("backend.xadd")
+        timeout = self.default_timeout if timeout is None else timeout
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             entries = self._streams.setdefault(stream, [])
@@ -80,6 +105,7 @@ class LocalBackend:
               block_ms: int = 100) -> List[Tuple[str, dict]]:
         """Pop up to ``count`` entries, waiting up to ``block_ms`` for the
         first (consume-on-read: the serving loop is the only consumer group)."""
+        faults.inject("backend.xread")
         deadline = time.monotonic() + block_ms / 1000.0
         with self._lock:
             entries = self._streams.setdefault(stream, [])
@@ -94,11 +120,13 @@ class LocalBackend:
             return out
 
     def stream_len(self, stream: str) -> int:
+        faults.inject("backend.stream_len")
         with self._lock:
             return len(self._streams.get(stream, []))
 
     # -- results -----------------------------------------------------------
     def set_result(self, uri: str, fields: dict) -> None:
+        faults.inject("backend.set_result")
         with self._lock:
             self._results[uri] = dict(fields)
             self._lock.notify_all()
@@ -110,6 +138,18 @@ class LocalBackend:
         ``notify_all`` each)."""
         if not results:
             return
+        spec = faults.inject("backend.set_results")
+        if spec is not None and spec.kind == "partial_write":
+            # the injected mid-write crash: apply a prefix of the batch,
+            # then fail like a dropped connection would
+            uris = list(results)
+            keep = uris[:max(int(len(uris) * spec.fraction), 0)]
+            with self._lock:
+                for uri in keep:
+                    self._results[uri] = dict(results[uri])
+                self._lock.notify_all()
+            raise ConnectionError(
+                f"injected partial write: {len(keep)}/{len(uris)} applied")
         with self._lock:
             for uri, fields in results.items():
                 self._results[uri] = dict(fields)
@@ -117,6 +157,7 @@ class LocalBackend:
 
     def pop_result(self, uri: str,
                    timeout: Optional[float] = None) -> Optional[dict]:
+        timeout = self.default_timeout if timeout is None else timeout
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while uri not in self._results:
@@ -146,39 +187,72 @@ class RedisBackend:
     when installed, otherwise the in-repo RESP wire client
     (``serving/resp.py``) — no package dependency to talk to a real
     server. The ``data``/``value`` payload fields round-trip as raw
-    bytes (wire-format v2); all other fields are text."""
+    bytes (wire-format v2); all other fields are text.
+
+    The full-stream and result waits poll with jittered backoff through
+    ``poll_policy`` (no fixed-interval spin hammering the server) and
+    are bounded by ``default_timeout`` when the caller passes no
+    timeout. Transport-level reconnects live one layer down, in the
+    RESP client (``serving/resp.py``)."""
 
     def __init__(self, host: str = "localhost", port: int = 6379,
-                 maxlen: int = 10000):
+                 maxlen: int = 10000,
+                 default_timeout: float = _DEFAULT_TIMEOUT,
+                 poll_policy: Optional[RetryPolicy] = None):
         try:
             import redis
             self._r = redis.Redis(host=host, port=port)
+            # redis-py's transport errors subclass RedisError, NOT the
+            # builtin ConnectionError — normalize them (see _call) or the
+            # breaker/retry classification upstream never engages
+            self._driver_errors: Tuple[type, ...] = (
+                redis.exceptions.ConnectionError,
+                redis.exceptions.TimeoutError)
         except ImportError:
             from .resp import RespClient
             self._r = RespClient(host=host, port=port)
+            self._driver_errors = ()    # RespClient raises builtins already
         self.maxlen = maxlen
+        self.default_timeout = default_timeout
+        #: backoff for the client-side polls (full stream, result wait):
+        #: starts near the old 10 ms spin, backs off to 50 ms so a long
+        #: wait costs dozens of round trips, not thousands
+        self.poll_policy = poll_policy if poll_policy is not None \
+            else RetryPolicy(base_delay=0.005, max_delay=0.05)
         self._last_id: Dict[str, str] = {}
+
+    def _call(self, fn, *args, **kwargs):
+        """One driver call with driver-specific transport exceptions
+        normalized to the builtin ``ConnectionError`` the reliability
+        layer (serve-loop breaker, retry classification) keys on."""
+        try:
+            return fn(*args, **kwargs)
+        except self._driver_errors as e:
+            raise ConnectionError(f"{type(e).__name__}: {e}") from e
 
     def xadd(self, stream: str, fields: dict,
              timeout: Optional[float] = None) -> str:
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while self._r.xlen(stream) >= self.maxlen:
-            if deadline is not None and time.monotonic() > deadline:
-                raise QueueFullError(f"stream {stream!r} full ({self.maxlen})")
-            time.sleep(0.01)
-        return self._r.xadd(stream, fields).decode()
+        timeout = self.default_timeout if timeout is None else timeout
+        if not self.poll_policy.wait_for(
+                lambda: self._call(self._r.xlen, stream) < self.maxlen,
+                timeout=timeout):
+            raise QueueFullError(
+                f"stream {stream!r} full ({self.maxlen}); inference is "
+                f"not keeping up — dequeue or raise maxlen")
+        return self._call(self._r.xadd, stream, fields).decode()
 
     def xread(self, stream: str, count: int,
               block_ms: int = 100) -> List[Tuple[str, dict]]:
         last = self._last_id.get(stream, "0")
-        resp = self._r.xread({stream: last}, count=count, block=block_ms)
+        resp = self._call(self._r.xread, {stream: last}, count=count,
+                          block=block_ms)
         out = []
         for _, entries in resp or []:
             for eid, fields in entries:
                 eid = eid.decode()
                 out.append((eid, self._decode_fields(fields)))
                 self._last_id[stream] = eid
-                self._r.xdel(stream, eid)
+                self._call(self._r.xdel, stream, eid)
         return out
 
     @staticmethod
@@ -192,10 +266,10 @@ class RedisBackend:
         return out
 
     def stream_len(self, stream: str) -> int:
-        return int(self._r.xlen(stream))
+        return int(self._call(self._r.xlen, stream))
 
     def set_result(self, uri: str, fields: dict) -> None:
-        self._r.hset(f"result:{uri}", mapping=fields)
+        self._call(self._r.hset, f"result:{uri}", mapping=fields)
 
     def set_results(self, results: Dict[str, dict]) -> None:
         """Batched result publish: ONE pipelined round trip for the whole
@@ -207,24 +281,29 @@ class RedisBackend:
         pipe = self._r.pipeline()
         for uri, fields in results.items():
             pipe.hset(f"result:{uri}", mapping=fields)
-        pipe.execute()
+        self._call(pipe.execute)
 
     def pop_result(self, uri: str,
                    timeout: Optional[float] = None) -> Optional[dict]:
-        deadline = None if timeout is None else time.monotonic() + timeout
+        timeout = self.default_timeout if timeout is None else timeout
         key = f"result:{uri}"
-        while True:
-            vals = self._r.hgetall(key)
+        found: List[Dict[bytes, bytes]] = []
+
+        def check() -> bool:
+            vals = self._call(self._r.hgetall, key)
             if vals:
-                self._r.delete(key)
-                return self._decode_fields(vals)
-            if deadline is not None and time.monotonic() > deadline:
-                return None
-            time.sleep(0.01)
+                found.append(vals)
+                return True
+            return False
+
+        if not self.poll_policy.wait_for(check, timeout=timeout):
+            return None
+        self._call(self._r.delete, key)
+        return self._decode_fields(found[0])
 
     def pop_all_results(self) -> Dict[str, dict]:
         out = {}
-        for key in self._r.keys("result:*"):
+        for key in self._call(self._r.keys, "result:*"):
             uri = key.decode().split(":", 1)[1]
             res = self.pop_result(uri, timeout=0)
             if res is not None:

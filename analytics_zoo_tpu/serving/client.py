@@ -223,7 +223,8 @@ class InputQueue:
         self.timeout = timeout
 
     def enqueue(self, uri: str, data: np.ndarray,
-                trace: Optional[str] = None) -> str:
+                trace: Optional[str] = None,
+                deadline_ms: Optional[int] = None) -> str:
         """Enqueue one record (wire-format v2: raw bytes + dtype/shape).
         Every record is stamped with a Dapper-style ``trace`` id (16 hex
         chars; pass ``trace=`` to adopt a caller's id, e.g. an upstream
@@ -231,12 +232,22 @@ class InputQueue:
         dispatch, and publish, emitting per-request phase events under
         that id so the JSON event log holds each request's exact latency
         breakdown. Records enqueued by foreign producers without the
-        field still serve; they just have no trace."""
+        field still serve; they just have no trace.
+
+        ``deadline_ms`` stamps an ABSOLUTE epoch-millisecond deadline
+        (the clock the stream entry ids already share): a server reading
+        the record after it has passed answers a distinct ``deadline
+        exceeded`` error instead of spending dispatch on a request whose
+        caller has already timed out. Producers typically stamp
+        ``int(time.time() * 1000) + budget_ms``. No stamp = no deadline
+        (the pre-deadline contract, unchanged)."""
         fields = encode_tensor(np.asarray(data))
         fields["uri"] = uri
         # falsy trace ("" from an unset upstream header) mints too —
         # stamping "" would merge unrelated requests into one bogus trace
         fields["trace"] = trace or new_trace_id()
+        if deadline_ms is not None:
+            fields["deadline_ms"] = str(int(deadline_ms))
         return self.backend.xadd(self.stream, fields, timeout=self.timeout)
 
 

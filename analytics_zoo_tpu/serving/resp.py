@@ -13,15 +13,42 @@ XREAD never holds up a producer thread's ``xadd``/``set_result``, and a
 connection that errors mid-command (timeout, partial read) is DISCARDED,
 never returned to the pool — a desynced socket would answer the next
 command with the previous command's late reply.
+
+Transparent reconnect (``docs/guides/RELIABILITY.md``): a transport
+error (``ConnectionError``/``OSError``) discards the socket and — for
+**idempotent** commands — retries on a fresh connection under the
+client's ``RetryPolicy`` (backoff + bounded attempts), counting each
+round in ``zoo_backend_reconnects_total{backend="resp"}``. The
+classification is per-op: every command in the serving contract is
+idempotent-in-effect (re-running XLEN/XREAD/HGETALL/KEYS/PING reads the
+same state; HSET re-writes the same fields; DEL/XDEL of a gone key is a
+no-op) EXCEPT ``XADD``, whose server-assigned entry id means a blind
+retry could enqueue — and serve, and bill — the same record twice.
+XADD therefore stays at-most-once: the error propagates to the producer,
+who owns the decision to re-enqueue. Pipelines retry as a unit only when
+every buffered command is idempotent; a retry discards all partial
+replies from the dead socket (they can never pair with the new
+connection's stream).
 """
 
 from __future__ import annotations
 
+import itertools
+import logging
 import socket
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
+from ..common.reliability import RetryPolicy
+
+log = logging.getLogger("analytics_zoo_tpu.serving.resp")
+
 __all__ = ["RespClient", "RespError", "RespPipeline"]
+
+#: commands whose blind re-execution changes observable state — everything
+#: else in the serving contract may retry transparently (see module doc)
+_NON_IDEMPOTENT = frozenset({"XADD"})
 
 
 class RespError(RuntimeError):
@@ -98,11 +125,24 @@ class _Conn:
 
 class RespClient:
     def __init__(self, host: str = "localhost", port: int = 6379,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, retry: Optional[RetryPolicy] = None,
+                 registry=None):
         self._host, self._port, self._timeout = host, port, timeout
+        #: reconnect/retry schedule for idempotent commands; pass a seeded
+        #: policy for deterministic backoff in tests
+        self._retry = retry if retry is not None else RetryPolicy(
+            max_attempts=4, base_delay=0.05, max_delay=1.0)
         self._pool: List[_Conn] = []
         self._pool_lock = threading.Lock()
         self._closed = False
+        self._m_reconnects = None
+        if registry is None:
+            from ..observability import default_registry
+            registry = default_registry()
+        self._m_reconnects = registry.counter(
+            "zoo_backend_reconnects_total",
+            "transport errors answered with a reconnect + retry",
+            labels={"backend": "resp"})
         self._release(_Conn(host, port, timeout))  # eager: bad host fails now
 
     def _acquire(self) -> _Conn:
@@ -127,22 +167,70 @@ class RespClient:
                 c.close()
             self._pool.clear()
 
-    def command(self, *parts):
-        c = self._acquire()
-        try:
-            c.send(*parts)
-            reply = c.read_reply()
-        except RespError:
-            # protocol-level error reply: the stream stayed in sync
+    @staticmethod
+    def _op_name(parts) -> str:
+        op = parts[0] if parts else ""
+        if isinstance(op, bytes):
+            op = op.decode("ascii", "replace")
+        return str(op).upper()
+
+    def _retries(self, retryable: bool):
+        """The reconnect schedule for one logical command: a leading None
+        (the first attempt sleeps nothing), then the policy's backoff
+        delays — empty for non-idempotent ops, which get ONE attempt."""
+        if not retryable:
+            return iter((None,))
+        return itertools.chain((None,), self._retry.delays())
+
+    def _run_with_reconnect(self, label: str, retryable: bool, attempt):
+        """The one reconnect-retry scaffold both surfaces share:
+        acquire a connection, run ``attempt(conn)``, release on success.
+        A transport error (connect refused / timeout / partial read /
+        loss) DISCARDS the socket — it may hold a late reply that would
+        answer the next command — and, for retryable work only, retries
+        fresh under the backoff schedule, counting each round in the
+        reconnect metric. ``attempt`` raising :class:`RespError` means
+        the reply stream stayed in sync: the connection is released and
+        the error propagates without a retry. Any other exception
+        discards the connection and propagates."""
+        last: Optional[BaseException] = None
+        for delay in self._retries(retryable):
+            if delay is not None:
+                self._m_reconnects.inc()
+                log.warning("resp %s hit %s; reconnecting in %.3fs",
+                            label, last, delay)
+                if delay > 0:
+                    time.sleep(delay)
+            c: Optional[_Conn] = None
+            try:
+                c = self._acquire()     # may itself fail: server down
+                result = attempt(c)
+            except RespError:
+                self._release(c)
+                raise
+            except (ConnectionError, OSError) as e:
+                if c is not None:
+                    c.close()
+                last = e
+                continue
+            except Exception:
+                if c is not None:
+                    c.close()
+                raise
             self._release(c)
-            raise
-        except Exception:
-            # timeout / partial read / connection loss: the socket may hold
-            # a late reply that would answer the NEXT command — discard it
-            c.close()
-            raise
-        self._release(c)
-        return reply
+            return result
+        assert last is not None
+        raise last
+
+    def command(self, *parts):
+        op = self._op_name(parts)
+
+        def attempt(c: _Conn):
+            c.send(*parts)
+            return c.read_reply()
+
+        return self._run_with_reconnect(op, op not in _NON_IDEMPOTENT,
+                                        attempt)
 
     def execute_many(self, commands):
         """Pipelined execution: write every command frame in ONE socket
@@ -150,29 +238,35 @@ class RespClient:
         trip for the whole batch (how the async publisher lands a
         batch's result hashes). An error REPLY keeps the stream in sync
         (remaining replies are still read, the first error raises after
-        the pass); a transport error discards the connection like
-        :meth:`command` does."""
+        the pass). A transport error discards the connection and — when
+        every command in the batch is idempotent — retries the WHOLE
+        batch on a fresh one, dropping any partial replies read off the
+        dead socket (a reply that might pair with an un-applied command
+        must never be surfaced). A batch containing a non-idempotent
+        command (XADD) never retries: the error propagates with the
+        stream state at most once-applied."""
         commands = list(commands)
         if not commands:
             return []
-        c = self._acquire()
-        replies, first_err = [], None
-        try:
+        retryable = all(self._op_name(c) not in _NON_IDEMPOTENT
+                        for c in commands)
+
+        def attempt(c: _Conn):
             c.sock.sendall(b"".join(_frame(parts) for parts in commands))
+            replies, first_err = [], None
             for _ in commands:
                 try:
                     replies.append(c.read_reply())
                 except RespError as e:
+                    # an error REPLY: the stream stays in sync — keep
+                    # reading so later replies pair with their commands
                     replies.append(e)
                     if first_err is None:
                         first_err = e
-        except Exception:
-            # timeout / partial read / connection loss mid-batch: the
-            # socket may hold late replies that would answer the NEXT
-            # command — discard it, never return it to the pool
-            c.close()
-            raise
-        self._release(c)
+            return replies, first_err
+
+        replies, first_err = self._run_with_reconnect(
+            f"pipeline({len(commands)} cmds)", retryable, attempt)
         if first_err is not None:
             raise first_err
         return replies

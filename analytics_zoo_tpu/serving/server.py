@@ -29,6 +29,23 @@ codec and publish work stay off the dispatch critical path):
   result encode + backend writes (batched via ``set_results``) plus the
   publish-side bookkeeping, so the serve loop never blocks on per-record
   encode or result-store round trips.
+
+The runtime is **self-healing** (``docs/guides/RELIABILITY.md``):
+
+* both loops run under a **supervisor** — an escaped exception restarts
+  the loop with bounded backoff (``zoo_serving_loop_restarts_total``),
+  and after ``max_loop_restarts`` crashes the server gives up, flipping
+  ``/healthz`` to ``down`` with the last traceback on ``/statusz``;
+* stream reads are guarded by a **circuit breaker** — transient
+  ``ConnectionError``/``OSError`` from the backend is absorbed in-loop,
+  consecutive failures open the breaker so a down backend is probed, not
+  hammered;
+* producers may stamp a ``deadline_ms`` — expired records are answered
+  with a distinct ``deadline exceeded`` error before any dispatch;
+* a batch whose dispatch crashes is retried **one record at a time**
+  (isolating a poison record so its batch-mates still serve); records
+  that keep crashing are dead-lettered with an addressable error
+  (``zoo_serving_dead_letter_total``) instead of retrying forever.
 """
 
 from __future__ import annotations
@@ -38,11 +55,14 @@ import logging
 import queue
 import threading
 import time
+import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import faults
+from ..common.reliability import CircuitBreaker, RetryPolicy
 from ..observability import default_registry, span
 from .backend import LocalBackend, default_backend
 from .client import (INPUT_STREAM, decode_payload, encode_array,
@@ -156,7 +176,11 @@ class ClusterServing:
     def __init__(self, model, backend: Optional[LocalBackend] = None,
                  batch_size: int = 32, stream: str = INPUT_STREAM,
                  block_ms: int = 50, registry=None, decode_workers: int = 2,
-                 max_inflight: int = 2, publish_queue: int = 8):
+                 max_inflight: int = 2, publish_queue: int = 8,
+                 max_loop_restarts: int = 5,
+                 restart_backoff: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 dispatch_retries: int = 1):
         self.model = model          # InferenceModel (or any .predict(x))
         self.backend = backend if backend is not None else default_backend()
         self.batch_size = int(batch_size)
@@ -194,7 +218,8 @@ class ClusterServing:
             "records dropped with an undecodable-payload error")
         self._m_failures = m.counter(
             "zoo_serving_failures_total",
-            "records answered with an inference-failure error")
+            "records answered with a failure error, all kinds (see "
+            "zoo_serving_failure_errors_total for the breakdown)")
         self._m_depth = m.gauge(
             "zoo_serving_stream_depth", "input-stream backlog after a read")
         self._m_backlog = m.gauge(
@@ -237,6 +262,40 @@ class ClusterServing:
         self._last_flush_wall = None   # epoch s of the newest publish
         self._events = None         # JsonEventSink (set_json_events)
         self._scrape = None         # ScrapeServer (serve_metrics)
+        # -- reliability (docs/guides/RELIABILITY.md) -----------------------
+        #: crashes each supervised loop survives per start() before the
+        #: supervisor gives up and /healthz reads down
+        self.max_loop_restarts = max(int(max_loop_restarts), 0)
+        #: backoff between restarts (its delays stretch restart storms;
+        #: the restart COUNT bound is max_loop_restarts)
+        self._restart_policy = restart_backoff if restart_backoff \
+            is not None else RetryPolicy(
+                max_attempts=self.max_loop_restarts + 1,
+                base_delay=0.05, max_delay=1.0)
+        #: guards the loop's backend reads: consecutive transport failures
+        #: open it, so a down backend gets probes, not a poll storm
+        self._breaker = breaker if breaker is not None else CircuitBreaker(
+            name="serving.backend", failure_threshold=3, reset_timeout=1.0,
+            registry=m)
+        #: solo re-dispatch attempts per record after its batch crashed
+        #: (0 = fail the whole batch immediately, the pre-reliability
+        #: behavior); beyond this the record is dead-lettered
+        self.dispatch_retries = max(int(dispatch_retries), 0)
+        self._m_restarts = {
+            name: m.counter(
+                "zoo_serving_loop_restarts_total",
+                "supervised loop restarts after an escaped exception",
+                labels={"loop": name})
+            for name in ("serve", "publish")}
+        self._m_deadline = m.counter(
+            "zoo_serving_deadline_exceeded_total",
+            "records answered with a deadline-exceeded error before "
+            "dispatch")
+        self._m_dead_letter = m.counter(
+            "zoo_serving_dead_letter_total",
+            "records dead-lettered after repeated dispatch crashes")
+        self._crash_info: Dict[str, str] = {}   # loop -> last traceback
+        self._loop_down: set = set()            # loops whose supervisor gave up
 
     def set_tensorboard(self, log_dir: str,
                         app_name: str = "serving") -> "ClusterServing":
@@ -294,22 +353,38 @@ class ClusterServing:
         """Serve-loop introspection for /healthz and /statusz. Runs on
         the scrape thread — reads only cheap fields and the backend's
         stream length (its lock is held per operation, never across a
-        dispatch)."""
+        dispatch). A loop whose supervisor gave up flips the whole
+        payload's ``status`` to ``down``, with the last traceback
+        included (what /statusz shows an operator first)."""
         age = (None if self._last_flush_wall is None
                else max(time.time() - self._last_flush_wall, 0.0))
         thread = self._thread
         pub = self._pub_queue
-        return {"serving": {
-            # is_alive, not a None check: a serve loop killed by an
+        try:
+            depth = self.backend.stream_len(self.stream)
+        except Exception as e:      # a dead backend must not 500 /healthz
+            depth = None
+            log.debug("stream_len failed on the scrape thread: %s", e)
+        down = sorted(self._loop_down)
+        info = {"serving": {
+            # is_alive AND not given-up: a serve loop killed by an
             # escaped exception must read as down — a liveness endpoint
             # that says ok over a dead loop is worse than none
-            "running": thread is not None and thread.is_alive(),
-            "stream_depth": self.backend.stream_len(self.stream),
+            "running": (thread is not None and thread.is_alive()
+                        and "serve" not in self._loop_down),
+            "stream_depth": depth,
             "served": self.served,
             "batches": self._batches,
             "publish_backlog": 0 if pub is None else pub.qsize(),
             "last_flush_age_s": age,
+            "backend_breaker": self._breaker.state,
+            "loops_down": down,
         }}
+        if self._crash_info:
+            info["serving"]["last_crash"] = dict(self._crash_info)
+        if down:
+            info["status"] = "down"
+        return info
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ClusterServing":
@@ -317,32 +392,82 @@ class ClusterServing:
             raise RuntimeError("serving already started")
         self._stop.clear()
         self._t_last_flush = None   # a restart must not span the downtime
+        self._crash_info = {}
+        self._loop_down = set()
         if self.decode_workers > 0:
             self._pool = ThreadPoolExecutor(
                 max_workers=self.decode_workers,
                 thread_name_prefix="serving-decode")
         self._pub_queue = queue.Queue(maxsize=self._pub_maxsize)
         self._pub_thread = threading.Thread(
-            target=self._publisher_loop, daemon=True,
-            name="cluster-serving-publish")
+            target=self._supervised, args=("publish", self._publisher_loop),
+            daemon=True, name="cluster-serving-publish")
         self._pub_thread.start()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="cluster-serving")
+        self._thread = threading.Thread(
+            target=self._supervised, args=("serve", self._loop),
+            daemon=True, name="cluster-serving")
         self._thread.start()
         return self
+
+    def _supervised(self, name: str, body) -> None:
+        """Run a loop body under restart supervision (the Ray
+        actor-restart discipline): an escaped exception logs, records its
+        traceback for /statusz, increments
+        ``zoo_serving_loop_restarts_total{loop=name}`` and re-enters the
+        body after a bounded backoff. After ``max_loop_restarts`` crashes
+        the supervisor gives up — the loop lands in ``_loop_down`` and
+        /healthz reads ``down`` (a crash-looping server must page, not
+        flap forever). Clean returns (stop requested, publisher
+        sentinel) end supervision."""
+        delays = self._restart_policy.delays()
+        crashes = 0
+        while True:
+            try:
+                body()
+                return
+            except Exception:
+                tb = traceback.format_exc()
+                self._crash_info[name] = tb
+                if self._stop.is_set():
+                    return              # crashed into shutdown: just exit
+                crashes += 1
+                self.metrics.emit("serving.loop_crash", loop=name,
+                                  crashes=crashes, traceback=tb)
+                if crashes > self.max_loop_restarts:
+                    log.error("%s loop crashed %d times; supervisor giving "
+                              "up — /healthz now reads down:\n%s",
+                              name, crashes, tb)
+                    self._loop_down.add(name)
+                    return
+                delay = next(delays, self._restart_policy.max_delay)
+                log.exception("%s loop crashed (%d/%d); restarting in "
+                              "%.3fs", name, crashes,
+                              self.max_loop_restarts, delay)
+                self._m_restarts[name].inc()
+                if self._stop.wait(delay):
+                    return
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop the loop; with ``drain`` first wait for the stream to
         empty. The publisher always drains: every batch the serve loop
-        handed it is published before the sinks close."""
+        handed it is published before the sinks close. A backend that is
+        already down cannot veto shutdown: the drain poll logs and skips
+        instead of raising, and workers/sinks still join and close."""
         if self._thread is None:
             self._shutdown_workers(timeout)
             self._close_sinks()
             return
         if drain:
             deadline = time.monotonic() + timeout
-            while (self.backend.stream_len(self.stream) > 0
-                   and time.monotonic() < deadline):
+            while time.monotonic() < deadline:
+                try:
+                    if self.backend.stream_len(self.stream) <= 0:
+                        break
+                except Exception as e:
+                    log.warning("stop(drain=True): backend unavailable "
+                                "(%s: %s); skipping the drain",
+                                type(e).__name__, e)
+                    break
                 time.sleep(0.01)
         self._stop.set()
         self._thread.join(timeout=timeout)
@@ -406,8 +531,8 @@ class ClusterServing:
         pendings: "collections.deque[_Pending]" = collections.deque()
         try:
             while not self._stop.is_set():
-                entries = self.backend.xread(self.stream, self.batch_size,
-                                             block_ms=self.block_ms)
+                faults.inject("serving.loop")
+                entries = self._read_entries()
                 if not entries:
                     self._drain(pendings)
                     continue
@@ -415,7 +540,7 @@ class ClusterServing:
                 # drain checks below — we are the only consumer, so the
                 # backlog can only grow between here and those checks
                 # (a stale 0 errs toward flushing, never toward parking)
-                depth = self.backend.stream_len(self.stream)
+                depth = self._stream_depth()
                 self._m_depth.set(depth)
                 recs, batch, arena, ragged = self._assemble(entries)
                 if not recs and not ragged:
@@ -460,6 +585,51 @@ class ClusterServing:
         while pendings:
             self._flush(pendings.popleft())
 
+    def _read_entries(self):
+        """One breaker-guarded stream read. Transport failures
+        (``ConnectionError``/``OSError`` — a dropped Redis connection)
+        are absorbed HERE: they count against the breaker and return an
+        empty read instead of killing the loop, so a blip costs one poll
+        interval, not a loop restart. While the breaker is open the
+        backend is left alone until the next probe window (the wait is
+        stop-aware). Anything non-transport still escapes to the
+        supervisor — a bug must restart the loop loudly, not spin
+        silently."""
+        if not self._breaker.allow():
+            self._stop.wait(min(max(self._breaker.probe_in(), 0.001),
+                                self.block_ms / 1000.0))
+            return []
+        try:
+            entries = self.backend.xread(self.stream, self.batch_size,
+                                         block_ms=self.block_ms)
+        except (ConnectionError, OSError) as e:
+            self._breaker.record_failure()
+            log.warning("input-stream read failed (%s: %s); breaker %s",
+                        type(e).__name__, e, self._breaker.state)
+            self.metrics.emit("serving.backend_error", op="xread",
+                              error=f"{type(e).__name__}: {e}",
+                              breaker=self._breaker.state)
+            return []
+        except Exception:
+            # non-transport escape (a bug, a protocol error): resolve the
+            # admitted call as a failure BEFORE the supervisor takes over
+            # — a half-open probe slot left in flight would refuse every
+            # future allow() and wedge the restarted loop forever
+            self._breaker.record_failure()
+            raise
+        self._breaker.record_success()
+        return entries
+
+    def _stream_depth(self) -> int:
+        """Post-read depth for the gauge/drain checks; a failing backend
+        reads as 0, which errs toward flushing (never toward parking a
+        dispatched batch behind a dead backend)."""
+        try:
+            return self.backend.stream_len(self.stream)
+        except (ConnectionError, OSError) as e:
+            log.debug("stream_len failed after a read: %s", e)
+            return 0
+
     # -- batch assembly ------------------------------------------------------
     def _assemble(self, entries):
         """Decode one read into ``(recs, batch, arena, ragged)``.
@@ -490,6 +660,12 @@ class ClusterServing:
                 # to write an error record to
                 log.error("record with no uri dropped (entry id %s)", eid)
                 self._drop_undecodable(fields)
+                continue
+            if self._expired(fields, now_s):
+                # answered BEFORE validation/decode/dispatch spend
+                # anything on a record whose producer has already given
+                # up (the point of a deadline is not wasting the budget)
+                self._drop_expired(fields)
                 continue
             hdr = None
             if is_v2(fields):
@@ -572,6 +748,45 @@ class ClusterServing:
             return list(self._pool.map(one, items))
         return [one(i) for i in items]
 
+    @staticmethod
+    def _expired(fields, now_s: float) -> bool:
+        """Whether the record's producer-stamped ``deadline_ms`` (absolute
+        epoch ms, the clock the entry ids already share) has passed.
+        Malformed stamps serve anyway — a producer bug must not turn into
+        dropped traffic."""
+        dl = fields.get("deadline_ms")
+        if dl is None:
+            return False
+        try:
+            return now_s * 1000.0 > float(str(dl))
+        except (TypeError, ValueError):
+            log.warning("unparseable deadline_ms %r; serving the record "
+                        "without a deadline", dl)
+            return False
+
+    def _drop_expired(self, fields) -> None:
+        """Answer an expired record with the distinct ``deadline
+        exceeded`` error — counted in its own family AND the
+        error-labeled failure breakdown, so an operator can tell a
+        deadline storm from a broken model in one scrape. Like
+        ``_drop_undecodable``, no phase events were emitted yet, so the
+        drop leaves no dangling trace."""
+        self._m_deadline.inc()
+        self._m_failures.inc()
+        self.metrics.counter(
+            "zoo_serving_failure_errors_total",
+            "failed records by error kind (model vs result-store)",
+            labels={"error": "deadline exceeded"}).inc()
+        self.metrics.emit("serving.deadline", uri=fields.get("uri"),
+                          trace=fields.get("trace"),
+                          deadline_ms=fields.get("deadline_ms"))
+        try:
+            self.backend.set_result(fields["uri"],
+                                    {"error": "deadline exceeded"})
+        except Exception:
+            log.exception("deadline-error record for %r could not be "
+                          "written (backend down?)", fields.get("uri"))
+
     def _drop_undecodable(self, fields) -> None:
         """Registry + event + (when addressable) an error record so the
         producer's ``query()`` fails fast instead of blocking out its
@@ -639,6 +854,7 @@ class ClusterServing:
         t0 = time.perf_counter()
         arena_owned = True
         try:
+            faults.inject("serving.dispatch")
             async_fn = getattr(self.model, "predict_async", None)
             if async_fn is not None:
                 collect = self._probe_dispatch(async_fn, batch, len(recs))
@@ -665,10 +881,75 @@ class ClusterServing:
             self._flush(_Pending(recs, (lambda: preds), t0, arena))
         except Exception:
             log.exception("inference dispatch failed for %d records; "
-                          "writing errors", len(recs))
-            self._record_failure(recs, parent="dequeue")
+                          "retrying one record at a time", len(recs))
+            # copy each record's input out BEFORE the arena goes back to
+            # the pool — a later read may overwrite it mid-retry
+            rows = None
+            if batch is not None and self.dispatch_retries > 0:
+                rows = [np.array(batch[i:i + 1]) for i in range(len(recs))]
             if arena_owned:
                 self._arena_pool.release(arena)
+            self._retry_or_dead_letter(recs, rows, pendings)
+
+    def _predict_once(self, batch):
+        """One synchronous model call for the retry path (the server
+        accepts models exposing either surface)."""
+        predict = getattr(self.model, "predict", None)
+        if predict is not None:
+            return predict(batch)
+        return self.model.predict_async(batch)()
+
+    def _retry_or_dead_letter(self, recs, rows, pendings) -> None:
+        """After a batch dispatch crash: re-dispatch each record ALONE,
+        up to ``dispatch_retries`` times. One poison record (a payload
+        that crashes the model) must not fail its batch-mates — they
+        serve from their solo retries — and must itself be dead-lettered
+        with an addressable error instead of being retried forever.
+        Runs synchronously on the serve loop: the crashed batch already
+        forfeited its pipeline slot, and bounded-blocking here is the
+        backpressure."""
+        if rows is None:
+            self._record_failure(recs, parent="dequeue")
+            return
+        # release the window's replica permits first: a blocking solo
+        # predict with every permit tied up in pendings would deadlock
+        # exactly like the dispatch-before-flush order this loop avoids
+        self._drain(pendings)
+        retry_counter = self.metrics.counter(
+            "zoo_retry_attempts_total",
+            "retries performed by reliability.RetryPolicy, by operation",
+            labels={"op": "serving.dispatch"})
+        for rec, row in zip(recs, rows):
+            err = None
+            for attempt in range(self.dispatch_retries):
+                retry_counter.inc()     # every solo re-dispatch is a retry
+                t1 = time.perf_counter()
+                try:
+                    faults.inject("serving.dispatch")
+                    with span("serving.dispatch", registry=self.metrics,
+                              records=1):
+                        preds = np.asarray(self._predict_once(row))
+                except Exception as e:
+                    err = e
+                    log.warning("solo re-dispatch of %r failed "
+                                "(attempt %d/%d): %s", rec.uri, attempt + 1,
+                                self.dispatch_retries, e)
+                    continue
+                self._emit_dispatch([rec], t1)
+                self._pub_queue.put(([rec], preds, t1))
+                self._m_backlog.set(self._pub_queue.qsize())
+                err = None
+                break
+            if err is not None:
+                log.error("record %r crashed dispatch %d time(s); "
+                          "dead-lettering", rec.uri,
+                          self.dispatch_retries + 1)
+                self._m_dead_letter.inc()
+                self.metrics.emit("serving.dead_letter", uri=rec.uri,
+                                  trace=rec.trace, error=str(err))
+                self._record_failure(
+                    [rec], parent="dequeue",
+                    error="dead-lettered: dispatch crashed repeatedly")
 
     def _probe_dispatch(self, async_fn, batch, n: int):
         """Non-blocking dispatch probe. Spans cover the MODEL calls only —

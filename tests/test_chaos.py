@@ -1,0 +1,388 @@
+"""Chaos harness: seeded, deterministic faults against a live serving
+stack, reconciled EXACTLY against the injected plan.
+
+Every scenario asserts the self-healing contract end to end:
+
+* **zero lost records** — every enqueued uri is eventually answered with
+  a prediction or an addressable error (never a hang),
+* **bounded recovery** — the serve loop restarts at most the configured
+  bound; a down backend trips the breaker instead of a poll/crash storm,
+* **exact metric reconciliation** — restart / breaker / deadline /
+  dead-letter counters match ``plan.fired`` one for one,
+* **zero orphaned traces** — every traced record ends in a terminal
+  ``publish`` or ``failed`` phase event.
+
+All waits are sub-50ms (tiny backoffs, tiny breaker windows); the query
+timeouts are safety nets, not sleeps.
+"""
+
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.common.faults import FaultPlan
+from analytics_zoo_tpu.common.reliability import CircuitBreaker, RetryPolicy
+from analytics_zoo_tpu.observability import MetricsRegistry, read_events
+from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                       LocalBackend, OutputQueue,
+                                       ServingError)
+
+
+def _toy_model():
+    init_zoo_context(faults_enabled=True)
+    m = Sequential()
+    m.add(Dense(4, input_shape=(6,), activation="relu"))
+    m.add(Dense(3, activation="softmax"))
+    m.init_weights()
+    return m
+
+
+def _serving(model, backend, reg, **kw):
+    """A server with chaos-friendly (tiny, seeded) recovery knobs."""
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("block_ms", 20)
+    kw.setdefault("max_loop_restarts", 3)
+    kw.setdefault("restart_backoff", RetryPolicy(
+        max_attempts=4, base_delay=0.005, max_delay=0.02, seed=7))
+    kw.setdefault("breaker", CircuitBreaker(
+        "serving.backend", failure_threshold=2, reset_timeout=0.05,
+        registry=reg))
+    return ClusterServing(model, backend=backend, registry=reg, **kw)
+
+
+def _enqueue(backend, n, prefix="c"):
+    inq = InputQueue(backend)
+    rng = np.random.default_rng(11)
+    xs = {f"{prefix}-{i}": rng.normal(size=(6,)).astype(np.float32)
+          for i in range(n)}
+    for uri, x in xs.items():
+        inq.enqueue(uri, x)
+    return xs
+
+
+def test_mid_serve_disconnect_recovers_via_breaker(tmp_path):
+    """Kill the stream connection twice mid-serve: the loop absorbs the
+    first failure, the second opens the breaker, the probe read closes
+    it, and every record is still answered — no loop restart, no lost
+    records, breaker metrics reconciled exactly against the plan."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    xs = _enqueue(backend, 12)           # pre-enqueued: read order is fixed
+    plan = FaultPlan(seed=3).add("backend.xread", "disconnect", at=(1, 2))
+    serving = _serving(im, backend, reg)
+    serving.set_json_events(str(tmp_path / "events.jsonl"))
+    outq = OutputQueue(backend)
+    with faults.activate(plan):
+        serving.start()
+        try:
+            results = {uri: outq.query(uri, timeout=30.0) for uri in xs}
+        finally:
+            serving.stop(drain=False)
+    direct = np.asarray(im.predict(np.stack(list(xs.values()))))
+    for i, uri in enumerate(xs):
+        assert results[uri] is not None, f"lost record {uri}"
+        np.testing.assert_allclose(results[uri], direct[i],
+                                   rtol=1e-5, atol=1e-6)
+    # exact reconciliation against the plan
+    assert plan.fired == [("backend.xread", "disconnect", 1),
+                          ("backend.xread", "disconnect", 2)]
+    snap = reg.snapshot()
+    b = 'zoo_breaker_transitions_total{breaker="serving.backend",state="%s"}'
+    assert snap[b % "open"]["value"] == 1          # exactly one trip
+    assert snap[b % "half_open"]["value"] == 1     # one probe window
+    assert snap[b % "closed"]["value"] == 1        # probe succeeded
+    assert snap['zoo_breaker_state{breaker="serving.backend"}']["value"] == 0
+    # transient transport blips are absorbed in-loop: NOT a crash/restart
+    assert snap['zoo_serving_loop_restarts_total{loop="serve"}']["value"] == 0
+    assert snap["zoo_serving_failures_total"]["value"] == 0
+    assert snap["zoo_serving_records_total"]["value"] == 12
+    # zero orphaned traces: every record's trace ends in a publish event
+    events = read_events(str(tmp_path / "events.jsonl"), kind="request")
+    by_trace = {}
+    for e in events:
+        by_trace.setdefault(e["trace"], []).append(e["phase"])
+    assert len(by_trace) == 12
+    for trace, phases in by_trace.items():
+        assert phases.count("publish") == 1, (trace, phases)
+        assert set(phases) == {"enqueue", "dequeue", "dispatch", "publish"}
+
+
+def test_loop_crash_restarts_under_supervisor():
+    """An escaped exception in the serve loop (a bug, not a transport
+    blip) restarts it with backoff; records enqueued before AND after the
+    crash are all answered; the restart counter matches the plan."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    xs = _enqueue(backend, 8, prefix="r")
+    plan = FaultPlan(seed=5).add("serving.loop", "error", at=(1,))
+    serving = _serving(im, backend, reg)
+    outq = OutputQueue(backend)
+    with faults.activate(plan):
+        serving.start()
+        try:
+            results = {uri: outq.query(uri, timeout=30.0) for uri in xs}
+        finally:
+            serving.stop(drain=False)
+    assert all(v is not None and v.shape == (3,) for v in results.values())
+    assert plan.fired == [("serving.loop", "error", 1)]
+    snap = reg.snapshot()
+    assert snap['zoo_serving_loop_restarts_total{loop="serve"}']["value"] == 1
+    assert snap["zoo_serving_records_total"]["value"] == 8
+    assert snap["zoo_serving_failures_total"]["value"] == 0
+
+
+def test_supervisor_gives_up_and_healthz_reads_down():
+    """A crash-looping serve loop stops flapping after max_loop_restarts:
+    /healthz flips to down and /statusz carries the last traceback — the
+    operator pages instead of the loop thrashing forever."""
+    import json
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    # crash EVERY iteration: initial run + the single allowed restart
+    plan = FaultPlan(seed=9).add("serving.loop", "error",
+                                 at=tuple(range(16)))
+    serving = _serving(im, backend, reg, max_loop_restarts=1)
+    scrape = serving.serve_metrics(port=0)
+    with faults.activate(plan):
+        serving.start()
+        try:
+            # the supervisor gives up quickly (two tiny backoffs)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if serving._thread is not None \
+                        and not serving._thread.is_alive():
+                    break
+                time.sleep(0.005)
+            base = f"http://{scrape.host}:{scrape.port}"
+            with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+                health = json.loads(r.read())
+            with urllib.request.urlopen(base + "/statusz", timeout=10) as r:
+                status = json.loads(r.read())
+        finally:
+            serving.stop(drain=False)
+    assert health["status"] == "down"
+    assert health["serving"]["running"] is False
+    assert "serve" in health["serving"]["loops_down"]
+    assert "FaultError" in status["serving"]["last_crash"]["serve"]
+    snap = reg.snapshot()
+    # exactly the configured bound, then give-up — no restart storm
+    assert snap['zoo_serving_loop_restarts_total{loop="serve"}']["value"] == 1
+    assert len(plan.fired) == 2          # initial crash + the one restart
+
+
+def test_dispatch_crash_retries_records_solo_with_no_loss():
+    """A batch whose dispatch crashes is re-dispatched one record at a
+    time: a transient crash costs one retry round and zero records."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    xs = _enqueue(backend, 4, prefix="d")       # exactly one batch
+    plan = FaultPlan(seed=1).add("serving.dispatch", "error", at=(0,))
+    serving = _serving(im, backend, reg)
+    outq = OutputQueue(backend)
+    with faults.activate(plan):
+        serving.start()
+        try:
+            results = {uri: outq.query(uri, timeout=30.0) for uri in xs}
+        finally:
+            serving.stop(drain=False)
+    direct = np.asarray(im.predict(np.stack(list(xs.values()))))
+    for i, uri in enumerate(xs):
+        assert results[uri] is not None
+        np.testing.assert_allclose(results[uri], direct[i],
+                                   rtol=1e-5, atol=1e-6)
+    assert plan.fired == [("serving.dispatch", "error", 0)]
+    snap = reg.snapshot()
+    assert snap['zoo_retry_attempts_total{op="serving.dispatch"}'][
+        "value"] == 4                            # one solo retry per record
+    assert snap["zoo_serving_failures_total"]["value"] == 0
+    assert snap["zoo_serving_dead_letter_total"]["value"] == 0
+    assert snap["zoo_serving_records_total"]["value"] == 4
+
+
+def test_poison_records_dead_letter_instead_of_retrying_forever():
+    """Records that crash EVERY dispatch attempt are answered with the
+    distinct dead-letter error after the bounded retries — reconciled
+    against the plan's fired log, never an infinite retry loop."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    xs = _enqueue(backend, 2, prefix="p")
+    plan = FaultPlan(seed=2).add("serving.dispatch", "error",
+                                 at=tuple(range(32)))
+    serving = _serving(im, backend, reg)
+    outq = OutputQueue(backend)
+    with faults.activate(plan):
+        serving.start()
+        try:
+            errors = {}
+            for uri in xs:
+                with pytest.raises(ServingError) as ei:
+                    outq.query(uri, timeout=30.0)
+                errors[uri] = str(ei.value)
+        finally:
+            serving.stop(drain=False)
+    assert all("dead-lettered" in e for e in errors.values())
+    # batch attempt + one solo attempt per record, nothing more
+    assert [f[:2] for f in plan.fired] == \
+        [("serving.dispatch", "error")] * 3
+    snap = reg.snapshot()
+    assert snap["zoo_serving_dead_letter_total"]["value"] == 2
+    assert snap["zoo_serving_failures_total"]["value"] == 2
+    assert snap['zoo_serving_failure_errors_total{error="dead-lettered: '
+                'dispatch crashed repeatedly"}']["value"] == 2
+    assert snap['zoo_retry_attempts_total{op="serving.dispatch"}'][
+        "value"] == 2
+
+
+def test_expired_deadline_answered_before_dispatch():
+    """A record whose producer-stamped deadline_ms has passed is answered
+    with the distinct `deadline exceeded` error without spending dispatch
+    on it; in-budget records in the same read still serve."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(6,)).astype(np.float32)
+    now_ms = int(time.time() * 1000)
+    inq.enqueue("late", x, deadline_ms=now_ms - 1)        # already expired
+    inq.enqueue("ok", x, deadline_ms=now_ms + 60_000)     # plenty of budget
+    inq.enqueue("no-deadline", x)                         # old contract
+    serving = _serving(im, backend, reg)
+    serving.start()
+    try:
+        with pytest.raises(ServingError, match="deadline exceeded"):
+            outq.query("late", timeout=30.0)
+        assert outq.query("ok", timeout=30.0) is not None
+        assert outq.query("no-deadline", timeout=30.0) is not None
+    finally:
+        serving.stop(drain=False)
+    snap = reg.snapshot()
+    assert snap["zoo_serving_deadline_exceeded_total"]["value"] == 1
+    assert snap['zoo_serving_failure_errors_total{error="deadline '
+                'exceeded"}']["value"] == 1
+    assert snap["zoo_serving_records_total"]["value"] == 2
+
+
+def test_partial_result_write_leaves_no_silent_loss(tmp_path):
+    """A result-store write that dies mid-batch (half applied, then the
+    connection drops) must leave every record answered — value or
+    addressable error — and every trace terminated."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    xs = _enqueue(backend, 4, prefix="w")
+    plan = FaultPlan(seed=6).add("backend.set_results", "partial_write",
+                                 at=(0,), fraction=0.5)
+    serving = _serving(im, backend, reg)
+    serving.set_json_events(str(tmp_path / "events.jsonl"))
+    outq = OutputQueue(backend)
+    with faults.activate(plan):
+        serving.start()
+        try:
+            answered = {}
+            for uri in xs:
+                try:
+                    answered[uri] = ("value", outq.query(uri, timeout=30.0))
+                except ServingError as e:
+                    answered[uri] = ("error", str(e))
+        finally:
+            serving.stop(drain=False)
+    assert plan.fired == [("backend.set_results", "partial_write", 0)]
+    # every record addressably answered (publish failure overwrites the
+    # half-written values with the distinct publish-failure error)
+    assert set(answered) == set(xs)
+    assert all(v is not None for _, v in answered.values())
+    assert any(kind == "error" and "result publish failed" in v
+               for kind, v in answered.values())
+    snap = reg.snapshot()
+    assert snap['zoo_serving_failure_errors_total{error="result publish '
+                'failed"}']["value"] == 4
+    # zero orphaned traces: each of the 4 ends in a terminal phase event
+    events = read_events(str(tmp_path / "events.jsonl"), kind="request")
+    by_trace = {}
+    for e in events:
+        by_trace.setdefault(e["trace"], []).append(e["phase"])
+    assert len(by_trace) == 4
+    for trace, phases in by_trace.items():
+        assert sum(p in ("publish", "failed") for p in phases) == 1, \
+            (trace, phases)
+
+
+def test_stop_drain_survives_dead_backend():
+    """stop(drain=True) against a backend that died mid-flight logs and
+    skips the drain instead of raising out of the stream_len poll —
+    workers still join, sinks still close."""
+
+    class DyingBackend(LocalBackend):
+        def __init__(self):
+            super().__init__()
+            self.dead = False
+
+        def stream_len(self, stream):
+            if self.dead:
+                raise ConnectionError("backend is gone")
+            return super().stream_len(stream)
+
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = DyingBackend()
+    xs = _enqueue(backend, 4, prefix="s")
+    serving = _serving(im, backend, reg)
+    outq = OutputQueue(backend)
+    serving.start()
+    results = {uri: outq.query(uri, timeout=30.0) for uri in xs}
+    assert all(v is not None for v in results.values())
+    backend.dead = True
+    serving.stop(drain=True, timeout=10.0)      # must not raise
+    assert serving._thread is None and serving._pub_thread is None
+    # and the server is restartable against a recovered backend
+    backend.dead = False
+    serving.start()
+    serving.stop(drain=False)
+
+
+def test_probe_crash_does_not_wedge_the_breaker():
+    """Regression: a NON-transport exception during the admitted
+    half-open probe read escaped to the supervisor without resolving the
+    probe slot — the restarted loop then found allow() refusing forever
+    and never read the stream again. The probe now records a failure
+    before escaping: the breaker re-opens cleanly, the next window's
+    probe succeeds, and every record still serves."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    xs = _enqueue(backend, 8, prefix="pb")
+    plan = (FaultPlan(seed=8)
+            .add("backend.xread", "disconnect", at=(1, 2))  # trip it open
+            .add("backend.xread", "error", at=(3,)))        # crash the probe
+    serving = _serving(im, backend, reg)
+    outq = OutputQueue(backend)
+    with faults.activate(plan):
+        serving.start()
+        try:
+            results = {uri: outq.query(uri, timeout=30.0) for uri in xs}
+        finally:
+            serving.stop(drain=False)
+    assert all(v is not None and v.shape == (3,) for v in results.values())
+    assert [f[:2] for f in plan.fired] == [
+        ("backend.xread", "disconnect"), ("backend.xread", "disconnect"),
+        ("backend.xread", "error")]
+    snap = reg.snapshot()
+    b = 'zoo_breaker_transitions_total{breaker="serving.backend",state="%s"}'
+    assert snap[b % "open"]["value"] == 2       # trip + probe-crash re-open
+    assert snap['zoo_breaker_state{breaker="serving.backend"}']["value"] == 0
+    # the probe crash is non-transport: it restarts the loop (once)
+    assert snap['zoo_serving_loop_restarts_total{loop="serve"}']["value"] == 1
+    assert snap["zoo_serving_records_total"]["value"] == 8

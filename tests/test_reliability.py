@@ -1,0 +1,298 @@
+"""common.reliability + common.faults unit coverage: deterministic
+backoff under a seeded policy, deadline caps, retry classification, the
+breaker state machine (half-open admits exactly ONE probe), and the
+fault plan's call-indexed determinism."""
+
+import threading
+
+import pytest
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common.faults import FaultError, FaultPlan
+from analytics_zoo_tpu.common.reliability import (CircuitBreaker,
+                                                  CircuitOpenError,
+                                                  RetryPolicy)
+from analytics_zoo_tpu.observability import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def test_backoff_sequence_is_deterministic_under_a_seed():
+    p1 = RetryPolicy(max_attempts=6, base_delay=0.01, max_delay=0.5, seed=42)
+    p2 = RetryPolicy(max_attempts=6, base_delay=0.01, max_delay=0.5, seed=42)
+    a, b = list(p1.delays()), list(p2.delays())
+    assert a == b and len(a) == 5
+    # the same policy consulted twice yields the SAME sequence (fresh rng
+    # per call, not a continuation)
+    assert list(p1.delays()) == a
+    # full jitter: every delay inside its exponential envelope
+    for k, d in enumerate(a):
+        assert 0.0 <= d <= min(0.5, 0.01 * 2 ** k)
+    # a different seed yields a different schedule
+    assert list(RetryPolicy(max_attempts=6, base_delay=0.01, max_delay=0.5,
+                            seed=43).delays()) != a
+
+
+def test_jitterless_policy_is_the_exponential_envelope():
+    p = RetryPolicy(max_attempts=5, base_delay=0.01, max_delay=0.05,
+                    jitter=False)
+    assert list(p.delays()) == [0.01, 0.02, 0.04, 0.05]
+
+
+def test_deadline_cap_truncates_the_sequence():
+    import time
+    p = RetryPolicy(max_attempts=50, base_delay=0.01, max_delay=0.01,
+                    jitter=False)
+    deadline = time.monotonic() + 0.03
+    ds = list(p.delays(deadline))
+    # ~3 delays fit a 30ms budget at 10ms each; never the full 49
+    assert 1 <= len(ds) <= 5
+    assert sum(ds) <= 0.03 + 0.01
+
+
+def test_call_retries_transient_then_raises_last_error():
+    reg = MetricsRegistry()
+    p = RetryPolicy(max_attempts=3, base_delay=0.0, max_delay=0.0, seed=0)
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        raise ConnectionError(f"boom {len(attempts)}")
+
+    with pytest.raises(ConnectionError, match="boom 3"):
+        p.call(flaky, op="test.flaky", sleep=lambda s: None, registry=reg)
+    assert len(attempts) == 3
+    snap = reg.snapshot()
+    assert snap['zoo_retry_attempts_total{op="test.flaky"}']["value"] == 2
+
+    # success after one failure returns the value
+    state = {"n": 0}
+
+    def recovers():
+        state["n"] += 1
+        if state["n"] < 2:
+            raise OSError("transient")
+        return "ok"
+
+    assert p.call(recovers, sleep=lambda s: None) == "ok"
+
+
+def test_call_does_not_retry_non_retryable_errors():
+    p = RetryPolicy(max_attempts=5, base_delay=0.0, max_delay=0.0)
+    attempts = []
+
+    def bug():
+        attempts.append(1)
+        raise ValueError("a bug, not an outage")
+
+    with pytest.raises(ValueError):
+        p.call(bug, sleep=lambda s: None)
+    assert len(attempts) == 1
+    # per-op classification override: the caller may widen or narrow
+    with pytest.raises(ValueError):
+        p.call(bug, classify=lambda e: isinstance(e, ValueError),
+               sleep=lambda s: None)
+    assert len(attempts) == 1 + 5
+
+
+def test_wait_for_polls_until_true_or_deadline():
+    p = RetryPolicy(base_delay=0.001, max_delay=0.002, seed=1)
+    state = {"n": 0}
+
+    def ready():
+        state["n"] += 1
+        return state["n"] >= 4
+
+    assert p.wait_for(ready, timeout=5.0) is True
+    assert state["n"] == 4
+    assert p.wait_for(lambda: False, timeout=0.02) is False
+    # timeout=0 still checks once (the immediate-success fast path)
+    assert p.wait_for(lambda: True, timeout=0.0) is True
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_opens_after_consecutive_failures_and_reprobes():
+    clock = _Clock()
+    reg = MetricsRegistry()
+    cb = CircuitBreaker("db", failure_threshold=3, reset_timeout=10.0,
+                        clock=clock, registry=reg)
+    # successes keep resetting the consecutive count
+    for _ in range(2):
+        assert cb.allow()
+        cb.record_failure()
+    assert cb.allow()
+    cb.record_success()
+    for _ in range(3):
+        assert cb.allow()
+        cb.record_failure()
+    assert cb.state == "open"
+    assert not cb.allow()
+    assert cb.probe_in() == pytest.approx(10.0)
+    snap = reg.snapshot()
+    assert snap['zoo_breaker_state{breaker="db"}']["value"] == 1
+    assert snap['zoo_breaker_transitions_total{breaker="db",'
+                'state="open"}']["value"] == 1
+
+
+def test_half_open_admits_exactly_one_probe():
+    clock = _Clock()
+    cb = CircuitBreaker("q", failure_threshold=1, reset_timeout=5.0,
+                        clock=clock)
+    cb.allow()
+    cb.record_failure()
+    assert cb.state == "open" and not cb.allow()
+    clock.t = 5.0
+    # the reset window elapsed: exactly ONE probe is admitted; further
+    # callers are refused until the probe resolves
+    assert cb.allow() is True
+    assert cb.state == "half_open"
+    assert cb.allow() is False
+    assert cb.allow() is False
+    # probe failure -> back to open with a FRESH window
+    cb.record_failure()
+    assert cb.state == "open" and not cb.allow()
+    clock.t = 10.0
+    assert cb.allow() is True          # next single probe
+    assert cb.allow() is False
+    cb.record_success()                # probe success closes
+    assert cb.state == "closed"
+    assert cb.allow() and cb.allow()   # closed admits freely
+
+
+def test_breaker_call_wrapper_raises_circuit_open():
+    clock = _Clock()
+    cb = CircuitBreaker("w", failure_threshold=1, reset_timeout=3.0,
+                        clock=clock)
+    with pytest.raises(RuntimeError, match="boom"):
+        cb.call(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    with pytest.raises(CircuitOpenError) as ei:
+        cb.call(lambda: "never runs")
+    assert ei.value.breaker == "w" and ei.value.retry_in <= 3.0
+    clock.t = 3.0
+    assert cb.call(lambda: "ok") == "ok"
+    assert cb.state == "closed"
+
+
+def test_breaker_single_probe_under_contention():
+    """Thread-safety of the one-probe rule: many threads racing allow()
+    in half-open get exactly one admission."""
+    clock = _Clock()
+    cb = CircuitBreaker("c", failure_threshold=1, reset_timeout=1.0,
+                        clock=clock)
+    cb.allow()
+    cb.record_failure()
+    clock.t = 1.0
+    admitted = []
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        if cb.allow():
+            admitted.append(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(admitted) == 1
+
+
+# ---------------------------------------------------------------------------
+# fault plans
+# ---------------------------------------------------------------------------
+
+def _enable_faults():
+    from analytics_zoo_tpu.common.context import init_zoo_context
+    init_zoo_context(faults_enabled=True)
+
+
+def test_fault_plan_fires_at_exact_call_indices():
+    _enable_faults()
+    plan = FaultPlan(seed=0)
+    plan.add("site.a", "error", at=(1, 3))
+    plan.add("site.b", "disconnect", at=(0,))
+    with faults.activate(plan):
+        faults.inject("site.a")                      # call 0: clean
+        with pytest.raises(FaultError):
+            faults.inject("site.a")                  # call 1: fires
+        faults.inject("site.a")                      # call 2: clean
+        with pytest.raises(FaultError):
+            faults.inject("site.a")                  # call 3: fires
+        with pytest.raises(ConnectionError):
+            faults.inject("site.b")
+        faults.inject("site.unknown")                # unplanned site: no-op
+    assert plan.fired == [("site.a", "error", 1), ("site.a", "error", 3),
+                          ("site.b", "disconnect", 0)]
+    assert plan.calls("site.a") == 4
+    # outside the activation block injection is inert again
+    assert faults.active_plan() is None
+    faults.inject("site.a")
+
+
+def test_fault_activation_requires_context_flag(monkeypatch):
+    from analytics_zoo_tpu.common import context as ctx_mod
+    from analytics_zoo_tpu.common.context import init_zoo_context
+    init_zoo_context(faults_enabled=False)
+    with pytest.raises(RuntimeError, match="zoo.faults.enabled"):
+        with faults.activate(FaultPlan()):
+            pass
+    init_zoo_context(faults_enabled=True)
+    with faults.activate(FaultPlan(seed=1).add("x", "error", at=(0,))):
+        pass
+    # nested activation is refused — two plans' counters would interleave
+    with faults.activate(FaultPlan(seed=2).add("x", "error", at=(0,))):
+        with pytest.raises(RuntimeError, match="already active"):
+            with faults.activate(FaultPlan()):
+                pass
+
+
+def test_fault_latency_and_custom_exception():
+    _enable_faults()
+    plan = (FaultPlan(seed=0)
+            .add("slow", "latency", at=(0,), delay_s=0.01)
+            .add("custom", "error", at=(0,), exc=KeyError("weird")))
+    with faults.activate(plan):
+        import time
+        t0 = time.perf_counter()
+        assert faults.inject("slow") is None
+        assert time.perf_counter() - t0 >= 0.01
+        with pytest.raises(KeyError):
+            faults.inject("custom")
+
+
+def test_fault_spec_validation():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan().add("x", "explode", at=(0,))
+    with pytest.raises(ValueError, match="fires never"):
+        FaultPlan().add("x", "error")
+
+
+def test_wait_for_survives_thousands_of_polls():
+    """Regression: the backoff envelope computed 2.0**k with unbounded k,
+    so a long-lived poll (a producer waiting out a 30s queue-full window
+    at ~tiny delays) crashed with OverflowError at poll 1025. The
+    exponent is now capped — the envelope saturates at max_delay."""
+    p = RetryPolicy(base_delay=1e-9, max_delay=1e-9, jitter=False)
+    state = {"n": 0}
+
+    def ready():
+        state["n"] += 1
+        return state["n"] >= 1500
+
+    assert p.wait_for(ready, timeout=60.0, sleep=lambda s: None) is True
+    assert state["n"] == 1500
+    assert p._envelope(5000) == 1e-9        # no overflow, saturated

@@ -523,3 +523,75 @@ def test_all_undecodable_read_flushes_parked_batch():
         assert out is not None and float(out[0]) == 5.0
     finally:
         serving.stop(drain=False, timeout=10.0)
+
+
+def test_status_cli_fleet_rollup_across_replicas(tmp_path):
+    """cluster-serving-status with several endpoints rolls the replicas'
+    quantile summaries into one fleet table (QuantileDigest.merge) and
+    sums counters — the multi-server deployment view (ROADMAP follow-up
+    from PR 3/4)."""
+    import os
+    import subprocess
+    import sys
+
+    from analytics_zoo_tpu import observability as obs
+
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(scripts) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+
+    model = _toy_model()
+    im = InferenceModel().from_keras(model)
+    servers = []
+    endpoints = []
+    counts = (6, 10)
+    try:
+        for r, n in enumerate(counts):
+            reg = obs.MetricsRegistry()
+            backend = LocalBackend()
+            serving = ClusterServing(im, backend=backend, batch_size=4,
+                                     registry=reg)
+            scrape = serving.serve_metrics(port=0)
+            serving.start()
+            servers.append(serving)
+            endpoints.append(f"{scrape.host}:{scrape.port}")
+            inq, outq = InputQueue(backend), OutputQueue(backend)
+            rng = np.random.default_rng(20 + r)
+            for i in range(n):
+                inq.enqueue(f"f{r}-{i}",
+                            rng.normal(size=(6,)).astype(np.float32))
+            for i in range(n):
+                assert outq.query(f"f{r}-{i}", timeout=30.0) is not None
+        r = subprocess.run(
+            [sys.executable, os.path.join(scripts, "cluster-serving-status"),
+             *endpoints],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        # each replica's health line prints, then ONE fleet table
+        for ep in endpoints:
+            assert f"== http://{ep} : ok" in r.stdout
+        assert "fleet roll-up across 2 replica(s)" in r.stdout
+        assert "fleet-wide latency quantiles" in r.stdout
+        # the merged e2e family reports the summed record count
+        fleet_line = next(
+            ln for ln in r.stdout.splitlines()
+            if ln.strip().startswith("zoo_serving_e2e_quantiles_seconds"))
+        assert f"{sum(counts)}" in fleet_line.split()
+        # counters summed across replicas
+        records_line = next(
+            ln for ln in r.stdout.splitlines()
+            if ln.strip().startswith("zoo_serving_records_total"))
+        assert records_line.split()[-1] == str(sum(counts))
+        # an SLO no fleet can meet breaches against the MERGED rows
+        r2 = subprocess.run(
+            [sys.executable, os.path.join(scripts, "cluster-serving-status"),
+             *endpoints, "--slo-p99-ms", "e2e=0.0000001"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert r2.returncode == 2
+        assert "SLO breach" in r2.stderr
+    finally:
+        for s in servers:
+            s.stop(drain=False)

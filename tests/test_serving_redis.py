@@ -313,3 +313,172 @@ def test_full_serving_flow_over_redis(redis_port):
                                        atol=1e-6)
     finally:
         serving.stop()
+
+
+# ---------------------------------------------------------------------------
+# RESP reconnect semantics (docs/guides/RELIABILITY.md): idempotent
+# commands retry transparently on a fresh connection; XADD never
+# double-applies; a pipeline's partial replies are invalidated wholesale.
+# Always against the MINI server (deterministic fault scripting).
+# ---------------------------------------------------------------------------
+
+class _FlakyHandler(_Handler):
+    """The mini-redis handler plus a per-command fault script:
+    ``server.state.fault_script[CMD]`` is a FIFO of ``"before"`` (drop the
+    connection without applying) / ``"after"`` (APPLY the command, then
+    drop without replying — the worst case for idempotency)."""
+
+    def handle(self):
+        st = self.server.state
+        buf = b""
+        while True:
+            try:
+                cmd, buf = self._read_command(buf)
+            except (ConnectionError, OSError):
+                return
+            if cmd is None:
+                return
+            name = cmd[0].upper().decode()
+            with st.lock:
+                script = getattr(st, "fault_script", {}).get(name) or []
+                fault = script.pop(0) if script else None
+            if fault == "before":
+                return                          # dropped, nothing applied
+            try:
+                reply = getattr(self, "_do_" + name.lower())(st, cmd[1:])
+            except AttributeError:
+                reply = b"-ERR unknown command '%s'\r\n" % name.encode()
+            if fault == "after":
+                return                          # applied, reply lost
+            try:
+                self.request.sendall(reply)
+            except OSError:
+                return
+
+
+@pytest.fixture()
+def flaky_server():
+    srv = _MiniRedisServer(("127.0.0.1", 0))
+    srv.RequestHandlerClass = _FlakyHandler
+    srv.state.fault_script = {}
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield srv
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _client(srv, **kw):
+    from analytics_zoo_tpu.common.reliability import RetryPolicy
+    from analytics_zoo_tpu.observability import MetricsRegistry
+    from analytics_zoo_tpu.serving.resp import RespClient
+    reg = MetricsRegistry()
+    kw.setdefault("retry", RetryPolicy(max_attempts=3, base_delay=0.001,
+                                       max_delay=0.005, seed=4))
+    c = RespClient(port=srv.server_address[1], timeout=5.0,
+                   registry=reg, **kw)
+    return c, reg
+
+
+def test_idempotent_command_reconnects_transparently(flaky_server):
+    c, reg = _client(flaky_server)
+    c.xadd("s", {"k": "v"})
+    # the next XLEN's connection drops mid-command: the client must
+    # discard the socket, reconnect, and answer correctly
+    flaky_server.state.fault_script["XLEN"] = ["before"]
+    assert c.xlen("s") == 1
+    snap = reg.snapshot()
+    assert snap['zoo_backend_reconnects_total{backend="resp"}']["value"] == 1
+    # a later command reuses the healthy pool without further retries
+    assert c.ping()
+    assert reg.snapshot()[
+        'zoo_backend_reconnects_total{backend="resp"}']["value"] == 1
+
+
+def test_xadd_is_never_double_applied(flaky_server):
+    """The worst case: the server APPLIES the XADD, then the connection
+    dies before the reply. A blind retry would enqueue (and serve, and
+    bill) the record twice — the client must raise instead, leaving the
+    stream at exactly one copy."""
+    c, _ = _client(flaky_server)
+    flaky_server.state.fault_script["XADD"] = ["after"]
+    with pytest.raises((ConnectionError, OSError)):
+        c.xadd("once", {"uri": "a"})
+    assert c.xlen("once") == 1          # applied exactly once, no retry
+    # and a drop BEFORE apply surfaces too (at-most-once, caller decides)
+    flaky_server.state.fault_script["XADD"] = ["before"]
+    with pytest.raises((ConnectionError, OSError)):
+        c.xadd("once", {"uri": "b"})
+    assert c.xlen("once") == 1
+
+
+def test_pipeline_retries_whole_batch_and_invalidates_partial_replies(
+        flaky_server):
+    """An all-idempotent pipeline whose connection dies after the server
+    applied part of it retries as a UNIT on a fresh connection: partial
+    replies are discarded with the dead socket and the final state is
+    exactly the batch (HSET is idempotent-in-effect)."""
+    c, reg = _client(flaky_server)
+    flaky_server.state.fault_script["HSET"] = ["after"]   # first HSET applies,
+    #                                   then the socket dies mid-pipeline
+    pipe = c.pipeline()
+    pipe.hset("result:a", {"value": "1"})
+    pipe.hset("result:b", {"value": "2"})
+    replies = pipe.execute()
+    assert len(replies) == 2            # full, fresh reply set — no stale
+    #                                     reply paired with the wrong command
+    assert c.hgetall("result:a") == {b"value": b"1"}
+    assert c.hgetall("result:b") == {b"value": b"2"}
+    assert reg.snapshot()[
+        'zoo_backend_reconnects_total{backend="resp"}']["value"] == 1
+
+
+def test_pipeline_with_non_idempotent_command_never_retries(flaky_server):
+    """A pipeline containing an XADD must NOT retry on a transport error
+    — the applied prefix would double-apply. The error propagates and the
+    stream holds at most one copy."""
+    c, _ = _client(flaky_server)
+    flaky_server.state.fault_script["XADD"] = ["after"]
+    with pytest.raises((ConnectionError, OSError)):
+        c.execute_many([("XADD", "mixed", "*", "uri", "x"),
+                        ("HSET", "result:x", "value", "1")])
+    assert c.xlen("mixed") == 1
+
+
+def test_reconnect_gives_up_after_bounded_attempts(flaky_server):
+    """A persistently failing transport must surface the error after the
+    policy's bounded attempts — not spin: every attempt (the pooled
+    connection AND both fresh reconnects) is dropped by the server."""
+    c, reg = _client(flaky_server)
+    assert c.ping()
+    flaky_server.state.fault_script["XLEN"] = ["before"] * 3
+    with pytest.raises((ConnectionError, OSError)):
+        c.xlen("s")
+    snap = reg.snapshot()
+    # max_attempts=3 -> exactly 2 reconnect rounds before giving up
+    assert snap['zoo_backend_reconnects_total{backend="resp"}']["value"] == 2
+
+
+def test_driver_transport_errors_normalize_to_builtin(redis_port):
+    """Regression: redis-py's ConnectionError subclasses RedisError, not
+    the builtin — the serve loop's breaker and the retry classification
+    key on builtins, so RedisBackend normalizes driver transport errors
+    at the boundary (`_call`)."""
+    b = RedisBackend(port=redis_port, maxlen=10)
+
+    class FakeDriverError(Exception):
+        pass
+
+    b._driver_errors = (FakeDriverError,)
+
+    def boom():
+        raise FakeDriverError("driver-specific transport loss")
+
+    with pytest.raises(ConnectionError, match="FakeDriverError"):
+        b._call(boom)
+    assert b._call(lambda: 7) == 7
+    # the RespClient path raises builtins already: nothing to normalize
+    b2 = RedisBackend(port=redis_port)
+    assert b2._driver_errors == ()

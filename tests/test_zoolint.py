@@ -1430,3 +1430,116 @@ def test_zl006_lambda_bodies_are_lazy_not_import_time():
            "def make(cb=lambda: jax.devices()):\n"
            "    return cb\n")
     assert not ids(lint_source(src), "ZL006")
+
+
+# ---------------------------------------------------------------------------
+# ZL010 — unbounded time.sleep retry spin
+# ---------------------------------------------------------------------------
+
+ZL010_BAD = """
+import time
+def wait_until_ready(backend):
+    while not backend.ready():
+        time.sleep(0.01)
+
+def spin_forever(q):
+    while True:
+        if q.poll():
+            handle(q.get())
+        time.sleep(0.01)
+"""
+
+ZL010_CLEAN = """
+import time
+from analytics_zoo_tpu.common.reliability import RetryPolicy
+
+def bounded_by_policy(backend, policy):
+    # the idiomatic fix: a bounded for over the policy's delays
+    for delay in policy.delays():
+        if backend.ready():
+            return True
+        time.sleep(delay)
+    return False
+
+def bounded_by_deadline(backend, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if backend.ready():
+            return True
+        time.sleep(0.01)
+    return False
+"""
+
+
+def test_zl010_triggers_in_hot_path_as_error():
+    fs = lint_source(ZL010_BAD, "analytics_zoo_tpu/serving/backend.py")
+    found = ids(fs, "ZL010")
+    assert len(found) == 2
+    assert len(errors(fs)) == 2
+    fs = lint_source(ZL010_BAD,
+                     "analytics_zoo_tpu/pipeline/inference/im.py")
+    assert errors(fs)
+
+
+def test_zl010_warning_outside_hot_path():
+    """An intentional forever-guard elsewhere (cf. raycontext's
+    parent-watch) is advisory, never a gate failure."""
+    fs = lint_source(ZL010_BAD, "analytics_zoo_tpu/utils/x.py")
+    assert len(ids(fs, "ZL010")) == 2 and not errors(fs)
+
+
+def test_zl010_clean_policy_and_deadline_forms():
+    assert not ids(lint_source(
+        ZL010_CLEAN, "analytics_zoo_tpu/serving/backend.py"), "ZL010")
+
+
+def test_zl010_import_resolved_sleep_and_clock():
+    """Aliased/from-imported time functions resolve like ZL002's: `from
+    time import sleep` still triggers, a local helper named sleep does
+    not, and an aliased monotonic still counts as the deadline check."""
+    src_from = ("from time import sleep\n"
+                "def f(q):\n"
+                "    while not q.ready():\n"
+                "        sleep(0.01)\n")
+    assert ids(lint_source(src_from,
+                           "analytics_zoo_tpu/serving/x.py"), "ZL010")
+    src_alias = ("import time as t\n"
+                 "def f(q, deadline):\n"
+                 "    while not q.ready():\n"
+                 "        if t.monotonic() > deadline:\n"
+                 "            return False\n"
+                 "        t.sleep(0.01)\n"
+                 "    return True\n")
+    assert not ids(lint_source(src_alias,
+                               "analytics_zoo_tpu/serving/x.py"), "ZL010")
+    src_local = ("def sleep(x):\n"
+                 "    return x\n"
+                 "def f(q):\n"
+                 "    while not q.ready():\n"
+                 "        sleep(0.01)\n")
+    assert not ids(lint_source(src_local,
+                               "analytics_zoo_tpu/serving/x.py"), "ZL010")
+
+
+def test_zl010_nested_scope_sleep_not_attributed():
+    """A sleep inside a def nested in the loop body runs when the nested
+    function is CALLED, not per loop iteration — not this loop's spin."""
+    src = ("import time\n"
+           "def f(q):\n"
+           "    while not q.ready():\n"
+           "        def later():\n"
+           "            time.sleep(0.01)\n"
+           "        register(later)\n"
+           "        if q.poll():\n"
+           "            break\n")
+    assert not ids(lint_source(src,
+                               "analytics_zoo_tpu/serving/x.py"), "ZL010")
+
+
+def test_zl010_suppression():
+    src = ZL010_BAD.replace(
+        "        time.sleep(0.01)\n\ndef spin_forever",
+        "        time.sleep(0.01)  # zoolint: disable=ZL010 probe loop\n\n"
+        "def spin_forever")
+    fs = lint_source(src, "analytics_zoo_tpu/serving/backend.py")
+    assert len(ids(fs, "ZL010")) == 1      # the other spin still flags

@@ -53,6 +53,7 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.failure.retry_window_sec": 3600,
     "zoo.faults.enabled": False,         # gate for common.faults.activate (chaos tests)
     "zoo.checkpoint.keep": 3,
+    "zoo.checkpoint.on_sigterm": False,  # SIGTERM during fit → final sync snapshot + clean exit
     "zoo.log.level": "INFO",
 }
 

@@ -25,10 +25,16 @@ Fault kinds:
   raises ``ConnectionError`` — the mid-write crash shape).
 
 Sites are plain strings; the current catalog (grep ``faults.inject`` for
-ground truth): ``backend.xadd`` / ``backend.xread`` /
-``backend.stream_len`` / ``backend.set_result`` / ``backend.set_results``
-(``LocalBackend``), ``serving.loop`` (top of each serve-loop iteration),
-``serving.dispatch`` (before every model call, retries included).
+ground truth): ``backend.xadd`` (``LocalBackend`` AND ``RedisBackend`` —
+chaos against a live server) / ``backend.xread`` / ``backend.stream_len``
+/ ``backend.set_result`` / ``backend.set_results`` (``LocalBackend``),
+``serving.loop`` (top of each serve-loop iteration), ``serving.dispatch``
+(before every model call, retries included), ``resp.send`` /
+``resp.recv`` (one fire per RESP command/pipeline attempt, around the
+wire ops — exercises the reconnect/idempotency rules against a real
+socket), and the checkpoint writer's ``ckpt.write`` (per tree file) /
+``ckpt.manifest`` / ``ckpt.rename`` (the manifest commit,
+``utils/checkpoint.py``).
 
 Determinism: each site keeps a 0-based call counter; a spec fires when
 its site's counter is in ``at`` (or, for rate-based specs, when the

@@ -19,6 +19,12 @@ supervisor discipline of Ray's actor-restart model:
   failure re-opens it with a fresh window. State and transitions are
   exported as ``zoo_breaker_state{breaker=}`` /
   ``zoo_breaker_transitions_total{breaker=,state=}``.
+* :class:`RetryBudget` — a GLOBAL deterministic token bucket shared by
+  every caller of a resource: retries withdraw, successes deposit, an
+  empty bucket refuses further retries
+  (``zoo_retry_budget_exhausted_total{budget=}``) so a correlated outage
+  cannot multiply load fleet-wide the way per-caller backoff alone
+  allows.
 
 Consumers: ``serving/resp.py`` (transparent reconnect), ``serving/
 backend.py`` (bounded full-stream waits), ``serving/server.py``
@@ -41,7 +47,8 @@ from typing import Callable, Iterator, Optional, Tuple, Type
 
 log = logging.getLogger("analytics_zoo_tpu.reliability")
 
-__all__ = ["RetryPolicy", "CircuitBreaker", "CircuitOpenError"]
+__all__ = ["RetryPolicy", "RetryBudget", "CircuitBreaker",
+           "CircuitOpenError"]
 
 #: default transient-transport classification: connection drops, socket
 #: errors and timeouts retry; everything else (protocol errors, bugs)
@@ -61,6 +68,71 @@ class CircuitOpenError(RuntimeError):
                          f"{retry_in:.3f}s")
         self.breaker = name
         self.retry_in = retry_in
+
+
+class RetryBudget:
+    """Global retry token bucket — the fleet-wide brake on correlated
+    retries (the classic Finagle/SRE "retry budget": per-op backoff
+    bounds ONE caller, but when a whole backend goes down every caller
+    retries ``max_attempts`` times at once and the retry storm multiplies
+    the outage load).
+
+    Semantics (deterministic — no RNG, so chaos tests reconcile exactly):
+
+    * each **retry** withdraws one token (``withdraw()`` → False once the
+      bucket is empty; the caller must NOT retry, counting the refusal in
+      ``zoo_retry_budget_exhausted_total{budget=...}``),
+    * each **success** deposits ``deposit`` tokens (capped at
+      ``capacity``), so the sustained retry rate is bounded at roughly
+      ``deposit`` retries per success — a healthy system earns its retry
+      allowance, a broken one drains the bucket once and then fails fast.
+
+    One budget is meant to be SHARED across every caller of a protected
+    resource (pass the same instance to each ``RetryPolicy.call`` /
+    ``ClusterServing(retry_budget=...)``); all methods are thread-safe.
+    """
+
+    def __init__(self, capacity: float = 100.0, deposit: float = 0.1,
+                 name: str = "default", registry=None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1 ({capacity})")
+        if deposit < 0:
+            raise ValueError(f"deposit must be >= 0 ({deposit})")
+        self.capacity = float(capacity)
+        self.deposit = float(deposit)
+        self.name = name
+        self._tokens = float(capacity)
+        self._lock = threading.Lock()
+        self._m_exhausted = None
+        if registry is not None:
+            self._m_exhausted = registry.counter(
+                "zoo_retry_budget_exhausted_total",
+                "retries refused because the shared retry budget was "
+                "empty (a correlated outage draining the bucket)",
+                labels={"budget": name})
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def withdraw(self) -> bool:
+        """Take one token for a retry. False (and a count in the
+        exhausted metric) when the bucket is empty — the caller must not
+        retry."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+        if self._m_exhausted is not None:
+            self._m_exhausted.inc()
+        log.warning("retry budget %r exhausted; refusing retry", self.name)
+        return False
+
+    def on_success(self) -> None:
+        """Deposit after a successful call (retried or not)."""
+        with self._lock:
+            self._tokens = min(self.capacity, self._tokens + self.deposit)
 
 
 class RetryPolicy:
@@ -161,17 +233,23 @@ class RetryPolicy:
     def call(self, fn: Callable, *, op: str = "op",
              classify: Optional[Callable[[BaseException], bool]] = None,
              sleep: Callable[[float], None] = time.sleep,
-             timeout: Optional[float] = None, registry=None):
+             timeout: Optional[float] = None, registry=None,
+             budget: Optional["RetryBudget"] = None):
         """Run ``fn()`` with retries. Non-retryable errors propagate
         immediately; retryable ones back off and re-run until attempts or
         the deadline run out, then the LAST error propagates. Each retry
         increments ``zoo_retry_attempts_total{op=...}`` in ``registry``
         (when given) and logs at warning level — silent retries hide a
-        dying backend until it is fully dead."""
+        dying backend until it is fully dead.
+
+        ``budget`` (a shared :class:`RetryBudget`) additionally gates
+        every retry on the GLOBAL token bucket — an exhausted budget
+        raises the last error immediately instead of piling this caller's
+        retries onto a correlated outage; successes deposit back."""
         deadline = None
-        budget = self.deadline if timeout is None else timeout
-        if budget is not None:
-            deadline = time.monotonic() + budget
+        time_budget = self.deadline if timeout is None else timeout
+        if time_budget is not None:
+            deadline = time.monotonic() + time_budget
         last: Optional[BaseException] = None
         counter = None
         if registry is not None:
@@ -181,17 +259,25 @@ class RetryPolicy:
                 labels={"op": op})
         for d in itertools.chain((None,), self.delays(deadline)):
             if d is not None:
+                if budget is not None and not budget.withdraw():
+                    log.warning("%s: retry budget exhausted after (%s); "
+                                "not retrying", op, last)
+                    break
                 if counter is not None:
                     counter.inc()
                 log.warning("%s failed (%s); retry in %.3fs", op, last, d)
                 if d > 0:
                     sleep(d)
             try:
-                return fn()
+                result = fn()
             except Exception as e:
                 if not self.should_retry(e, classify):
                     raise
                 last = e
+            else:
+                if budget is not None:
+                    budget.on_success()
+                return result
         assert last is not None
         raise last
 

@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import collections
 import logging
+import signal
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -43,6 +45,15 @@ from . import objectives, optimizers as optim_lib
 from .engine import KerasNet
 
 log = logging.getLogger("analytics_zoo_tpu.training")
+
+
+class TrainingPreempted(SystemExit):
+    """Raised out of ``fit`` after a SIGTERM-requested final checkpoint
+    (``zoo.checkpoint.on_sigterm``): the snapshot is on disk, in-memory
+    model state is published, and the process should now exit — a
+    ``SystemExit`` subclass so it escapes the step-failure retry loop and
+    terminates cleanly (the TPU-preemption analogue of the reference's
+    driver-failure snapshot)."""
 
 
 class CompiledSpec:
@@ -292,6 +303,11 @@ class TrainingLoop:
         self._m_predict_records = self._registry.counter(
             "zoo_predict_examples_total", "examples predicted")
         self._flops_per_example: Optional[float] = None
+        # durable checkpointing (docs/guides/TRAINING.md): the manager of
+        # the fit attempt in flight (its async writer is joined/closed by
+        # _close_active_ckpt_mgr) and the SIGTERM preemption latch
+        self._active_ckpt_mgr: Optional[CheckpointManager] = None
+        self._preempted = threading.Event()
 
     # -- jitted steps -------------------------------------------------------
     def build_train_step(self):
@@ -614,33 +630,81 @@ class TrainingLoop:
         keep = spec.get("keep")
         if keep is None:  # keep=0 means keep-all, so no falsy check
             keep = int(ctx.get("zoo.checkpoint.keep", 3))
-        return CheckpointManager(spec["path"], keep=keep)
+        return CheckpointManager(spec["path"], keep=keep,
+                                 registry=self._registry)
 
     def _ckpt_trigger(self) -> Trigger:
         spec = getattr(self.model, "_checkpoint", None) or {}
         return spec.get("trigger") or EveryEpoch()
 
     def _save_checkpoint(self, mgr: CheckpointManager, loop_state, params,
-                         opt_state, net_state) -> None:
+                         opt_state, net_state, sync: bool = False) -> None:
+        """Cut a snapshot. Async by default: the step path pays one host
+        transfer and the serialization/commit rides the manager's writer
+        thread; ``sync=True`` (the SIGTERM path) blocks until committed."""
         mgr.save(loop_state.iteration,
                  {"params": params, "opt_state": opt_state,
                   "net_state": net_state},
                  meta={"epoch": loop_state.epoch,
                        "iteration": loop_state.iteration,
-                       "epoch_finished": loop_state.epoch_finished})
+                       "epoch_finished": loop_state.epoch_finished},
+                 sync=sync)
+
+    def _close_active_ckpt_mgr(self, surface: bool) -> None:
+        """Join the active manager's in-flight save. ``surface=True``
+        re-raises a background save failure (the end-of-fit surfacing
+        point); ``surface=False`` is exception-path cleanup — the failure
+        was already counted, and masking the in-flight exception with a
+        second one would hide the real crash."""
+        mgr, self._active_ckpt_mgr = self._active_ckpt_mgr, None
+        if mgr is not None:
+            mgr.close(raise_pending=surface)
+
+    def _maybe_preempt(self, mgr, loop_state, params, opt_state,
+                       net_state) -> None:
+        """SIGTERM arrived (``zoo.checkpoint.on_sigterm``): cut one final
+        SYNCHRONOUS checkpoint at this step boundary, publish in-memory
+        state, and exit cleanly via :class:`TrainingPreempted`."""
+        if mgr is None or not self._preempted.is_set():
+            return
+        log.warning("SIGTERM: cutting a final synchronous checkpoint at "
+                    "iteration %d before exiting", loop_state.iteration)
+        try:
+            self._save_checkpoint(mgr, loop_state, params, opt_state,
+                                  net_state, sync=True)
+        except Exception:
+            # the process is going down either way; the newest previous
+            # snapshot (already committed) remains the resume point
+            log.exception("final preemption checkpoint failed")
+        model = self.model
+        model.params, model.net_state, model.opt_state = _clone_tree(
+            (params, net_state, opt_state))
+        model.finished_iterations = loop_state.iteration
+        raise TrainingPreempted(
+            f"training preempted by SIGTERM; final checkpoint cut at "
+            f"iteration {loop_state.iteration}")
+
+    def _on_sigterm(self, signum, frame) -> None:
+        log.warning("SIGTERM received; requesting a final checkpoint at "
+                    "the next step boundary")
+        self._preempted.set()
 
     def _try_resume(self, mgr: CheckpointManager, params, opt_state, net_state):
-        """Restore the newest snapshot (``Topology.scala:1220-1246``).
-        Returns (params, opt_state, net_state, meta) — inputs unchanged if
-        there is nothing to restore."""
-        step = mgr.latest()
-        if step is None or step < self.model.finished_iterations:
-            # never regress: in-memory progress is ahead of the newest
-            # snapshot (it was cut mid-epoch before further completed epochs)
+        """Restore the newest VALID snapshot (``Topology.scala:1220-1246``
+        + manifest/checksum verification): a corrupt or uncommitted
+        snapshot is quarantined and the restore falls back to the next
+        one that verifies, so resume always lands on good weights.
+        Returns (params, opt_state, net_state, meta) — inputs unchanged
+        if there is nothing at or past the model's in-memory progress
+        (never regress: a snapshot older than ``finished_iterations`` was
+        cut mid-epoch before further completed epochs)."""
+        out = mgr.restore_latest(
+            {"params": params, "opt_state": opt_state,
+             "net_state": net_state},
+            min_step=self.model.finished_iterations)
+        if out is None:
             return params, opt_state, net_state, None
-        trees, meta = mgr.restore(step, {"params": params,
-                                         "opt_state": opt_state,
-                                         "net_state": net_state})
+        step, trees, meta = out
         log.info("resumed from checkpoint ckpt-%d (epoch %s)", step,
                  meta.get("epoch"))
         return trees["params"], trees["opt_state"], trees["net_state"], meta
@@ -682,36 +746,73 @@ class TrainingLoop:
         profile_dir = getattr(self.model, "_profile_dir", None)
         if profile_dir:
             self.model._profile_dir = None
+        # preemption-safe shutdown (zoo.checkpoint.on_sigterm, opt-in):
+        # SIGTERM during this fit requests one final synchronous snapshot
+        # at the next step boundary, then exits via TrainingPreempted —
+        # the TPU-preemption analogue of the reference's driver-failure
+        # snapshot. Signal handlers only install on the main thread.
+        self._preempted.clear()
+        sig_installed = False
+        prev_handler = None
+        if (bool(ctx.get("zoo.checkpoint.on_sigterm", False))
+                and getattr(self.model, "_checkpoint", None) is not None):
+            if threading.current_thread() is threading.main_thread():
+                prev_handler = signal.signal(signal.SIGTERM,
+                                             self._on_sigterm)
+                sig_installed = True
+            else:
+                log.warning("zoo.checkpoint.on_sigterm is set but fit() "
+                            "is not on the main thread; SIGTERM "
+                            "checkpointing disabled for this fit")
         from ....utils import profiling
-        with profiling.trace(profile_dir), span("train.fit",
-                                                registry=self._registry):
-            return self._fit_with_retry(
-                fs, batch_size=batch_size, nb_epoch=nb_epoch,
-                target_holder=target_holder,
-                validation_data=validation_data, rng=rng,
-                callbacks=callbacks, end_trigger=end_trigger,
-                retry_times=retry_times, window_sec=window_sec,
-                attempts=attempts, window_start=window_start)
+        try:
+            with profiling.trace(profile_dir), span("train.fit",
+                                                    registry=self._registry):
+                return self._fit_with_retry(
+                    fs, batch_size=batch_size, nb_epoch=nb_epoch,
+                    target_holder=target_holder,
+                    validation_data=validation_data, rng=rng,
+                    callbacks=callbacks, end_trigger=end_trigger,
+                    retry_times=retry_times, window_sec=window_sec,
+                    attempts=attempts, window_start=window_start)
+        finally:
+            if sig_installed:
+                # getsignal/signal return None for a handler not installed
+                # from Python (an embedding runtime's C-level handler) —
+                # None is not re-installable; SIG_DFL is the closest we
+                # can restore without raising out of this finally
+                signal.signal(signal.SIGTERM,
+                              prev_handler if prev_handler is not None
+                              else signal.SIG_DFL)
 
     def _fit_with_retry(self, fs, *, batch_size, nb_epoch, target_holder,
                         validation_data, rng, callbacks, end_trigger,
                         retry_times, window_sec, attempts, window_start):
         while True:
             try:
-                return self._fit_impl(fs, batch_size=batch_size,
-                                      nb_epoch=nb_epoch,
-                                      target_holder=target_holder,
-                                      validation_data=validation_data,
-                                      rng=rng, callbacks=callbacks,
-                                      end_trigger=end_trigger)
+                history = self._fit_impl(fs, batch_size=batch_size,
+                                         nb_epoch=nb_epoch,
+                                         target_holder=target_holder,
+                                         validation_data=validation_data,
+                                         rng=rng, callbacks=callbacks,
+                                         end_trigger=end_trigger)
+                # end-of-fit join of the async checkpoint writer: a
+                # background save failure surfaces HERE (CheckpointSaveError
+                # → the generic handler below, which re-cuts the lost
+                # snapshot through the normal retry path)
+                self._close_active_ckpt_mgr(surface=True)
+                return history
             except KeyboardInterrupt:
+                self._close_active_ckpt_mgr(surface=False)
                 raise
             except (ValueError, TypeError):
                 # user/config errors are not transient — the reference likewise
                 # excludes IllegalArgumentException from its retry loop
                 # (Topology.scala:1171-1253)
+                self._close_active_ckpt_mgr(surface=False)
                 raise
             except Exception:
+                self._close_active_ckpt_mgr(surface=False)
                 mgr = self._ckpt_manager()
                 if mgr is None or mgr.latest() is None:
                     raise  # nothing to recover from
@@ -727,6 +828,11 @@ class TrainingLoop:
                             retry_times, exc_info=True)
                 # the next _fit_impl attempt restores params/opt_state from
                 # the latest snapshot via _try_resume
+            except BaseException:
+                # TrainingPreempted (SystemExit): the final sync snapshot is
+                # already committed — just release the writer and exit
+                self._close_active_ckpt_mgr(surface=False)
+                raise
 
     def _fit_impl(self, fs: FeatureSet, *, batch_size: int, nb_epoch: int,
                   target_holder: Dict[str, int], validation_data=None,
@@ -808,6 +914,9 @@ class TrainingLoop:
         # resume: if a checkpoint directory is configured and holds a snapshot
         # newer than this model's progress, restore it (process-death resume)
         mgr = self._ckpt_manager()
+        # registered so _fit_with_retry can join/close the async writer on
+        # every exit path (including exceptions and preemption)
+        self._active_ckpt_mgr = mgr
         ckpt_trigger = self._ckpt_trigger()
         if mgr is not None:
             params, opt_state, net_state, meta = self._try_resume(
@@ -1014,6 +1123,8 @@ class TrainingLoop:
                                                      prev_iter):
                     self._save_checkpoint(mgr, loop_state, params, opt_state,
                                           net_state)
+                self._maybe_preempt(mgr, loop_state, params, opt_state,
+                                    net_state)
                 if _fired_within(end_trigger, loop_state, prev_iter):
                     stop = True
                 stream = ()
@@ -1063,6 +1174,8 @@ class TrainingLoop:
                                                      prev_iter):
                     self._save_checkpoint(mgr, loop_state, params, opt_state,
                                           net_state)
+                self._maybe_preempt(mgr, loop_state, params, opt_state,
+                                    net_state)
                 if _fired_within(end_trigger, loop_state, prev_iter):
                     stop = True
                     break

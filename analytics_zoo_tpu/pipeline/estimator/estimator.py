@@ -102,12 +102,18 @@ class Estimator:
               batch_size: int = 32, nb_epoch: int = 1,
               end_trigger: Optional[Trigger] = None,
               checkpoint_trigger: Optional[Trigger] = None,
+              checkpoint_keep: Optional[int] = None,
               validation_set: Optional[FeatureSet] = None,
               validation_methods: Optional[Sequence[Any]] = None,
               callbacks: Sequence[Callable] = ()) -> Dict[str, List[float]]:
         """Train on a FeatureSet. Checkpoints go to ``model_dir`` on
-        ``checkpoint_trigger`` (``Estimator.scala:118-155``), with the
-        engine's retry-on-failure semantics."""
+        ``checkpoint_trigger`` (``Estimator.scala:118-155``) through the
+        durable async checkpoint subsystem (``utils/checkpoint.py``:
+        manifest-committed snapshots, verified resume with corruption
+        fallback — see docs/guides/TRAINING.md), with the engine's
+        retry-on-failure semantics. ``checkpoint_keep`` bounds retention
+        (default: the ``zoo.checkpoint.keep`` conf; 0 keeps every
+        snapshot)."""
         if not isinstance(train_set, FeatureSet):
             raise TypeError("train expects a FeatureSet; build one with "
                             "FeatureSet.array(...)")
@@ -115,7 +121,8 @@ class Estimator:
         self._last_criterion = criterion
         if self.model_dir is not None:
             self.model.set_checkpoint(self.model_dir,
-                                      trigger=checkpoint_trigger)
+                                      trigger=checkpoint_trigger,
+                                      keep=checkpoint_keep)
         elif checkpoint_trigger is not None:
             import logging
             logging.getLogger("analytics_zoo_tpu.estimator").warning(

@@ -191,11 +191,17 @@ class InferenceModel:
         if model.params is None:
             model.init_weights()
         mgr = CheckpointManager(ckpt_dir)
-        step = mgr.latest()
-        if step is None:
-            raise FileNotFoundError(f"no snapshot in {ckpt_dir}")
-        trees, _ = mgr.restore(step, {"params": model.params,
-                                      "net_state": model.net_state})
+        # verified restore with fallback (docs/guides/TRAINING.md): a
+        # torn newest snapshot is skipped and the next valid one loads —
+        # serving never boots on bad weights. READ-ONLY (quarantine=False):
+        # this process does not own the directory, and what looks
+        # uncommitted may be a live training run's save in flight
+        out = mgr.restore_latest({"params": model.params,
+                                  "net_state": model.net_state},
+                                 quarantine=False)
+        if out is None:
+            raise FileNotFoundError(f"no valid snapshot in {ckpt_dir}")
+        _step, trees, _meta = out
         model.params = trees["params"]
         model.net_state = trees["net_state"]
         return self.from_keras(model, dtype=dtype, quantize=quantize,

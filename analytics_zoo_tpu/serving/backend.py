@@ -232,6 +232,9 @@ class RedisBackend:
 
     def xadd(self, stream: str, fields: dict,
              timeout: Optional[float] = None) -> str:
+        # same named fault site as LocalBackend.xadd, so the chaos
+        # scenarios in test_chaos.py can also run against a live Redis
+        faults.inject("backend.xadd")
         timeout = self.default_timeout if timeout is None else timeout
         if not self.poll_policy.wait_for(
                 lambda: self._call(self._r.xlen, stream) < self.maxlen,

@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..common import faults
 from ..common.reliability import RetryPolicy
 
 log = logging.getLogger("analytics_zoo_tpu.serving.resp")
@@ -226,7 +227,13 @@ class RespClient:
         op = self._op_name(parts)
 
         def attempt(c: _Conn):
+            # chaos sites (docs/guides/RELIABILITY.md): one fire per
+            # logical command attempt, BEFORE the socket op it models —
+            # a `disconnect` here exercises the exact reconnect/idempotency
+            # rules a dropped TCP connection would, against a REAL backend
+            faults.inject("resp.send")
             c.send(*parts)
+            faults.inject("resp.recv")
             return c.read_reply()
 
         return self._run_with_reconnect(op, op not in _NON_IDEMPOTENT,
@@ -252,7 +259,9 @@ class RespClient:
                         for c in commands)
 
         def attempt(c: _Conn):
+            faults.inject("resp.send")   # once per pipeline attempt
             c.sock.sendall(b"".join(_frame(parts) for parts in commands))
+            faults.inject("resp.recv")
             replies, first_err = [], None
             for _ in commands:
                 try:

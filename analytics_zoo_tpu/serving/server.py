@@ -62,7 +62,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common import faults
-from ..common.reliability import CircuitBreaker, RetryPolicy
+from ..common.reliability import CircuitBreaker, RetryBudget, RetryPolicy
 from ..observability import default_registry, span
 from .backend import LocalBackend, default_backend
 from .client import (INPUT_STREAM, decode_payload, encode_array,
@@ -180,7 +180,8 @@ class ClusterServing:
                  max_loop_restarts: int = 5,
                  restart_backoff: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 dispatch_retries: int = 1):
+                 dispatch_retries: int = 1,
+                 retry_budget: Optional[RetryBudget] = None):
         self.model = model          # InferenceModel (or any .predict(x))
         self.backend = backend if backend is not None else default_backend()
         self.batch_size = int(batch_size)
@@ -281,6 +282,11 @@ class ClusterServing:
         #: (0 = fail the whole batch immediately, the pre-reliability
         #: behavior); beyond this the record is dead-lettered
         self.dispatch_retries = max(int(dispatch_retries), 0)
+        #: optional SHARED RetryBudget (docs/guides/RELIABILITY.md):
+        #: solo re-dispatches withdraw from it and successful dispatches
+        #: deposit, so a fleet of replicas against one broken model/backend
+        #: cannot multiply retries during a correlated outage
+        self._retry_budget = retry_budget
         self._m_restarts = {
             name: m.counter(
                 "zoo_serving_loop_restarts_total",
@@ -879,7 +885,7 @@ class ClusterServing:
             self._emit_dispatch(recs, t0)
             arena_owned = False
             self._flush(_Pending(recs, (lambda: preds), t0, arena))
-        except Exception:
+        except Exception as e:
             log.exception("inference dispatch failed for %d records; "
                           "retrying one record at a time", len(recs))
             # copy each record's input out BEFORE the arena goes back to
@@ -889,7 +895,7 @@ class ClusterServing:
                 rows = [np.array(batch[i:i + 1]) for i in range(len(recs))]
             if arena_owned:
                 self._arena_pool.release(arena)
-            self._retry_or_dead_letter(recs, rows, pendings)
+            self._retry_or_dead_letter(recs, rows, pendings, cause=e)
 
     def _predict_once(self, batch):
         """One synchronous model call for the retry path (the server
@@ -899,15 +905,19 @@ class ClusterServing:
             return predict(batch)
         return self.model.predict_async(batch)()
 
-    def _retry_or_dead_letter(self, recs, rows, pendings) -> None:
+    def _retry_or_dead_letter(self, recs, rows, pendings,
+                              cause: Optional[BaseException] = None) -> None:
         """After a batch dispatch crash: re-dispatch each record ALONE,
         up to ``dispatch_retries`` times. One poison record (a payload
         that crashes the model) must not fail its batch-mates — they
         serve from their solo retries — and must itself be dead-lettered
         with an addressable error instead of being retried forever.
-        Runs synchronously on the serve loop: the crashed batch already
-        forfeited its pipeline slot, and bounded-blocking here is the
-        backpressure."""
+        ``cause`` is the batch-crash exception, preserved in the
+        dead-letter event when a drained retry budget refuses the solo
+        attempts (the operator debugging the outage needs the REAL
+        error, not 'budget exhausted'). Runs synchronously on the serve
+        loop: the crashed batch already forfeited its pipeline slot, and
+        bounded-blocking here is the backpressure."""
         if rows is None:
             self._record_failure(recs, parent="dequeue")
             return
@@ -921,7 +931,17 @@ class ClusterServing:
             labels={"op": "serving.dispatch"})
         for rec, row in zip(recs, rows):
             err = None
+            budget_refused = False
             for attempt in range(self.dispatch_retries):
+                if (self._retry_budget is not None
+                        and not self._retry_budget.withdraw()):
+                    # the shared budget is drained (correlated outage):
+                    # skip the solo retry and dead-letter addressably,
+                    # keeping the ORIGINAL batch-crash error as the cause
+                    budget_refused = True
+                    err = cause if cause is not None else RuntimeError(
+                        "retry budget exhausted")
+                    break
                 retry_counter.inc()     # every solo re-dispatch is a retry
                 t1 = time.perf_counter()
                 try:
@@ -941,9 +961,14 @@ class ClusterServing:
                 err = None
                 break
             if err is not None:
-                log.error("record %r crashed dispatch %d time(s); "
-                          "dead-lettering", rec.uri,
-                          self.dispatch_retries + 1)
+                if budget_refused:
+                    log.error("record %r: batch dispatch crashed and the "
+                              "retry budget is exhausted; dead-lettering "
+                              "without a solo retry", rec.uri)
+                else:
+                    log.error("record %r crashed dispatch %d time(s); "
+                              "dead-lettering", rec.uri,
+                              self.dispatch_retries + 1)
                 self._m_dead_letter.inc()
                 self.metrics.emit("serving.dead_letter", uri=rec.uri,
                                   trace=rec.trace, error=str(err))
@@ -969,7 +994,10 @@ class ClusterServing:
         assembly+decode time from this record's dequeue to the moment its
         batch entered the model (``t0``), ``batch`` the co-dispatched
         record count — the field that explains a latency outlier caused
-        by riding in a large batch."""
+        by riding in a large batch. Every successful dispatch also
+        deposits into the shared retry budget (when one is attached)."""
+        if self._retry_budget is not None:
+            self._retry_budget.on_success()
         n = len(recs)
         for rec in recs:
             if rec.trace is not None:

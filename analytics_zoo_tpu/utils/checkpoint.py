@@ -1,53 +1,175 @@
-"""Checkpoint/resume — the TPU-native equivalent of the reference's
+"""Durable checkpoint/resume — the TPU-native equivalent of the reference's
 epoch-triggered snapshots (``Topology.scala:109-114,1161-1168``), the
 ``setCheckpoint`` API (``Topology.scala:245-255``) and the latest-file
-resume logic (``Topology.scala:1220-1246``, ``getLatestFile`` ``:1511-1528``).
+resume logic (``Topology.scala:1220-1246``, ``getLatestFile`` ``:1511-1528``)
+— hardened for a preemptible fleet where the snapshot you resume from is
+the one thing that must never lie.
 
-Format: one directory per snapshot (``ckpt-<iteration>/``) holding one ``.npz``
-per pytree (params / opt_state / net_state — leaves in deterministic
-``tree_flatten`` order, restored against a same-structure template) plus a
-``meta.json``. Writes are atomic (tmp dir + rename) so a crash mid-save never
-corrupts the latest snapshot; old snapshots are pruned to ``keep``.
+Format: one directory per snapshot (``ckpt-<iteration>/``) holding one
+``.npz`` per pytree (params / opt_state / net_state — leaves in
+deterministic ``tree_flatten`` order, restored against a same-structure
+template) plus a ``manifest.json`` carrying per-tree CRC32 checksums, leaf
+counts/shapes/dtypes, and the resume metadata. The manifest is written
+LAST (tmp file + ``os.replace``) and is the **commit marker**: a directory
+without one was never committed — a process killed mid-write can never
+produce a snapshot that a resume will trust. (This replaces the old
+whole-directory tmp+rename commit, which is only atomic on filesystems
+with atomic directory rename — object-store and NFS mounts are not.)
+
+Durability contract (``docs/guides/TRAINING.md``):
+
+* **Async save.** :meth:`CheckpointManager.save` snapshots device arrays
+  to host (one batched ``jax.device_get``) and returns; serialization,
+  checksumming, the manifest commit, and pruning run on a background
+  writer thread, off the training step path. At most ONE save is in
+  flight: the next ``save()`` (or ``close()``) joins it first. A
+  background failure counts in ``zoo_ckpt_save_failures_total`` and
+  surfaces as :class:`CheckpointSaveError` on that next call — never
+  silently.
+* **Verified restore with fallback.** :meth:`restore` verifies the
+  manifest and checksums; :meth:`restore_latest` walks snapshots newest
+  → oldest, **quarantines** a corrupt/uncommitted one to
+  ``ckpt-<n>.corrupt`` (counted in ``zoo_ckpt_corrupt_total``, never
+  silently deleted) and falls back to the newest snapshot that verifies,
+  so resume always lands on good weights. Legacy snapshots (pre-manifest:
+  ``meta.json`` only) restore with a logged warning — there is nothing to
+  verify them against.
+* **Chaos-provable.** The writer carries named fault sites
+  (``ckpt.write`` per tree file, ``ckpt.manifest``, ``ckpt.rename`` for
+  the commit) through ``common.faults``;
+  ``tests/test_checkpoint_chaos.py`` reconciles kill-mid-write /
+  truncation / bit-flip / missing-manifest recovery exactly.
+
+Single-writer discipline (unchanged from the start): one process owns a
+checkpoint directory at a time — concurrent writers were never supported.
+Quarantining is an OWNER action: a reader of someone else's live
+directory (serving loading a training run's weights) must restore with
+``restore_latest(..., quarantine=False)``, which skips bad snapshots
+instead of renaming them — an "uncommitted" directory seen from outside
+may be the owner's save in flight.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 import shutil
-import tempfile
-from typing import Any, Dict, Optional, Tuple
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from ..common import faults
+
+log = logging.getLogger("analytics_zoo_tpu.checkpoint")
+
+__all__ = ["CheckpointManager", "CheckpointError", "CheckpointSaveError",
+           "CheckpointCorruptError", "CheckpointArchitectureError"]
+
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+MANIFEST = "manifest.json"
+_MANIFEST_VERSION = 1
+_UNCOMMITTED = "no manifest.json — the save never committed"
 
 
-def _save_tree(path: str, tree: Any) -> None:
+class CheckpointError(RuntimeError):
+    """Base class for checkpoint failures."""
+
+
+class CheckpointSaveError(CheckpointError):
+    """A background (async) save failed; raised on the NEXT checkpoint
+    call so the failure is never silent. The original error is chained."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A snapshot failed verification (bad checksum, torn write, missing
+    commit marker). The snapshot has been quarantined to
+    ``ckpt-<n>.corrupt``."""
+
+    def __init__(self, step: int, reason: str):
+        super().__init__(f"checkpoint ckpt-{step} is corrupt: {reason}")
+        self.step = step
+        self.reason = reason
+
+
+class CheckpointArchitectureError(ValueError):
+    """The snapshot does not match the restore template (leaf count or
+    shape) — a configuration error, NOT corruption: it must never trigger
+    quarantine or fallback (every snapshot of the run would be
+    quarantined against a wrong template)."""
+
+
+# ---------------------------------------------------------------------------
+# leaf-level helpers
+# ---------------------------------------------------------------------------
+
+def _snapshot_leaves(tree: Any) -> List[np.ndarray]:
+    """Host-side copies of every leaf — the only work that stays on the
+    caller's (step) path. Device leaves come back in ONE batched
+    ``jax.device_get``; host leaves are copied so the background writer
+    never races a caller mutating its own arrays."""
     leaves = jax.tree_util.tree_leaves(tree)
-    arrays = {f"leaf_{i}": np.asarray(jax.device_get(l)) for i, l in enumerate(leaves)}
-    np.savez(path, **arrays)
+    fetched = jax.device_get(leaves)
+    out = []
+    for orig, got in zip(leaves, fetched):
+        a = np.asarray(got)
+        if a is orig:
+            a = np.array(a, copy=True)
+        out.append(a)
+    return out
 
 
-def _restore_tree(path: str, template: Any) -> Any:
-    """Rebuild a pytree from saved leaves using ``template``'s structure.
-    The template supplies the treedef (avoids pickling treedefs to disk)."""
+def _write_tree(path: str, leaves: List[np.ndarray]) -> Tuple[int, int]:
+    """Serialize ``leaves`` to ``path`` (.npz), fsync, and return
+    ``(crc32, bytes)`` of the file as written."""
+    faults.inject("ckpt.write")
+    np.savez(path, **{f"leaf_{i}": a for i, a in enumerate(leaves)})
+    crc = 0
+    size = 0
+    with open(path, "rb+") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+        os.fsync(f.fileno())
+    return crc & 0xFFFFFFFF, size
+
+
+def _file_crc(path: str) -> Tuple[int, int]:
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return crc & 0xFFFFFFFF, size
+
+
+def _rebuild_tree(template: Any, loaded: List[np.ndarray], path: str) -> Any:
+    """Rebuild a pytree from loaded leaves using ``template``'s structure
+    (the template supplies the treedef — no pickled treedefs on disk).
+    Preserves template leaf dtypes for non-array leaves (e.g. optax
+    counts) and fails loudly on any shape mismatch — silently installing
+    permuted leaves would train on scrambled weights."""
     leaves, treedef = jax.tree_util.tree_flatten(template)
-    with np.load(path) as data:
-        if len(data.files) != len(leaves):
-            raise ValueError(
-                f"{path}: checkpoint has {len(data.files)} leaves, "
-                f"template has {len(leaves)} — architecture mismatch")
-        loaded = [data[f"leaf_{i}"] for i in range(len(leaves))]
-    # preserve template leaf dtypes for non-array leaves (e.g. optax counts),
-    # and fail loudly on any shape mismatch — silently installing permuted
-    # leaves would train on scrambled weights
+    if len(loaded) != len(leaves):
+        raise CheckpointArchitectureError(
+            f"{path}: checkpoint has {len(loaded)} leaves, "
+            f"template has {len(leaves)} — architecture mismatch")
     out = []
     for i, (tmpl, arr) in enumerate(zip(leaves, loaded)):
         if tuple(np.shape(tmpl)) != tuple(arr.shape):
-            raise ValueError(
+            raise CheckpointArchitectureError(
                 f"{path}: leaf {i} shape {arr.shape} != template "
                 f"{np.shape(tmpl)} — architecture mismatch")
         if np.ndim(tmpl) == 0 and not isinstance(tmpl, (np.ndarray, jax.Array)):
@@ -57,61 +179,503 @@ def _restore_tree(path: str, template: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-class CheckpointManager:
-    """Directory of snapshots with atomic save, latest-lookup, and pruning."""
+def _load_leaves(path: str) -> List[np.ndarray]:
+    with np.load(path) as data:
+        return [data[f"leaf_{i}"] for i in range(len(data.files))]
 
-    def __init__(self, directory: str, keep: int = 3):
+
+class CheckpointManager:
+    """Directory of snapshots with asynchronous verified save,
+    checksum-verified restore with corruption fallback, and pruning."""
+
+    def __init__(self, directory: str, keep: int = 3, registry=None):
+        if keep < 0:
+            raise ValueError(
+                f"keep must be >= 0 (0 = keep every snapshot), got {keep}")
         self.directory = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
+        # -- async writer state (at most one save in flight) ---------------
+        self._lock = threading.Lock()
+        self._pending: Optional[Tuple[threading.Thread, dict]] = None
+        # -- observability (docs/guides/OBSERVABILITY.md zoo_ckpt_*) -------
+        if registry is None:
+            from ..observability import default_registry
+            registry = default_registry()
+        self._registry = registry
+        self._m_save_s = registry.histogram(
+            "zoo_ckpt_save_seconds",
+            "background checkpoint write wall time per committed save")
+        self._m_bytes = registry.histogram(
+            "zoo_ckpt_bytes", "bytes written per committed save")
+        self._m_save_fail = registry.counter(
+            "zoo_ckpt_save_failures_total",
+            "checkpoint saves that failed (surfaced on the next "
+            "checkpoint call)")
+        self._m_corrupt = registry.counter(
+            "zoo_ckpt_corrupt_total",
+            "snapshots quarantined to ckpt-<n>.corrupt (bad checksum, "
+            "torn write, or missing commit marker)")
+        self._m_fallback = registry.counter(
+            "zoo_ckpt_restore_fallback_total",
+            "restores that could not use the newest snapshot and fell "
+            "back past quarantined one(s)")
+
+    # ---- paths ------------------------------------------------------------
+    def _dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt-{step}")
+
+    # ---- async plumbing ---------------------------------------------------
+    def save_in_flight(self) -> bool:
+        """Whether a background save is currently writing."""
+        with self._lock:
+            return (self._pending is not None
+                    and self._pending[0].is_alive())
+
+    def join(self) -> None:
+        """Wait for the in-flight save (if any); surface its failure as
+        :class:`CheckpointSaveError` exactly once."""
+        with self._lock:
+            pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        thread, box = pending
+        thread.join()
+        err = box.get("error")
+        if err is not None:
+            raise CheckpointSaveError(
+                f"background save of ckpt-{box['step']} failed: "
+                f"{err}") from err
+
+    def close(self, raise_pending: bool = True) -> None:
+        """Join the in-flight save. ``raise_pending=False`` logs a
+        pending failure instead of raising (exception-path cleanup — the
+        failure was already counted when it happened)."""
+        try:
+            self.join()
+        except CheckpointSaveError:
+            if raise_pending:
+                raise
+            log.exception("in-flight checkpoint save failed during close")
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(raise_pending=exc_type is None)
 
     # ---- save -------------------------------------------------------------
     def save(self, step: int, trees: Dict[str, Any],
-             meta: Optional[Dict[str, Any]] = None) -> str:
-        final = os.path.join(self.directory, f"ckpt-{step}")
-        tmp = tempfile.mkdtemp(prefix=".tmp-ckpt-", dir=self.directory)
-        try:
-            for name, tree in trees.items():
-                _save_tree(os.path.join(tmp, name + ".npz"), tree)
-            with open(os.path.join(tmp, "meta.json"), "w") as f:
-                json.dump({"step": step, **(meta or {})}, f)
-            if os.path.exists(final):
-                shutil.rmtree(final)
-            os.rename(tmp, final)
-        except BaseException:
-            shutil.rmtree(tmp, ignore_errors=True)
-            raise
-        self._prune()
+             meta: Optional[Dict[str, Any]] = None,
+             sync: bool = False) -> str:
+        """Snapshot ``trees`` as ``ckpt-<step>``.
+
+        Device arrays are fetched to host NOW (the step path pays one
+        batched transfer); serialization + commit happen on a background
+        writer unless ``sync=True``. Joins any previous in-flight save
+        first — surfacing ITS failure — so at most one save is ever in
+        flight and failures are never silent. Returns the final snapshot
+        path (committed only once the manifest lands)."""
+        self.join()
+        host = {name: _snapshot_leaves(tree) for name, tree in trees.items()}
+        meta = {"step": step, **(meta or {})}
+        final = self._dir(step)
+        if sync:
+            try:
+                self._write(step, host, meta, final)
+            except Exception as e:
+                # Exception only: a KeyboardInterrupt/SystemExit mid-write
+                # must stay a BaseException (wrapping it would feed the
+                # user's Ctrl-C into the fit retry loop as a step failure)
+                raise CheckpointSaveError(
+                    f"save of ckpt-{step} failed: {e}") from e
+            return final
+        box: dict = {"step": step}
+        thread = threading.Thread(
+            target=self._write_guarded, args=(step, host, meta, final, box),
+            name=f"ckpt-writer-{step}", daemon=True)
+        with self._lock:
+            self._pending = (thread, box)
+        thread.start()
         return final
 
+    def _write_guarded(self, step, host, meta, final, box) -> None:
+        try:
+            self._write(step, host, meta, final)
+        except BaseException as e:   # surfaced via join(); never silent
+            box["error"] = e
+
+    def _write(self, step, host, meta, final) -> None:
+        t0 = time.perf_counter()
+        try:
+            total = self._commit(step, host, meta, final)
+        except BaseException as e:
+            self._m_save_fail.inc()
+            self._registry.emit("ckpt.save_failure", step=step,
+                                error=f"{type(e).__name__}: {e}")
+            log.error("checkpoint save of ckpt-%d failed: %s", step, e)
+            raise
+        dur = time.perf_counter() - t0
+        self._m_save_s.observe(dur)
+        self._m_bytes.observe(total)
+        self._registry.emit("ckpt.save", step=step, bytes=total, dur_s=dur)
+        self._prune()
+
+    def _commit(self, step, host, meta, final) -> int:
+        """Write tree files, then the manifest (the commit marker) LAST.
+        A crash at any earlier point leaves an uncommitted directory no
+        restore will trust."""
+        if os.path.isdir(final):
+            # leftovers of an uncommitted attempt at the same step (or an
+            # explicit re-save): drop the commit marker FIRST so a crash
+            # mid-overwrite cannot leave old-manifest/new-files mixtures
+            marker = os.path.join(final, MANIFEST)
+            if os.path.exists(marker):
+                os.remove(marker)
+            shutil.rmtree(final)
+        os.makedirs(final)
+        total = 0
+        tree_entries: Dict[str, dict] = {}
+        for name, leaves in host.items():
+            fname = name + ".npz"
+            crc, size = _write_tree(os.path.join(final, fname), leaves)
+            total += size
+            tree_entries[name] = {
+                "file": fname, "crc32": crc, "bytes": size,
+                "leaves": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                           for a in leaves]}
+        manifest = {"version": _MANIFEST_VERSION, "step": step,
+                    "meta": meta, "trees": tree_entries}
+        faults.inject("ckpt.manifest")
+        tmp = os.path.join(final, MANIFEST + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        faults.inject("ckpt.rename")
+        os.replace(tmp, os.path.join(final, MANIFEST))
+        return total
+
     def _prune(self) -> None:
-        steps = self.steps()
+        steps = self._scan()
         for s in steps[:-self.keep] if self.keep > 0 else []:
-            shutil.rmtree(os.path.join(self.directory, f"ckpt-{s}"),
-                          ignore_errors=True)
+            shutil.rmtree(self._dir(s), ignore_errors=True)
 
     # ---- lookup -----------------------------------------------------------
-    def steps(self) -> list:
+    def _scan(self) -> List[int]:
+        """Committed-looking steps: a manifest (new format) or a
+        ``meta.json`` (legacy, pre-manifest) marks a committed snapshot.
+        No checksum verification here — that is :meth:`verify` /
+        :meth:`restore_latest`'s job."""
         out = []
         for name in os.listdir(self.directory):
             m = _CKPT_RE.match(name)
-            if m and os.path.exists(os.path.join(self.directory, name, "meta.json")):
+            if not m:
+                continue
+            d = os.path.join(self.directory, name)
+            if (os.path.exists(os.path.join(d, MANIFEST))
+                    or os.path.exists(os.path.join(d, "meta.json"))):
                 out.append(int(m.group(1)))
         return sorted(out)
 
+    def _scan_all(self) -> List[int]:
+        """Every ``ckpt-<n>`` directory, committed or not — the restore
+        fallback walk must SEE uncommitted snapshots to quarantine them."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = _CKPT_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.directory, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def steps(self) -> list:
+        """Committed snapshot steps, ascending (joins an in-flight save
+        first so a just-requested snapshot is visible once committed)."""
+        self.join()
+        return self._scan()
+
     def latest(self) -> Optional[int]:
+        """Newest COMMITTED step (commit-marker check only; full checksum
+        verification happens in :meth:`restore_latest`/:meth:`verify`)."""
         steps = self.steps()
         return steps[-1] if steps else None
 
+    # ---- verification -----------------------------------------------------
+    def _commit_status(self, step: int) -> str:
+        """Cheap commit-marker classification, no checksums:
+        ``committed`` / ``legacy`` / ``uncommitted`` / ``missing``."""
+        d = self._dir(step)
+        if not os.path.isdir(d):
+            return "missing"
+        if os.path.exists(os.path.join(d, MANIFEST)):
+            return "committed"
+        if os.path.exists(os.path.join(d, "meta.json")):
+            return "legacy"
+        return "uncommitted"
+
+    def _read_manifest(self, step: int) -> dict:
+        """Parse AND schema-check the manifest; raises
+        :class:`CheckpointCorruptError` on anything unreadable or
+        malformed — a manifest that parses as JSON but lost its schema
+        (version skew, hand edit, torn rewrite) is corruption, not a
+        crash."""
+        try:
+            with open(os.path.join(self._dir(step), MANIFEST)) as f:
+                manifest = json.load(f)
+            for name, entry in manifest["trees"].items():
+                if (not isinstance(entry.get("file"), str)
+                        or not isinstance(entry.get("bytes"), int)
+                        or not isinstance(entry.get("crc32"), int)
+                        or not isinstance(entry.get("leaves"), list)):
+                    raise CheckpointCorruptError(
+                        step, f"manifest entry for tree {name!r} is "
+                              f"malformed")
+            manifest["meta"]
+            return manifest
+        except CheckpointCorruptError:
+            raise
+        except (OSError, ValueError, KeyError, AttributeError,
+                TypeError) as e:
+            raise CheckpointCorruptError(step, f"unreadable manifest: {e}")
+
+    @staticmethod
+    def _check_entry(step: int, entry: dict, crc: int, size: int) -> None:
+        if size != entry["bytes"]:
+            raise CheckpointCorruptError(
+                step, f"{entry['file']}: {size} bytes on disk, manifest "
+                      f"says {entry['bytes']} (truncated?)")
+        if crc != entry["crc32"]:
+            raise CheckpointCorruptError(
+                step, f"{entry['file']}: CRC32 {crc:#010x} != manifest "
+                      f"{entry['crc32']:#010x}")
+
+    def verify(self, step: int) -> Tuple[str, Optional[str]]:
+        """Classify snapshot ``step`` without touching it:
+        ``("ok", None)`` — manifest present, every tree file matches its
+        CRC32 and byte count; ``("legacy", None)`` — pre-manifest layout,
+        nothing to verify against; ``("uncommitted", reason)`` — no
+        commit marker; ``("corrupt", reason)`` — failed verification."""
+        status = self._commit_status(step)
+        if status == "missing":
+            return "corrupt", "snapshot directory missing"
+        if status == "legacy":
+            return "legacy", None
+        if status == "uncommitted":
+            return "uncommitted", _UNCOMMITTED
+        try:
+            manifest = self._read_manifest(step)
+            for entry in manifest["trees"].values():
+                path = os.path.join(self._dir(step), entry["file"])
+                if not os.path.exists(path):
+                    raise CheckpointCorruptError(
+                        step, f"tree file {entry['file']} missing")
+                crc, size = _file_crc(path)
+                self._check_entry(step, entry, crc, size)
+        except CheckpointCorruptError as e:
+            return "corrupt", e.reason
+        return "ok", None
+
+    def survey(self, verify: bool = False) -> List[dict]:
+        """Operator inventory of the directory (``scripts/zoo-ckpt``):
+        one dict per snapshot/quarantine directory with ``name``,
+        ``step``, ``status`` (``committed``/``ok``/``corrupt``/
+        ``legacy``/``uncommitted``/``quarantined``), ``reason``, and
+        ``bytes``. ``verify=True`` upgrades the commit-marker check to a
+        full checksum pass (``committed`` → ``ok``/``corrupt``)."""
+        out = []
+        for name in sorted(os.listdir(self.directory)):
+            full = os.path.join(self.directory, name)
+            if not os.path.isdir(full):
+                continue
+            m = _CKPT_RE.match(name)
+            quarantined = re.match(r"^ckpt-(\d+)\.corrupt", name)
+            if m:
+                step: Optional[int] = int(m.group(1))
+                if verify:
+                    status, reason = self.verify(step)
+                else:
+                    # cheap pass: commit markers only, no checksums
+                    status = self._commit_status(step)
+                    reason = _UNCOMMITTED if status == "uncommitted" \
+                        else None
+            elif quarantined:
+                step = int(quarantined.group(1))
+                status, reason = "quarantined", None
+            else:
+                continue
+            size = 0
+            for f in os.listdir(full):
+                try:
+                    size += os.path.getsize(os.path.join(full, f))
+                except OSError:
+                    pass
+            out.append({"name": name, "step": step, "status": status,
+                        "reason": reason, "bytes": size})
+        return out
+
+    # ---- quarantine -------------------------------------------------------
+    def _quarantine(self, step: int, reason: str) -> str:
+        """Move a bad snapshot aside as ``ckpt-<n>.corrupt`` — out of the
+        resume path but NEVER silently deleted (an operator may want the
+        evidence; ``zoo-ckpt list`` shows it)."""
+        src = self._dir(step)
+        dst = src + ".corrupt"
+        k = 1
+        while os.path.exists(dst):
+            dst = f"{src}.corrupt.{k}"
+            k += 1
+        os.rename(src, dst)
+        self._m_corrupt.inc()
+        self._registry.emit("ckpt.corrupt", step=step, reason=reason,
+                            quarantined_to=os.path.basename(dst))
+        log.error("checkpoint ckpt-%d failed verification (%s); "
+                  "quarantined to %s", step, reason, os.path.basename(dst))
+        return dst
+
     # ---- restore ----------------------------------------------------------
-    def restore(self, step: int, templates: Dict[str, Any],
-                ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
-        """Load snapshot ``step``; each named tree is rebuilt against the
-        same-structure template (fresh ``optimizer.init`` output, fresh
-        ``build`` params)."""
-        d = os.path.join(self.directory, f"ckpt-{step}")
-        trees = {name: _restore_tree(os.path.join(d, name + ".npz"), tmpl)
-                 for name, tmpl in templates.items()}
+    def _load_verified(self, step: int, templates: Dict[str, Any],
+                       ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Verify-and-load in ONE read per file: requested trees are read
+        into memory, CRC32-checked against the manifest, then parsed from
+        the same buffer; non-requested trees are stream-checked — restore
+        never pays the read-twice cost a separate verify pass would."""
+        import io
+
+        d = self._dir(step)
+        manifest = self._read_manifest(step)
+        for name in templates:
+            if name not in manifest["trees"]:
+                raise CheckpointArchitectureError(
+                    f"{d}: manifest has no tree {name!r} — "
+                    f"architecture mismatch")
+        trees = {}
+        for name, entry in manifest["trees"].items():
+            path = os.path.join(d, entry["file"])
+            try:
+                if name in templates:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                    crc, size = zlib.crc32(data) & 0xFFFFFFFF, len(data)
+                else:
+                    data = None
+                    crc, size = _file_crc(path)
+            except OSError as e:
+                raise CheckpointCorruptError(step, f"{entry['file']}: {e}")
+            self._check_entry(step, entry, crc, size)
+            if data is None:
+                continue
+            with np.load(io.BytesIO(data)) as z:
+                loaded = [z[f"leaf_{i}"] for i in range(len(z.files))]
+            if len(loaded) != len(entry["leaves"]):
+                raise CheckpointCorruptError(
+                    step, f"{entry['file']}: {len(loaded)} leaves on disk, "
+                          f"manifest says {len(entry['leaves'])}")
+            trees[name] = _rebuild_tree(templates[name], loaded, path)
+        return trees, dict(manifest["meta"])
+
+    def _load_legacy(self, step: int, templates: Dict[str, Any],
+                     ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        d = self._dir(step)
+        log.warning("snapshot ckpt-%d predates manifests; restoring "
+                    "WITHOUT checksum verification", step)
+        trees = {}
+        for name, tmpl in templates.items():
+            path = os.path.join(d, name + ".npz")
+            trees[name] = _rebuild_tree(tmpl, _load_leaves(path), path)
         with open(os.path.join(d, "meta.json")) as f:
             meta = json.load(f)
         return trees, meta
+
+    def restore(self, step: int, templates: Dict[str, Any],
+                ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Load snapshot ``step`` after verification; each named tree is
+        rebuilt against the same-structure template (fresh
+        ``optimizer.init`` output, fresh ``build`` params).
+
+        A snapshot that fails verification is quarantined and raises
+        :class:`CheckpointCorruptError`; a template that does not match
+        raises :class:`CheckpointArchitectureError` (a ``ValueError`` —
+        config bug, nothing is quarantined). Use :meth:`restore_latest`
+        for the newest-valid-with-fallback semantics."""
+        self.join()
+        status = self._commit_status(step)
+        if status == "missing":
+            raise FileNotFoundError(f"no snapshot ckpt-{step} in "
+                                    f"{self.directory}")
+        if status == "legacy":
+            return self._load_legacy(step, templates)
+        if status == "uncommitted":
+            self._quarantine(step, _UNCOMMITTED)
+            raise CheckpointCorruptError(step, _UNCOMMITTED)
+        try:
+            return self._load_verified(step, templates)
+        except CheckpointCorruptError as e:
+            self._quarantine(step, e.reason)
+            raise
+
+    def _discard(self, step: int, reason: str, quarantine: bool) -> None:
+        """A bad snapshot encountered during a fallback walk: the OWNING
+        process quarantines it; a read-only observer (another process's
+        directory — e.g. serving loading a live training dir) must only
+        SKIP it, because what looks uncommitted from outside may be a
+        concurrent writer's save in flight."""
+        if quarantine:
+            self._quarantine(step, reason)
+        else:
+            log.warning("skipping snapshot ckpt-%d (%s) — read-only "
+                        "restore, not quarantining", step, reason)
+
+    def restore_latest(self, templates: Dict[str, Any],
+                       min_step: Optional[int] = None,
+                       quarantine: bool = True,
+                       ) -> Optional[Tuple[int, Dict[str, Any],
+                                           Dict[str, Any]]]:
+        """Restore the newest snapshot that VERIFIES, walking newest →
+        oldest: a corrupt or uncommitted snapshot is quarantined (counted
+        in ``zoo_ckpt_corrupt_total``) and the walk falls back to the
+        next one (``zoo_ckpt_restore_fallback_total`` counts restores
+        that could not use the newest snapshot). Returns ``(step, trees,
+        meta)``, or ``None`` when no snapshot at or past ``min_step``
+        verifies.
+
+        ``quarantine=False`` makes the walk READ-ONLY (skip instead of
+        rename): required for any process that does not own the
+        directory — against a live training run, an "uncommitted"
+        snapshot may simply be the writer's save in flight, and renaming
+        it from outside would destroy a healthy save mid-commit."""
+        self.join()
+        skipped = 0
+        result = None
+        for step in reversed(self._scan_all()):
+            status = self._commit_status(step)
+            if status == "uncommitted":
+                self._discard(step, _UNCOMMITTED, quarantine)
+                skipped += 1
+                continue
+            if min_step is not None and step < min_step:
+                break   # older than the caller's in-memory progress
+            try:
+                if status == "legacy":
+                    trees, meta = self._load_legacy(step, templates)
+                else:
+                    trees, meta = self._load_verified(step, templates)
+            except CheckpointArchitectureError:
+                raise   # wrong template, not corruption — fail loudly
+            except CheckpointCorruptError as e:
+                self._discard(step, e.reason, quarantine)
+                skipped += 1
+                continue
+            except (OSError, KeyError, ValueError, EOFError) as e:
+                # a legacy (unverifiable) snapshot torn on disk, or an
+                # unreadable file: discard and keep walking
+                self._discard(step, f"{type(e).__name__}: {e}", quarantine)
+                skipped += 1
+                continue
+            result = (step, trees, meta)
+            break
+        if skipped:
+            self._m_fallback.inc()
+            self._registry.emit(
+                "ckpt.fallback", skipped=skipped,
+                restored_step=result[0] if result else None)
+        return result

@@ -386,3 +386,41 @@ def test_probe_crash_does_not_wedge_the_breaker():
     # the probe crash is non-transport: it restarts the loop (once)
     assert snap['zoo_serving_loop_restarts_total{loop="serve"}']["value"] == 1
     assert snap["zoo_serving_records_total"]["value"] == 8
+
+
+def test_retry_budget_caps_solo_redispatches_fleet_wide():
+    """A shared RetryBudget bounds TOTAL solo re-dispatches during a
+    correlated outage: with one token, the first crashed record gets its
+    solo retry, later ones dead-letter immediately — the exhausted
+    counter and the plan's fired log reconcile exactly."""
+    from analytics_zoo_tpu.common.reliability import RetryBudget
+
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    xs = _enqueue(backend, 2, prefix="b")
+    # every dispatch crashes: batch attempt + whatever solo retries run
+    plan = FaultPlan(seed=10).add("serving.dispatch", "error",
+                                  at=tuple(range(32)))
+    budget = RetryBudget(capacity=1, deposit=0.1, name="fleet",
+                         registry=reg)
+    serving = _serving(im, backend, reg, retry_budget=budget)
+    outq = OutputQueue(backend)
+    with faults.activate(plan):
+        serving.start()
+        try:
+            for uri in xs:
+                with pytest.raises(ServingError, match="dead-lettered"):
+                    outq.query(uri, timeout=30.0)
+        finally:
+            serving.stop(drain=False)
+    # fired: the batch attempt + exactly ONE budgeted solo retry — the
+    # second record's retry was refused by the drained bucket
+    assert [f[:2] for f in plan.fired] == \
+        [("serving.dispatch", "error")] * 2
+    snap = reg.snapshot()
+    assert snap['zoo_retry_budget_exhausted_total{budget="fleet"}'][
+        "value"] == 1
+    assert snap["zoo_serving_dead_letter_total"]["value"] == 2
+    assert snap['zoo_retry_attempts_total{op="serving.dispatch"}'][
+        "value"] == 1

@@ -145,3 +145,103 @@ def test_failure_without_checkpoint_raises_immediately():
         RuntimeError("no checkpoint to recover from"))
     with pytest.raises(RuntimeError):
         m.fit(x, y, batch_size=32, nb_epoch=1)
+
+
+def test_keep_validation_and_keep_zero_retains_all(tmp_path):
+    """keep < 0 is rejected up front; keep == 0 means keep EVERY
+    snapshot (the training loop's documented keep-all spelling)."""
+    with pytest.raises(ValueError, match="keep"):
+        CheckpointManager(str(tmp_path), keep=-1)
+    mgr = CheckpointManager(str(tmp_path), keep=0)
+    tree = {"a": np.ones(3, np.float32)}
+    for step in (1, 2, 3, 4, 5):
+        mgr.save(step, {"t": tree}, sync=True)
+    assert mgr.steps() == [1, 2, 3, 4, 5]     # nothing pruned
+
+
+def test_zero_size_leaf_roundtrips(tmp_path):
+    """Regression: a pytree containing a zero-size leaf (an empty bias,
+    a 0-row buffer) must survive the npz save/verify/restore path."""
+    mgr = CheckpointManager(str(tmp_path))
+    tree = {"empty": np.zeros((0, 3), np.float32),
+            "scalar": np.float32(2.5),
+            "normal": np.arange(4, dtype=np.int32)}
+    mgr.save(7, {"t": tree}, sync=True)
+    assert mgr.verify(7)[0] == "ok"
+    template = {"empty": np.ones((0, 3), np.float32),
+                "scalar": np.float32(0.0),
+                "normal": np.zeros(4, np.int32)}
+    trees, meta = mgr.restore(7, {"t": template})
+    assert trees["t"]["empty"].shape == (0, 3)
+    assert float(trees["t"]["scalar"]) == 2.5
+    np.testing.assert_array_equal(trees["t"]["normal"], tree["normal"])
+    assert meta["step"] == 7
+
+
+def test_manifest_is_the_commit_marker(tmp_path):
+    """New-format snapshots carry manifest.json (written last) with
+    per-tree CRC32 + leaf shapes/dtypes — the on-disk durability
+    contract documented in TRAINING.md."""
+    import json
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(3, {"params": {"w": np.ones((2, 2), np.float32)}}, sync=True)
+    with open(str(tmp_path / "ckpt-3" / "manifest.json")) as f:
+        manifest = json.load(f)
+    entry = manifest["trees"]["params"]
+    assert entry["file"] == "params.npz"
+    assert entry["leaves"] == [{"shape": [2, 2], "dtype": "float32"}]
+    assert entry["bytes"] > 0 and 0 <= entry["crc32"] <= 0xFFFFFFFF
+    assert manifest["meta"]["step"] == 3
+
+
+def test_zoo_ckpt_cli_list_verify_prune(tmp_path):
+    """The operator CLI (`scripts/zoo-ckpt`): list inventories, verify
+    exits 2 on a corrupt snapshot, prune --keep bounds retention and
+    never touches quarantined evidence."""
+    import os
+    import subprocess
+    import sys
+
+    mgr = CheckpointManager(str(tmp_path / "d"), keep=0)
+    tree = {"w": np.arange(8, dtype=np.float32)}
+    for step in (4, 8, 12):
+        mgr.save(step, {"params": tree}, meta={"epoch": step // 4},
+                 sync=True)
+    # flip a byte in the middle snapshot
+    p = str(tmp_path / "d" / "ckpt-8" / "params.npz")
+    b = bytearray(open(p, "rb").read())
+    b[len(b) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(b))
+
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(scripts) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, os.path.join(scripts, "zoo-ckpt"), *args],
+            capture_output=True, text=True, env=env, timeout=120)
+
+    r = run("list", str(tmp_path / "d"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "ckpt-4" in r.stdout and "committed" in r.stdout
+
+    r = run("verify", str(tmp_path / "d"))
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "CRC32" in r.stdout and "FAILED" in r.stderr
+
+    # --keep 0 refuses (never delete everything)
+    r = run("prune", "--keep", "0", str(tmp_path / "d"))
+    assert r.returncode == 1
+
+    r = run("prune", "--keep", "2", str(tmp_path / "d"))
+    assert r.returncode == 0
+    assert sorted(os.listdir(str(tmp_path / "d"))) == ["ckpt-12", "ckpt-8"]
+
+    # a nonexistent directory is a usage error, not a traceback
+    r = run("list", str(tmp_path / "nope"))
+    assert r.returncode == 1 and "not a directory" in r.stderr

@@ -1,0 +1,507 @@
+"""Checkpoint chaos: seeded, deterministic faults against the durable
+checkpoint subsystem (``utils/checkpoint.py``), reconciled EXACTLY
+against the injected plan / the corruption the test applied.
+
+The durability contract under test (docs/guides/TRAINING.md):
+
+* **async save off the step path** — fit keeps stepping while a slow
+  checkpoint write is in flight; ``zoo_ckpt_save_seconds`` records every
+  committed save,
+* **no torn snapshot is ever trusted** — a save killed mid-write (no
+  manifest), a truncated ``.npz``, a flipped byte (CRC32), and a deleted
+  manifest are all quarantined to ``ckpt-<n>.corrupt`` (never silently
+  deleted) and resume falls back to the newest snapshot that verifies,
+* **zero scrambled leaves** — the restored weights equal the valid
+  snapshot's bit for bit, and post-resume losses match an uninterrupted
+  run,
+* **failures are never silent** — a background save failure surfaces on
+  the next checkpoint call and in ``zoo_ckpt_save_failures_total``,
+* **preemption-safe shutdown** — SIGTERM during fit (opt-in
+  ``zoo.checkpoint.on_sigterm``) cuts one final synchronous snapshot at
+  the next step boundary and exits via ``TrainingPreempted``.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.common.faults import FaultPlan
+from analytics_zoo_tpu.common.triggers import Trigger
+from analytics_zoo_tpu.observability import MetricsRegistry, default_registry
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.api.keras.training import TrainingPreempted
+from analytics_zoo_tpu.utils.checkpoint import (CheckpointCorruptError,
+                                                CheckpointManager,
+                                                CheckpointSaveError)
+
+
+def _data(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    w = rng.normal(size=(4, 1)).astype(np.float32)
+    return x, (x @ w).astype(np.float32)
+
+
+def _model():
+    m = Sequential([Dense(8, activation="relu", input_shape=(4,)), Dense(1)])
+    m.compile(optimizer="adam", loss="mse", lr=0.05)
+    return m
+
+
+def _tree(seed=3):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.normal(size=(4, 8)).astype(np.float32),
+            "b": {"c": rng.normal(size=(8,)).astype(np.float32)}}
+
+
+def _template():
+    return {"w": np.zeros((4, 8), np.float32),
+            "b": {"c": np.zeros((8,), np.float32)}}
+
+
+def _counters(*names):
+    """Current default-registry values for counter/histogram families
+    (absent -> 0) — tests diff before/after so they reconcile exactly
+    without resetting the process-wide registry."""
+    snap = default_registry().snapshot()
+    out = {}
+    for n in names:
+        e = snap.get(n, {})
+        out[n] = e.get("value", e.get("count", 0))
+    return out
+
+
+def _flip_byte(path, offset_frac=0.5):
+    b = bytearray(open(path, "rb").read())
+    b[int(len(b) * offset_frac)] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(b))
+
+
+# ---------------------------------------------------------------------------
+# manager-level scenarios (private registry: exact reconciliation)
+# ---------------------------------------------------------------------------
+
+def test_kill_mid_write_is_never_committed_and_falls_back(tmp_path):
+    """A writer killed mid-write (injected error at the `ckpt.write`
+    site) leaves NO manifest: the snapshot is invisible to latest(),
+    quarantined by restore_latest, and resume lands on the previous
+    verified snapshot with zero scrambled leaves."""
+    init_zoo_context(faults_enabled=True)
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path), keep=0, registry=reg)
+    good = _tree(seed=1)
+    mgr.save(8, {"params": good}, meta={"epoch": 1}, sync=True)
+    plan = FaultPlan(seed=7).add("ckpt.write", "error", at=(0,))
+    with faults.activate(plan):
+        with pytest.raises(CheckpointSaveError):
+            mgr.save(16, {"params": _tree(seed=2)}, meta={"epoch": 2},
+                     sync=True)
+    assert plan.fired == [("ckpt.write", "error", 0)]
+    # the torn snapshot never became visible as a resume candidate
+    assert mgr.latest() == 8
+    out = mgr.restore_latest({"params": _template()})
+    assert out is not None
+    step, trees, meta = out
+    assert step == 8 and meta["epoch"] == 1
+    # zero scrambled leaves: bit-for-bit what was saved
+    np.testing.assert_array_equal(trees["params"]["w"], good["w"])
+    np.testing.assert_array_equal(trees["params"]["b"]["c"], good["b"]["c"])
+    # the uncommitted dir was quarantined, never silently deleted
+    assert os.path.isdir(str(tmp_path / "ckpt-16.corrupt"))
+    snap = reg.snapshot()
+    assert snap["zoo_ckpt_save_failures_total"]["value"] == 1
+    assert snap["zoo_ckpt_corrupt_total"]["value"] == 1
+    assert snap["zoo_ckpt_restore_fallback_total"]["value"] == 1
+
+
+def test_manifest_commit_crash_never_commits(tmp_path):
+    """A crash at the manifest rename (the commit point itself) leaves
+    manifest.json.tmp but no marker — uncommitted, exactly as if the
+    write never started."""
+    init_zoo_context(faults_enabled=True)
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path), keep=0, registry=reg)
+    plan = FaultPlan(seed=9).add("ckpt.rename", "error", at=(0,))
+    with faults.activate(plan):
+        with pytest.raises(CheckpointSaveError):
+            mgr.save(4, {"params": _tree()}, sync=True)
+    assert plan.fired == [("ckpt.rename", "error", 0)]
+    assert os.path.exists(str(tmp_path / "ckpt-4" / "manifest.json.tmp"))
+    assert not os.path.exists(str(tmp_path / "ckpt-4" / "manifest.json"))
+    assert mgr.latest() is None
+    status, reason = mgr.verify(4)
+    assert status == "uncommitted" and "never committed" in reason
+
+
+def test_async_save_failure_surfaces_on_next_save(tmp_path):
+    """An ASYNC background save failure is raised by the NEXT save call
+    (never silent), counted once, and the follow-up save succeeds."""
+    init_zoo_context(faults_enabled=True)
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path), keep=0, registry=reg)
+    plan = FaultPlan(seed=5).add("ckpt.write", "error", at=(0,))
+    with faults.activate(plan):
+        mgr.save(8, {"params": _tree()})          # async; fails in background
+        with pytest.raises(CheckpointSaveError, match="ckpt-8"):
+            mgr.save(16, {"params": _tree()})
+        # surfacing is once: the failed save was consumed, this one runs
+        mgr.save(16, {"params": _tree()})
+        mgr.close()
+    assert plan.fired == [("ckpt.write", "error", 0)]
+    assert mgr.latest() == 16
+    assert reg.snapshot()["zoo_ckpt_save_failures_total"]["value"] == 1
+
+
+def test_flipped_byte_fails_crc_and_quarantines(tmp_path):
+    """One flipped byte anywhere in a tree file fails the manifest CRC32:
+    restore(step) quarantines and raises; restore_latest falls back."""
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path), keep=0, registry=reg)
+    mgr.save(8, {"params": _tree(seed=1)}, sync=True)
+    mgr.save(16, {"params": _tree(seed=2)}, sync=True)
+    _flip_byte(str(tmp_path / "ckpt-16" / "params.npz"))
+    status, reason = mgr.verify(16)
+    assert status == "corrupt" and "CRC32" in reason
+    out = mgr.restore_latest({"params": _template()})
+    assert out is not None and out[0] == 8
+    assert os.path.isdir(str(tmp_path / "ckpt-16.corrupt"))
+    snap = reg.snapshot()
+    assert snap["zoo_ckpt_corrupt_total"]["value"] == 1
+    assert snap["zoo_ckpt_restore_fallback_total"]["value"] == 1
+
+
+def test_truncated_npz_fails_verification(tmp_path):
+    """A truncated tree file (partial disk flush at power loss) is caught
+    by the manifest byte count before anyone parses it."""
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path), keep=0, registry=reg)
+    mgr.save(8, {"params": _tree(seed=1)}, sync=True)
+    mgr.save(16, {"params": _tree(seed=2)}, sync=True)
+    p = str(tmp_path / "ckpt-16" / "params.npz")
+    data = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(data[:len(data) // 2])
+    status, reason = mgr.verify(16)
+    assert status == "corrupt" and "truncated" in reason
+    with pytest.raises(CheckpointCorruptError):
+        mgr.restore(16, {"params": _template()})
+    assert os.path.isdir(str(tmp_path / "ckpt-16.corrupt"))
+    assert reg.snapshot()["zoo_ckpt_corrupt_total"]["value"] == 1
+
+
+def test_missing_manifest_is_uncommitted_and_falls_back(tmp_path):
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path), keep=0, registry=reg)
+    mgr.save(8, {"params": _tree(seed=1)}, sync=True)
+    mgr.save(16, {"params": _tree(seed=2)}, sync=True)
+    os.remove(str(tmp_path / "ckpt-16" / "manifest.json"))
+    out = mgr.restore_latest({"params": _template()})
+    assert out is not None and out[0] == 8
+    assert os.path.isdir(str(tmp_path / "ckpt-16.corrupt"))
+    snap = reg.snapshot()
+    assert snap["zoo_ckpt_corrupt_total"]["value"] == 1
+    assert snap["zoo_ckpt_restore_fallback_total"]["value"] == 1
+
+
+def test_legacy_snapshot_without_manifest_restores_with_warning(tmp_path,
+                                                                caplog):
+    """Backward compatibility: a pre-manifest snapshot (leaf npz files +
+    meta.json, the old writer's layout) restores with a logged warning —
+    NOT quarantined, not corrupt."""
+    import json
+
+    import jax
+    reg = MetricsRegistry()
+    d = tmp_path / "ckpt-12"
+    d.mkdir()
+    tree = _tree(seed=4)
+    leaves = jax.tree_util.tree_leaves(tree)
+    np.savez(str(d / "params.npz"),
+             **{f"leaf_{i}": a for i, a in enumerate(leaves)})
+    with open(str(d / "meta.json"), "w") as f:
+        json.dump({"step": 12, "epoch": 3}, f)
+    mgr = CheckpointManager(str(tmp_path), keep=0, registry=reg)
+    assert mgr.verify(12) == ("legacy", None)
+    import logging
+    with caplog.at_level(logging.WARNING,
+                         logger="analytics_zoo_tpu.checkpoint"):
+        out = mgr.restore_latest({"params": _template()})
+    assert out is not None
+    step, trees, meta = out
+    assert step == 12 and meta["epoch"] == 3
+    np.testing.assert_array_equal(trees["params"]["w"], tree["w"])
+    assert any("WITHOUT checksum verification" in r.message
+               for r in caplog.records)
+    assert reg.snapshot()["zoo_ckpt_corrupt_total"]["value"] == 0
+
+
+def test_architecture_mismatch_is_not_corruption(tmp_path):
+    """A wrong restore template must fail loudly WITHOUT quarantining —
+    otherwise one config bug walks the whole directory into .corrupt."""
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path), keep=0, registry=reg)
+    mgr.save(8, {"params": _tree()}, sync=True)
+    with pytest.raises(ValueError, match="architecture mismatch"):
+        mgr.restore_latest({"params": {"w": np.zeros((9, 9), np.float32)}})
+    assert os.path.isdir(str(tmp_path / "ckpt-8"))      # untouched
+    assert reg.snapshot()["zoo_ckpt_corrupt_total"]["value"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fit-level scenarios: resume through the training loop
+# ---------------------------------------------------------------------------
+
+def _fit_control(tmp_path, nb_epoch=3):
+    """The uninterrupted reference run: same data/seeds/checkpointing."""
+    init_zoo_context(faults_enabled=True)
+    x, y = _data()
+    m = _model()
+    m.set_checkpoint(str(tmp_path / "control"), keep=None)
+    h = m.fit(x, y, batch_size=32, nb_epoch=nb_epoch)
+    return x, y, m, h
+
+
+@pytest.mark.parametrize("corruption", ["flip", "truncate", "rm_manifest"])
+def test_resume_after_corruption_matches_uninterrupted_run(tmp_path,
+                                                           corruption):
+    """The acceptance scenario: train 2 epochs, corrupt the NEWEST
+    snapshot (flipped byte / truncated npz / missing manifest), resume in
+    a fresh 'process'. The resume must quarantine the bad snapshot, fall
+    back to epoch 1's, retrain epochs 2-3 — and the post-resume losses
+    must match the uninterrupted control run exactly (same rng schedule
+    from the same restored state: zero scrambled leaves)."""
+    x, y, _, h_control = _fit_control(tmp_path, nb_epoch=3)
+
+    m = _model()
+    m.set_checkpoint(str(tmp_path / "ckpt"))
+    m.fit(x, y, batch_size=32, nb_epoch=2)      # snapshots at steps 8, 16
+    newest = str(tmp_path / "ckpt" / "ckpt-16")
+    if corruption == "flip":
+        _flip_byte(os.path.join(newest, "params.npz"))
+    elif corruption == "truncate":
+        p = os.path.join(newest, "opt_state.npz")
+        data = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(data[: len(data) // 3])
+    else:
+        os.remove(os.path.join(newest, "manifest.json"))
+
+    before = _counters("zoo_ckpt_corrupt_total",
+                       "zoo_ckpt_restore_fallback_total")
+    # "new process": a fresh model object pointed at the same directory
+    m2 = _model()
+    m2.set_checkpoint(str(tmp_path / "ckpt"))
+    h = m2.fit(x, y, batch_size=32, nb_epoch=2)  # resumes epoch 1 → 2, 3
+    after = _counters("zoo_ckpt_corrupt_total",
+                      "zoo_ckpt_restore_fallback_total")
+
+    assert m2.finished_epochs == 3
+    assert os.path.isdir(newest + ".corrupt")    # quarantined, not deleted
+    assert after["zoo_ckpt_corrupt_total"] \
+        - before["zoo_ckpt_corrupt_total"] == 1
+    assert after["zoo_ckpt_restore_fallback_total"] \
+        - before["zoo_ckpt_restore_fallback_total"] == 1
+    # post-resume losses match the uninterrupted run: epochs 2 and 3
+    np.testing.assert_allclose(h["loss"], h_control["loss"][1:3],
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_fit_retry_resumes_past_save_killed_mid_write(tmp_path):
+    """End to end through the retry loop: epoch 2's async save is killed
+    mid-write; the failure surfaces at the NEXT checkpoint call (epoch
+    3's), the retry attempt quarantines the torn snapshot, continues
+    from the published in-memory state, and the re-cut snapshot
+    verifies clean."""
+    x, y, _, h_control = _fit_control(tmp_path, nb_epoch=3)
+
+    m = _model()
+    m.set_checkpoint(str(tmp_path / "ckpt"))
+    m.fit(x, y, batch_size=32, nb_epoch=1)      # clean ckpt-8
+    before = _counters("zoo_ckpt_corrupt_total",
+                       "zoo_ckpt_save_failures_total")
+    # ckpt.write fires per TREE FILE (3 per snapshot): index 0 is the
+    # first file of epoch 2's save — that snapshot dies mid-write
+    plan = FaultPlan(seed=11).add("ckpt.write", "error", at=(0,))
+    with faults.activate(plan):
+        h = m.fit(x, y, batch_size=32, nb_epoch=2)
+    after = _counters("zoo_ckpt_corrupt_total",
+                      "zoo_ckpt_save_failures_total")
+
+    assert plan.fired == [("ckpt.write", "error", 0)]
+    assert after["zoo_ckpt_save_failures_total"] \
+        - before["zoo_ckpt_save_failures_total"] == 1
+    assert after["zoo_ckpt_corrupt_total"] \
+        - before["zoo_ckpt_corrupt_total"] == 1
+    assert m.finished_epochs == 3
+    # the torn ckpt-16 is quarantined; everything still on disk verifies
+    assert os.path.isdir(str(tmp_path / "ckpt" / "ckpt-16.corrupt"))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"),
+                            registry=MetricsRegistry())
+    assert mgr.steps() == [8, 24]
+    assert all(mgr.verify(s)[0] == "ok" for s in mgr.steps())
+    # the retried epoch reproduces the control run's epoch 3 loss
+    np.testing.assert_allclose(h["loss"][-1], h_control["loss"][2],
+                               rtol=1e-5, atol=1e-7)
+    # and a genuinely fresh process resumes from the newest clean snapshot
+    m3 = _model()
+    m3.set_checkpoint(str(tmp_path / "ckpt"))
+    h3 = m3.fit(x, y, batch_size=32, nb_epoch=1)
+    assert m3.finished_epochs == 4 and len(h3["loss"]) == 1
+
+
+class _OnceAt(Trigger):
+    """Fires exactly once, at a given iteration (chaos tests need one
+    isolated save whose write latency they can observe)."""
+
+    def __init__(self, iteration):
+        self.iteration = iteration
+
+    def __call__(self, state):
+        return state.iteration == self.iteration
+
+
+def test_async_save_is_off_the_step_path(tmp_path):
+    """The acceptance test for async semantics: a slow (fault-injected
+    latency) checkpoint write is STILL IN FLIGHT while fit keeps
+    stepping — observed at the epoch boundary 4 steps after the save was
+    cut — and zoo_ckpt_save_seconds records the save."""
+    init_zoo_context(faults_enabled=True)
+    x, y = _data()
+    m = _model()
+    m.set_checkpoint(str(tmp_path / "ckpt"), trigger=_OnceAt(4))
+    before = _counters("zoo_ckpt_save_seconds")
+    plan = FaultPlan(seed=2).add("ckpt.write", "latency", at=(0,),
+                                 delay_s=0.6)
+    observed = []
+
+    def spy(record):
+        mgr = m._loop._active_ckpt_mgr
+        observed.append((record["iteration"], mgr.save_in_flight()))
+
+    with faults.activate(plan):
+        t0 = time.perf_counter()
+        m.fit(x, y, batch_size=32, nb_epoch=2, callbacks=[spy])
+    assert plan.fired == [("ckpt.write", "latency", 0)]
+    # epoch 1's boundary (iteration 8) ran while the iteration-4 save was
+    # still writing: 4 optimizer steps of a toy model finish long before
+    # a 0.6 s write — training progressed PAST the in-flight save
+    assert observed[0][0] == 8 and observed[0][1] is True, observed
+    # the snapshot still committed (end-of-fit joins the writer)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"),
+                            registry=MetricsRegistry())
+    assert mgr.steps() == [4] and mgr.verify(4)[0] == "ok"
+    after = _counters("zoo_ckpt_save_seconds")
+    assert after["zoo_ckpt_save_seconds"] \
+        - before["zoo_ckpt_save_seconds"] == 1
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe shutdown (zoo.checkpoint.on_sigterm)
+# ---------------------------------------------------------------------------
+
+def test_sigterm_cuts_final_checkpoint_and_exits_cleanly(tmp_path):
+    """SIGTERM mid-fit (opt-in flag): one final SYNCHRONOUS snapshot at
+    the next step boundary, then a clean TrainingPreempted (SystemExit)
+    exit — and a fresh process resumes from exactly that snapshot."""
+    init_zoo_context(checkpoint_on_sigterm=True)
+    x, y = _data()
+    m = _model()
+    m.set_checkpoint(str(tmp_path / "ckpt"))
+
+    def cb(record):
+        if record["epoch"] == 1:    # end of epoch 1 (iteration 8)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    prev = signal.getsignal(signal.SIGTERM)
+    with pytest.raises(TrainingPreempted):
+        m.fit(x, y, batch_size=32, nb_epoch=5, callbacks=[cb])
+    # the previous handler is restored even on the preemption exit path
+    assert signal.getsignal(signal.SIGTERM) is prev
+    # the final snapshot landed at the first step boundary of epoch 2,
+    # synchronously (committed BEFORE the exit) and verified
+    mgr = CheckpointManager(str(tmp_path / "ckpt"),
+                            registry=MetricsRegistry())
+    assert mgr.latest() == 9
+    assert mgr.verify(9)[0] == "ok"
+    assert m.finished_iterations == 9
+    # a fresh process resumes from it: epoch 2 retrains (it was cut
+    # mid-epoch), ending at finished_epochs == 2
+    m2 = _model()
+    m2.set_checkpoint(str(tmp_path / "ckpt"))
+    h = m2.fit(x, y, batch_size=32, nb_epoch=1)
+    assert m2.finished_epochs == 2 and len(h["loss"]) == 1
+    assert np.isfinite(h["loss"][0])
+    init_zoo_context(checkpoint_on_sigterm=False)
+
+
+def test_sigterm_flag_off_keeps_default_behavior(tmp_path):
+    """Without the opt-in flag fit must NOT touch the process signal
+    table."""
+    init_zoo_context(checkpoint_on_sigterm=False)
+    x, y = _data()
+    m = _model()
+    m.set_checkpoint(str(tmp_path / "ckpt"))
+    seen = []
+
+    def cb(record):
+        seen.append(signal.getsignal(signal.SIGTERM))
+
+    m.fit(x, y, batch_size=32, nb_epoch=1, callbacks=[cb])
+    assert seen == [signal.getsignal(signal.SIGTERM)]   # untouched
+
+
+def test_read_only_restore_skips_without_quarantining(tmp_path):
+    """A reader that does NOT own the directory (serving loading a live
+    training run) must skip a bad/uncommitted snapshot, never rename it:
+    from outside, 'uncommitted' may be the owner's save in flight, and a
+    rename would destroy a healthy save mid-commit."""
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path), keep=0, registry=reg)
+    good = _tree(seed=1)
+    mgr.save(8, {"params": good}, sync=True)
+    # simulate the owner's NEXT save caught mid-write: files, no manifest
+    d = tmp_path / "ckpt-16"
+    d.mkdir()
+    np.savez(str(d / "params.npz"), leaf_0=np.ones(3, np.float32))
+
+    out = mgr.restore_latest({"params": _template()}, quarantine=False)
+    assert out is not None and out[0] == 8
+    # the in-flight dir is untouched — the owner can still commit it
+    assert os.path.isdir(str(d))
+    assert not os.path.exists(str(tmp_path / "ckpt-16.corrupt"))
+    snap = reg.snapshot()
+    assert snap["zoo_ckpt_corrupt_total"]["value"] == 0
+    assert snap["zoo_ckpt_restore_fallback_total"]["value"] == 1
+
+
+def test_malformed_manifest_schema_is_corrupt_not_a_crash(tmp_path):
+    """A manifest that parses as JSON but lost its schema (version skew,
+    hand edit, torn rewrite) must classify as corrupt — verify() and
+    restore() report it, never raise a raw KeyError."""
+    import json
+
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path), keep=0, registry=reg)
+    mgr.save(8, {"params": _tree(seed=1)}, sync=True)
+    mgr.save(16, {"params": _tree(seed=2)}, sync=True)
+    man = str(tmp_path / "ckpt-16" / "manifest.json")
+    with open(man, "w") as f:
+        json.dump({"version": 1, "step": 16, "meta": {"step": 16},
+                   "trees": {"params": {"nope": True}}}, f)
+    status, reason = mgr.verify(16)
+    assert status == "corrupt" and "malformed" in reason
+    # survey(verify=True) — the zoo-ckpt verify path — reports, not raises
+    by_name = {e["name"]: e for e in mgr.survey(verify=True)}
+    assert by_name["ckpt-16"]["status"] == "corrupt"
+    # and the fallback walk lands on the older good snapshot
+    out = mgr.restore_latest({"params": _template()})
+    assert out is not None and out[0] == 8
+    assert reg.snapshot()["zoo_ckpt_corrupt_total"]["value"] == 1

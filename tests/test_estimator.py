@@ -257,3 +257,42 @@ def test_nnmodel_save_rejects_lambda_preprocessing(tmp_path):
     model = clf.fit(table)
     with pytest.raises(ValueError, match="picklable"):
         model.save(str(tmp_path / "nope.nnmodel"))
+
+
+def test_train_checkpoint_trigger_without_model_dir_warns(caplog, tmp_path):
+    """checkpoint_trigger without a model_dir cannot snapshot (and a
+    failure cannot resume): Estimator.train must say so loudly, train
+    anyway, and write nothing."""
+    import logging
+    import os
+
+    init_zoo_context()
+    x, y = _mlp_data(n=64)
+    m = _mlp()
+    m.init_weights(sample_input=x[:2])
+    est = Estimator(m, optim_methods="adam", model_dir=None)
+    with caplog.at_level(logging.WARNING,
+                         logger="analytics_zoo_tpu.estimator"):
+        h = est.train(FeatureSet.array(x, y), criterion="scce",
+                      batch_size=32, nb_epoch=1,
+                      checkpoint_trigger=SeveralIteration(1))
+    assert any("no model_dir" in r.message for r in caplog.records)
+    assert len(h["loss"]) == 1 and np.isfinite(h["loss"][0])
+    assert not any(n.startswith("ckpt-") for n in os.listdir(str(tmp_path)))
+
+
+def test_estimator_checkpoint_keep_bounds_retention(tmp_path):
+    """checkpoint_keep flows through to the durable CheckpointManager."""
+    from analytics_zoo_tpu.utils.checkpoint import CheckpointManager
+
+    init_zoo_context()
+    x, y = _mlp_data(n=128)
+    m = _mlp()
+    m.init_weights(sample_input=x[:2])
+    est = Estimator(m, optim_methods="adam", model_dir=str(tmp_path / "ck"))
+    est.train(FeatureSet.array(x, y), criterion="scce", batch_size=32,
+              nb_epoch=5, checkpoint_keep=2)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    steps = mgr.steps()
+    assert len(steps) == 2                       # pruned to keep=2
+    assert all(mgr.verify(s)[0] == "ok" for s in steps)

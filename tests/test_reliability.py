@@ -296,3 +296,91 @@ def test_wait_for_survives_thousands_of_polls():
     assert p.wait_for(ready, timeout=60.0, sleep=lambda s: None) is True
     assert state["n"] == 1500
     assert p._envelope(5000) == 1e-9        # no overflow, saturated
+
+
+# ---------------------------------------------------------------------------
+# RetryBudget (ROADMAP PR-5 follow-up): the global token bucket that
+# keeps a correlated outage from multiplying retries fleet-wide
+# ---------------------------------------------------------------------------
+
+def test_retry_budget_withdraw_deposit_deterministic():
+    from analytics_zoo_tpu.common.reliability import RetryBudget
+
+    reg = MetricsRegistry()
+    b = RetryBudget(capacity=3, deposit=0.5, name="t", registry=reg)
+    assert b.tokens == 3.0
+    assert b.withdraw() and b.withdraw() and b.withdraw()
+    assert not b.withdraw()                     # empty: refuse
+    assert not b.withdraw()                     # deterministically so
+    snap = reg.snapshot()
+    assert snap['zoo_retry_budget_exhausted_total{budget="t"}'][
+        "value"] == 2
+    b.on_success()
+    assert b.tokens == 0.5                      # deposits accrue...
+    assert not b.withdraw()                     # ...but < 1 still refuses
+    b.on_success()
+    assert b.withdraw()                         # a full token earned back
+    for _ in range(100):
+        b.on_success()
+    assert b.tokens == 3.0                      # capped at capacity
+
+
+def test_retry_budget_validation():
+    from analytics_zoo_tpu.common.reliability import RetryBudget
+
+    with pytest.raises(ValueError, match="capacity"):
+        RetryBudget(capacity=0)
+    with pytest.raises(ValueError, match="deposit"):
+        RetryBudget(deposit=-0.1)
+
+
+def test_call_stops_retrying_when_budget_exhausted():
+    """RetryPolicy.call under an exhausted shared budget raises the last
+    error immediately instead of running its remaining attempts — the
+    correlated-outage brake."""
+    from analytics_zoo_tpu.common.reliability import RetryBudget
+
+    reg = MetricsRegistry()
+    budget = RetryBudget(capacity=1, deposit=0.0, name="shared",
+                         registry=reg)
+    policy = RetryPolicy(max_attempts=5, base_delay=0.0, max_delay=0.0,
+                         seed=1)
+    calls = {"n": 0}
+
+    def always_down():
+        calls["n"] += 1
+        raise ConnectionError("backend down")
+
+    with pytest.raises(ConnectionError):
+        policy.call(always_down, op="a", budget=budget,
+                    sleep=lambda s: None)
+    # initial attempt + exactly ONE budgeted retry (capacity 1), not 5
+    assert calls["n"] == 2
+    # a second caller of the same budget gets NO retries at all
+    with pytest.raises(ConnectionError):
+        policy.call(always_down, op="b", budget=budget,
+                    sleep=lambda s: None)
+    assert calls["n"] == 3
+    snap = reg.snapshot()
+    assert snap['zoo_retry_budget_exhausted_total{budget="shared"}'][
+        "value"] == 2   # op a's second retry refused + op b's first
+
+
+def test_call_success_deposits_into_budget():
+    from analytics_zoo_tpu.common.reliability import RetryBudget
+
+    budget = RetryBudget(capacity=2, deposit=1.0)
+    policy = RetryPolicy(max_attempts=3, base_delay=0.0, max_delay=0.0,
+                         seed=2)
+    flaky = {"n": 0}
+
+    def once_flaky():
+        flaky["n"] += 1
+        if flaky["n"] == 1:
+            raise ConnectionError("blip")
+        return "ok"
+
+    assert policy.call(once_flaky, budget=budget,
+                       sleep=lambda s: None) == "ok"
+    # one retry withdrawn (-1), one success deposited (+1): back to 2
+    assert budget.tokens == 2.0

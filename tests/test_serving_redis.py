@@ -482,3 +482,101 @@ def test_driver_transport_errors_normalize_to_builtin(redis_port):
     # the RespClient path raises builtins already: nothing to normalize
     b2 = RedisBackend(port=redis_port)
     assert b2._driver_errors == ()
+
+
+# ---------------------------------------------------------------------------
+# Named fault sites against a LIVE backend (ROADMAP PR-5 follow-up):
+# the chaos harness (`common/faults`) can now fire inside the RESP wire
+# client (`resp.send` / `resp.recv`) and `RedisBackend.xadd`
+# (`backend.xadd`), so the recovery rules proven against LocalBackend
+# also get exercised over a real socket.
+# ---------------------------------------------------------------------------
+
+def test_resp_send_fault_reconnects_transparently(flaky_server):
+    """A planned disconnect at the `resp.send` site (connection dies
+    before the command frame leaves) reconnects under the retry policy —
+    same contract as a server-side drop — reconciled exactly against the
+    plan's fired log."""
+    from analytics_zoo_tpu.common import faults
+    from analytics_zoo_tpu.common.faults import FaultPlan
+
+    init_zoo_context(faults_enabled=True)
+    c, reg = _client(flaky_server)
+    # resp.send call indices: 0 = PING, 1 = XADD, 2 = XLEN (faulted)
+    plan = FaultPlan(seed=13).add("resp.send", "disconnect", at=(2,))
+    with faults.activate(plan):
+        assert c.ping()
+        c.xadd("s", {"k": "v"})
+        assert c.xlen("s") == 1       # reconnected + retried transparently
+    assert plan.fired == [("resp.send", "disconnect", 2)]
+    snap = reg.snapshot()
+    assert snap['zoo_backend_reconnects_total{backend="resp"}']["value"] == 1
+
+
+def test_resp_recv_fault_on_xadd_stays_at_most_once(flaky_server):
+    """A planned disconnect at `resp.recv` during an XADD models the
+    worst case: the frame was SENT (the server may have applied it) and
+    the reply is lost. The client must surface the error — never blind-
+    retry a non-idempotent command — leaving exactly one copy applied."""
+    from analytics_zoo_tpu.common import faults
+    from analytics_zoo_tpu.common.faults import FaultPlan
+
+    init_zoo_context(faults_enabled=True)
+    c, reg = _client(flaky_server)
+    # resp.recv indices: 0 = PING, 1 = XADD (faulted after send)
+    plan = FaultPlan(seed=14).add("resp.recv", "disconnect", at=(1,))
+    with faults.activate(plan):
+        assert c.ping()
+        with pytest.raises((ConnectionError, OSError)):
+            c.xadd("once-chaos", {"uri": "a"})
+        assert c.xlen("once-chaos") == 1   # applied exactly once, no retry
+    assert plan.fired == [("resp.recv", "disconnect", 1)]
+    assert reg.snapshot()[
+        'zoo_backend_reconnects_total{backend="resp"}']["value"] == 0
+
+
+def test_chaos_scenario_runs_against_live_backend(redis_port):
+    """Smoke: one test_chaos.py-style scenario against a REAL Redis-
+    speaking socket — a planned `backend.xadd` disconnect hits the
+    producer mid-enqueue (at-most-once: the producer owns re-enqueueing),
+    and every record the stream accepted is still served."""
+    import optax
+
+    from analytics_zoo_tpu.common import faults
+    from analytics_zoo_tpu.common.faults import FaultPlan
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.serving.client import (InputQueue, OutputQueue,
+                                                  ServingError)
+    from analytics_zoo_tpu.serving.server import ClusterServing
+
+    init_zoo_context(faults_enabled=True)
+    m = Sequential([Dense(3, activation="softmax", input_shape=(4,))])
+    m.compile(optimizer=optax.adam(1e-3), loss="scce")
+    m.init_weights()
+
+    backend = RedisBackend(port=redis_port, maxlen=50)
+    serving = ClusterServing(m, backend=backend, batch_size=4)
+    plan = FaultPlan(seed=21).add("backend.xadd", "disconnect", at=(2,))
+    inq = InputQueue(backend=backend)
+    outq = OutputQueue(backend=backend)
+    rng = np.random.default_rng(3)
+    xs = {f"cx{i}": rng.normal(size=(4,)).astype(np.float32)
+          for i in range(6)}
+    dropped = []
+    with faults.activate(plan):
+        serving.start()
+        try:
+            for uri, arr in xs.items():
+                try:
+                    inq.enqueue(uri, arr)
+                except ConnectionError:
+                    # at-most-once: the producer decides — re-enqueue
+                    dropped.append(uri)
+                    inq.enqueue(uri, arr)
+            got = {uri: outq.query(uri, timeout=30.0) for uri in xs}
+        finally:
+            serving.stop(drain=False)
+    assert plan.fired == [("backend.xadd", "disconnect", 2)]
+    assert dropped == ["cx2"]           # exactly the planned victim
+    assert all(v is not None and v.shape == (3,) for v in got.values())

@@ -163,6 +163,7 @@ class ModuleContext:
               "numpy": {"numpy"},
               "jax.numpy": {"jax.numpy"},
               "time": {"time"},
+              "queue": {"queue"},
               "logging": {"logging"}}
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Import):

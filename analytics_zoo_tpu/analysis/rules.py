@@ -1,4 +1,4 @@
-"""zoolint rules ZL001–ZL010 — the JAX/TPU hazards that bite this stack.
+"""zoolint rules ZL001–ZL011 — the JAX/TPU hazards that bite this stack.
 
 Every rule documents its rationale in the class docstring (surfaced by
 ``--list-rules`` and docs/guides/STATIC_ANALYSIS.md). Severities:
@@ -1094,3 +1094,127 @@ class UnboundedRetrySpin(Rule):
                   "(delays()/wait_for) or check a time.monotonic() "
                   "deadline",
                 severity=sev)
+
+
+# ---------------------------------------------------------------------------
+# ZL011 — unbounded queue.Queue / blocking put with no timeout
+# ---------------------------------------------------------------------------
+
+_QUEUE_CLASSES = ("Queue", "LifoQueue", "PriorityQueue", "SimpleQueue")
+
+
+@register
+class UnboundedQueueUse(Rule):
+    """An unbounded ``queue.Queue()`` between pipeline stages removes the
+    backpressure the serving path depends on — a stalled consumer lets
+    the producer buffer without limit until the host OOMs (the failure
+    mode the bounded publisher queue exists to prevent). And a blocking
+    ``.put()`` with no ``timeout`` on a BOUNDED queue is the same hang
+    ZL010 flags for sleep spins: when the consumer wedges, the producer
+    thread parks forever instead of surfacing the stall. Bound the queue
+    (``maxsize=``) and the put (``timeout=`` + handle ``queue.Full``, or
+    ``put_nowait``/``block=False`` where dropping is correct). Error
+    severity in the ``serving/`` and ``pipeline/inference/`` paths,
+    warning elsewhere (a deliberately unbounded hand-off carries the
+    warning knowingly, with a justified suppression)."""
+
+    id = "ZL011"
+    severity = ERROR
+
+    def _is_queue_ctor(self, ctx: ModuleContext, node: ast.Call) -> bool:
+        d = dotted(node.func)
+        if not d:
+            return False
+        if ctx.is_call_to(d, "queue", _QUEUE_CLASSES):
+            return True
+        return "." not in d and \
+            ctx.from_imported("queue").get(d) in _QUEUE_CLASSES
+
+    @staticmethod
+    def _maxsize(node: ast.Call) -> Optional[ast.AST]:
+        for kw in node.keywords:
+            if kw.arg == "maxsize":
+                return kw.value
+        return node.args[0] if node.args else None
+
+    @staticmethod
+    def _target_leaf(t: ast.AST) -> Optional[str]:
+        if isinstance(t, ast.Name):
+            return t.id
+        if isinstance(t, ast.Attribute):
+            return t.attr
+        return None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        sev = ERROR if _in_serving_hot_path(ctx.path) else WARNING
+        # names bound to a queue constructor anywhere in the module
+        # (`q = queue.Queue(...)`, `self._pub_queue = queue.Queue(...)`,
+        # annotated forms included): the receivers whose `.put` calls
+        # this rule attributes to a stdlib queue rather than to some
+        # unrelated object's put method
+        qnames = set()
+        for node in ast.walk(ctx.tree):
+            value = getattr(node, "value", None)
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                    and isinstance(value, ast.Call) \
+                    and self._is_queue_ctor(ctx, value):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    leaf = self._target_leaf(t)
+                    if leaf:
+                        qnames.add(leaf)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_queue_ctor(ctx, node):
+                d = dotted(node.func) or "queue.Queue"
+                if d.rsplit(".", 1)[-1] == "SimpleQueue":
+                    # SimpleQueue cannot be bounded at all
+                    yield self.finding(
+                        ctx, node.lineno,
+                        "queue.SimpleQueue() is always unbounded — a "
+                        "stalled consumer buffers without limit; use "
+                        "queue.Queue(maxsize=...) so the producer "
+                        "backpressures",
+                        severity=sev)
+                    continue
+                size = self._maxsize(node)
+                if size is None or (isinstance(size, ast.Constant)
+                                    and isinstance(size.value, (int, float))
+                                    and not isinstance(size.value, bool)
+                                    and size.value <= 0):
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"{d}() with no positive maxsize is unbounded"
+                        + (" in a serving/inference path"
+                           if sev == ERROR else "")
+                        + " — a stalled consumer buffers without limit; "
+                          "pass maxsize= so the producer backpressures",
+                        severity=sev)
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "put" \
+                    and self._target_leaf(node.func.value) in qnames:
+                # Queue.put(item, block=True, timeout=None): both block
+                # and timeout may be passed positionally
+                if any(kw.arg == "timeout" for kw in node.keywords) \
+                        or len(node.args) >= 3:
+                    continue
+                block_arg = None
+                for kw in node.keywords:
+                    if kw.arg == "block":
+                        block_arg = kw.value
+                if block_arg is None and len(node.args) >= 2:
+                    block_arg = node.args[1]
+                if isinstance(block_arg, ast.Constant) \
+                        and block_arg.value is False:
+                    continue    # non-blocking put raises Full immediately
+                yield self.finding(
+                    ctx, node.lineno,
+                    "blocking .put() on a queue with no timeout"
+                    + (" in a serving/inference path" if sev == ERROR
+                       else "")
+                    + " — a wedged consumer parks this thread forever; "
+                      "pass timeout= and handle queue.Full (or "
+                      "put_nowait/block=False where dropping is correct)",
+                    severity=sev)

@@ -54,6 +54,20 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.faults.enabled": False,         # gate for common.faults.activate (chaos tests)
     "zoo.checkpoint.keep": 3,
     "zoo.checkpoint.on_sigterm": False,  # SIGTERM during fit → final sync snapshot + clean exit
+    "zoo.checkpoint.sigterm_grace_s": 0.0,  # >0: cut a MID-EPOCH snapshot from the
+    #   SIGTERM handler when the estimated time to the next step boundary
+    #   exceeds this budget (preemption deadline shorter than a dispatch)
+    # -- serving overload / degradation (docs/guides/RELIABILITY.md) --------
+    "zoo.serving.shed_watermark": 0,     # stream-depth watermark; >0 sheds the
+    #   newest records in each admission window once the backlog exceeds it
+    "zoo.serving.adaptive_batch": False,  # AIMD batch-size control from the
+    #   live backlog/queue-wait signals (zoo_serving_batch_size_target)
+    "zoo.serving.queue_wait_target_ms": 500,  # queue-wait breach target the
+    #   AIMD controller backs off against
+    "zoo.serving.dlq_dir": "",           # non-empty: spill dead-lettered records
+    #   to this append-only on-disk DLQ (scripts/zoo-dlq replays them)
+    "zoo.serving.dlq_max_bytes": 64 << 20,  # DLQ disk bound; oldest sealed
+    #   segment evicted first once exceeded
     "zoo.log.level": "INFO",
 }
 

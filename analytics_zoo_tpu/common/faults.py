@@ -29,7 +29,10 @@ ground truth): ``backend.xadd`` (``LocalBackend`` AND ``RedisBackend`` —
 chaos against a live server) / ``backend.xread`` / ``backend.stream_len``
 / ``backend.set_result`` / ``backend.set_results`` (``LocalBackend``),
 ``serving.loop`` (top of each serve-loop iteration), ``serving.dispatch``
-(before every model call, retries included), ``resp.send`` /
+(before every model call, retries included), ``serving.publish`` (one
+per published result batch, on the publisher thread — unlike
+``backend.set_results`` it never collides with the shed/error-record
+writes, so an outage plan hits exactly the publishes), ``resp.send`` /
 ``resp.recv`` (one fire per RESP command/pipeline attempt, around the
 wire ops — exercises the reconnect/idempotency rules against a real
 socket), and the checkpoint writer's ``ckpt.write`` (per tree file) /

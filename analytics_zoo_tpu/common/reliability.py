@@ -25,6 +25,12 @@ supervisor discipline of Ray's actor-restart model:
   (``zoo_retry_budget_exhausted_total{budget=}``) so a correlated outage
   cannot multiply load fleet-wide the way per-caller backoff alone
   allows.
+* :class:`AIMDController` — bounded additive-increase /
+  multiplicative-decrease control (the TCP congestion-avoidance shape):
+  a healthy signal grows the value additively toward a ceiling, a breach
+  backs it off multiplicatively toward a floor. Deterministic by
+  construction (no RNG) — the serving loop's adaptive batch sizing
+  reconciles its target sequence exactly under test.
 
 Consumers: ``serving/resp.py`` (transparent reconnect), ``serving/
 backend.py`` (bounded full-stream waits), ``serving/server.py``
@@ -48,7 +54,7 @@ from typing import Callable, Iterator, Optional, Tuple, Type
 log = logging.getLogger("analytics_zoo_tpu.reliability")
 
 __all__ = ["RetryPolicy", "RetryBudget", "CircuitBreaker",
-           "CircuitOpenError"]
+           "CircuitOpenError", "AIMDController"]
 
 #: default transient-transport classification: connection drops, socket
 #: errors and timeouts retry; everything else (protocol errors, bugs)
@@ -458,3 +464,62 @@ class CircuitBreaker:
             raise
         self.record_success()
         return result
+
+
+# ---------------------------------------------------------------------------
+# AIMD controller
+# ---------------------------------------------------------------------------
+
+class AIMDController:
+    """Bounded additive-increase / multiplicative-decrease controller.
+
+    The control shape TCP congestion avoidance (and Clipper-style
+    adaptive batching) uses: while the observed signal is healthy the
+    value climbs ``add`` per update toward ``ceiling``; one breach backs
+    it off by ``backoff`` (multiplicative), never below ``floor``. The
+    asymmetry is the point — growth probes capacity slowly, a breach
+    sheds it immediately, and the loop converges instead of oscillating
+    wall to wall.
+
+    Deterministic: no RNG, no clock — the value after N updates is a
+    pure function of the breach sequence, so tests reconcile the target
+    trajectory exactly. Thread-safe; ``value`` reads the current target
+    without updating it."""
+
+    def __init__(self, floor: int = 1, ceiling: int = 32,
+                 initial: Optional[int] = None, add: float = 1.0,
+                 backoff: float = 0.5):
+        if floor < 1:
+            raise ValueError(f"floor must be >= 1 ({floor})")
+        if ceiling < floor:
+            raise ValueError(f"ceiling {ceiling} < floor {floor}")
+        if add <= 0:
+            raise ValueError(f"add must be > 0 ({add})")
+        if not 0.0 < backoff < 1.0:
+            raise ValueError(f"backoff must be in (0, 1) ({backoff})")
+        self.floor = int(floor)
+        self.ceiling = int(ceiling)
+        self.add = float(add)
+        self.backoff = float(backoff)
+        v = ceiling if initial is None else initial
+        if not floor <= v <= ceiling:
+            raise ValueError(f"initial {v} outside [{floor}, {ceiling}]")
+        self._value = float(v)
+        self._lock = threading.Lock()
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return int(self._value)
+
+    def update(self, overloaded: bool) -> int:
+        """One control step: ``overloaded=True`` backs off
+        multiplicatively, ``False`` grows additively. Returns the new
+        integer target."""
+        with self._lock:
+            if overloaded:
+                self._value = max(float(self.floor),
+                                  self._value * self.backoff)
+            else:
+                self._value = min(float(self.ceiling), self._value + self.add)
+            return int(self._value)

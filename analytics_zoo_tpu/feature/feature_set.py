@@ -188,16 +188,45 @@ class _ThreadedIterator:
         def run():
             try:
                 for item in it:
-                    if self._stop.is_set():
+                    if not self._put(item):
                         return
-                    self._q.put(item)
             except BaseException as e:  # propagate to consumer
                 self._err = e
             finally:
-                self._q.put(self._END)
+                # the sentinel is delivered UNCONDITIONALLY — a consumer
+                # blocked in __next__ (or one that races close()) needs
+                # the END to raise StopIteration/propagate _err rather
+                # than hang. While live, wait for the consumer like any
+                # item; once close() set the stop flag the stream is
+                # abandoned, so freeing a slot (dropping one unread
+                # item) to land the sentinel is correct and guarantees
+                # termination.
+                while True:
+                    try:
+                        self._q.put(self._END, timeout=0.1)
+                        return
+                    except queue.Full:
+                        if self._stop.is_set():
+                            try:
+                                self._q.get_nowait()
+                            except queue.Empty:
+                                pass
 
         self._t = threading.Thread(target=run, daemon=True)
         self._t.start()
+
+    def _put(self, item) -> bool:
+        """Bounded producer put: re-check the stop flag between timed
+        attempts so a consumer that stopped consuming mid-buffer-full
+        (close() racing a refill) releases this thread instead of
+        parking it forever on a full queue (ZL011)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def __iter__(self):
         return self

@@ -308,6 +308,17 @@ class TrainingLoop:
         # _close_active_ckpt_mgr) and the SIGTERM preemption latch
         self._active_ckpt_mgr: Optional[CheckpointManager] = None
         self._preempted = threading.Event()
+        # SIGTERM grace budget (zoo.checkpoint.sigterm_grace_s): the
+        # in-flight dispatch segment's start stamp + EWMA duration
+        # estimate, and — only when the estimate already exceeds the
+        # budget — a cloned copy of the last boundary state the handler
+        # can cut a MID-EPOCH snapshot from (the in-flight trees are
+        # donated to the dispatch and unreadable by then)
+        self._sigterm_grace: Optional[float] = None
+        self._segment_t0: Optional[float] = None
+        self._segment_est: Optional[float] = None
+        self._segment_count = 0     # loop-lifetime; first sample discarded
+        self._boundary_ref = None
 
     # -- jitted steps -------------------------------------------------------
     def build_train_step(self):
@@ -685,9 +696,105 @@ class TrainingLoop:
             f"iteration {loop_state.iteration}")
 
     def _on_sigterm(self, signum, frame) -> None:
+        grace = self._sigterm_grace
+        if grace is not None:
+            self._try_grace_cut(grace)      # raises when it cuts
         log.warning("SIGTERM received; requesting a final checkpoint at "
                     "the next step boundary")
         self._preempted.set()
+
+    # -- SIGTERM grace budget (zoo.checkpoint.sigterm_grace_s) --------------
+    def _segment_begin(self, mgr, loop_state, params, opt_state,
+                       net_state) -> None:
+        """A dispatch segment (one step / scan chunk / fused epoch) is
+        about to enter the device. When the running duration estimate
+        already exceeds the grace budget, clone the boundary state NOW —
+        the dispatch donates these trees, so by the time the handler
+        fires mid-segment the originals are deleted device buffers. A
+        segment estimated to finish within the budget skips the clone
+        (the handler just waits for the boundary), so the copy is only
+        paid in the slow-segment regime it exists for."""
+        if self._sigterm_grace is None or mgr is None:
+            return
+        est = self._segment_est
+        if est is not None and est > self._sigterm_grace:
+            self._boundary_ref = (
+                mgr, loop_state.iteration, loop_state.epoch,
+                loop_state.epoch_finished,
+                _clone_tree((params, opt_state, net_state)))
+        else:
+            self._boundary_ref = None
+        self._segment_t0 = time.monotonic()
+
+    def _segment_end(self) -> None:
+        """Fold the completed segment's wall time into the EWMA estimate
+        the handler projects the next boundary from. The loop's FIRST
+        segment ever is discarded: it carries the one-time jit compile
+        (tens of seconds), and folding it in would overestimate the next
+        boundaries — paying boundary clones and cutting mid-epoch
+        snapshots when the real boundary is milliseconds away (the
+        training-side analogue of serving's ``_DOOMED_MIN_OBS``
+        warm-up)."""
+        if self._sigterm_grace is None:
+            return
+        t0 = self._segment_t0
+        self._segment_t0 = None
+        self._boundary_ref = None
+        if t0 is None:
+            return
+        self._segment_count += 1
+        if self._segment_count == 1:
+            return                      # compile-contaminated sample
+        dur = time.monotonic() - t0
+        est = self._segment_est
+        self._segment_est = dur if est is None else 0.5 * est + 0.5 * dur
+
+    def _try_grace_cut(self, grace: float) -> None:
+        """SIGTERM-handler path: when the estimated time to the next
+        step boundary exceeds the grace budget, cut one synchronous
+        snapshot of the LAST boundary's state immediately — mid-epoch —
+        and exit via :class:`TrainingPreempted`, instead of gambling
+        that the in-flight dispatch beats the preemption deadline. No
+        estimate, no captured boundary, or a near boundary → return and
+        let the normal next-boundary path run."""
+        t0, est, ref = self._segment_t0, self._segment_est, \
+            self._boundary_ref
+        if t0 is None or est is None or ref is None:
+            return
+        eta = est - (time.monotonic() - t0)
+        if eta <= grace:
+            return
+        # de-arm BEFORE the (multi-second) synchronous save: a supervisor
+        # that re-sends SIGTERM while it runs re-enters this handler, and
+        # a nested save of the same snapshot interleaved with the paused
+        # outer one would corrupt exactly the checkpoint being cut — the
+        # re-entrant call must fall through to the boundary-latch path
+        self._boundary_ref = None
+        self._segment_t0 = None
+        mgr, iteration, epoch, epoch_finished, trees = ref
+        params, opt_state, net_state = trees
+        log.warning("SIGTERM: estimated %.2fs to the next step boundary "
+                    "exceeds the %.2fs grace budget; cutting a mid-epoch "
+                    "snapshot at iteration %d now", eta, grace, iteration)
+        try:
+            mgr.save(iteration,
+                     {"params": params, "opt_state": opt_state,
+                      "net_state": net_state},
+                     meta={"epoch": epoch, "iteration": iteration,
+                           "epoch_finished": epoch_finished},
+                     sync=True)
+        except Exception:
+            # going down either way; the newest committed snapshot
+            # remains the resume point
+            log.exception("grace-budget preemption checkpoint failed")
+        model = self.model
+        model.params, model.net_state, model.opt_state = _clone_tree(
+            (params, net_state, opt_state))
+        model.finished_iterations = iteration
+        raise TrainingPreempted(
+            f"training preempted by SIGTERM; grace budget {grace:g}s is "
+            f"shorter than the ~{eta:.2f}s to the next step boundary — "
+            f"mid-epoch checkpoint cut at iteration {iteration}")
 
     def _try_resume(self, mgr: CheckpointManager, params, opt_state, net_state):
         """Restore the newest VALID snapshot (``Topology.scala:1220-1246``
@@ -754,12 +861,25 @@ class TrainingLoop:
         self._preempted.clear()
         sig_installed = False
         prev_handler = None
+        self._sigterm_grace = None
+        self._segment_t0 = self._segment_est = None
+        self._boundary_ref = None
         if (bool(ctx.get("zoo.checkpoint.on_sigterm", False))
                 and getattr(self.model, "_checkpoint", None) is not None):
             if threading.current_thread() is threading.main_thread():
                 prev_handler = signal.signal(signal.SIGTERM,
                                              self._on_sigterm)
                 sig_installed = True
+                # grace budget: with the estimated time-to-boundary
+                # above this, the handler cuts a MID-EPOCH snapshot
+                # immediately instead of waiting out a dispatch the
+                # preemption deadline may not cover. Armed ONLY with the
+                # handler installed — the segment tracking clones whole
+                # param trees, a price with no payoff when no handler
+                # can ever fire.
+                grace = float(ctx.get("zoo.checkpoint.sigterm_grace_s", 0)
+                              or 0)
+                self._sigterm_grace = grace if grace > 0 else None
             else:
                 log.warning("zoo.checkpoint.on_sigterm is set but fit() "
                             "is not on the main thread; SIGTERM "
@@ -776,6 +896,9 @@ class TrainingLoop:
                     retry_times=retry_times, window_sec=window_sec,
                     attempts=attempts, window_start=window_start)
         finally:
+            # the boundary clone holds whole param trees — never past fit
+            self._boundary_ref = None
+            self._segment_t0 = None
             if sig_installed:
                 # getsignal/signal return None for a handler not installed
                 # from Python (an embedding runtime's C-level handler) —
@@ -1113,9 +1236,12 @@ class TrainingLoop:
                     epoch_fn, (params, opt_state, net_state, base_rng, it0,
                                shuffle_rng, xs_dev, ys_dev),
                     n_steps * batch_size)
+                self._segment_begin(mgr, loop_state, params, opt_state,
+                                    net_state)
                 params, opt_state, net_state, l = epoch_fn(
                     params, opt_state, net_state, base_rng, it0, shuffle_rng,
                     xs_dev, ys_dev)
+                self._segment_end()
                 losses.append(l)
                 loop_state.iteration += n_steps
                 n_seen += n_steps * batch_size
@@ -1147,9 +1273,12 @@ class TrainingLoop:
                         self._scan_step,
                         (params, opt_state, net_state, base_rng, it0,
                          bx_d, by_d), k * batch_size)
+                    self._segment_begin(mgr, loop_state, params, opt_state,
+                                        net_state)
                     params, opt_state, net_state, l = self._scan_step(
                         params, opt_state, net_state, base_rng, it0,
                         bx_d, by_d)
+                    self._segment_end()
                     loop_state.iteration += k
                     n_seen += k * batch_size
                 else:
@@ -1158,8 +1287,11 @@ class TrainingLoop:
                         self._train_step,
                         (params, opt_state, net_state, step_rng, bx_d, by_d),
                         batch_size)
+                    self._segment_begin(mgr, loop_state, params, opt_state,
+                                        net_state)
                     params, opt_state, net_state, l = self._train_step(
                         params, opt_state, net_state, step_rng, bx_d, by_d)
+                    self._segment_end()
                     loop_state.iteration += 1
                     n_seen += batch_size
                 losses.append(l)

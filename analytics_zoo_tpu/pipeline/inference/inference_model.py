@@ -164,9 +164,13 @@ class InferenceModel:
         self._m_records = self.metrics.counter(
             "zoo_inference_records_total", "records predicted")
         self.mesh = mesh_lib.global_mesh()
-        self._permits: "queue.Queue[int]" = queue.Queue()
+        # replica-permit pool: exactly concurrent_num tokens ever exist,
+        # so the explicit bound documents the invariant and every return
+        # is put_nowait — a put into this pool can never block (ZL011)
+        self._permits: "queue.Queue[int]" = queue.Queue(
+            maxsize=self.concurrent_num)
         for i in range(self.concurrent_num):
-            self._permits.put(i)
+            self._permits.put_nowait(i)
         self._model: Optional[KerasNet] = None
         self._params = None
         self._net_state = None
@@ -437,7 +441,7 @@ class InferenceModel:
                                    chunk_d if len(chunk_d) > 1 else chunk_d[0])
                 deferred.append((yp, m))
         except BaseException:
-            self._permits.put(permit)
+            self._permits.put_nowait(permit)
             raise
 
         done = [False]
@@ -455,7 +459,7 @@ class InferenceModel:
                 return jax.tree.map(
                     lambda *ys: np.concatenate(ys, axis=0), *outs)
             finally:
-                self._permits.put(permit)
+                self._permits.put_nowait(permit)
 
         return collect
 

@@ -46,6 +46,36 @@ The runtime is **self-healing** (``docs/guides/RELIABILITY.md``):
   (isolating a poison record so its batch-mates still serve); records
   that keep crashing are dead-lettered with an addressable error
   (``zoo_serving_dead_letter_total``) instead of retrying forever.
+
+And it **degrades predictably under sustained overload** instead of
+collapsing (RELIABILITY.md "Overload & degradation"):
+
+* **admission control + load shedding** — above a configurable
+  stream-depth watermark (``shed_watermark`` /
+  ``zoo.serving.shed_watermark``) each read admits the oldest
+  ``batch_size`` records and sheds the newest remainder of its admission
+  window with a distinct addressable ``shed: server overloaded`` error
+  (``zoo_serving_shed_total{reason="depth"}``) — bounding the backlog
+  admitted records wait behind, so their latency stays flat while the
+  unshedded alternative grows without bound. Deadline-aware admission
+  additionally refuses records that *cannot* meet their producer-stamped
+  ``deadline_ms`` given the live dispatch-latency estimate
+  (``reason="deadline"``) — answering them early costs one error write
+  instead of a doomed dispatch. Shedding is degradation, not failure:
+  ``/healthz`` stays up and ``/statusz`` carries an ``overload`` block.
+* **adaptive batch sizing** — opt-in (``adaptive_batch`` /
+  ``zoo.serving.adaptive_batch``): a bounded, deterministic AIMD
+  controller grows the per-read batch target toward ``batch_size``
+  while the publish backlog and the current read's queue waits stay
+  under target, and backs off multiplicatively on a breach
+  (``zoo_serving_batch_size_target``).
+* **durable dead letters** — with a DLQ attached (``dlq_dir`` /
+  ``zoo.serving.dlq_dir``), dispatch-poison records and batches the
+  publisher gives up on (after a publisher-side circuit breaker trips)
+  spill their full request payload to the append-only on-disk queue in
+  ``serving/dlq.py`` — crash-safe, CRC-framed, byte-bounded — and
+  ``scripts/zoo-dlq replay`` re-enqueues them after the outage, so a
+  result-store outage delays work instead of destroying it.
 """
 
 from __future__ import annotations
@@ -62,11 +92,13 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common import faults
-from ..common.reliability import CircuitBreaker, RetryBudget, RetryPolicy
+from ..common.reliability import (AIMDController, CircuitBreaker,
+                                  RetryBudget, RetryPolicy)
 from ..observability import default_registry, span
 from .backend import LocalBackend, default_backend
 from .client import (INPUT_STREAM, decode_payload, encode_array,
                      encode_tensor, is_v2, validate_v2)
+from .dlq import DeadLetterQueue
 
 log = logging.getLogger("analytics_zoo_tpu.serving")
 
@@ -84,9 +116,11 @@ _Rec = collections.namedtuple("_Rec", ("uri", "trace", "t_enq", "t_deq",
 
 #: a dispatched batch whose readback is deferred: ``collect`` blocks on
 #: the device transfer, ``arena`` (may be None) returns to the pool after
-#: readback proves the device consumed the input buffer.
+#: readback proves the device consumed the input buffer. ``inputs`` is a
+#: DLQ-only copy of the batch's request tensors (None with no DLQ
+#: attached) so a publish give-up can spill the original payloads.
 _Pending = collections.namedtuple("_Pending", ("recs", "collect", "t0",
-                                               "arena"))
+                                               "arena", "inputs"))
 
 #: one read-time candidate: the record, its raw fields, its queue wait,
 #: and — for a validated v2 record — the (payload, dtype, shape) header.
@@ -101,6 +135,27 @@ _PUB_STOP = object()    # publisher-queue sentinel: drain, then exit
 #: assemble via the decode+stack fallback instead, whose allocation is
 #: proportional to the bytes actually received off the stream.
 _MAX_ARENA_BYTES = 1 << 31
+
+#: per-iteration ceiling on EXTRA entries read just to be shed — sheds
+#: are cheap (no decode, batched error writes) but the loop must still
+#: touch the stream and the scrape at a bounded cadence under a
+#: producer flood; the remaining overage sheds on the next iterations
+_SHED_MAX_PER_READ = 256
+
+#: bound on the serve loop's publisher-queue puts: a publisher wedged on
+#: a stalled result store must surface as addressable failures (and DLQ
+#: spills), not as a serve loop silently parked on an unbounded put
+_PUB_PUT_TIMEOUT_S = 30.0
+
+#: deadline-aware admission only engages once this many batches have been
+#: dispatched: with fewer observations the dispatch-latency median is
+#: dominated by the one-time jit compile (tens of seconds), and refusing
+#: deadline-stamped records on it would latch — refused records add no
+#: observations, so an inflated cold-start estimate could refuse
+#: deadline traffic forever on a server whose steady state is
+#: milliseconds. Past the warm-up the compile outlier cannot move the
+#: median.
+_DOOMED_MIN_OBS = 16
 
 
 class _ArenaPool:
@@ -181,7 +236,14 @@ class ClusterServing:
                  restart_backoff: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  dispatch_retries: int = 1,
-                 retry_budget: Optional[RetryBudget] = None):
+                 retry_budget: Optional[RetryBudget] = None,
+                 shed_watermark: Optional[int] = None,
+                 adaptive_batch: Optional[bool] = None,
+                 queue_wait_target_s: Optional[float] = None,
+                 batch_controller: Optional[AIMDController] = None,
+                 publish_breaker: Optional[CircuitBreaker] = None,
+                 dlq: Optional[DeadLetterQueue] = None,
+                 dlq_dir: Optional[str] = None):
         self.model = model          # InferenceModel (or any .predict(x))
         self.backend = backend if backend is not None else default_backend()
         self.batch_size = int(batch_size)
@@ -302,6 +364,63 @@ class ClusterServing:
             "records dead-lettered after repeated dispatch crashes")
         self._crash_info: Dict[str, str] = {}   # loop -> last traceback
         self._loop_down: set = set()            # loops whose supervisor gave up
+        # -- overload / degradation (RELIABILITY.md "Overload & degradation")
+        #: stream-depth watermark: >0 sheds the newest remainder of each
+        #: admission window once the backlog exceeds it (0 = off)
+        self.shed_watermark = int(self._conf("zoo.serving.shed_watermark", 0)
+                                  if shed_watermark is None
+                                  else shed_watermark)
+        self._m_shed = {
+            reason: m.counter(
+                "zoo_serving_shed_total",
+                "records shed by admission control, by reason: depth = "
+                "backlog above the watermark, deadline = cannot meet its "
+                "producer-stamped deadline",
+                labels={"reason": reason})
+            for reason in ("depth", "deadline")}
+        #: AIMD batch-size control, off by default — `batch_size` is the
+        #: ceiling, the live backlog/queue-wait signals drive the target
+        self.adaptive_batch = bool(
+            self._conf("zoo.serving.adaptive_batch", False)
+            if adaptive_batch is None else adaptive_batch)
+        self.queue_wait_target_s = float(
+            self._conf("zoo.serving.queue_wait_target_ms", 500) / 1000.0
+            if queue_wait_target_s is None else queue_wait_target_s)
+        self._batch_ctl = batch_controller if batch_controller is not None \
+            else AIMDController(floor=1, ceiling=self.batch_size)
+        self._m_batch_target = m.gauge(
+            "zoo_serving_batch_size_target",
+            "adaptive per-read batch target (AIMD; equals batch_size "
+            "when adaptive_batch is off)")
+        self._m_batch_target.set(self._batch_ctl.value if self.adaptive_batch
+                                 else self.batch_size)
+        self._last_read_waits: List[float] = []  # queue waits, newest read
+        #: guards publisher writes: repeated publish failures trip it so
+        #: an outage fast-fails to the DLQ instead of burning the publish
+        #: queue's drain time on a dead result store
+        self._pub_breaker = publish_breaker if publish_breaker is not None \
+            else CircuitBreaker(name="serving.publish", failure_threshold=3,
+                                reset_timeout=5.0, registry=m)
+        #: durable dead letters: records serving gives up on spill here
+        #: (dispatch poison, publish give-up) for operator replay
+        if dlq is not None:
+            self._dlq: Optional[DeadLetterQueue] = dlq
+        else:
+            if dlq_dir is None:
+                dlq_dir = str(self._conf("zoo.serving.dlq_dir", "") or "")
+            self._dlq = DeadLetterQueue(
+                dlq_dir,
+                max_bytes=int(self._conf("zoo.serving.dlq_max_bytes",
+                                         64 << 20)),
+                registry=m) if dlq_dir else None
+
+    @staticmethod
+    def _conf(key: str, default):
+        """A zoo-context conf read, imported lazily — constructing a
+        server must not pull the jax-backed context module in unless a
+        knob actually defaults from it."""
+        from ..common.context import get_zoo_context
+        return get_zoo_context().get(key, default)
 
     def set_tensorboard(self, log_dir: str,
                         app_name: str = "serving") -> "ClusterServing":
@@ -386,6 +505,25 @@ class ClusterServing:
             "backend_breaker": self._breaker.state,
             "loops_down": down,
         }}
+        # degradation is NOT failure: shedding/DLQ activity shows up here
+        # (and in the scrape) while "status" stays up — an overloaded
+        # server that answers what it admits must not get itself
+        # restarted by a liveness probe
+        overload = {
+            "stream_depth": depth,
+            "shed_watermark": self.shed_watermark,
+            "shed_depth_total": self._m_shed["depth"].value,
+            "shed_deadline_total": self._m_shed["deadline"].value,
+            "adaptive_batch": self.adaptive_batch,
+            "batch_size_target": (self._batch_ctl.value
+                                  if self.adaptive_batch
+                                  else self.batch_size),
+            "publish_breaker": self._pub_breaker.state,
+        }
+        if self._dlq is not None:
+            overload["dlq_records"] = self._dlq._m_records.value
+            overload["dlq_bytes"] = self._dlq._m_bytes.value
+        info["serving"]["overload"] = overload
         if self._crash_info:
             info["serving"]["last_crash"] = dict(self._crash_info)
         if down:
@@ -524,6 +662,10 @@ class ClusterServing:
             self.metrics.remove_event_sink(self._events)
             self._events.close()
             self._events = None
+        if self._dlq is not None:
+            # seal (don't discard): a stopped server's active segment
+            # becomes replayable — the handle is reopened on restart
+            self._dlq.close()
 
     # -- the loop -----------------------------------------------------------
     def _loop(self) -> None:
@@ -538,10 +680,34 @@ class ClusterServing:
         try:
             while not self._stop.is_set():
                 faults.inject("serving.loop")
-                entries = self._read_entries()
+                # admission window: `want` records are admitted (oldest
+                # first — FIFO fairness); when the backlog stands above
+                # the shed watermark the read pulls the window's newest
+                # remainder too, purely to shed it — bounding the queue
+                # admitted records wait behind (their latency), while
+                # the shed ones get an immediate addressable error
+                # instead of a doomed wait
+                want = (self._batch_ctl.value if self.adaptive_batch
+                        else self.batch_size)
+                extra = 0
+                if self.shed_watermark > 0 \
+                        and self._breaker.state == CircuitBreaker.CLOSED:
+                    # the pre-read depth probe respects the read breaker:
+                    # while it is open/half-open the backend gets its
+                    # probe read only — an extra stream_len per poll
+                    # would burn a connection timeout against a dead
+                    # host, exactly what the breaker exists to stop
+                    overage = (self._stream_depth() - want
+                               - self.shed_watermark)
+                    if overage > 0:
+                        extra = min(overage, _SHED_MAX_PER_READ)
+                entries = self._read_entries(want + extra)
                 if not entries:
                     self._drain(pendings)
                     continue
+                if len(entries) > want:
+                    self._shed(entries[want:], reason="depth")
+                    entries = entries[:want]
                 # ONE stream_len per read feeds both the gauge and the
                 # drain checks below — we are the only consumer, so the
                 # backlog can only grow between here and those checks
@@ -549,6 +715,8 @@ class ClusterServing:
                 depth = self._stream_depth()
                 self._m_depth.set(depth)
                 recs, batch, arena, ragged = self._assemble(entries)
+                if self.adaptive_batch:
+                    self._update_batch_target(self._last_read_waits)
                 if not recs and not ragged:
                     # every record in this read was undecodable: the same
                     # drain signal applies — an empty stream means no next
@@ -591,8 +759,10 @@ class ClusterServing:
         while pendings:
             self._flush(pendings.popleft())
 
-    def _read_entries(self):
-        """One breaker-guarded stream read. Transport failures
+    def _read_entries(self, count: Optional[int] = None):
+        """One breaker-guarded stream read of up to ``count`` entries
+        (default ``batch_size``; admission control reads more when there
+        is overage to shed, adaptive batching less). Transport failures
         (``ConnectionError``/``OSError`` — a dropped Redis connection)
         are absorbed HERE: they count against the breaker and return an
         empty read instead of killing the loop, so a blip costs one poll
@@ -601,12 +771,14 @@ class ClusterServing:
         stop-aware). Anything non-transport still escapes to the
         supervisor — a bug must restart the loop loudly, not spin
         silently."""
+        if count is None:
+            count = self.batch_size
         if not self._breaker.allow():
             self._stop.wait(min(max(self._breaker.probe_in(), 0.001),
                                 self.block_ms / 1000.0))
             return []
         try:
-            entries = self.backend.xread(self.stream, self.batch_size,
+            entries = self.backend.xread(self.stream, count,
                                          block_ms=self.block_ms)
         except (ConnectionError, OSError) as e:
             self._breaker.record_failure()
@@ -629,12 +801,70 @@ class ClusterServing:
     def _stream_depth(self) -> int:
         """Post-read depth for the gauge/drain checks; a failing backend
         reads as 0, which errs toward flushing (never toward parking a
-        dispatched batch behind a dead backend)."""
+        dispatched batch behind a dead backend). A 0 also disables the
+        shed overage for that iteration — admission control must never
+        shed on a backend blip's missing reading."""
         try:
             return self.backend.stream_len(self.stream)
         except (ConnectionError, OSError) as e:
             log.debug("stream_len failed after a read: %s", e)
             return 0
+
+    # -- overload: shedding + adaptive batch ---------------------------------
+    def _shed(self, entries, reason: str) -> None:
+        """Answer shed records with the distinct addressable ``shed:
+        server overloaded`` error — no decode, no dispatch, one batched
+        error write for the whole set. Runs BEFORE any trace event is
+        emitted, so a shed record leaves no dangling trace (the shed
+        counters + its error answer are its whole story). Sheds are
+        degradation, not loop failure: a result store refusing the
+        error writes logs and moves on."""
+        # counters resolved ONCE per shed set (a flood sheds up to
+        # _SHED_MAX_PER_READ records per iteration — per-record label
+        # lookups are exactly the cost this path must not pay); the
+        # per-record emit stays: the shed event is the ONLY trace these
+        # records leave, and emit() is a no-op without sinks
+        n = len(entries)
+        self._m_shed[reason].inc(n)
+        self._m_failures.inc(n)
+        self.metrics.counter(
+            "zoo_serving_failure_errors_total",
+            "failed records by error kind (model vs result-store)",
+            labels={"error": "shed: server overloaded"}).inc(n)
+        results = {}
+        for _eid, fields in entries:
+            uri = fields.get("uri")
+            self.metrics.emit("serving.shed", reason=reason, uri=uri,
+                              trace=fields.get("trace"))
+            if uri:
+                results[uri] = {"error": "shed: server overloaded"}
+        if not results:
+            return
+        try:
+            set_results = getattr(self.backend, "set_results", None)
+            if set_results is not None:
+                set_results(results)
+            else:
+                for uri, fields in results.items():
+                    self.backend.set_result(uri, fields)
+        except Exception:
+            log.exception("shed-error records for %d record(s) could not "
+                          "be written (backend down?)", len(results))
+
+    def _update_batch_target(self, waits) -> None:
+        """One AIMD step per non-empty read. Breach = the publish
+        backlog above half its bound (the publisher is falling behind)
+        OR this READ's queue-wait p95 above target (records are aging
+        in the stream). The current read's waits — not the cumulative
+        digest — drive the controller: control needs a live signal that
+        recovers when the overload clears, and it keeps the trajectory
+        a pure function of the traffic (deterministic under test)."""
+        backlog = 0 if self._pub_queue is None else self._pub_queue.qsize()
+        breach = backlog > self._pub_maxsize // 2
+        if not breach and waits:
+            w = sorted(waits)
+            breach = w[-(-len(w) * 95 // 100) - 1] > self.queue_wait_target_s
+        self._m_batch_target.set(self._batch_ctl.update(breach))
 
     # -- batch assembly ------------------------------------------------------
     def _assemble(self, entries):
@@ -667,11 +897,13 @@ class ClusterServing:
                 log.error("record with no uri dropped (entry id %s)", eid)
                 self._drop_undecodable(fields)
                 continue
-            if self._expired(fields, now_s):
+            verdict = self._deadline_verdict(fields, now_s)
+            if verdict is not None:
                 # answered BEFORE validation/decode/dispatch spend
                 # anything on a record whose producer has already given
-                # up (the point of a deadline is not wasting the budget)
-                self._drop_expired(fields)
+                # up (expired) or will have by the time a dispatch could
+                # answer it (doomed — deadline-aware admission control)
+                self._drop_expired(fields, doomed=(verdict == "doomed"))
                 continue
             hdr = None
             if is_v2(fields):
@@ -688,6 +920,8 @@ class ClusterServing:
             items.append(_Item(
                 _Rec(uri, fields.get("trace"), t_enq, now_p,
                      hdr is not None), fields, wait, hdr))
+        # the adaptive-batch controller's live signal: THIS read's waits
+        self._last_read_waits = [i.wait for i in items if i.wait is not None]
         recs: List[_Rec] = []
         batch = arena = None
         ragged: List[Tuple[_Rec, np.ndarray]] = []
@@ -754,30 +988,48 @@ class ClusterServing:
             return list(self._pool.map(one, items))
         return [one(i) for i in items]
 
-    @staticmethod
-    def _expired(fields, now_s: float) -> bool:
-        """Whether the record's producer-stamped ``deadline_ms`` (absolute
-        epoch ms, the clock the entry ids already share) has passed.
-        Malformed stamps serve anyway — a producer bug must not turn into
-        dropped traffic."""
+    def _deadline_verdict(self, fields, now_s: float) -> Optional[str]:
+        """``"expired"`` when the record's producer-stamped
+        ``deadline_ms`` (absolute epoch ms, the clock the entry ids
+        already share) has passed; ``"doomed"`` when it has not, but the
+        live dispatch-latency estimate (the quantile digest's median)
+        says no dispatch could answer it in time — the deadline-aware
+        half of admission control: spending a dispatch on a record whose
+        caller is guaranteed to time out only delays the records behind
+        it. Engages only after ``_DOOMED_MIN_OBS`` dispatched batches,
+        so the one-time jit-compile outlier cannot inflate the estimate
+        into refusing steady-state traffic. None serves. Malformed
+        stamps serve anyway — a producer bug must not turn into dropped
+        traffic."""
         dl = fields.get("deadline_ms")
         if dl is None:
-            return False
+            return None
         try:
-            return now_s * 1000.0 > float(str(dl))
+            dl_ms = float(str(dl))
         except (TypeError, ValueError):
             log.warning("unparseable deadline_ms %r; serving the record "
                         "without a deadline", dl)
-            return False
+            return None
+        if now_s * 1000.0 > dl_ms:
+            return "expired"
+        if self._q_dispatch.count >= _DOOMED_MIN_OBS \
+                and (now_s + self._q_dispatch.quantile(0.5)) * 1000.0 > dl_ms:
+            return "doomed"
+        return None
 
-    def _drop_expired(self, fields) -> None:
-        """Answer an expired record with the distinct ``deadline
-        exceeded`` error — counted in its own family AND the
-        error-labeled failure breakdown, so an operator can tell a
-        deadline storm from a broken model in one scrape. Like
+    def _drop_expired(self, fields, doomed: bool = False) -> None:
+        """Answer an expired (or doomed — see ``_deadline_verdict``)
+        record with the distinct ``deadline exceeded`` error — counted
+        in its own family AND the error-labeled failure breakdown, so an
+        operator can tell a deadline storm from a broken model in one
+        scrape; a doomed record additionally counts as a shed
+        (``zoo_serving_shed_total{reason="deadline"}``) — it was
+        admission control, not a late producer. Like
         ``_drop_undecodable``, no phase events were emitted yet, so the
         drop leaves no dangling trace."""
         self._m_deadline.inc()
+        if doomed:
+            self._m_shed["deadline"].inc()
         self._m_failures.inc()
         self.metrics.counter(
             "zoo_serving_failure_errors_total",
@@ -785,7 +1037,8 @@ class ClusterServing:
             labels={"error": "deadline exceeded"}).inc()
         self.metrics.emit("serving.deadline", uri=fields.get("uri"),
                           trace=fields.get("trace"),
-                          deadline_ms=fields.get("deadline_ms"))
+                          deadline_ms=fields.get("deadline_ms"),
+                          shed=doomed)
         try:
             self.backend.set_result(fields["uri"],
                                     {"error": "deadline exceeded"})
@@ -859,6 +1112,11 @@ class ClusterServing:
         only add latency)."""
         t0 = time.perf_counter()
         arena_owned = True
+        # durable dead letters need the ORIGINAL request payloads at
+        # publish time (the arena is recycled after readback): one
+        # batch-sized copy per dispatch, paid only with a DLQ attached
+        inputs = (np.array(batch[:len(recs)]) if self._dlq is not None
+                  and batch is not None else None)
         try:
             faults.inject("serving.dispatch")
             async_fn = getattr(self.model, "predict_async", None)
@@ -876,7 +1134,7 @@ class ClusterServing:
                         collect = async_fn(batch)
                 self._emit_dispatch(recs, t0)
                 arena_owned = False
-                pendings.append(_Pending(recs, collect, t0, arena))
+                pendings.append(_Pending(recs, collect, t0, arena, inputs))
                 return
             self._drain(pendings)
             with span("serving.dispatch", registry=self.metrics,
@@ -884,7 +1142,7 @@ class ClusterServing:
                 preds = self.model.predict(batch)
             self._emit_dispatch(recs, t0)
             arena_owned = False
-            self._flush(_Pending(recs, (lambda: preds), t0, arena))
+            self._flush(_Pending(recs, (lambda: preds), t0, arena, inputs))
         except Exception as e:
             log.exception("inference dispatch failed for %d records; "
                           "retrying one record at a time", len(recs))
@@ -956,8 +1214,7 @@ class ClusterServing:
                                 self.dispatch_retries, e)
                     continue
                 self._emit_dispatch([rec], t1)
-                self._pub_queue.put(([rec], preds, t1))
-                self._m_backlog.set(self._pub_queue.qsize())
+                self._pub_put([rec], preds, t1, row)
                 err = None
                 break
             if err is not None:
@@ -972,6 +1229,17 @@ class ClusterServing:
                 self._m_dead_letter.inc()
                 self.metrics.emit("serving.dead_letter", uri=rec.uri,
                                   trace=rec.trace, error=str(err))
+                # durable: the poison payload spills to the on-disk DLQ
+                # (operators replay it after a fix) BEFORE the producer
+                # is answered — the answer is a receipt, the spill is
+                # the work
+                if self._dlq is not None:
+                    try:
+                        self._dlq.append(rec.uri, row[0], reason="dispatch",
+                                         trace=rec.trace, error=str(err))
+                    except Exception:
+                        log.exception("DLQ spill failed for dead-lettered "
+                                      "record %r", rec.uri)
                 self._record_failure(
                     [rec], parent="dequeue",
                     error="dead-lettered: dispatch crashed repeatedly")
@@ -1049,7 +1317,7 @@ class ClusterServing:
         fully consumed the input buffer. The publisher queue is bounded,
         so a stalled result backend backpressures the loop instead of
         buffering unboundedly."""
-        recs, collect, t0, arena = pending
+        recs, collect, t0, arena, inputs = pending
         try:
             with span("serving.flush", registry=self.metrics,
                       records=len(recs)):
@@ -1067,30 +1335,84 @@ class ClusterServing:
             return
         finally:
             self._arena_pool.release(arena)
-        self._pub_queue.put((recs, preds, t0))
+        self._pub_put(recs, preds, t0, inputs)
+
+    def _pub_put(self, recs, preds, t0: float, inputs) -> None:
+        """Hand one batch to the publisher, bounded: a publisher wedged
+        on a stalled result store must surface as addressable failures
+        (and DLQ spills) after ``_PUB_PUT_TIMEOUT_S``, not park the
+        serve loop forever on an unbounded put. The bounded queue is
+        still the normal backpressure — the timeout only fires once the
+        stall outlasts any healthy drain."""
+        try:
+            self._pub_queue.put((recs, preds, t0, inputs),
+                                timeout=_PUB_PUT_TIMEOUT_S)
+        except queue.Full:
+            log.error("publisher queue still full after %.0fs (result "
+                      "backend stalled?); failing %d record(s) "
+                      "addressably", _PUB_PUT_TIMEOUT_S, len(recs))
+            self._spill_publish(recs, inputs, error="publish queue full")
+            self._record_failure(recs, parent="dispatch",
+                                 error="result publish failed")
+            return
         self._m_backlog.set(self._pub_queue.qsize())
+
+    def _spill_publish(self, recs, inputs, error: str) -> None:
+        """Spill a batch the publisher gave up on to the durable DLQ —
+        the original request payloads, so ``zoo-dlq replay`` can re-serve
+        them after the result store recovers. No-op without a DLQ (or
+        for batches dispatched before one was attached)."""
+        if self._dlq is None or inputs is None:
+            return
+        for i, rec in enumerate(recs):
+            try:
+                self._dlq.append(rec.uri, inputs[i], reason="publish",
+                                 trace=rec.trace, error=error)
+            except Exception:
+                log.exception("DLQ spill failed for %r", rec.uri)
 
     def _publisher_loop(self) -> None:
         """The dedicated publisher thread: drains the bounded queue in
         order, publishing each batch. Exits only on the stop sentinel —
         which ``stop()`` enqueues AFTER the serve loop has flushed every
-        pending batch, so acked work is never dropped."""
+        pending batch, so acked work is never dropped.
+
+        Writes run under the publisher-side circuit breaker: a publish
+        failure dead-letters the batch durably (DLQ spill + the distinct
+        ``result publish failed`` answer) and counts against the
+        breaker; once it trips, queued batches fast-fail straight to the
+        DLQ — during a result-store outage the queue drains at spill
+        speed instead of one write-timeout per batch, and the half-open
+        probe publishes a real batch when the window elapses."""
         q = self._pub_queue
         while True:
             item = q.get()
             if item is _PUB_STOP:
                 return
-            recs, preds, t0 = item
-            try:
-                self._publish(recs, preds, t0)
-            except Exception:
-                # a publish failure must not kill the drain thread —
-                # answer the batch with addressable error records so
-                # producers fail fast instead of timing out
-                log.exception("publish failed for %d records; writing "
-                              "error records", len(recs))
+            recs, preds, t0, inputs = item
+            if not self._pub_breaker.allow():
+                self._spill_publish(recs, inputs,
+                                    error="publish breaker open")
                 self._record_failure(recs, parent="dispatch",
                                      error="result publish failed")
+                self._m_backlog.set(q.qsize())
+                continue
+            try:
+                self._publish(recs, preds, t0)
+            except Exception as e:
+                # a publish failure must not kill the drain thread —
+                # spill durably, then answer the batch with addressable
+                # error records so producers fail fast instead of
+                # timing out
+                self._pub_breaker.record_failure()
+                log.exception("publish failed for %d records; writing "
+                              "error records", len(recs))
+                self._spill_publish(recs, inputs,
+                                    error=f"{type(e).__name__}: {e}")
+                self._record_failure(recs, parent="dispatch",
+                                     error="result publish failed")
+            else:
+                self._pub_breaker.record_success()
             self._m_backlog.set(q.qsize())
 
     def _publish(self, recs, preds, t0: float) -> None:
@@ -1101,6 +1423,10 @@ class ClusterServing:
         the TensorBoard scalars. Each result echoes its request's wire
         version — v2 requests get raw-bytes results, v1 requests get the
         base64 ``.npy`` form old consumers decode."""
+        # publisher-only fault site: unlike backend.set_results (shared
+        # with the shed/error-record writes), a plan here hits exactly
+        # the result publishes — the overload-chaos outage window
+        faults.inject("serving.publish")
         t_enc = time.perf_counter()
         results = {}
         for i, rec in enumerate(recs):

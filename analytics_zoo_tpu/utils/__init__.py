@@ -1,0 +1,12 @@
+"""Host-side utilities. Nothing imported at this package's top level may
+pull in jax — the operator CLIs (`scripts/zoo-ckpt`, `scripts/zoo-dlq`,
+`scripts/cluster-serving-status`) import from here on hosts with no
+device runtime."""
+
+
+def human_bytes(n: float) -> str:
+    """``1536 -> "1.5KiB"`` — the operator CLIs' shared size formatter."""
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0

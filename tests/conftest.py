@@ -23,6 +23,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; the long chaos scenarios opt out of it
+    config.addinivalue_line(
+        "markers", "slow: long-running scenario excluded from tier-1 "
+                   "(run explicitly or with -m slow)")
+
+
 @pytest.fixture(autouse=True)
 def fresh_context():
     """Reset global context/mesh (and the process-wide metrics registry —

@@ -442,6 +442,62 @@ def test_sigterm_cuts_final_checkpoint_and_exits_cleanly(tmp_path):
     init_zoo_context(checkpoint_on_sigterm=False)
 
 
+def test_sigterm_grace_budget_cuts_mid_epoch_immediately(tmp_path):
+    """SIGTERM grace budget (zoo.checkpoint.sigterm_grace_s): with a
+    latency-injected step whose estimated time-to-boundary exceeds the
+    budget, the handler cuts a MID-EPOCH snapshot of the LAST boundary's
+    state from inside the handler and exits — instead of waiting out the
+    in-flight dispatch the preemption deadline cannot cover."""
+    from analytics_zoo_tpu.common.context import get_zoo_context
+
+    init_zoo_context(checkpoint_on_sigterm=True,
+                     checkpoint_sigterm_grace_s=0.05)
+    assert get_zoo_context().get("zoo.checkpoint.sigterm_grace_s") == 0.05
+    x, y = _data(n=64)                    # 2 steps/epoch at batch 32
+    m = _model()
+    m.set_checkpoint(str(tmp_path / "ckpt"))
+    m.fit(x, y, batch_size=32, nb_epoch=1)  # builds the step; ckpt-2
+    loop = m._loop
+    orig = loop._train_step
+    calls = []
+
+    def slow_step(*args):
+        calls.append(1)
+        if len(calls) == 2:
+            # mid-dispatch of the SECOND slow step: fire SIGTERM from a
+            # helper thread; the handler must interrupt this sleep (the
+            # grace cut), not wait the full 30s for the boundary
+            threading.Timer(
+                0.05, lambda: os.kill(os.getpid(), signal.SIGTERM)).start()
+            time.sleep(30.0)
+            pytest.fail("SIGTERM handler did not preempt the slow step")
+        time.sleep(0.5)                  # teach the estimate a slow step
+        return orig(*args)
+
+    loop._train_step = slow_step
+    t0 = time.monotonic()
+    with pytest.raises(TrainingPreempted, match="grace budget"):
+        m.fit(x, y, batch_size=32, nb_epoch=1)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0                 # did NOT wait out the dispatch
+    # the snapshot is the LAST BOUNDARY's state: one slow step past the
+    # epoch-1 checkpoint (iteration 3), not the boundary save at 4 the
+    # wait-for-boundary path would have cut
+    mgr = CheckpointManager(str(tmp_path / "ckpt"),
+                            registry=MetricsRegistry())
+    assert mgr.latest() == 3
+    assert mgr.verify(3)[0] == "ok"
+    assert m.finished_iterations == 3
+    # and a fresh model resumes from it cleanly
+    loop._train_step = orig
+    m2 = _model()
+    m2.set_checkpoint(str(tmp_path / "ckpt"))
+    h = m2.fit(x, y, batch_size=32, nb_epoch=1)
+    assert np.isfinite(h["loss"][0])
+    init_zoo_context(checkpoint_on_sigterm=False,
+                     checkpoint_sigterm_grace_s=0.0)
+
+
 def test_sigterm_flag_off_keeps_default_behavior(tmp_path):
     """Without the opt-in flag fit must NOT touch the process signal
     table."""

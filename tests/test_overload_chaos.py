@@ -1,0 +1,547 @@
+"""Overload chaos: sustained-overload and publish-outage scenarios against
+a live serving stack, reconciled EXACTLY — the graceful-degradation
+contract (RELIABILITY.md "Overload & degradation"):
+
+* **nothing is lost**: every produced record is answered with a value, an
+  addressable shed/deadline error, or sits durably in the on-disk DLQ —
+  answered + shed + dead-lettered == produced, zero lost, zero orphaned
+  traces,
+* **admitted latency stays bounded**: with shedding on and the backlog
+  above the watermark, admitted records' p99 e2e stays flat while the
+  unshedded control run's p99 grows with the backlog (reconciled against
+  the /metrics scrape),
+* **adaptive batch sizing is deterministic**: the AIMD target trajectory
+  is a pure function of the breach sequence,
+* **replay serves every dead letter exactly once** after the outage
+  clears.
+
+All waits are safety nets, not sleeps; the only real sleeps are the slow
+model's injected per-dispatch latency in the p99 scenario.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.common.faults import FaultPlan
+from analytics_zoo_tpu.common.reliability import AIMDController, CircuitBreaker
+from analytics_zoo_tpu.observability import (MetricsRegistry,
+                                             parse_prometheus, read_events)
+from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+from analytics_zoo_tpu.serving import (ClusterServing, DeadLetterQueue,
+                                       InputQueue, LocalBackend, OutputQueue,
+                                       ServingError)
+
+SHED_ERR = "shed: server overloaded"
+PUB_ERR = "result publish failed"
+
+
+def _toy_model():
+    init_zoo_context(faults_enabled=True)
+    m = Sequential()
+    m.add(Dense(4, input_shape=(6,), activation="relu"))
+    m.add(Dense(3, activation="softmax"))
+    m.init_weights()
+    return m
+
+
+def _enqueue(backend, n, prefix="o", deadline_ms=None):
+    inq = InputQueue(backend)
+    rng = np.random.default_rng(17)
+    xs = {f"{prefix}-{i}": rng.normal(size=(6,)).astype(np.float32)
+          for i in range(n)}
+    for uri, x in xs.items():
+        inq.enqueue(uri, x, deadline_ms=deadline_ms)
+    return xs
+
+
+def _query_all(backend, xs, timeout=30.0):
+    """``uri -> ("value", arr) | ("error", text)`` for every produced
+    record — the reconciliation's answered set."""
+    outq = OutputQueue(backend)
+    out = {}
+    for uri in xs:
+        try:
+            out[uri] = ("value", outq.query(uri, timeout=timeout))
+        except ServingError as e:
+            out[uri] = ("error", str(e))
+    return out
+
+
+def _terminal_phases(path):
+    by_trace = {}
+    for e in read_events(path, kind="request"):
+        by_trace.setdefault(e["trace"], []).append(e["phase"])
+    return by_trace
+
+
+# ---------------------------------------------------------------------------
+# admission control + load shedding
+# ---------------------------------------------------------------------------
+
+def test_depth_shedding_reconciles_exactly(tmp_path):
+    """40 pre-enqueued records against watermark 8, batch 4: the first
+    admission window admits its oldest 4 and sheds its newest 28 with the
+    distinct error; 12 serve. Counters, /statusz overload block, and
+    /healthz (still up — shedding is degradation, not failure) reconcile
+    exactly; shed records never enter the pipeline, so no trace dangles."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    xs = _enqueue(backend, 40)
+    serving = ClusterServing(im, backend=backend, registry=reg, batch_size=4,
+                             block_ms=20, shed_watermark=8)
+    serving.set_json_events(str(tmp_path / "events.jsonl"))
+    scrape = serving.serve_metrics(port=0)
+    serving.start()
+    try:
+        answered = _query_all(backend, xs)
+        base = f"http://{scrape.host}:{scrape.port}"
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+        with urllib.request.urlopen(base + "/statusz", timeout=10) as r:
+            status = json.loads(r.read())
+    finally:
+        serving.stop(drain=False)
+    served = {u for u, (k, _v) in answered.items() if k == "value"}
+    shed = {u for u, (k, v) in answered.items()
+            if k == "error" and SHED_ERR in v}
+    assert served | shed == set(xs) and not (served & shed)
+    assert len(shed) == 28 and len(served) == 12
+    # FIFO fairness: the admitted records are the window's oldest
+    assert {f"o-{i}" for i in range(4)} <= served
+    snap = reg.snapshot()
+    assert snap['zoo_serving_shed_total{reason="depth"}']["value"] == 28
+    assert snap['zoo_serving_shed_total{reason="deadline"}']["value"] == 0
+    assert snap['zoo_serving_failure_errors_total{error="%s"}' % SHED_ERR][
+        "value"] == 28
+    assert snap["zoo_serving_records_total"]["value"] == 12
+    # shedding is degradation, not failure: health stays up, the operator
+    # reads the pressure off the /statusz overload block
+    assert health.get("status") != "down"
+    ov = status["serving"]["overload"]
+    assert ov["shed_watermark"] == 8
+    assert ov["shed_depth_total"] == 28 and ov["shed_deadline_total"] == 0
+    # zero dangling traces: shed records emitted no phase events at all,
+    # served ones all terminate in publish
+    by_trace = _terminal_phases(str(tmp_path / "events.jsonl"))
+    assert len(by_trace) == 12
+    assert all(p.count("publish") == 1 for p in by_trace.values())
+
+
+def test_deadline_doomed_records_shed_before_dispatch():
+    """Deadline-aware admission: a record whose headroom is smaller than
+    the live dispatch-latency estimate is answered `deadline exceeded`
+    at read time — before decode/dispatch — and counted as a deadline
+    shed; a record with real headroom serves."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, registry=reg, batch_size=4,
+                             block_ms=20)
+    # seed the dispatch estimate past the cold-start warm-up guard: the
+    # digest's median says a dispatch takes ~10s, so a 2s-headroom
+    # record is doomed, deterministically
+    serving._q_dispatch.observe(10.0, n=16)
+    inq = InputQueue(backend)
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(6,)).astype(np.float32)
+    now_ms = int(time.time() * 1000)
+    inq.enqueue("doomed", x, deadline_ms=now_ms + 2_000)
+    inq.enqueue("fine", x, deadline_ms=now_ms + 60_000_000)
+    serving.start()
+    try:
+        outq = OutputQueue(backend)
+        with pytest.raises(ServingError, match="deadline exceeded"):
+            outq.query("doomed", timeout=30.0)
+        assert outq.query("fine", timeout=30.0) is not None
+    finally:
+        serving.stop(drain=False)
+    snap = reg.snapshot()
+    assert snap['zoo_serving_shed_total{reason="deadline"}']["value"] == 1
+    assert snap["zoo_serving_deadline_exceeded_total"]["value"] == 1
+    assert snap["zoo_serving_records_total"]["value"] == 1
+
+
+def test_deadline_admission_waits_out_cold_start():
+    """The doomed check must NOT engage on a cold digest: the first
+    dispatch's jit compile (a one-time tens-of-seconds outlier) would
+    otherwise latch the estimate and refuse deadline-stamped traffic
+    forever — refused records add no observations to recover from."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, registry=reg, batch_size=4,
+                             block_ms=20)
+    # one compile-shaped outlier, below the warm-up count: not trusted
+    serving._q_dispatch.observe(30.0)
+    inq = InputQueue(backend)
+    x = np.random.default_rng(5).normal(size=(6,)).astype(np.float32)
+    inq.enqueue("cold", x, deadline_ms=int(time.time() * 1000) + 5_000)
+    serving.start()
+    try:
+        assert OutputQueue(backend).query("cold", timeout=30.0) is not None
+    finally:
+        serving.stop(drain=False)
+    assert reg.snapshot()['zoo_serving_shed_total{reason="deadline"}'][
+        "value"] == 0
+
+
+# ---------------------------------------------------------------------------
+# adaptive batch sizing (AIMD)
+# ---------------------------------------------------------------------------
+
+def test_adaptive_batch_backs_off_multiplicatively_to_floor():
+    """With the queue-wait target set below any real wait, every
+    non-empty read breaches: the target halves per read down to the
+    floor (4 → 2 → 1), deterministically, and every record still
+    serves."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    xs = _enqueue(backend, 24, prefix="ab")
+    serving = ClusterServing(im, backend=backend, registry=reg, batch_size=4,
+                             block_ms=20, adaptive_batch=True,
+                             queue_wait_target_s=-1.0)
+    serving.start()
+    try:
+        answered = _query_all(backend, xs)
+    finally:
+        serving.stop(drain=False)
+    assert all(k == "value" for k, _v in answered.values())
+    snap = reg.snapshot()
+    assert snap["zoo_serving_batch_size_target"]["value"] == 1
+    assert snap["zoo_serving_records_total"]["value"] == 24
+
+
+def test_adaptive_batch_grows_additively_to_ceiling():
+    """Healthy signals grow the target one step per read up to the
+    ceiling — the deterministic AIMD trajectory 2,3,...,8."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    xs = _enqueue(backend, 40, prefix="ag")
+    serving = ClusterServing(
+        im, backend=backend, registry=reg, batch_size=8, block_ms=20,
+        adaptive_batch=True, queue_wait_target_s=1e9,
+        batch_controller=AIMDController(floor=1, ceiling=8, initial=2))
+    serving.start()
+    try:
+        answered = _query_all(backend, xs)
+    finally:
+        serving.stop(drain=False)
+    assert all(k == "value" for k, _v in answered.values())
+    snap = reg.snapshot()
+    assert snap["zoo_serving_batch_size_target"]["value"] == 8
+    assert snap["zoo_serving_records_total"]["value"] == 40
+
+
+# ---------------------------------------------------------------------------
+# durable DLQ: publish outage → spill → replay
+# ---------------------------------------------------------------------------
+
+def test_publish_outage_spills_to_dlq_and_replay_serves_exactly_once(
+        tmp_path):
+    """The tentpole reconciliation: 24 records, the first 3 result-store
+    batch writes die (injected) — those 12 records are answered with the
+    distinct publish-failure error AND spill durably to the DLQ; the
+    other 12 serve. answered + dead-lettered == produced, zero lost,
+    zero orphaned traces. After recovery, `replay` re-enqueues every DLQ
+    record exactly once with fresh trace ids and all 12 serve."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    dlq = DeadLetterQueue(str(tmp_path / "dlq"), registry=reg)
+    xs = _enqueue(backend, 24, prefix="po")
+    plan = FaultPlan(seed=6).add("backend.set_results", "disconnect",
+                                 at=(0, 1, 2))
+    serving = ClusterServing(
+        im, backend=backend, registry=reg, batch_size=4, block_ms=20,
+        dlq=dlq,
+        publish_breaker=CircuitBreaker("serving.publish",
+                                       failure_threshold=100,
+                                       reset_timeout=0.05, registry=reg))
+    serving.set_json_events(str(tmp_path / "events1.jsonl"))
+    with faults.activate(plan):
+        serving.start()
+        try:
+            answered = _query_all(backend, xs)
+        finally:
+            serving.stop(drain=False)
+    assert plan.fired == [("backend.set_results", "disconnect", i)
+                          for i in range(3)]
+    served = {u for u, (k, _v) in answered.items() if k == "value"}
+    failed = {u for u, (k, v) in answered.items()
+              if k == "error" and PUB_ERR in v}
+    assert served | failed == set(xs) and len(failed) == 12
+    # every failed record is durably dead-lettered, nothing else is
+    assert dlq.depth == 12
+    spilled = {rec["uri"] for _s, rec in dlq.scan()}
+    assert spilled == failed
+    snap = reg.snapshot()
+    assert snap['zoo_serving_dlq_spilled_total{reason="publish"}'][
+        "value"] == 12
+    assert snap['zoo_serving_failure_errors_total{error="%s"}' % PUB_ERR][
+        "value"] == 12
+    assert snap["zoo_serving_records_total"]["value"] == 12
+    # zero orphaned traces in the outage phase: 12 publish + 12 failed
+    by_trace = _terminal_phases(str(tmp_path / "events1.jsonl"))
+    assert len(by_trace) == 24
+    assert sum(p.count("publish") for p in by_trace.values()) == 12
+    assert sum(p.count("failed") for p in by_trace.values()) == 12
+    phase1_traces = set(by_trace)
+
+    # -- recovery: replay re-enqueues, the server serves each exactly once
+    assert dlq.replay(backend) == 12
+    assert dlq.depth == 0
+    serving.set_json_events(str(tmp_path / "events2.jsonl"))
+    serving.start()
+    try:
+        replay_answers = _query_all(backend, {u: None for u in failed})
+    finally:
+        serving.stop(drain=False)
+    direct = np.asarray(im.predict(np.stack([xs[u] for u in sorted(failed)])))
+    for i, uri in enumerate(sorted(failed)):
+        kind, val = replay_answers[uri]
+        assert kind == "value", (uri, val)
+        np.testing.assert_allclose(val, direct[i], rtol=1e-5, atol=1e-6)
+    # replayed exactly once, under FRESH trace ids
+    assert dlq.replay(backend) == 0
+    by_trace2 = _terminal_phases(str(tmp_path / "events2.jsonl"))
+    assert len(by_trace2) == 12
+    assert not (set(by_trace2) & phase1_traces)
+    assert all(p.count("publish") == 1 for p in by_trace2.values())
+    assert reg.snapshot()["zoo_serving_dlq_replayed_total"]["value"] == 12
+
+
+def test_publish_breaker_trips_and_fast_fails_to_dlq(tmp_path):
+    """A sustained result-store outage: the publisher breaker trips
+    after its threshold and later batches spill to the DLQ WITHOUT
+    touching the dead store — exactly 2 write attempts fire, every
+    record is answered addressably and spilled durably."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    dlq = DeadLetterQueue(str(tmp_path / "dlq"), registry=reg)
+    xs = _enqueue(backend, 24, prefix="br")
+    plan = FaultPlan(seed=9).add("backend.set_results", "disconnect",
+                                 at=tuple(range(100)))
+    serving = ClusterServing(
+        im, backend=backend, registry=reg, batch_size=4, block_ms=20,
+        dlq=dlq,
+        publish_breaker=CircuitBreaker("serving.publish",
+                                       failure_threshold=2,
+                                       reset_timeout=10.0, registry=reg))
+    with faults.activate(plan):
+        serving.start()
+        try:
+            answered = _query_all(backend, xs)
+        finally:
+            serving.stop(drain=False)
+    # the breaker absorbed the outage after exactly 2 real attempts
+    assert len(plan.fired) == 2
+    assert all(k == "error" and PUB_ERR in v
+               for k, v in answered.values())
+    assert dlq.depth == 24
+    snap = reg.snapshot()
+    b = 'zoo_breaker_transitions_total{breaker="serving.publish",state="%s"}'
+    assert snap[b % "open"]["value"] == 1
+    assert snap['zoo_breaker_state{breaker="serving.publish"}']["value"] == 1
+    assert snap['zoo_serving_dlq_spilled_total{reason="publish"}'][
+        "value"] == 24
+
+
+def test_dispatch_poison_dead_letters_into_dlq(tmp_path):
+    """A poison record (crashes every dispatch) keeps its addressable
+    dead-letter answer AND now spills its payload durably — the operator
+    can replay it against a fixed model instead of asking the producer
+    to resend."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    dlq = DeadLetterQueue(str(tmp_path / "dlq"), registry=reg)
+    xs = _enqueue(backend, 2, prefix="px")
+    plan = FaultPlan(seed=2).add("serving.dispatch", "error",
+                                 at=tuple(range(32)))
+    serving = ClusterServing(im, backend=backend, registry=reg, batch_size=4,
+                             block_ms=20, dlq=dlq)
+    with faults.activate(plan):
+        serving.start()
+        try:
+            answered = _query_all(backend, xs)
+        finally:
+            serving.stop(drain=False)
+    assert all(k == "error" and "dead-lettered" in v
+               for k, v in answered.values())
+    assert dlq.depth == 2
+    recs = {rec["uri"]: rec for _s, rec in dlq.scan()}
+    assert set(recs) == set(xs)
+    assert all(r["reason"] == "dispatch" for r in recs.values())
+    # the spilled payload is the original request, bit for bit
+    import base64
+    for uri, rec in recs.items():
+        arr = np.frombuffer(base64.b64decode(rec["data"]),
+                            dtype=rec["dtype"]).reshape(
+            tuple(int(d) for d in rec["shape"].split(",")))
+        np.testing.assert_array_equal(arr, xs[uri])
+    assert reg.snapshot()[
+        'zoo_serving_dlq_spilled_total{reason="dispatch"}']["value"] == 2
+
+
+# ---------------------------------------------------------------------------
+# acceptance: shedding bounds admitted p99 (reconciled against the scrape)
+# ---------------------------------------------------------------------------
+
+class _SlowModel:
+    """A sync model with injected per-dispatch latency — makes queueing
+    delay dominate so the latency comparison is about the BACKLOG, not
+    CPU noise."""
+
+    def __init__(self, im, delay_s):
+        self._im = im
+        self.delay_s = delay_s
+
+    def predict(self, x):
+        time.sleep(self.delay_s)
+        return np.asarray(self._im.predict(x))
+
+
+def _run_and_scrape_p99(n, watermark, delay_s=0.02):
+    """One serving run over ``n`` pre-enqueued records; returns
+    (e2e p99 seconds from the /metrics scrape, answered dict)."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    # warm the compiled program BEFORE any clock starts: the one-time jit
+    # compile would otherwise ride the first batch's e2e and compress the
+    # backlog-growth ratio this test measures
+    im.predict(np.zeros((4, 6), np.float32))
+    backend = LocalBackend()
+    xs = _enqueue(backend, n, prefix=f"p{watermark}")
+    serving = ClusterServing(_SlowModel(im, delay_s), backend=backend,
+                             registry=reg, batch_size=4, block_ms=20,
+                             shed_watermark=watermark)
+    scrape = serving.serve_metrics(port=0)
+    serving.start()
+    try:
+        answered = _query_all(backend, xs)
+        url = f"http://{scrape.host}:{scrape.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            families = parse_prometheus(r.read().decode())
+    finally:
+        serving.stop(drain=False)
+    fam = families["zoo_serving_e2e_quantiles_seconds"]
+    p99 = next(v for name, lab, v in fam["samples"]
+               if lab.get("quantile") == "0.99")
+    return p99, answered
+
+
+def test_shedding_bounds_admitted_p99_vs_unshedded_control():
+    """The acceptance criterion: the unshedded control's p99 e2e grows
+    with the backlog (60 records wait ~2x longer than 30 at the tail);
+    with the watermark on, admitted records' p99 stays bounded — well
+    under the control's — while the overflow is shed."""
+    p99_small, a_small = _run_and_scrape_p99(30, watermark=0)
+    p99_big, a_big = _run_and_scrape_p99(60, watermark=0)
+    p99_shed, a_shed = _run_and_scrape_p99(60, watermark=8)
+    # control: everything served, p99 grows with the backlog
+    assert all(k == "value" for k, _ in a_small.values())
+    assert all(k == "value" for k, _ in a_big.values())
+    assert p99_big > p99_small * 1.4, (p99_small, p99_big)
+    # shed run: the admitted subset's p99 is bounded by the watermark,
+    # not the offered load — decisively below the unshedded control
+    shed = sum(1 for k, v in a_shed.values()
+               if k == "error" and SHED_ERR in v)
+    served = sum(1 for k, _ in a_shed.values() if k == "value")
+    assert shed > 0 and shed + served == 60
+    assert p99_shed * 2 < p99_big, (p99_shed, p99_big)
+
+
+# ---------------------------------------------------------------------------
+# the full storm (slow): overload + outage + recovery + replay
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sustained_overload_with_publish_outage_reconciles(tmp_path):
+    """Everything at once, producers racing the server: shedding holds
+    the backlog at the watermark, a mid-run result-store outage spills
+    batches to the DLQ, and the invariant holds exactly — every produced
+    record is answered (value, shed, or publish-failure error), the
+    publish-failed set equals the DLQ set, and replay after recovery
+    serves all of it."""
+    reg = MetricsRegistry()
+    im = InferenceModel().from_keras(_toy_model())
+    backend = LocalBackend()
+    dlq = DeadLetterQueue(str(tmp_path / "dlq"), registry=reg)
+    # the publisher-only site: the outage window hits exactly the 4th-7th
+    # result publishes, never a shed/error write racing on the backend
+    plan = FaultPlan(seed=13).add("serving.publish", "disconnect",
+                                  at=(3, 4, 5, 6))
+    serving = ClusterServing(
+        im, backend=backend, registry=reg, batch_size=8, block_ms=20,
+        shed_watermark=32, adaptive_batch=True, queue_wait_target_s=5.0,
+        dlq=dlq,
+        publish_breaker=CircuitBreaker("serving.publish",
+                                       failure_threshold=100,
+                                       reset_timeout=0.05, registry=reg))
+    n = 200
+    rng = np.random.default_rng(23)
+    xs = {f"st-{i}": rng.normal(size=(6,)).astype(np.float32)
+          for i in range(n)}
+
+    def produce(items):
+        inq = InputQueue(backend)
+        for uri, x in items:
+            inq.enqueue(uri, x)
+
+    threads = [threading.Thread(target=produce, args=(chunk,))
+               for chunk in np.array_split(
+                   np.array(list(xs.items()), dtype=object), 4)]
+    with faults.activate(plan):
+        serving.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        try:
+            answered = _query_all(backend, xs, timeout=60.0)
+        finally:
+            serving.stop(drain=True, timeout=60.0)
+    served = {u for u, (k, _v) in answered.items() if k == "value"}
+    shed = {u for u, (k, v) in answered.items()
+            if k == "error" and SHED_ERR in v}
+    pub_failed = {u for u, (k, v) in answered.items()
+                  if k == "error" and PUB_ERR in v}
+    # the invariant: answered + shed + dead-lettered == produced,
+    # zero lost — and the publish-failed set IS the DLQ set
+    assert served | shed | pub_failed == set(xs)
+    assert len(served) + len(shed) + len(pub_failed) == n
+    assert {rec["uri"] for _s, rec in dlq.scan()} == pub_failed
+    # how many of the 4 planned outage indices fired depends on how much
+    # the flood was shed (publish count tracks ADMITTED load) — but every
+    # fired one produced a dead-lettered batch, and only at this site
+    assert plan.fired and all(f[0] == "serving.publish"
+                              for f in plan.fired)
+    assert len(pub_failed) > 0
+    snap = reg.snapshot()
+    assert snap["zoo_serving_records_total"]["value"] == len(served)
+    assert snap['zoo_serving_shed_total{reason="depth"}']["value"] == \
+        len(shed)
+    # recovery: every dead letter serves exactly once
+    replayed = dlq.replay(backend)
+    assert replayed == len(pub_failed)
+    serving.start()
+    try:
+        again = _query_all(backend, {u: None for u in pub_failed},
+                           timeout=60.0)
+    finally:
+        serving.stop(drain=False)
+    assert all(k == "value" for k, _v in again.values())
+    assert dlq.replay(backend) == 0

@@ -384,3 +384,45 @@ def test_call_success_deposits_into_budget():
                        sleep=lambda s: None) == "ok"
     # one retry withdrawn (-1), one success deposited (+1): back to 2
     assert budget.tokens == 2.0
+
+
+# ---------------------------------------------------------------------------
+# AIMDController
+# ---------------------------------------------------------------------------
+
+def test_aimd_trajectory_is_deterministic():
+    from analytics_zoo_tpu.common.reliability import AIMDController
+
+    c = AIMDController(floor=1, ceiling=8, initial=4, add=1.0, backoff=0.5)
+    # the target after N updates is a pure function of the breach
+    # sequence: grow, grow, breach, breach, grow
+    assert [c.update(o) for o in (False, False, True, True, False)] == \
+        [5, 6, 3, 1, 2]
+    assert c.value == 2
+
+
+def test_aimd_bounds_clamp_floor_and_ceiling():
+    from analytics_zoo_tpu.common.reliability import AIMDController
+
+    c = AIMDController(floor=2, ceiling=4, initial=4)
+    for _ in range(10):
+        c.update(True)
+    assert c.value == 2                    # never below floor
+    for _ in range(10):
+        c.update(False)
+    assert c.value == 4                    # never above ceiling
+
+
+def test_aimd_rejects_bad_parameters():
+    from analytics_zoo_tpu.common.reliability import AIMDController
+
+    with pytest.raises(ValueError):
+        AIMDController(floor=0)
+    with pytest.raises(ValueError):
+        AIMDController(floor=4, ceiling=2)
+    with pytest.raises(ValueError):
+        AIMDController(backoff=1.0)
+    with pytest.raises(ValueError):
+        AIMDController(add=0)
+    with pytest.raises(ValueError):
+        AIMDController(floor=2, ceiling=8, initial=1)

@@ -12,7 +12,7 @@ from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
 from analytics_zoo_tpu.pipeline.inference import InferenceModel
 from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
                                        LocalBackend, OutputQueue,
-                                       QueueFullError)
+                                       QueueFullError, ServingError)
 from analytics_zoo_tpu.serving.client import decode_array, encode_array
 
 
@@ -592,6 +592,83 @@ def test_status_cli_fleet_rollup_across_replicas(tmp_path):
             capture_output=True, text=True, env=env, timeout=120)
         assert r2.returncode == 2
         assert "SLO breach" in r2.stderr
+    finally:
+        for s in servers:
+            s.stop(drain=False)
+
+
+def test_status_cli_surfaces_degradation(tmp_path):
+    """cluster-serving-status prints each replica's degradation line
+    (shed totals, DLQ depth/bytes, batch target from the /statusz
+    overload block) and a fleet-wide degradation rollup — the on-call
+    answer to "is the fleet shedding and where is the spilled work"."""
+    import os
+    import subprocess
+    import sys
+
+    from analytics_zoo_tpu import observability as obs
+    from analytics_zoo_tpu.serving import DeadLetterQueue
+
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(scripts) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+
+    model = _toy_model()
+    im = InferenceModel().from_keras(model)
+    servers = []
+    endpoints = []
+    try:
+        # replica 0: shedding on with a tiny watermark + a DLQ; replica 1
+        # healthy — the fleet line must sum only what degraded
+        for r, watermark in enumerate((2, 0)):
+            reg = obs.MetricsRegistry()
+            backend = LocalBackend()
+            dlq = DeadLetterQueue(str(tmp_path / f"dlq{r}"),
+                                  registry=reg) if watermark else None
+            serving = ClusterServing(im, backend=backend, batch_size=2,
+                                     registry=reg, shed_watermark=watermark,
+                                     dlq=dlq)
+            scrape = serving.serve_metrics(port=0)
+            inq, outq = InputQueue(backend), OutputQueue(backend)
+            rng = np.random.default_rng(40 + r)
+            n = 12 if watermark else 4
+            for i in range(n):
+                inq.enqueue(f"g{r}-{i}",
+                            rng.normal(size=(6,)).astype(np.float32))
+            serving.start()
+            servers.append(serving)
+            endpoints.append(f"{scrape.host}:{scrape.port}")
+            for i in range(n):
+                try:
+                    outq.query(f"g{r}-{i}", timeout=30.0)
+                except ServingError:
+                    pass            # shed records answer with the error
+        r1 = subprocess.run(
+            [sys.executable, os.path.join(scripts, "cluster-serving-status"),
+             endpoints[0]],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert r1.returncode == 0, r1.stderr[-2000:]
+        deg = next(ln for ln in r1.stdout.splitlines()
+                   if ln.startswith("degradation"))
+        assert "wm 2" in deg and "dlq" in deg and "batch target" in deg
+        snap0 = servers[0].metrics.snapshot()
+        shed0 = snap0['zoo_serving_shed_total{reason="depth"}']["value"]
+        assert shed0 > 0 and f"shed {shed0:.0f} depth" in deg
+        # the fleet view: one rollup degradation line summing the shed
+        r2 = subprocess.run(
+            [sys.executable, os.path.join(scripts, "cluster-serving-status"),
+             *endpoints],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        assert "fleet roll-up across 2 replica(s)" in r2.stdout
+        fleet_deg = [ln for ln in r2.stdout.splitlines()
+                     if ln.startswith("degradation")]
+        # two per-replica lines + one fleet line
+        assert len(fleet_deg) == 3
+        assert f"shed {shed0:.0f} depth" in fleet_deg[-1]
     finally:
         for s in servers:
             s.stop(drain=False)

@@ -1,0 +1,247 @@
+"""Durable dead-letter queue (`serving/dlq.py`) + the `zoo-dlq` operator
+CLI: on-disk format (CRC framing, torn-tail tolerance), segment lifecycle
+(open → sealed → replayed), byte bound with oldest-first eviction, and
+at-most-once replay — the rename-before-re-enqueue commit discipline."""
+
+import base64
+import os
+import subprocess
+import sys
+import zlib
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.observability import MetricsRegistry
+from analytics_zoo_tpu.serving import LocalBackend
+from analytics_zoo_tpu.serving.client import decode_payload
+from analytics_zoo_tpu.serving.dlq import DeadLetterQueue
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+def _cli(args, timeout=120):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(SCRIPTS) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "zoo-dlq")] + args,
+        capture_output=True, text=True, env=env, timeout=timeout)
+
+
+def _spill(q, n, reason="dispatch", prefix="u"):
+    rng = np.random.default_rng(3)
+    tensors = {}
+    for i in range(n):
+        t = rng.normal(size=(4,)).astype(np.float32)
+        tensors[f"{prefix}-{i}"] = t
+        q.append(f"{prefix}-{i}", t, reason=reason, trace=f"{i:016x}",
+                 error="boom")
+    return tensors
+
+
+def test_append_scan_roundtrip_and_gauges(tmp_path):
+    """Appended records come back bit-exact from scan (uri, trace,
+    reason, payload); the depth/bytes gauges track the directory."""
+    reg = MetricsRegistry()
+    q = DeadLetterQueue(str(tmp_path), registry=reg)
+    tensors = _spill(q, 5)
+    got = {rec["uri"]: rec for _seg, rec in q.scan()}
+    assert set(got) == set(tensors)
+    for uri, rec in got.items():
+        assert rec["reason"] == "dispatch" and rec["error"] == "boom"
+        arr = np.frombuffer(base64.b64decode(rec["data"]),
+                            dtype=rec["dtype"]).reshape(
+            tuple(int(d) for d in rec["shape"].split(",")))
+        np.testing.assert_array_equal(arr, tensors[uri])
+    assert q.depth == 5
+    snap = reg.snapshot()
+    assert snap["zoo_serving_dlq_records"]["value"] == 5
+    assert snap["zoo_serving_dlq_bytes"]["value"] == q.total_bytes > 0
+    assert snap['zoo_serving_dlq_spilled_total{reason="dispatch"}'][
+        "value"] == 5
+    q.close()
+    # a fresh handle over the same directory sees the same state
+    q2 = DeadLetterQueue(str(tmp_path), registry=MetricsRegistry())
+    assert q2.depth == 5
+    assert [s["state"] for s in q2.segments()] == ["sealed"]
+
+
+def test_torn_tail_line_is_skipped_and_counted(tmp_path):
+    """A torn final append (the crash shape for an append-only log) fails
+    its CRC frame: the record is skipped + counted, every earlier record
+    still reads."""
+    reg = MetricsRegistry()
+    q = DeadLetterQueue(str(tmp_path), registry=reg)
+    _spill(q, 3)
+    q.close()
+    seg = os.path.join(str(tmp_path), q.segments()[0]["name"])
+    with open(seg, "ab") as f:     # a half-written frame
+        f.write(b"deadbeef {\"uri\": \"torn")
+    q2 = DeadLetterQueue(str(tmp_path), registry=reg)
+    recs = [rec for _s, rec in q2.scan()]
+    assert len(recs) == 3 and all(r["uri"] != "torn" for r in recs)
+    assert reg.snapshot()["zoo_serving_dlq_corrupt_total"]["value"] >= 1
+    # a flipped byte inside a committed frame is caught the same way
+    data = open(seg, "rb").read()
+    flipped = data[:10] + bytes([data[10] ^ 0xFF]) + data[11:]
+    open(seg, "wb").write(flipped)
+    q3 = DeadLetterQueue(str(tmp_path), registry=MetricsRegistry())
+    assert len([r for _s, r in q3.scan()]) == 2
+
+
+def test_rotation_and_bounded_bytes_evict_oldest(tmp_path):
+    """Segments rotate at segment_bytes; exceeding max_bytes evicts the
+    OLDEST sealed segment (newest dead letters survive) and counts every
+    dropped record."""
+    reg = MetricsRegistry()
+    q = DeadLetterQueue(str(tmp_path), registry=reg, max_bytes=4096,
+                        segment_bytes=1024)
+    _spill(q, 40, prefix="e")       # ~200B/record → many rotations
+    q.close()
+    segs = q.segments()
+    assert len(segs) > 1            # rotation happened
+    assert q.total_bytes <= 4096 + 1024     # bound (±1 active segment)
+    evicted = reg.snapshot()["zoo_serving_dlq_evicted_total"]["value"]
+    assert evicted > 0
+    survivors = {rec["uri"] for _s, rec in q.scan()}
+    assert len(survivors) == 40 - evicted
+    # the NEWEST records survive; eviction ate from the oldest end
+    assert "e-39" in survivors and "e-0" not in survivors
+
+
+def test_replay_is_at_most_once_with_fresh_traces(tmp_path):
+    """replay() renames the segment .replayed BEFORE re-enqueueing
+    (at-most-once), stamps fresh trace ids linked via replay_of, and a
+    second replay is a no-op."""
+    q = DeadLetterQueue(str(tmp_path), registry=MetricsRegistry())
+    tensors = _spill(q, 4, reason="publish")
+    q.close()
+    backend = LocalBackend()
+    assert q.replay(backend) == 4
+    assert all(s["state"] == "replayed" for s in q.segments())
+    assert q.depth == 0
+    entries = backend.xread("tensor_stream", 100, block_ms=50)
+    assert len(entries) == 4
+    for _eid, fields in entries:
+        np.testing.assert_array_equal(decode_payload(fields),
+                                      tensors[fields["uri"]])
+        assert len(fields["trace"]) == 16
+        assert fields["replay_of"] != fields["trace"]   # fresh id
+    # second replay: nothing left
+    assert q.replay(backend) == 0
+    assert backend.xread("tensor_stream", 100, block_ms=50) == []
+
+
+def test_replay_skips_foreign_open_segment_unless_told(tmp_path):
+    """A FOREIGN open segment (another process's live writer — the CLI's
+    view of a running server's DLQ) is skipped by default; include_open
+    seals and replays it — the explicit server-is-stopped switch. The
+    owning instance's own active segment replays without it (it holds
+    the writer, sealing is always safe)."""
+    backend = LocalBackend()
+    q = DeadLetterQueue(str(tmp_path / "live"), registry=MetricsRegistry())
+    _spill(q, 2)
+    # NOT closed: the .open segment on disk belongs to q's live writer
+    foreign = DeadLetterQueue(str(tmp_path / "live"),
+                              registry=MetricsRegistry())
+    assert foreign.replay(backend) == 0
+    # the owner itself replays its own active segment directly
+    assert q.replay(backend) == 2
+    # a crashed server's leftover .open segment: include_open seals +
+    # replays it
+    crashed = DeadLetterQueue(str(tmp_path / "crashed"),
+                              registry=MetricsRegistry())
+    _spill(crashed, 3, prefix="c")
+    after = DeadLetterQueue(str(tmp_path / "crashed"),
+                            registry=MetricsRegistry())
+    assert after.replay(backend) == 0
+    assert after.replay(backend, include_open=True) == 3
+
+
+def test_purge_receipts_and_all(tmp_path):
+    q = DeadLetterQueue(str(tmp_path), registry=MetricsRegistry())
+    _spill(q, 3)
+    q.close()
+    q.replay(LocalBackend())
+    _spill(q, 2, prefix="w")
+    q.close()
+    assert q.purge() == 1           # only the .replayed receipt
+    assert q.depth == 2             # unreplayed work untouched
+    assert q.purge(replayed_only=False) == 1
+    assert q.depth == 0
+
+
+def test_purge_all_never_touches_foreign_open_segment(tmp_path):
+    """purge --all from a second handle (the CLI against a RUNNING
+    server) must not unlink the live writer's .open segment — the
+    server's fd would keep appending to a deleted inode, silently
+    sinking every future spill until rotation."""
+    owner = DeadLetterQueue(str(tmp_path), registry=MetricsRegistry())
+    _spill(owner, 2, prefix="live")
+    cli_view = DeadLetterQueue(str(tmp_path), registry=MetricsRegistry())
+    assert cli_view.purge(replayed_only=False) == 0
+    # the owner's segment survived; spills keep landing durably
+    _spill(owner, 1, prefix="live2")
+    owner.close()
+    assert owner.depth == 3
+
+
+def test_uri_filter_retires_whole_segment(tmp_path):
+    """A uri-filtered replay re-enqueues only the selection but still
+    retires the segment — at-most-once is per segment, and the skipped
+    remainder is abandoned (the CLI prints it loudly)."""
+    q = DeadLetterQueue(str(tmp_path), registry=MetricsRegistry())
+    _spill(q, 3, prefix="f")
+    q.close()
+    backend = LocalBackend()
+    assert q.replay(backend, uris=["f-1"]) == 1
+    assert q.depth == 0             # the other two are retired unserved
+    assert q.replay(backend) == 0
+
+
+# ---------------------------------------------------------------------------
+# zoo-dlq CLI (subprocess, like zoo-ckpt)
+# ---------------------------------------------------------------------------
+
+def test_cli_list_inspect_purge(tmp_path):
+    q = DeadLetterQueue(str(tmp_path), registry=MetricsRegistry())
+    _spill(q, 3, prefix="cli")
+    q.close()
+
+    r = _cli(["list", str(tmp_path)])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "sealed" in r.stdout and "replayable: 3 record(s)" in r.stdout
+
+    r = _cli(["inspect", str(tmp_path)])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "cli-0" in r.stdout and "reason=dispatch" in r.stdout
+    assert "error: boom" in r.stdout
+
+    # purge --all without --yes refuses; with --yes it drops the work
+    r = _cli(["purge", str(tmp_path), "--all"])
+    assert r.returncode == 1 and "--yes" in r.stderr
+    r = _cli(["purge", str(tmp_path), "--all", "--yes"])
+    assert r.returncode == 0 and "3 unreplayed record(s) dropped" in r.stdout
+    assert DeadLetterQueue(str(tmp_path),
+                           registry=MetricsRegistry()).depth == 0
+
+
+def test_cli_list_empty_and_bad_dir(tmp_path):
+    r = _cli(["list", str(tmp_path / "empty_makes")])
+    assert r.returncode == 1
+    os.makedirs(tmp_path / "empty")
+    r = _cli(["list", str(tmp_path / "empty")])
+    assert r.returncode == 0 and "no segments" in r.stdout
+
+
+def test_cli_replay_nothing_exits_2(tmp_path):
+    """An empty replay during an incident must be visible to the
+    operator's script — exit 2, not a quiet 0."""
+    os.makedirs(tmp_path / "d")
+    # no backend needed: with no sealed segments replay() touches nothing
+    r = _cli(["replay", str(tmp_path / "d"), "--port", "1"])
+    assert r.returncode == 2
+    assert "nothing replayed" in r.stderr

@@ -580,3 +580,90 @@ def test_chaos_scenario_runs_against_live_backend(redis_port):
     assert plan.fired == [("backend.xadd", "disconnect", 2)]
     assert dropped == ["cx2"]           # exactly the planned victim
     assert all(v is not None and v.shape == (3,) for v in got.values())
+
+
+# ---------------------------------------------------------------------------
+# durable DLQ replay over RESP (RELIABILITY.md "Overload & degradation"):
+# dead-lettered work re-enqueues onto a LIVE Redis-protocol stream via the
+# zoo-dlq CLI and serves end to end under fresh trace ids.
+# ---------------------------------------------------------------------------
+
+def test_dlq_replay_over_resp_serves_end_to_end(redis_port, tmp_path):
+    """Spill records to an on-disk DLQ, replay them through the zoo-dlq
+    CLI against the live Redis-speaking backend (one subprocess, real
+    RESP round trips), then serve them: every record answers with the
+    right prediction, and the replayed stream entries carry FRESH trace
+    ids linked to the originals via replay_of."""
+    import os
+    import subprocess
+    import sys
+
+    import optax
+
+    from analytics_zoo_tpu.observability import MetricsRegistry, read_events
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.serving.client import OutputQueue
+    from analytics_zoo_tpu.serving.dlq import DeadLetterQueue
+    from analytics_zoo_tpu.serving.server import ClusterServing
+
+    init_zoo_context()
+    rng = np.random.default_rng(31)
+    xs = {f"rp-{i}": rng.normal(size=(4,)).astype(np.float32)
+          for i in range(4)}
+    dlq = DeadLetterQueue(str(tmp_path / "dlq"),
+                          registry=MetricsRegistry())
+    original_traces = set()
+    for i, (uri, x) in enumerate(xs.items()):
+        trace = f"{i:016x}"
+        original_traces.add(trace)
+        dlq.append(uri, x, reason="publish", trace=trace, error="outage")
+    dlq.close()
+
+    # replay through the operator CLI — RESP XADDs over the socket
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(scripts) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(scripts, "zoo-dlq"), "replay",
+         str(tmp_path / "dlq"), "--port", str(redis_port)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "replayed 4 record(s)" in r.stdout
+
+    backend = RedisBackend(port=redis_port, maxlen=50)
+    assert backend.stream_len("tensor_stream") == 4
+
+    m = Sequential([Dense(3, activation="softmax", input_shape=(4,))])
+    m.compile(optimizer=optax.adam(1e-3), loss="scce")
+    m.init_weights()
+    serving = ClusterServing(m, backend=backend, batch_size=4)
+    serving.set_json_events(str(tmp_path / "events.jsonl"))
+    serving.start()
+    try:
+        outq = OutputQueue(backend=backend)
+        got = {uri: outq.query(uri, timeout=30.0) for uri in xs}
+    finally:
+        serving.stop()
+    direct = np.asarray(m.predict(np.stack(list(xs.values()))))
+    for i, uri in enumerate(xs):
+        assert got[uri] is not None, f"lost replayed record {uri}"
+        np.testing.assert_allclose(got[uri], direct[i], rtol=1e-5,
+                                   atol=1e-6)
+    # fresh trace ids: the served traces are NOT the dead-lettered ones,
+    # and each replayed record's lifetime terminates in a publish event
+    by_trace = {}
+    for e in read_events(str(tmp_path / "events.jsonl"), kind="request"):
+        by_trace.setdefault(e["trace"], []).append(e["phase"])
+    assert len(by_trace) == 4
+    assert not (set(by_trace) & original_traces)
+    assert all(p.count("publish") == 1 for p in by_trace.values())
+    # at-most-once held over the wire too: a second CLI replay is empty
+    r = subprocess.run(
+        [sys.executable, os.path.join(scripts, "zoo-dlq"), "replay",
+         str(tmp_path / "dlq"), "--port", str(redis_port)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 2

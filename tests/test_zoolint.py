@@ -1543,3 +1543,98 @@ def test_zl010_suppression():
         "def spin_forever")
     fs = lint_source(src, "analytics_zoo_tpu/serving/backend.py")
     assert len(ids(fs, "ZL010")) == 1      # the other spin still flags
+
+
+# ---------------------------------------------------------------------------
+# ZL011 — unbounded queue.Queue / blocking put with no timeout
+# ---------------------------------------------------------------------------
+
+ZL011_BAD = """
+import queue
+work = queue.Queue()
+
+def produce(item):
+    work.put(item)
+"""
+
+ZL011_CLEAN = """
+import queue
+work = queue.Queue(maxsize=8)
+
+def produce(item):
+    work.put(item, timeout=1.0)
+
+def drop_on_full(item):
+    work.put_nowait(item)
+
+def positional_nonblocking(item):
+    work.put(item, False)
+
+def kw_nonblocking(item):
+    work.put(item, block=False)
+
+def positional_timeout(item):
+    work.put(item, True, 0.5)
+"""
+
+
+def test_zl011_triggers_in_hot_path_as_error():
+    fs = lint_source(ZL011_BAD, "analytics_zoo_tpu/serving/server.py")
+    assert len(ids(fs, "ZL011")) == 2      # unbounded ctor + naked put
+    assert len(errors(fs)) == 2
+    fs = lint_source(ZL011_BAD,
+                     "analytics_zoo_tpu/pipeline/inference/im.py")
+    assert errors(fs)
+
+
+def test_zl011_warning_outside_hot_path():
+    fs = lint_source(ZL011_BAD, "analytics_zoo_tpu/utils/x.py")
+    assert len(ids(fs, "ZL011")) == 2 and not errors(fs)
+
+
+def test_zl011_clean_bounded_forms():
+    assert not ids(lint_source(
+        ZL011_CLEAN, "analytics_zoo_tpu/serving/server.py"), "ZL011")
+
+
+def test_zl011_maxsize_zero_and_simplequeue_flag():
+    """maxsize=0 (and any non-positive constant) means unbounded in the
+    stdlib; SimpleQueue cannot be bounded at all."""
+    src = ("import queue\n"
+           "a = queue.Queue(maxsize=0)\n"
+           "b = queue.Queue(0)\n"
+           "c = queue.SimpleQueue()\n")
+    fs = lint_source(src, "analytics_zoo_tpu/serving/x.py")
+    assert len(ids(fs, "ZL011")) == 3
+
+
+def test_zl011_from_import_and_annotated_assign():
+    """`from queue import Queue` resolves like ZL010's time imports, and
+    an annotated assignment (`self._q: "queue.Queue" = Queue(...)`) still
+    registers the receiver for the put check."""
+    src = ("from queue import Queue\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self._q: 'Queue' = Queue(maxsize=4)\n"
+           "    def go(self, item):\n"
+           "        self._q.put(item)\n")
+    fs = lint_source(src, "analytics_zoo_tpu/serving/x.py")
+    assert len(ids(fs, "ZL011")) == 1      # only the naked put (bounded ctor)
+    assert any("put" in f.message for f in fs if f.rule_id == "ZL011")
+
+
+def test_zl011_foreign_put_not_attributed():
+    """.put on something never bound to a stdlib queue (an S3 client, a
+    dict-like) is not this rule's business."""
+    src = ("def upload(s3, key, body):\n"
+           "    s3.put(key, body)\n")
+    assert not ids(lint_source(src,
+                               "analytics_zoo_tpu/serving/x.py"), "ZL011")
+
+
+def test_zl011_suppression():
+    src = ZL011_BAD.replace("work = queue.Queue()",
+                            "work = queue.Queue()  "
+                            "# zoolint: disable=ZL011 hand-off by design")
+    fs = lint_source(src, "analytics_zoo_tpu/serving/server.py")
+    assert len(ids(fs, "ZL011")) == 1      # the put still flags

@@ -1,4 +1,4 @@
-"""zoolint rules ZL001–ZL011 — the JAX/TPU hazards that bite this stack.
+"""zoolint rules ZL001–ZL012 — the JAX/TPU hazards that bite this stack.
 
 Every rule documents its rationale in the class docstring (surfaced by
 ``--list-rules`` and docs/guides/STATIC_ANALYSIS.md). Severities:
@@ -1218,3 +1218,96 @@ class UnboundedQueueUse(Rule):
                       "pass timeout= and handle queue.Full (or "
                       "put_nowait/block=False where dropping is correct)",
                     severity=sev)
+
+
+# ---------------------------------------------------------------------------
+# ZL012 — full-vocab cross-entropy materialization in a training path
+# ---------------------------------------------------------------------------
+
+def _in_training_hot_path(path: str) -> bool:
+    """Whether a file lives in the keras training engine — the paths where
+    a full-logits cross-entropy lands on the LM-head training hot loop
+    (objectives, the step builders, the estimator driver). Absolutized
+    like ``_in_serving_hot_path`` so severity tracks the file's real
+    location."""
+    if os.path.exists(path):
+        path = os.path.abspath(path)
+    p = path.replace("\\", "/")
+    return ("/pipeline/api/keras/" in p or p.startswith("pipeline/api/keras/")
+            or "/pipeline/estimator/" in p
+            or p.startswith("pipeline/estimator/"))
+
+
+@register
+class FullVocabCrossEntropy(Rule):
+    """``log_softmax`` over full logits followed by a label pick
+    (``take_along_axis`` / ``one_hot``) is the sparse-cross-entropy shape
+    that materializes the ``(N, V)`` log-probability tensor — three times
+    over, counting the softmax backward and the pick's scatter. At LM-head
+    vocab widths that is gigabytes of fp32 HBM traffic per step (the 32k
+    long-context bench budgeted 2 GB for it at 4k seq). Training-path
+    sparse CE should stream through ``ops.fused_cross_entropy`` (chunked
+    online logsumexp + label logit, O(chunk·V) memory, custom VJP) — the
+    keras loss resolution does this automatically for big-vocab Dense
+    heads (``zoo.train.fused_ce``). Error severity in the keras training
+    engine (``pipeline/api/keras/``, ``pipeline/estimator/``); warning
+    elsewhere — a small-class head where full logits are harmless, or the
+    equivalence oracle itself, carries a justified suppression."""
+
+    id = "ZL012"
+    severity = ERROR
+
+    def _is_log_softmax(self, ctx: ModuleContext, node: ast.Call) -> bool:
+        d = dotted(node.func)
+        if not d:
+            return False
+        mods, froms = ctx.jax_names
+        if "." in d:
+            prefix, leaf = d.rsplit(".", 1)
+            return leaf == "log_softmax" and prefix.split(".", 1)[0] in mods
+        return froms.get(d) == "log_softmax"
+
+    def _is_label_pick(self, ctx: ModuleContext, node: ast.Call) -> bool:
+        d = dotted(node.func)
+        if not d:
+            return False
+        mods, froms = ctx.jax_names
+        if "." in d:
+            prefix, leaf = d.rsplit(".", 1)
+            if leaf == "take_along_axis" \
+                    and prefix in ctx.aliases["jax.numpy"]:
+                return True
+            return leaf == "one_hot" and prefix.split(".", 1)[0] in mods
+        if ctx.from_imported("jax.numpy").get(d) == "take_along_axis":
+            return True
+        return froms.get(d) == "one_hot"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        sev = ERROR if _in_training_hot_path(ctx.path) else WARNING
+        nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        scopes = [ctx.tree] + list(ctx.functions()) + [
+            n for n in ast.walk(ctx.tree) if isinstance(n, ast.Lambda)]
+        for scope in scopes:
+            body = scope.body if isinstance(scope.body, list) \
+                else [scope.body]
+            # nested functions/lambdas are their own scope — a module-level
+            # walk must not merge two different functions' calls into one
+            # fake cross-entropy
+            calls = [n for st in body if not isinstance(st, nested)
+                     for n in _walk_skipping(st, skip_types=nested)
+                     if isinstance(n, ast.Call)]
+            softmaxes = [n for n in calls if self._is_log_softmax(ctx, n)]
+            if not softmaxes:
+                continue
+            if not any(self._is_label_pick(ctx, n) for n in calls):
+                continue
+            yield self.finding(
+                ctx, softmaxes[0].lineno,
+                "full-vocab log_softmax + label pick materializes the "
+                "(N, V) log-probability tensor"
+                + (" in a training path" if sev == ERROR else "")
+                + " — stream it through ops.fused_cross_entropy "
+                  "(fused_sparse_cross_entropy: chunked logsumexp + label "
+                  "logit, O(chunk*V) memory; the keras loss resolution "
+                  "picks it up via zoo.train.fused_ce)",
+                severity=sev)

@@ -42,12 +42,18 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.distributed.process_id": 0,
     "zoo.matmul.precision": "default",   # default | high | highest
     "zoo.pallas.attention": "auto",      # auto (TPU only) | true | false
+    "zoo.pallas.cross_entropy": "auto",  # fused-CE forward kernel: auto (TPU) | true | false
+    "zoo.pallas.block_sweep": False,     # one-shot on-device block sweep per kernel signature
+    "zoo.pallas.vmem_budget_mb": 0,      # 0 = the per-core default (16 MiB) for block selection
     "zoo.rng.impl": "auto",              # auto (rbg on TPU) | default | rbg
     "zoo.compute.dtype": "float32",      # float32 | bfloat16
     "zoo.train.scan_steps": 1,           # optimizer steps fused per dispatch (lax.scan)
     "zoo.train.device_cache": False,     # HBM-resident dataset, 1 dispatch/epoch
     "zoo.train.fuse_epochs": 1,          # epochs fused per dispatch (device_cache only)
     "zoo.train.zero_sharding": False,    # ZeRO-1: optimizer state sharded over data axis
+    "zoo.train.fused_ce": "auto",        # fused blockwise LM-head CE: auto (V>=1024) | true | false
+    "zoo.train.fused_ce_chunk": 512,     # rows per streamed logits tile (O(chunk*V) memory)
+    "zoo.train.remat": False,            # scan-body remat: false | true/dots | full
     "zoo.metrics.flops": False,          # fit(): cost-analysis pass feeding the MFU gauge
     "zoo.failure.retry_times": 5,        # ≅ bigdl.failure.retryTimes (Topology.scala:1172)
     "zoo.failure.retry_window_sec": 3600,
@@ -334,6 +340,33 @@ def init_zoo_context(
 def get_zoo_context() -> ZooContext:
     """Fetch the context, initialising with defaults if needed."""
     return init_zoo_context()
+
+
+#: accepted spellings for boolean context flags — every tri-state
+#: (auto|true|false) parser shares these so the flags can never drift
+TRUE_FLAG_SPELLINGS = ("1", "true", "yes", "on")
+FALSE_FLAG_SPELLINGS = ("0", "false", "no", "off", "")
+
+
+def tri_state_conf(key: str, default: str = "auto"):
+    """Parse an ``auto|true|false`` context flag to ``"auto"``, ``True``,
+    or ``False`` — the call site decides what ``auto`` resolves to. Falls
+    back to ``default`` when no context is constructible (odd device
+    counts); raises ``ValueError`` on an unrecognized spelling."""
+    try:
+        flag = get_zoo_context().get(key, default)
+    except Exception:  # zoolint: disable=ZL007 context not constructible
+        flag = default
+    if isinstance(flag, str):
+        low = flag.strip().lower()
+        if low == "auto":
+            return "auto"
+        if low in TRUE_FLAG_SPELLINGS:
+            return True
+        if low in FALSE_FLAG_SPELLINGS:
+            return False
+        raise ValueError(f"{key} must be auto|true|false, got {flag!r}")
+    return bool(flag)
 
 
 def reset_zoo_context() -> None:

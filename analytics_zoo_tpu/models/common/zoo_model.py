@@ -73,6 +73,12 @@ class ZooModel(KerasNet):
     def param_sharding(self, params):
         return self.model.param_sharding(params)
 
+    def fused_head(self):
+        """Fused LM-head loss resolution (``keras/fused_loss.py``) sees
+        through the ZooModel facade to the inner graph's logits head."""
+        from ...pipeline.api.keras.fused_loss import find_head
+        return find_head(self.model)
+
     # ---- save / load (ZooModel.scala:38-154) ------------------------------
     def save(self, path: str, over_write: bool = True) -> str:
         """``saveModel(path, overWrite)``: one .npz with config + weights."""

@@ -90,7 +90,10 @@ class MultiBoxLoss:
             # confidence: CE against matched label (bg for negatives)
             labels = jnp.where(pos, gt_i[gt_idx, 0].astype(jnp.int32),
                                self.bg_label)
-            logp = jax.nn.log_softmax(logits_i, axis=-1)
+            # detection class head (~21 classes); the per-prior CE
+            # vector is reused below for hard-negative mining, so the
+            # log-probs must materialize regardless
+            logp = jax.nn.log_softmax(logits_i, axis=-1)  # zoolint: disable=ZL012 small class head; CE reused for mining
             ce = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
 
             # hard negative mining: top (ratio * npos) negatives by CE

@@ -1,0 +1,261 @@
+"""Fused blockwise LM-head cross-entropy — the bandwidth-proportional
+replacement for the full-logits ``sparse_categorical_crossentropy_from_logits``
+training objective (the oracle it is equivalence-tested against in
+``tests/test_fused_ce.py``).
+
+The full-logits objective materializes ``(B·T, V)`` fp32 log-probabilities —
+2 GB at the 4k long-context bench shape, 8 GB at 32k — three times over
+(forward, the softmax backward, the label pick's scatter). This op streams
+the hidden states through the vocab projection in row-chunked tiles instead
+(Liu & Abbeel 2023's blockwise-parallel formulation applied to the LM head):
+
+* **forward** — per chunk, form the ``(chunk, V)`` logits tile once, fold
+  its ``logsumexp`` and the label's logit online, discard the tile. On TPU
+  the tile never even reaches HBM: ``ops/pallas/cross_entropy.py`` computes
+  both scalars in one VMEM-resident pass (``zoo.pallas.cross_entropy=auto``
+  routing, same convention as flash attention).
+* **backward** (custom VJP) — re-form one tile at a time from the saved
+  row ``logsumexp``: ``dlogits = (softmax - onehot) * g``, then
+  ``dW += hᵀ @ dlogits`` and ``dh = dlogits @ Wᵀ`` — both on the MXU in the
+  compute dtype (bf16 operands, f32 accumulation), with the ``dW`` carry
+  accumulated in f32 across chunks.
+
+Memory is O(chunk·V) end to end; FLOPs are identical to the full-logits
+path, so the win is pure HBM bandwidth. Labels < 0 are masked out of the
+loss and every gradient (padded/ignored positions); labels >= V poison
+the row to NaN, exactly as loudly as the full-logits objective's
+fill-mode gather — a dataset off-by-one can never train on silently.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["fused_cross_entropy_rows", "fused_sparse_cross_entropy",
+           "pallas_ce_enabled", "DEFAULT_CHUNK", "AUTO_MIN_VOCAB"]
+
+#: rows per streamed logits tile: 512·V·4 B of transient f32 per tile
+#: (16 MB at V=8192) — small enough to live in cache-adjacent HBM, large
+#: enough that the (chunk, V) matmuls stay MXU-shaped
+DEFAULT_CHUNK = 512
+
+#: ``zoo.train.fused_ce=auto`` engages the fused loss at/above this head
+#: width: below it the full-logits tensor is small, XLA's fused softmax is
+#: fine, and the scan's sequentialization would only add dispatch overhead
+#: (the flash-attention FLASH_AUTO_MIN_SEQ convention, applied to vocab)
+AUTO_MIN_VOCAB = 1024
+
+
+def _conf(key: str, default):
+    from ..common.context import get_zoo_context
+    try:
+        return get_zoo_context().get(key, default)
+    except Exception:  # context not constructible (odd device counts)
+        return default
+
+
+def pallas_ce_enabled() -> bool:
+    """``zoo.pallas.cross_entropy``: auto (TPU only) | true | false — the
+    flash-attention flag convention."""
+    from ..common.context import tri_state_conf
+    flag = tri_state_conf("zoo.pallas.cross_entropy")
+    if flag == "auto":
+        return jax.default_backend() == "tpu"
+    return flag
+
+
+def _pad_rows(a: jax.Array, n_pad: int, value=0):
+    if n_pad == 0:
+        return a
+    cfg = [(0, n_pad)] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, cfg, constant_values=value)
+
+
+def _fwd_scan(h, w, b, labels, chunk: int) -> Tuple[jax.Array, jax.Array]:
+    """XLA path: per-row (logsumexp, label_logit) via a lax.scan over row
+    chunks — the (chunk, V) logits tile is the largest live tensor."""
+    n, hidden = h.shape
+    n_pad = (-n) % chunk
+    hp = _pad_rows(h, n_pad)
+    lp = _pad_rows(labels, n_pad, value=-1)
+    k = hp.shape[0] // chunk
+    wc = w.astype(h.dtype)
+    bc = None if b is None else b.astype(h.dtype)
+
+    def one(_, inp):
+        hc, lc = inp
+        # replicate Dense.call's rounding exactly: f32 MXU accumulation,
+        # round to the compute dtype, bias added in the compute dtype —
+        # under bf16 policy the oracle's logits carry that rounding, and
+        # the silent substitution must not be more precise than the path
+        # it replaces (loss-gate comparability across the flag)
+        logits = jax.lax.dot_general(hc, wc, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32
+                                     ).astype(hc.dtype)
+        if bc is not None:
+            logits = logits + bc
+        logits = logits.astype(jnp.float32)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        lse = (m + jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1,
+                                   keepdims=True)))[:, 0]
+        idx = jnp.clip(lc, 0, logits.shape[-1] - 1)
+        ll = jnp.take_along_axis(logits, idx[:, None], axis=-1)[:, 0]
+        return None, (lse, jnp.where(lc >= 0, ll, 0.0))
+
+    _, (lse, ll) = jax.lax.scan(
+        one, None, (hp.reshape(k, chunk, hidden), lp.reshape(k, chunk)))
+    return lse.reshape(-1)[:n], ll.reshape(-1)[:n]
+
+
+def _fwd(h, w, b, labels, chunk: int, use_pallas: bool,
+         interpret: Optional[bool]):
+    if use_pallas:
+        from .pallas.cross_entropy import fused_ce_forward
+        return fused_ce_forward(h, w.astype(h.dtype), b, labels,
+                                block_n=min(chunk, 256),
+                                interpret=interpret)
+    return _fwd_scan(h, w, b, labels, chunk)
+
+
+def _bwd_scan(h, w, b, labels, lse, g, chunk: int):
+    """Tile-at-a-time backward: re-form each (chunk, V) probability tile
+    from the saved row logsumexp, fold ``dW``/``db`` into an f32 scan carry,
+    emit ``dh`` per chunk. The dW/dh matmuls run in the compute dtype on
+    the MXU with f32 accumulation."""
+    n, hidden = h.shape
+    v = w.shape[1]
+    n_pad = (-n) % chunk
+    hp = _pad_rows(h, n_pad)
+    lp = _pad_rows(labels, n_pad, value=-1)
+    # pad the saved logsumexp with +inf: a padded row's logits are the
+    # bare bias (h = 0), and exp(bias - 0) overflows to inf for bias >
+    # ~88 — inf * the row's zero grad-scale is NaN, and the dW matmul
+    # spreads it everywhere. exp(bias - inf) = 0 keeps pad rows inert.
+    lsep = _pad_rows(lse, n_pad, value=jnp.inf)
+    gp = _pad_rows(g.astype(jnp.float32), n_pad)
+    k = hp.shape[0] // chunk
+    wc = w.astype(h.dtype)
+    bc = None if b is None else b.astype(h.dtype)
+
+    def one(carry, inp):
+        dw, db = carry
+        hc, lc, lsec, gc = inp
+        # tile re-formation carries the SAME compute-dtype rounding as
+        # the forward (see _fwd_scan) so p is re-formed bit-for-bit
+        logits = jax.lax.dot_general(hc, wc, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32
+                                     ).astype(hc.dtype)
+        if bc is not None:
+            logits = logits + bc
+        logits = logits.astype(jnp.float32)
+        p = jnp.exp(logits - lsec[:, None])
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, (chunk, v), 1)
+                  == lc[:, None])
+        scale = jnp.where(lc >= 0, gc, 0.0)       # masked rows: zero grad
+        scale = jnp.where(lc >= v, jnp.nan, scale)  # over-range: NaN out
+        dl = (p - onehot) * scale[:, None]
+        dlc = dl.astype(h.dtype)
+        dh = jax.lax.dot_general(dlc, wc, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32
+                                 ).astype(h.dtype)
+        dw = dw + jax.lax.dot_general(hc, dlc, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        if db is not None:
+            db = db + jnp.sum(dl, axis=0)
+        return (dw, db), dh
+
+    dw0 = jnp.zeros((hidden, v), jnp.float32)
+    db0 = None if b is None else jnp.zeros((v,), jnp.float32)
+    (dw, db), dh = jax.lax.scan(
+        one, (dw0, db0),
+        (hp.reshape(k, chunk, hidden), lp.reshape(k, chunk),
+         lsep.reshape(k, chunk), gp.reshape(k, chunk)))
+    dh = dh.reshape(-1, hidden)[:n]
+    return (dh, dw.astype(w.dtype),
+            None if b is None else db.astype(b.dtype))
+
+
+def _poison_over_range(rows, labels, v):
+    """Labels >= V poison their row to NaN — the full-logits oracle's
+    fill-mode ``take_along_axis`` fails just as loudly, so a dataset
+    off-by-one can never train on silently under either path."""
+    return jnp.where(labels >= v, jnp.float32(jnp.nan), rows)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused_rows(h, w, b, labels, chunk, use_pallas, interpret):
+    lse, ll = _fwd(h, w, b, labels, chunk, use_pallas, interpret)
+    return _poison_over_range(jnp.where(labels >= 0, lse - ll, 0.0),
+                              labels, w.shape[1])
+
+
+def _fused_rows_vjp_fwd(h, w, b, labels, chunk, use_pallas, interpret):
+    lse, ll = _fwd(h, w, b, labels, chunk, use_pallas, interpret)
+    rows = _poison_over_range(jnp.where(labels >= 0, lse - ll, 0.0),
+                              labels, w.shape[1])
+    return rows, (h, w, b, labels, lse)
+
+
+def _fused_rows_vjp_bwd(chunk, use_pallas, interpret, res, g):
+    h, w, b, labels, lse = res
+    dh, dw, db = _bwd_scan(h, w, b, labels, lse, g, chunk)
+    # integer primals take float0 cotangents (jax custom_vjp contract)
+    dlabels = np.zeros(labels.shape, dtype=jax.dtypes.float0)
+    return dh, dw, db, dlabels
+
+
+_fused_rows.defvjp(_fused_rows_vjp_fwd, _fused_rows_vjp_bwd)
+
+
+def _resolve_chunk(n: int, chunk: Optional[int]) -> int:
+    if chunk is None:
+        chunk = int(_conf("zoo.train.fused_ce_chunk", DEFAULT_CHUNK)
+                    or DEFAULT_CHUNK)
+    if chunk <= 0:
+        raise ValueError(f"fused-CE chunk must be positive, got {chunk}")
+    return max(1, min(chunk, max(n, 1)))
+
+
+def fused_cross_entropy_rows(hidden: jax.Array, w: jax.Array,
+                             b: Optional[jax.Array], labels: jax.Array,
+                             chunk: Optional[int] = None,
+                             use_pallas: Optional[bool] = None,
+                             interpret: Optional[bool] = None) -> jax.Array:
+    """Per-row cross-entropy of ``softmax(hidden @ w [+ b])`` against int
+    ``labels`` — f32 ``(N,)``, rows with label < 0 contribute 0 loss and 0
+    gradient; rows with label >= V are NaN (loss and gradient — the
+    full-logits objective fails the same way). Differentiable in
+    ``hidden``/``w``/``b`` via the tile-streamed custom VJP; the ``(N, V)``
+    logits tensor is never materialized."""
+    n = hidden.shape[0]
+    labels = labels.reshape(-1).astype(jnp.int32)
+    if labels.shape[0] != n:
+        raise ValueError(f"fused CE: {n} hidden rows vs "
+                         f"{labels.shape[0]} labels")
+    chunk = _resolve_chunk(n, chunk)
+    if use_pallas is None:
+        use_pallas = pallas_ce_enabled()
+    return _fused_rows(hidden, w, b, labels, chunk, bool(use_pallas),
+                       interpret)
+
+
+def fused_sparse_cross_entropy(y_true, hidden, w, b=None, *,
+                               chunk: Optional[int] = None,
+                               use_pallas: Optional[bool] = None,
+                               interpret: Optional[bool] = None) -> jax.Array:
+    """Scalar mean fused CE — the drop-in for
+    ``sparse_categorical_crossentropy_from_logits(y, hidden @ w + b)``.
+    ``hidden`` may be (..., H); labels broadcast-reshape to the leading
+    dims. The mean runs over valid (label >= 0) rows."""
+    h2 = hidden.reshape(-1, hidden.shape[-1])
+    l2 = jnp.asarray(y_true).reshape(-1).astype(jnp.int32)
+    rows = fused_cross_entropy_rows(h2, w, b, l2, chunk=chunk,
+                                    use_pallas=use_pallas,
+                                    interpret=interpret)
+    count = jnp.maximum(jnp.sum((l2 >= 0).astype(jnp.float32)), 1.0)
+    return jnp.sum(rows) / count

@@ -4,5 +4,6 @@ of an HBM (T,T) score matrix) and fused int8 weight-only dequant-matmul.
 Kernels auto-select interpreter mode off-TPU so the same code paths test on
 the CPU mesh."""
 
+from .cross_entropy import fused_ce_forward  # noqa: F401
 from .flash_attention import flash_attention  # noqa: F401
 from .quantized import int8_matmul  # noqa: F401

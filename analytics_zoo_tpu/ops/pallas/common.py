@@ -5,6 +5,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+LANES = 128     # lane width (TPU min tile last dim)
+SUBLANES = 8    # sublane width (TPU min tile second-to-last dim)
+
+
+def round_up(n: int, mult: int) -> int:
+    """``n`` rounded up to the next multiple of ``mult``."""
+    return ((n + mult - 1) // mult) * mult
+
 
 def pad_to_multiple(x: jax.Array, axis: int, mult: int) -> jax.Array:
     """Zero-pad ``axis`` up to the next multiple of ``mult`` (no-op when

@@ -1,0 +1,163 @@
+"""Fused LM-head cross-entropy forward — the L0 Pallas kernel behind
+``ops/fused_cross_entropy.py`` (routing: ``zoo.pallas.cross_entropy``, same
+auto-on-TPU convention as the flash-attention kernel).
+
+One pass computes, per hidden-state row, the two scalars the blockwise loss
+needs — ``logsumexp(h @ W + b)`` and the label's logit — WITHOUT ever writing
+a logits tile back to HBM: grid ``(row-blocks, vocab-blocks)`` with the vocab
+dimension innermost (TPU pallas runs the grid sequentially, so the online
+logsumexp carry ``m``/``l`` and the label-logit accumulator live in VMEM
+scratch across the vocab steps of one row block, exactly the flash-attention
+carry scheme). The ``(block_n, block_v)`` logits tile exists only in
+registers/VMEM; HBM traffic is the streamed ``W`` tiles plus O(N) outputs,
+which is what makes the LM head bandwidth-proportional instead of
+logits-proportional (Liu & Abbeel 2023's blockwise-parallel argument applied
+to the head instead of attention).
+
+The matmul runs on the MXU in the input dtype (bf16 operands at full rate)
+with float32 accumulation. The backward stays in
+``ops/fused_cross_entropy.py`` as chunked XLA tile re-formation — it needs
+the dW/dx matmuls anyway, which XLA already emits tiled; only the forward's
+extra logits round-trip is worth a hand-written kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import LANES as _LANES
+from .common import SUBLANES as _SUBLANES
+from .common import pad_to_multiple, round_up
+
+__all__ = ["fused_ce_forward"]
+
+
+def _ce_fwd_kernel(h_ref, w_ref, b_ref, lab_ref, lse_ref, ll_ref, m_ref,
+                   l_ref, a_ref, *, block_n: int, block_v: int, v_total: int,
+                   has_bias: bool):
+    """Grid cell (ri, vi). h (block_n, H); w (H, block_v);
+    [b (SUBLANES, block_v)]; labels (block_n, LANES) int32 broadcast;
+    outputs lse/ll (block_n, LANES) f32; scratch m/l/a (block_n, LANES).
+    Row vectors carry the LANES broadcast dim — TPU blocks need tileable
+    trailing dims (the flash-attention l/m layout)."""
+    vi = pl.program_id(1)
+    n_v = pl.num_programs(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        a_ref[:] = jnp.zeros_like(a_ref)
+
+    # operands stay in the input dtype (bf16 = full MXU rate); the product
+    # accumulates f32 via preferred_element_type, then rounds to the
+    # compute dtype with the bias added in it — Dense.call's exact
+    # rounding, which the oracle's logits carry under bf16 policy
+    logits = jax.lax.dot_general(h_ref[...], w_ref[...],
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32
+                                 ).astype(h_ref.dtype)
+    if has_bias:
+        logits = logits + b_ref[0:1, :].astype(h_ref.dtype)
+    logits = logits.astype(jnp.float32)
+    col = vi * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (block_n, block_v), 1)
+    ok = col < v_total              # mask vocab padding out of the lse
+    logits = jnp.where(ok, logits, -jnp.inf)
+
+    m_prev = m_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+    # padded rows (h = 0, all-real columns) stay finite, but a fully-padded
+    # vocab tile is all -inf — guard the exp shift like the flash kernel
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.where(ok, jnp.exp(logits - m_safe), 0.0)
+    corr = jnp.where(jnp.isneginf(m_prev), 0.0, jnp.exp(m_prev - m_safe))
+    l_ref[:, :1] = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[:, :1] = m_new
+    # label logit: at most one column of one tile matches each row's label
+    # (padded rows carry label -1 and never match)
+    hit = (col == lab_ref[:, :1]) & ok
+    a_ref[:, :1] += jnp.sum(jnp.where(hit, logits, 0.0), axis=-1,
+                            keepdims=True)
+
+    @pl.when(vi == n_v - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        m = m_ref[:, :1]
+        lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+        ll_ref[...] = jnp.broadcast_to(a_ref[:, :1], ll_ref.shape)
+
+
+def fused_ce_forward(h: jax.Array, w: jax.Array, b: Optional[jax.Array],
+                     labels: jax.Array, block_n: int = 256,
+                     block_v: int = 512,
+                     interpret: Optional[bool] = None,
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Per-row ``(logsumexp, label_logit)`` of ``h @ w [+ b]`` — f32 ``(N,)``
+    pairs, no ``(N, V)`` tensor in HBM.
+
+    ``h`` (N, H) in the compute dtype, ``w`` (H, V) pre-cast to match,
+    ``b`` (V,) or None, ``labels`` (N,) int32 — rows with label < 0 get a
+    zero label logit (the caller masks their loss). ``interpret`` defaults
+    to auto: compiled on TPU, interpreter elsewhere (tests)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, hidden = h.shape
+    v = w.shape[1]
+    # blocks stay on the hardware tile floors (Mosaic needs sublane/lane
+    # alignment on compiled TPU runs — the interpreter would not care);
+    # the row/vocab padding below absorbs the overshoot
+    block_n = round_up(min(block_n, max(n, 1)), _SUBLANES)
+    block_v = round_up(min(block_v, max(v, 1)), _LANES)
+    hp = pad_to_multiple(pad_to_multiple(h, 0, block_n), 1, _LANES)
+    wp = pad_to_multiple(pad_to_multiple(w, 0, _LANES), 1, block_v)
+    lp = jnp.pad(labels.astype(jnp.int32), (0, hp.shape[0] - n),
+                 constant_values=-1)
+    lab2 = jnp.broadcast_to(lp[:, None], (hp.shape[0], _LANES))
+    has_bias = b is not None
+    operands = [hp, wp]
+    in_specs = [
+        pl.BlockSpec((block_n, hp.shape[1]), lambda ri, vi: (ri, 0)),
+        pl.BlockSpec((wp.shape[0], block_v), lambda ri, vi: (0, vi)),
+    ]
+    if has_bias:
+        bp = pad_to_multiple(b.astype(jnp.float32).reshape(1, -1), 1, block_v)
+        operands.append(jnp.broadcast_to(bp, (_SUBLANES, bp.shape[1])))
+        in_specs.append(pl.BlockSpec((_SUBLANES, block_v),
+                                     lambda ri, vi: (0, vi)))
+    operands.append(lab2)
+    in_specs.append(pl.BlockSpec((block_n, _LANES), lambda ri, vi: (ri, 0)))
+
+    kernel = functools.partial(_ce_fwd_kernel, block_n=block_n,
+                               block_v=block_v, v_total=v, has_bias=has_bias)
+    if not has_bias:
+        # keep the kernel's positional layout: splice a no-op bias ref out
+        def kernel(h_ref, w_ref, lab_ref, lse_ref, ll_ref, m_ref, l_ref,
+                   a_ref):
+            return _ce_fwd_kernel(h_ref, w_ref, None, lab_ref, lse_ref,
+                                  ll_ref, m_ref, l_ref, a_ref,
+                                  block_n=block_n, block_v=block_v,
+                                  v_total=v, has_bias=False)
+    rowspec = pl.BlockSpec((block_n, _LANES), lambda ri, vi: (ri, 0))
+    lse, ll = pl.pallas_call(
+        kernel,
+        grid=(hp.shape[0] // block_n, wp.shape[1] // block_v),
+        in_specs=in_specs,
+        out_specs=[rowspec, rowspec],
+        out_shape=[jax.ShapeDtypeStruct((hp.shape[0], _LANES), jnp.float32),
+                   jax.ShapeDtypeStruct((hp.shape[0], _LANES), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((block_n, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_n, _LANES), jnp.float32),  # running denom
+            pltpu.VMEM((block_n, _LANES), jnp.float32),  # label logit
+        ],
+        interpret=interpret,
+    )(*operands)
+    return lse[:n, 0], ll[:n, 0]

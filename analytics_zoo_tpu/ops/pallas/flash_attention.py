@@ -40,12 +40,225 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .common import LANES as _LANES
+from .common import SUBLANES as _SUBLANES
 from .common import pad_to_multiple
+from .common import round_up as _round_up
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "select_attention_blocks"]
 
-_LANES = 128     # lane width (TPU min tile last dim)
-_SUBLANES = 8    # sublane width (TPU min tile second-to-last dim)
+
+# ---------------------------------------------------------------------------
+# block autotuning: VMEM-budget heuristic + optional one-shot on-device sweep
+# ---------------------------------------------------------------------------
+
+#: preferred default, swept on a v5e (causal, D=64, T=32k, fwd+bwd):
+#: (256, 512) hit 29.3 TF/s vs 21.2 for (256, 256), 23.1 for (512, 512),
+#: 24.4-24.9 for k-blocks of 1024/2048 — the larger k block amortizes the
+#: per-k-step carry fold without outgrowing VMEM
+_PREFERRED_BLOCKS = (256, 512)
+#: per-core VMEM (the pallas guide's ~16 MB/core); overridable per run via
+#: ``zoo.pallas.vmem_budget_mb`` for chips with a different budget
+_VMEM_BYTES_DEFAULT = 16 * 1024 * 1024
+#: fraction of VMEM the selector hands the kernel — the rest stays with the
+#: compiler (spills, the backward's second operand window, semaphores)
+_VMEM_USABLE_FRACTION = 0.5
+
+#: abstract signature -> (block_q, block_k), resolved once per process
+_BLOCK_CACHE: dict = {}
+
+
+def _vmem_budget_bytes() -> int:
+    try:
+        from ...common.context import get_zoo_context
+        mb = float(get_zoo_context().get("zoo.pallas.vmem_budget_mb", 0) or 0)
+        if mb > 0:
+            return int(mb * 1024 * 1024)
+    # no context constructible (odd device counts) — default budget holds
+    except Exception:  # zoolint: disable=ZL007
+        pass
+    return _VMEM_BYTES_DEFAULT
+
+
+def _kernel_vmem_bytes(block_q: int, block_k: int, d: int, itemsize: int,
+                       has_mask: bool = False) -> int:
+    """Estimated per-grid-cell VMEM of the forward kernel (the backward's
+    tiles are the same sizes): double-buffered operand windows + scratch +
+    the f32 score/probability compute tiles. ``d`` widens to the 128-lane
+    tile floor like the hardware does."""
+    d_eff = _round_up(max(d, 1), _LANES)
+    bq = _round_up(block_q, _SUBLANES)
+    bk = _round_up(block_k, _LANES)
+    operands = 2 * (bq * d_eff + 2 * bk * d_eff) * itemsize
+    if has_mask:
+        operands += 2 * _SUBLANES * bk * 4
+    scratch = bq * d_eff * 4 + 2 * bq * _LANES * 4
+    outputs = 2 * (bq * d_eff * itemsize + bq * _LANES * 4)
+    compute = 2 * bq * bk * 4      # s and p tiles, f32
+    return operands + scratch + outputs + compute
+
+
+def select_attention_blocks(t_q: int, t_kv: int, d: int, dtype,
+                            causal: bool = False, has_mask: bool = False,
+                            budget_bytes: Optional[int] = None):
+    """VMEM-budget-aware (block_q, block_k): start from the swept
+    ``(256, 512)`` sweet spot, clamp to the sequence lengths, then shrink
+    the larger block until the kernel's estimated footprint fits the
+    budget. Deterministic — a pure function of the abstract signature, so
+    the jit cache is stable."""
+    budget = budget_bytes if budget_bytes is not None else int(
+        _vmem_budget_bytes() * _VMEM_USABLE_FRACTION)
+    itemsize = jnp.dtype(dtype).itemsize
+    bq, bk = _PREFERRED_BLOCKS
+    bq = max(_SUBLANES, min(bq, _round_up(max(t_q, 1), _SUBLANES)))
+    bk = max(_LANES, min(bk, _round_up(max(t_kv, 1), _LANES)))
+    # every shrink step rounds DOWN to the tile floor — halving an
+    # already-clamped odd block (bq 56 -> 28, or 200 -> 100) would hand
+    # Mosaic an untileable pair on the default path every caller hits
+    while (_kernel_vmem_bytes(bq, bk, d, itemsize, has_mask) > budget
+           and (bq > _SUBLANES or bk > _LANES)):
+        if bk >= 2 * bq and bk > _LANES:
+            bk = max(_LANES, bk // 2 // _LANES * _LANES)
+        elif bq > _SUBLANES:
+            bq = max(_SUBLANES, bq // 2 // _SUBLANES * _SUBLANES)
+        else:
+            bk = max(_LANES, bk // 2 // _LANES * _LANES)
+    return bq, bk
+
+
+def _sweep_candidates(t_q: int, t_kv: int, d: int, itemsize: int,
+                      has_mask: bool, heuristic):
+    budget = int(_vmem_budget_bytes() * _VMEM_USABLE_FRACTION)
+    out = []
+    for bq, bk in (heuristic, (256, 512), (128, 512), (256, 256),
+                   (512, 512), (128, 1024)):
+        # clamp to the sequence lengths WITH the tile rounding the kernel
+        # needs (a raw min() against an unaligned T yields untileable
+        # pairs like (128, 1000) that can only fail to compile)
+        cand = (max(_SUBLANES, min(bq, _round_up(max(t_q, 1), _SUBLANES))),
+                max(_LANES, min(bk, _round_up(max(t_kv, 1), _LANES))))
+        if cand in out:
+            continue
+        if _kernel_vmem_bytes(*cand, d=d, itemsize=itemsize,
+                              has_mask=has_mask) <= budget:
+            out.append(cand)
+    return out or [heuristic]
+
+
+def _time_blocks(b, h, t_q, t_kv, d, dtype, causal, has_mask, block_q,
+                 block_k, repeats: int = 2) -> float:
+    """Best-of-``repeats`` wall seconds for one compiled fwd+bwd of the
+    kernel at the given blocks, on synthetic on-device operands. Masked
+    signatures time the MASKED kernel — the winner is cached per
+    signature (has_mask included), so it must be measured on the kernel
+    that signature will actually run."""
+    import time
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+    q = jax.device_put(jnp.asarray(
+        rng.normal(size=(b, h, t_q, d)).astype(np.float32), dtype))
+    k = jax.device_put(jnp.asarray(
+        rng.normal(size=(b, h, t_kv, d)).astype(np.float32), dtype))
+    v = jax.device_put(jnp.asarray(
+        rng.normal(size=(b, h, t_kv, d)).astype(np.float32), dtype))
+    m = (jax.device_put(jnp.ones((b, t_kv), jnp.float32))
+         if has_mask else None)
+
+    def fwd_bwd(q, k, v):
+        return jax.grad(lambda q: jnp.sum(
+            _flash(q, k, v, m, causal, block_q, block_k, False)
+            .astype(jnp.float32)))(q)
+
+    fn = jax.jit(fwd_bwd)
+    jax.block_until_ready(fn(q, k, v))      # compile + warm
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(q, k, v))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _sweep_blocks(b, h, t_q, t_kv, d, dtype, causal, has_mask, heuristic,
+                  timer=None):
+    """``zoo.pallas.block_sweep``: one-shot on-device sweep over the
+    candidate block pairs, winner cached per abstract signature. ``timer``
+    is injectable for tests; the default times a real compiled fwd+bwd."""
+    timer = timer or (lambda bq, bk: _time_blocks(
+        b, h, t_q, t_kv, d, dtype, causal, has_mask, bq, bk))
+    best, best_t = heuristic, float("inf")
+    for bq, bk in _sweep_candidates(t_q, t_kv, d,
+                                    jnp.dtype(dtype).itemsize, has_mask,
+                                    heuristic):
+        try:
+            t = timer(bq, bk)
+        # a candidate that fails to compile/run just loses the sweep
+        except Exception:  # zoolint: disable=ZL007
+            continue
+        if t < best_t:
+            best, best_t = (bq, bk), t
+    return best
+
+
+def _record_block_choice(sig: str, choice) -> None:
+    try:
+        from ...observability import default_registry
+        default_registry().gauge(
+            "zoo_pallas_block_choice",
+            "selected pallas kernel block sizes per abstract signature "
+            "(1 = active choice)",
+            labels={"kernel": "flash_attention", "sig": sig,
+                    "choice": f"{choice[0]}x{choice[1]}"}).set(1)
+    # metrics must never break the compute path
+    except Exception:  # zoolint: disable=ZL007
+        pass
+
+
+def _auto_blocks(q_shape, t_kv: int, dtype, causal: bool, has_mask: bool,
+                 interpret: bool):
+    """Cached per-signature block choice: the VMEM heuristic, optionally
+    refined by the one-shot on-device sweep (compiled TPU runs only — the
+    interpreter's timings say nothing about the MXU). The heuristic is a
+    pure function of (T, D, dtype, causal, mask), so its cache key drops
+    batch/heads — a ragged final batch or an evaluate at a different B
+    must not re-resolve (or worse, re-SWEEP: compiling and timing six
+    candidates with live training state resident). Only sweep-timed
+    entries key on the full shape, since wall time does scale with B·H."""
+    b, h, t_q, d = q_shape
+    dt = jnp.dtype(dtype)
+    sweep = False
+    try:
+        from ...common.context import get_zoo_context
+        sweep = bool(get_zoo_context().get("zoo.pallas.block_sweep", False))
+    # no context constructible — the sweep stays off, heuristic holds
+    except Exception:  # zoolint: disable=ZL007
+        pass
+    sweep = sweep and not interpret and jax.default_backend() == "tpu"
+    # the live budget is part of the key — re-initializing the context
+    # with zoo.pallas.vmem_budget_mb must take effect at the next call,
+    # not silently keep blocks sized for the old budget
+    budget = int(_vmem_budget_bytes() * _VMEM_USABLE_FRACTION)
+    base = (t_q, t_kv, d, dt.name, causal, has_mask)
+    sig = (budget, "sweep", b, h) + base if sweep else (budget,) + base
+    cached = _BLOCK_CACHE.get(sig)
+    if cached is not None:
+        return cached
+    choice = select_attention_blocks(t_q, t_kv, d, dt, causal=causal,
+                                     has_mask=has_mask,
+                                     budget_bytes=budget)
+    if sweep:
+        choice = _sweep_blocks(b, h, t_q, t_kv, d, dt, causal, has_mask,
+                               choice)
+    _BLOCK_CACHE[sig] = choice
+    # the metric label mirrors the cache key: heuristic entries apply to
+    # EVERY batch/head shape at this (T, D, dtype) signature, so baking
+    # the first caller's b/h into the label would misdescribe the scope
+    _record_block_choice(
+        (f"b{b}h{h}" if sweep else "")
+        + f"tq{t_q}tk{t_kv}d{d}{dt.name}"
+        f"{'c' if causal else ''}{'m' if has_mask else ''}", choice)
+    return choice
 
 
 def _visibility(qi, ki, s_shape, *, t_q, t_kv, offset, causal, mask_blk):
@@ -398,8 +611,8 @@ _flash.defvjp(_vjp_fwd, _vjp_bwd)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     mask: Optional[jax.Array] = None,
-                    causal: bool = False, block_q: int = 256,
-                    block_k: int = 512,
+                    causal: bool = False, block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Blockwise-softmax attention: q/k/v (B, H, T, D) → (B, H, Tq, D).
 
@@ -413,10 +626,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``interpret`` defaults to auto: compiled on TPU, interpreter elsewhere
     (tests).
 
-    Block defaults are swept on a v5e (causal, D=64, T=32k, fwd+bwd):
-    (256, 512) hit 29.3 TF/s vs 21.2 for (256, 256), 23.1 for (512, 512),
-    24.4-24.9 for k-blocks of 1024/2048 — the larger k block amortizes the
-    per-k-step carry fold without outgrowing VMEM."""
+    ``block_q``/``block_k`` default to auto selection
+    (``select_attention_blocks``): the VMEM-budget-aware heuristic around
+    the swept v5e sweet spot (256, 512) — which hit 29.3 TF/s vs 21.2 for
+    (256, 256), 23.1 for (512, 512), 24.4-24.9 for k-blocks of 1024/2048
+    at causal D=64 T=32k fwd+bwd — shrunk when the abstract signature
+    (T, D, dtype, mask) would outgrow VMEM. ``zoo.pallas.block_sweep``
+    refines the heuristic with a one-shot on-device sweep, cached per
+    signature and surfaced as ``zoo_pallas_block_choice`` info metrics.
+    Explicit ints pin the blocks (tests, reproductions)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if mask is not None:
@@ -428,4 +646,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                              f"shape {mask.shape} — reduce broadcast masks "
                              f"at the layer level")
         mask = jax.lax.stop_gradient(mask.astype(jnp.float32))
+    if block_q is None or block_k is None:
+        abq, abk = _auto_blocks(q.shape, k.shape[2], q.dtype, causal,
+                                mask is not None, interpret)
+        block_q = block_q if block_q is not None else abq
+        block_k = block_k if block_k is not None else abk
     return _flash(q, k, v, mask, causal, block_q, block_k, interpret)

@@ -163,12 +163,21 @@ def param_shardings(model, params, mesh: Optional[Mesh] = None):
         to_sharding, spec_tree, params,
         is_leaf=lambda s: s is None or isinstance(s, P))
     if fallbacks:
+        # ONE summary line — count + first offender. Per-leaf spam (a W
+        # and b line per undividable head, re-listed on every run) buried
+        # the signal in multichip logs; anyone chasing the rest can log
+        # analytics_zoo_tpu.mesh at DEBUG.
         import logging
-        logging.getLogger("analytics_zoo_tpu.mesh").warning(
+        logger = logging.getLogger("analytics_zoo_tpu.mesh")
+        first_p, first_s, first_sp = fallbacks[0]
+        logger.warning(
             "%d param leaf/leaves replicated instead of model-sharded "
-            "(dim not divisible by axis size): %s", len(fallbacks),
-            "; ".join(f"{p} shape={s} spec={sp}" for p, s, sp in
-                      fallbacks[:5]) + (" ..." if len(fallbacks) > 5 else ""))
+            "(dim not divisible by axis size); first offender: %s shape=%s "
+            "spec=%s", len(fallbacks), first_p, first_s, first_sp)
+        if len(fallbacks) > 1:
+            logger.debug("all replicated-fallback leaves: %s",
+                         "; ".join(f"{p} shape={s} spec={sp}"
+                                   for p, s, sp in fallbacks))
     return out
 
 

@@ -143,23 +143,12 @@ class MultiHeadSelfAttention(Layer):
         # single-device pallas decision below is still valid
         except Exception:  # zoolint: disable=ZL007
             pass
-        from .....common.context import get_zoo_context
-        try:
-            flag = get_zoo_context().get("zoo.pallas.attention", "auto")
-        except Exception:
-            flag = "auto"
-        if isinstance(flag, str):
-            low = flag.strip().lower()
-            if low == "auto":
-                return (jax.default_backend() == "tpu"
-                        and seq_len >= self.FLASH_AUTO_MIN_SEQ)
-            if low in ("1", "true", "yes", "on"):
-                return True
-            if low in ("0", "false", "no", "off", ""):
-                return False
-            raise ValueError(f"zoo.pallas.attention must be auto|true|false,"
-                             f" got {flag!r}")
-        return bool(flag)
+        from .....common.context import tri_state_conf
+        flag = tri_state_conf("zoo.pallas.attention")
+        if flag == "auto":
+            return (jax.default_backend() == "tpu"
+                    and seq_len >= self.FLASH_AUTO_MIN_SEQ)
+        return flag
 
     def _seq_fallback(self, reason: str, probe: bool = False):
         """A seq mesh exists but this call can't ride it. Default: warn ONCE

@@ -103,7 +103,11 @@ def sparse_categorical_crossentropy_pe(y_true, y_pred):
 def sparse_categorical_crossentropy_from_logits_pe(y_true, y_pred):
     y_pred = jnp.asarray(y_pred, jnp.float32)
     labels = jnp.asarray(y_true, jnp.int32).reshape(y_pred.shape[:-1])
-    logp = jax.nn.log_softmax(y_pred, axis=-1)
+    # the full-logits ORACLE the fused blockwise loss is equivalence-
+    # tested against; big-vocab training heads never reach it — the
+    # loss resolution reroutes them to ops.fused_cross_entropy
+    # (zoo.train.fused_ce, keras/fused_loss.py)
+    logp = jax.nn.log_softmax(y_pred, axis=-1)  # zoolint: disable=ZL012 the fused-CE equivalence oracle
     picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     return _per_example(-picked)
 

@@ -319,18 +319,105 @@ class TrainingLoop:
         self._segment_est: Optional[float] = None
         self._segment_count = 0     # loop-lifetime; first sample discarded
         self._boundary_ref = None
+        self._apply_loss = None     # resolved once per loop (fused CE)
 
     # -- jitted steps -------------------------------------------------------
+    #: the labels of the most recent fused-CE gauge write in this process —
+    #: a later non-fused (or differently-headed) loop zeroes the stale
+    #: series so the scrape never claims fusion is active when it is not
+    _last_fused_labels = None
+    _FUSED_GAUGE_HELP = ("1 while the fused blockwise LM-head cross-entropy "
+                         "is active for the current training loop")
+
+    def _loss_application(self):
+        """``fn(params, net_state, x, y, rng) -> (loss, new_state)`` — the
+        forward+loss shared by every training-step builder. Resolves the
+        fused LM-head cross-entropy (``fused_loss.resolve_fused_loss``,
+        ``zoo.train.fused_ce``) ONCE per loop — the scan/epoch builders
+        call this at trace time, and re-resolving would re-log and
+        re-write the gauge on every retrace: a big-vocab Dense head with
+        a sparse-CE loss streams through ``ops/fused_cross_entropy`` so the
+        ``(B·T, V)`` logits tensor never materializes; everything else runs
+        the plain apply + objective (the oracle path, which ``evaluate``
+        always uses)."""
+        if self._apply_loss is not None:
+            return self._apply_loss
+        model, loss_fn = self.model, self.loss
+        from .fused_loss import resolve_fused_loss
+        spec = resolve_fused_loss(model, loss_fn)
+        prev = TrainingLoop._last_fused_labels
+        if spec is None:
+            if prev is not None:
+                self._registry.gauge("zoo_train_fused_ce",
+                                     self._FUSED_GAUGE_HELP,
+                                     labels=prev).set(0)
+                TrainingLoop._last_fused_labels = None
+
+            def apply_loss(p, net_state, x, y, rng):
+                yp, ns = model.apply(p, net_state, x, training=True, rng=rng)
+                return loss_fn(y, yp), ns
+            self._apply_loss = apply_loss
+            return apply_loss
+        log.info("fused LM-head cross-entropy engaged: head=%s vocab=%d "
+                 "(zoo.train.fused_ce; the (N, V) logits tensor is never "
+                 "materialized)", spec.head.name, spec.head.output_dim)
+        labels = {"head": spec.head.name,
+                  "vocab": str(spec.head.output_dim)}
+        if prev is not None and prev != labels:
+            self._registry.gauge("zoo_train_fused_ce",
+                                 self._FUSED_GAUGE_HELP, labels=prev).set(0)
+        self._registry.gauge("zoo_train_fused_ce", self._FUSED_GAUGE_HELP,
+                             labels=labels).set(1)
+        TrainingLoop._last_fused_labels = labels
+
+        def apply_loss(p, net_state, x, y, rng):
+            return spec.apply_and_loss(model, p, net_state, x, y, rng=rng)
+        self._apply_loss = apply_loss
+        return apply_loss
+
+    def _remat_wrapper(self):
+        """``zoo.train.remat`` (opt-in): wrap the per-step forward+loss in
+        ``jax.checkpoint`` so the backward recomputes activations instead of
+        saving them across the scan — 32k training can raise batch/K
+        instead of sitting at batch 1. ``true``/``dots`` keeps MXU outputs
+        (``dots_with_no_batch_dims_saveable`` — recompute the cheap
+        elementwise chains, keep the matmuls); ``full`` saves nothing
+        (maximum memory relief, a full extra forward of recompute). See
+        TRAINING.md "Long-context tuning" for the trade-off table."""
+        from ....common.context import (FALSE_FLAG_SPELLINGS,
+                                        TRUE_FLAG_SPELLINGS)
+        mode = get_zoo_context().get("zoo.train.remat", False)
+        if isinstance(mode, str):
+            low = mode.strip().lower()
+            if low in FALSE_FLAG_SPELLINGS or low == "none":
+                return lambda f: f
+            if low in TRUE_FLAG_SPELLINGS or low in (
+                    "dots", "dots_with_no_batch_dims_saveable"):
+                policy = jax.checkpoint_policies.\
+                    dots_with_no_batch_dims_saveable
+            elif low in ("full", "all", "nothing_saveable"):
+                policy = jax.checkpoint_policies.nothing_saveable
+            else:
+                raise ValueError(f"zoo.train.remat must be "
+                                 f"false|true|dots|full, got {mode!r}")
+        elif mode:
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        else:
+            return lambda f: f
+        return lambda f: jax.checkpoint(f, policy=policy)
+
     def build_train_step(self):
-        model, opt, loss_fn = self.model, self.optimizer, self.loss
+        opt = self.optimizer
+        apply_loss = self._loss_application()
+        remat = self._remat_wrapper()
 
         def step(params, opt_state, net_state, rng, x, y):
             def lfn(p):
-                yp, ns = model.apply(p, net_state, x, training=True, rng=rng)
-                l = loss_fn(y, yp)
+                l, ns = apply_loss(p, net_state, x, y, rng)
                 aux = _aux_loss_sum(ns)
                 return (l if aux is None else l + aux), ns
-            (l, ns), grads = jax.value_and_grad(lfn, has_aux=True)(params)
+            (l, ns), grads = jax.value_and_grad(remat(lfn),
+                                                has_aux=True)(params)
             updates, opt_state = opt.update(grads, opt_state, params)
             opt_state = self._pin_opt_state(opt_state)
             params = optax.apply_updates(params, updates)
@@ -349,7 +436,9 @@ class TrainingLoop:
         optimizer update) used by both the K-step chunk dispatch and the
         whole-epoch dispatch, so the two fused paths can never diverge
         numerically from each other or from the single-step path."""
-        model, opt, loss_fn = self.model, self.optimizer, self.loss
+        opt = self.optimizer
+        apply_loss = self._loss_application()
+        remat = self._remat_wrapper()
 
         def body(carry, batch):
             params, opt_state, net_state, i = carry
@@ -357,12 +446,12 @@ class TrainingLoop:
             rng = jax.random.fold_in(base_rng, i)
 
             def lfn(p):
-                yp, ns = model.apply(p, net_state, x, training=True, rng=rng)
-                l = loss_fn(y, yp)
+                l, ns = apply_loss(p, net_state, x, y, rng)
                 aux = _aux_loss_sum(ns)
                 return (l if aux is None else l + aux), ns
 
-            (l, ns), grads = jax.value_and_grad(lfn, has_aux=True)(params)
+            (l, ns), grads = jax.value_and_grad(remat(lfn),
+                                                has_aux=True)(params)
             updates, opt_state = opt.update(grads, opt_state, params)
             opt_state = self._pin_opt_state(opt_state)
             params = optax.apply_updates(params, updates)

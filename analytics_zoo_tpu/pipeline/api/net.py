@@ -468,7 +468,9 @@ class TorchCriterion:
     def _ce_from_logits(yt, yp):
         import jax
         import jax.numpy as jnp
-        logp = jax.nn.log_softmax(yp, axis=-1)
+        # imported-net classifier heads: class-count logits, not LM
+        # vocab — full log-probs are KBs here, fusion buys nothing
+        logp = jax.nn.log_softmax(yp, axis=-1)  # zoolint: disable=ZL012 small-class imported-net head
         return -jnp.take_along_axis(
             logp, yt.astype(jnp.int32).reshape(-1, 1), axis=-1)[:, 0]
 

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..pipeline.api.keras.engine import Layer
+from ..pipeline.api.keras.engine import Layer, dispatch_layer
 from ..pipeline.api.keras.layers import BERT, Dense, Dropout
 from ..models.common.zoo_model import ZooModel, register_model
 
@@ -59,7 +59,16 @@ class _BertClassifierNet(Layer):
         _, pooled = self.bert.call(params["bert"], x, training=training,
                                    rng=r1)
         pooled = self.drop.call({}, pooled, training=training, rng=r2)
-        return self.cls.call(params["cls"], pooled)
+        # the head goes through dispatch_layer so loss resolution can fuse
+        # it (keras/fused_loss.py) and the inference runtime can calibrate/
+        # quantize it like any container-dispatched Dense
+        y, _ = dispatch_layer(self.cls, params["cls"], {}, pooled,
+                              training=training, rng=None)
+        return y
+
+    def fused_head(self):
+        """Fused LM-head loss resolution (``keras/fused_loss.py``)."""
+        return self.cls, ("cls",)
 
 
 @register_model

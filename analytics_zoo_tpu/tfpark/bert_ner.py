@@ -32,7 +32,9 @@ def masked_token_scce(y_true, y_pred):
     ``_bert_ner_model_fn``)."""
     labels = jnp.asarray(y_true, jnp.int32)
     mask = (labels >= 0).astype(jnp.float32)
-    logp = jax.nn.log_softmax(jnp.asarray(y_pred, jnp.float32), axis=-1)
+    # NER tag-set head (~10 labels): the (N, V) tensor is tiny and the
+    # masked pick needs the per-token log-probs anyway
+    logp = jax.nn.log_softmax(jnp.asarray(y_pred, jnp.float32), axis=-1)  # zoolint: disable=ZL012 small tag-set head
     picked = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None],
                                  axis=-1)[..., 0]
     return jnp.sum(-picked * mask) / jnp.maximum(jnp.sum(mask), 1e-12)
@@ -45,7 +47,8 @@ def squad_span_loss(y_true, y_pred):
     logits = jnp.asarray(y_pred, jnp.float32)
 
     def ce(lg, pos):
-        logp = jax.nn.log_softmax(lg, axis=-1)
+        # span logits over T positions (seq-len wide, not vocab-wide)
+        logp = jax.nn.log_softmax(lg, axis=-1)  # zoolint: disable=ZL012 seq-len span head, not a vocab head
         return -jnp.take_along_axis(logp, pos[:, None], axis=-1)[:, 0]
 
     return jnp.mean(0.5 * (ce(logits[..., 0], spans[:, 0])

@@ -236,6 +236,78 @@ def bench_bert_finetune():
     return best, m_mfu, extras
 
 
+def _device_peak_hbm_bytes():
+    """Process-lifetime peak HBM watermark of device 0 (``memory_stats()``
+    where the backend publishes it; None elsewhere). A cumulative
+    watermark — per-tag readings are upper bounds that include earlier
+    phases — but it makes the logits-memory win of the fused LM-head CE
+    visible round over round in the BENCH extras."""
+    import jax
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+    except Exception:
+        return None
+    v = stats.get("peak_bytes_in_use")
+    return int(v) if v is not None else None
+
+
+def bench_fused_ce():
+    """Fused blockwise LM-head cross-entropy vs the full-logits objective
+    at the 32k long-context head shape (T=32k rows, V=8192, H=512, bf16
+    hidden states): one fwd+bwd each through ``jax.grad``, tokens/s
+    best-of-3. The full path materializes the (T, V) fp32 log-probabilities
+    (1 GB at this shape — the tensor ``ops/fused_cross_entropy.py``
+    eliminates); the fused path streams O(chunk·V) tiles, so the ratio is
+    the LM-head bandwidth win ``bench_long_context`` realizes end to end."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.ops.fused_cross_entropy import (
+        DEFAULT_CHUNK, fused_sparse_cross_entropy)
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+
+    t, v, h_dim = 32768, 8192, 512
+    rng = np.random.default_rng(11)
+    h = jax.device_put(jnp.asarray(
+        rng.normal(size=(t, h_dim)).astype(np.float32), jnp.bfloat16))
+    w = jax.device_put(jnp.asarray(
+        rng.normal(size=(h_dim, v)).astype(np.float32) * 0.02))
+    b = jax.device_put(jnp.zeros((v,), jnp.float32))
+    y = jax.device_put(jnp.asarray(
+        rng.integers(0, v, t).astype(np.int32)))
+
+    def full_loss(h, w, b):
+        # the oracle path exactly as Dense + scce_with_logits runs it:
+        # bf16 matmul, f32 accumulation, full-logits log_softmax objective
+        logits = (jnp.matmul(h, w.astype(h.dtype),
+                             preferred_element_type=jnp.float32)
+                  .astype(h.dtype) + b.astype(h.dtype))
+        return objectives.sparse_categorical_crossentropy_from_logits(
+            y, logits)
+
+    def fused_loss(h, w, b):
+        return fused_sparse_cross_entropy(y, h, w, b)
+
+    out = {}
+    rates = {}
+    for tag, fn in (("fullvocab", full_loss), ("fused", fused_loss)):
+        g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+        jax.block_until_ready(g(h, w, b))          # compile + warm
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(g(h, w, b))
+            best = max(best, t / (time.perf_counter() - t0))
+        rates[tag] = best
+        out[f"{tag}_ce_tokens_per_sec"] = round(best, 1)
+    out["fused_ce_speedup"] = round(rates["fused"] / rates["fullvocab"], 3)
+    # the memory story, statically: what each path's largest loss-side
+    # tensor costs (the fused figure is the streamed tile bound)
+    out["fullvocab_ce_logits_bytes"] = t * v * 4
+    out["fused_ce_tile_bytes"] = DEFAULT_CHUNK * v * 4
+    return out
+
+
 def bench_long_context():
     """Long-context training ON the scoreboard (VERDICT r4 weak #3: the
     flagship Pallas flash fwd+bwd kernels appeared in no driver-verified
@@ -265,8 +337,11 @@ def bench_long_context():
     out = {}
     set_policy(compute_dtype="bfloat16", param_dtype="float32")
     try:
-        # 4k batch 16: +10% tok/s over batch 4 (measured 221k vs 200k) and
-        # the 2 GB fp32 log-softmax still fits beside the bf16 activations
+        # 4k batch 16: +10% tok/s over batch 4 (measured 221k vs 200k).
+        # The LM head rides the fused blockwise CE (zoo.train.fused_ce
+        # auto engages at V=8192): the (B·T, V) fp32 log-softmax this
+        # comment once budgeted 2 GB for is now O(chunk·V) streamed tiles
+        # — long_context_{tag}_peak_hbm_bytes tracks the win
         for tag, seq_len, batch, n_seqs in (("4k", 4096, 16, 32),
                                             ("32k", 32768, 1, 4)):
             rng = np.random.default_rng(7)
@@ -306,6 +381,12 @@ def bench_long_context():
             out[f"long_context_{tag}_tokens_per_sec"] = round(toks_per_sec, 1)
             if m_mfu is not None:
                 out[f"long_context_{tag}_mfu"] = round(m_mfu, 4)
+            # peak-HBM watermark after this tag's round (cumulative across
+            # the bench process — an upper bound per tag) so the fused-CE
+            # logits-memory win shows in the perf trajectory
+            peak = _device_peak_hbm_bytes()
+            if peak is not None:
+                out[f"long_context_{tag}_peak_hbm_bytes"] = peak
     finally:
         _reset_policy()
     return out
@@ -848,6 +929,10 @@ def main():
     except Exception as e:
         print(f"# long-context bench failed: {e!r}", file=sys.stderr)
     try:
+        out.update(bench_fused_ce())
+    except Exception as e:
+        print(f"# fused-CE microbench failed: {e!r}", file=sys.stderr)
+    try:
         out.update(bench_codec())
     except Exception as e:
         print(f"# serving codec bench failed: {e!r}", file=sys.stderr)
@@ -948,6 +1033,10 @@ ABSOLUTE_FLOORS = {
     # amplifies tunnel noise); the meaningful gate is the >=1.5x
     # bandwidth-regime claim, not round-over-round relative drift
     "int8_stream_b1_speedup": 1.5,
+    # the fused blockwise LM-head CE must beat the full-logits objective
+    # at the 32k head shape (ISSUE 9 acceptance) — a bandwidth-bound win,
+    # so 1.0 is a conservative floor, not a noise-sized margin
+    "fused_ce_speedup": 1.0,
 }
 # lower-is-better correctness metrics: fail above the ceiling.
 # device_step_ms is the NCF compute-regression backstop for the wide

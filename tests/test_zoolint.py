@@ -1638,3 +1638,105 @@ def test_zl011_suppression():
                             "# zoolint: disable=ZL011 hand-off by design")
     fs = lint_source(src, "analytics_zoo_tpu/serving/server.py")
     assert len(ids(fs, "ZL011")) == 1      # the put still flags
+
+
+# ---------------------------------------------------------------------------
+# ZL012 — full-vocab log_softmax + label pick cross-entropy in training paths
+# ---------------------------------------------------------------------------
+
+ZL012_BAD = """
+import jax
+import jax.numpy as jnp
+
+def scce_from_logits(y_true, y_pred):
+    logp = jax.nn.log_softmax(y_pred, axis=-1)
+    picked = jnp.take_along_axis(logp, y_true[..., None], axis=-1)[..., 0]
+    return -picked.mean()
+"""
+
+ZL012_ONEHOT = """
+import jax
+import jax.numpy as jnp
+
+def scce_onehot(y_true, y_pred, v):
+    logp = jax.nn.log_softmax(y_pred, axis=-1)
+    return -jnp.sum(jax.nn.one_hot(y_true, v) * logp, axis=-1).mean()
+"""
+
+ZL012_CLEAN = """
+import jax
+import jax.numpy as jnp
+
+def log_probs_only(y_pred):
+    # log_softmax with no label pick: a predict/export path, not a CE
+    return jax.nn.log_softmax(y_pred, axis=-1)
+
+def pick_only(logp, y_true):
+    # pick without the softmax: the log-probs came from somewhere cheap
+    return jnp.take_along_axis(logp, y_true[..., None], axis=-1)
+
+def fused(y_true, hidden, w, b):
+    from analytics_zoo_tpu.ops.fused_cross_entropy import \\
+        fused_sparse_cross_entropy
+    return fused_sparse_cross_entropy(y_true, hidden, w, b)
+"""
+
+
+def test_zl012_triggers_in_keras_training_path_as_error():
+    fs = lint_source(ZL012_BAD,
+                     "analytics_zoo_tpu/pipeline/api/keras/objectives.py")
+    assert len(ids(fs, "ZL012")) == 1
+    assert errors(fs)
+    assert "fused_cross_entropy" in [f for f in fs
+                                     if f.rule_id == "ZL012"][0].message
+    fs = lint_source(ZL012_BAD,
+                     "analytics_zoo_tpu/pipeline/estimator/estimator.py")
+    assert errors(fs)
+
+
+def test_zl012_one_hot_matmul_form_triggers():
+    fs = lint_source(ZL012_ONEHOT,
+                     "analytics_zoo_tpu/pipeline/api/keras/objectives.py")
+    assert len(ids(fs, "ZL012")) == 1
+
+
+def test_zl012_warning_outside_training_engine():
+    fs = lint_source(ZL012_BAD, "analytics_zoo_tpu/models/text/ner.py")
+    assert len(ids(fs, "ZL012")) == 1 and not errors(fs)
+
+
+def test_zl012_clean_forms():
+    assert not ids(lint_source(
+        ZL012_CLEAN,
+        "analytics_zoo_tpu/pipeline/api/keras/objectives.py"), "ZL012")
+
+
+def test_zl012_scopes_do_not_merge():
+    """A log_softmax in one function and a take_along_axis in a DIFFERENT
+    function are two unrelated ops, not one cross-entropy."""
+    src = ("import jax\nimport jax.numpy as jnp\n"
+           "def a(x):\n"
+           "    return jax.nn.log_softmax(x, axis=-1)\n"
+           "def b(logp, y):\n"
+           "    return jnp.take_along_axis(logp, y[..., None], axis=-1)\n")
+    assert not ids(lint_source(
+        src, "analytics_zoo_tpu/pipeline/api/keras/x.py"), "ZL012")
+
+
+def test_zl012_from_import_forms_resolve():
+    src = ("from jax.nn import log_softmax, one_hot\n"
+           "from jax.numpy import take_along_axis\n"
+           "def ce(y, yp):\n"
+           "    logp = log_softmax(yp, axis=-1)\n"
+           "    return -take_along_axis(logp, y[..., None], axis=-1).mean()\n")
+    fs = lint_source(src, "analytics_zoo_tpu/pipeline/api/keras/x.py")
+    assert len(ids(fs, "ZL012")) == 1
+
+
+def test_zl012_suppression():
+    src = ZL012_BAD.replace(
+        "    logp = jax.nn.log_softmax(y_pred, axis=-1)",
+        "    logp = jax.nn.log_softmax(y_pred, axis=-1)  "
+        "# zoolint: disable=ZL012 the equivalence oracle")
+    assert not ids(lint_source(
+        src, "analytics_zoo_tpu/pipeline/api/keras/objectives.py"), "ZL012")

@@ -1,4 +1,4 @@
-"""zoolint rules ZL001–ZL012 — the JAX/TPU hazards that bite this stack.
+"""zoolint rules ZL001–ZL013 — the JAX/TPU hazards that bite this stack.
 
 Every rule documents its rationale in the class docstring (surfaced by
 ``--list-rules`` and docs/guides/STATIC_ANALYSIS.md). Severities:
@@ -478,6 +478,51 @@ _SAFE_FUNCS = {"len", "isinstance", "getattr", "hasattr", "callable",
                "type", "id"}
 
 
+def _traced_name_in_expr(ctx: ModuleContext, test: ast.AST,
+                         traced: Set[str]) -> Optional[str]:
+    """First traced NAME an expression would concretize — the shared
+    heuristic behind ZL004 (if/while tests) and ZL013 (assert tests):
+    static-metadata attributes (``x.shape``), metadata builtins
+    (``len``/``isinstance``/...), identity and ``is None`` comparisons
+    don't concretize and are not flagged."""
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in traced):
+            continue
+        par = ctx.parent(node)
+        if isinstance(par, ast.Attribute) \
+                and par.attr in _STATIC_ATTRS:
+            continue
+        if isinstance(par, ast.Call):
+            if node is par.func:
+                continue
+            if dotted(par.func) in _SAFE_FUNCS:
+                continue
+        if isinstance(par, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in par.ops):
+                continue
+            operands = [par.left] + list(par.comparators)
+            if any(isinstance(o, ast.Constant) and o.value is None
+                   for o in operands):
+                continue
+        return node.id
+    return None
+
+
+def _traced_params(info) -> Set[str]:
+    """Traced (non-static) parameter names of a jitted function — the
+    shared set construction behind ZL004 (branch tests) and ZL013
+    (assert tests): every positional and kwonly param minus the
+    ``static_argnames``, minus ``self``/``cls``. One definition so the
+    two rules can never drift on which names count as traced."""
+    fn = info.fn
+    traced = {n for n in param_names(fn)
+              if n not in info.static_names} - {"self", "cls"}
+    traced.update(kw.arg for kw in fn.args.kwonlyargs
+                  if kw.arg not in info.static_names)
+    return traced
+
+
 @register
 class TracedBranch(Rule):
     """A Python ``if``/``while`` on a traced argument concretizes it at
@@ -491,36 +536,12 @@ class TracedBranch(Rule):
 
     def _test_traced_name(self, ctx: ModuleContext, test: ast.AST,
                           traced: Set[str]) -> Optional[str]:
-        for node in ast.walk(test):
-            if not (isinstance(node, ast.Name) and node.id in traced):
-                continue
-            par = ctx.parent(node)
-            if isinstance(par, ast.Attribute) \
-                    and par.attr in _STATIC_ATTRS:
-                continue
-            if isinstance(par, ast.Call):
-                if node is par.func:
-                    continue
-                if dotted(par.func) in _SAFE_FUNCS:
-                    continue
-            if isinstance(par, ast.Compare):
-                if all(isinstance(op, (ast.Is, ast.IsNot))
-                       for op in par.ops):
-                    continue
-                operands = [par.left] + list(par.comparators)
-                if any(isinstance(o, ast.Constant) and o.value is None
-                       for o in operands):
-                    continue
-            return node.id
-        return None
+        return _traced_name_in_expr(ctx, test, traced)
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         for info in ctx.jitted.values():
             fn = info.fn
-            traced = {n for n in param_names(fn)
-                      if n not in info.static_names} - {"self", "cls"}
-            traced.update(kw.arg for kw in fn.args.kwonlyargs
-                          if kw.arg not in info.static_names)
+            traced = _traced_params(info)
             if not traced:
                 continue
             for node in ast.walk(fn):
@@ -1311,3 +1332,97 @@ class FullVocabCrossEntropy(Rule):
                   "logit, O(chunk*V) memory; the keras loss resolution "
                   "picks it up via zoo.train.fused_ce)",
                 severity=sev)
+
+
+# ---------------------------------------------------------------------------
+# ZL013 — bare Python assert on traced values inside jit-staged bodies
+# ---------------------------------------------------------------------------
+
+def _in_package(path: str) -> bool:
+    """Whether a file is package code (``analytics_zoo_tpu/``) — where a
+    compiled-away assertion is a shipped latent bug, so ZL013 runs at
+    error severity; elsewhere (tests, examples, user scripts) it warns."""
+    if os.path.exists(path):
+        path = os.path.abspath(path)
+    p = path.replace("\\", "/")
+    return "/analytics_zoo_tpu/" in p or p.startswith("analytics_zoo_tpu/")
+
+
+@register
+class TracedAssert(Rule):
+    """A bare Python ``assert`` on a traced value inside a jit-staged
+    body is a guard that cannot guard: at trace time the tracer either
+    raises ``TracerBoolConversionError`` (boolean contexts) or — the
+    insidious form — the assert evaluates ONCE on the abstract value,
+    is baked out of the compiled program, and never runs again on real
+    data (and under ``python -O`` asserts vanish entirely). A numeric
+    invariant the author meant to enforce per step silently enforces
+    nothing. Use ``checkify.check`` / ``jax.debug`` for a real runtime
+    check, branch on static metadata (``x.shape`` asserts are fine and
+    not flagged), or return a packed sentinel flag the host inspects
+    (the ``common/anomaly.py`` pattern). Error severity in package
+    code; warning elsewhere."""
+
+    id = "ZL013"
+    severity = ERROR
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        sev = ERROR if _in_package(ctx.path) else WARNING
+        bodies: List[Tuple[ast.AST, Set[str], str]] = []
+        for info in ctx.jitted.values():
+            fn = info.fn
+            if not hasattr(fn, "args"):
+                continue
+            bodies.append((fn, _traced_params(info),
+                           getattr(fn, "name", "<fn>")))
+        for fn in ctx.scan_bodies:
+            if hasattr(fn, "args"):   # every param of a scan body traces
+                traced = set(param_names(fn)) - {"self", "cls"}
+                bodies.append((fn, traced,
+                               getattr(fn, "name", "<lambda>")))
+        seen: Set[int] = set()
+        for fn, traced, name in bodies:
+            if id(fn) in seen or not traced:
+                continue
+            seen.add(id(fn))
+            # derivation-aware (the ZL009 discipline): a local assigned
+            # from a traced value is itself traced (`y = jnp.dot(x, w);
+            # assert y.sum() > 0`). Taint propagates through the static-
+            # metadata filter, so `n = x.shape[0]` stays untainted.
+            derived = set(traced)
+            # one AST walk collects the candidate assignments; the
+            # fixpoint then iterates only over that list (a long
+            # derivation chain must not re-walk the whole body per
+            # newly-tainted name)
+            assigns = [node for node in ast.walk(fn)
+                       if isinstance(node, (ast.Assign, ast.AugAssign))
+                       and not ctx.in_nested_scope(node, fn)]
+            changed = True
+            while changed:
+                changed = False
+                for node in assigns:
+                    if not _traced_name_in_expr(ctx, node.value, derived):
+                        continue
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        for tn in ast.walk(t):
+                            if isinstance(tn, ast.Name) \
+                                    and tn.id not in derived:
+                                derived.add(tn.id)
+                                changed = True
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assert):
+                    continue
+                if ctx.in_nested_scope(node, fn):   # own scope: shadows
+                    continue
+                offender = _traced_name_in_expr(ctx, node.test, derived)
+                if offender:
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"bare `assert` on traced value `{offender}` in "
+                        f"jit-staged `{name}` — evaluated once at trace "
+                        f"time (or TracerBoolConversionError), never on "
+                        f"real data; use checkify.check/jax.debug, "
+                        f"assert static metadata, or return a sentinel "
+                        f"flag the host checks", severity=sev)

@@ -54,6 +54,16 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.train.fused_ce": "auto",        # fused blockwise LM-head CE: auto (V>=1024) | true | false
     "zoo.train.fused_ce_chunk": 512,     # rows per streamed logits tile (O(chunk*V) memory)
     "zoo.train.remat": False,            # scan-body remat: false | true/dots | full
+    # -- anomaly sentinels / self-healing training (docs/guides/TRAINING.md)
+    "zoo.train.sentinel": "off",         # off | warn | recover: on-device
+    #   nan-loss / nan-grad / grad-norm-spike checks folded into the step
+    "zoo.train.spike_factor": 10.0,      # grad-norm spike = factor x its EWMA
+    "zoo.train.grad_clip": 0.0,          # >0: global-norm gradient clipping in
+    #   the step builders (zoo_train_grad_clip_engaged_total)
+    "zoo.train.max_skips_per_epoch": 8,  # recover mode: skips past this in one
+    #   epoch escalate to rollback-to-last-good-checkpoint
+    "zoo.train.max_rollbacks": 3,        # rollbacks per fit before the loop
+    #   fails loudly with TrainingDiverged (RetryBudget-backed)
     "zoo.metrics.flops": False,          # fit(): cost-analysis pass feeding the MFU gauge
     "zoo.failure.retry_times": 5,        # ≅ bigdl.failure.retryTimes (Topology.scala:1172)
     "zoo.failure.retry_window_sec": 3600,

@@ -22,7 +22,14 @@ Fault kinds:
 * ``partial_write`` — no raise here; the SITE receives the spec back and
   applies its own partial-effect semantics (e.g.
   ``LocalBackend.set_results`` writes ``fraction`` of the batch, then
-  raises ``ConnectionError`` — the mid-write crash shape).
+  raises ``ConnectionError`` — the mid-write crash shape),
+* ``nan_loss`` / ``nan_grad`` / ``spike`` — site-applied like
+  ``partial_write``: the ``train.grads`` site (the training loop, one
+  call per dispatched optimizer step) feeds the returned spec to the
+  compiled step as an on-device poison code — NaN the loss, NaN the
+  gradients, or multiply them by ``scale`` — so the anomaly-sentinel
+  chaos harness (``tests/test_training_chaos.py``) reconciles detected
+  anomalies exactly against the plan.
 
 Sites are plain strings; the current catalog (grep ``faults.inject`` for
 ground truth): ``backend.xadd`` (``LocalBackend`` AND ``RedisBackend`` —
@@ -35,9 +42,11 @@ per published result batch, on the publisher thread — unlike
 writes, so an outage plan hits exactly the publishes), ``resp.send`` /
 ``resp.recv`` (one fire per RESP command/pipeline attempt, around the
 wire ops — exercises the reconnect/idempotency rules against a real
-socket), and the checkpoint writer's ``ckpt.write`` (per tree file) /
+socket), the checkpoint writer's ``ckpt.write`` (per tree file) /
 ``ckpt.manifest`` / ``ckpt.rename`` (the manifest commit,
-``utils/checkpoint.py``).
+``utils/checkpoint.py``), and the training loop's ``train.grads`` (one
+per dispatched optimizer step when the anomaly sentinels are armed —
+``pipeline/api/keras/training.py``).
 
 Determinism: each site keeps a 0-based call counter; a spec fires when
 its site's counter is in ``at`` (or, for rate-based specs, when the
@@ -63,7 +72,8 @@ from typing import List, Optional, Tuple
 __all__ = ["FaultError", "FaultSpec", "FaultPlan", "activate", "inject",
            "active_plan", "KINDS"]
 
-KINDS = ("error", "disconnect", "latency", "partial_write")
+KINDS = ("error", "disconnect", "latency", "partial_write",
+         "nan_loss", "nan_grad", "spike")
 
 
 class FaultError(RuntimeError):
@@ -79,13 +89,15 @@ class FaultSpec:
     ``delay_s`` — sleep for ``latency``. ``exc`` — exception INSTANCE to
     raise for ``error`` (a fresh ``FaultError`` per firing otherwise).
     ``fraction`` — for ``partial_write``, how much of the batch the site
-    applies before failing."""
+    applies before failing. ``scale`` — for ``spike``, the gradient
+    multiplier the ``train.grads`` site applies on device."""
 
-    __slots__ = ("site", "kind", "at", "p", "delay_s", "exc", "fraction")
+    __slots__ = ("site", "kind", "at", "p", "delay_s", "exc", "fraction",
+                 "scale")
 
     def __init__(self, site: str, kind: str, at=(), p: float = 0.0,
                  delay_s: float = 0.0, exc: Optional[BaseException] = None,
-                 fraction: float = 0.5):
+                 fraction: float = 0.5, scale: float = 1e4):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r}; one of {KINDS}")
         if not at and not p:
@@ -98,6 +110,7 @@ class FaultSpec:
         self.delay_s = float(delay_s)
         self.exc = exc
         self.fraction = float(fraction)
+        self.scale = float(scale)
 
     def __repr__(self) -> str:
         trig = f"at={sorted(self.at)}" if self.at else f"p={self.p}"
@@ -198,4 +211,5 @@ def inject(site: str) -> Optional[FaultSpec]:
             else FaultError(f"injected error at {site}")
     if spec.kind == "disconnect":
         raise ConnectionError(f"injected disconnect at {site}")
-    return spec     # partial_write: the site applies its own semantics
+    # partial_write / nan_loss / nan_grad / spike: site-applied semantics
+    return spec

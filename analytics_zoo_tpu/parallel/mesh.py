@@ -106,6 +106,26 @@ def data_parallel_size(mesh: Optional[Mesh] = None) -> int:
     return mesh.shape[DATA_AXIS]
 
 
+def mesh_metadata(mesh: Optional[Mesh] = None) -> dict:
+    """JSON-serializable topology descriptor — stored in checkpoint
+    manifests (``utils/checkpoint.py``) so a restore under a DIFFERENT
+    device count/mesh shape is detected and re-placed instead of
+    silently mis-sharded. Host-side snapshot leaves are topology-free;
+    this records only what the snapshot was cut under."""
+    mesh = mesh or global_mesh()
+    return {"axes": {str(k): int(v) for k, v in mesh.shape.items()},
+            "devices": int(mesh.devices.size)}
+
+
+def format_mesh(meta: Optional[dict]) -> str:
+    """Compact human form of :func:`mesh_metadata` output for log lines:
+    ``{data:8}`` (singleton axes elided; ``{}`` when all are 1)."""
+    axes = (meta or {}).get("axes", {}) or {}
+    kept = {k: v for k, v in axes.items() if int(v) != 1}
+    inner = ", ".join(f"{k}:{v}" for k, v in kept.items())
+    return "{" + inner + "}"
+
+
 def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     """Sharding for a batch: leading dim split over the data axis."""
     mesh = mesh or global_mesh()

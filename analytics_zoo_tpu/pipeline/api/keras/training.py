@@ -33,7 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ....common import anomaly, faults
 from ....common.context import get_zoo_context
+from ....common.reliability import RetryBudget
 from ....common.triggers import (EveryEpoch, MaxEpoch, SeveralIteration,
                                  TrainLoopState, Trigger)
 from ....feature.feature_set import FeatureSet, prefetch_to_device
@@ -54,6 +56,36 @@ class TrainingPreempted(SystemExit):
     ``SystemExit`` subclass so it escapes the step-failure retry loop and
     terminates cleanly (the TPU-preemption analogue of the reference's
     driver-failure snapshot)."""
+
+
+class TrainingDiverged(RuntimeError):
+    """The anomaly sentinels (``zoo.train.sentinel=recover``) could not
+    contain a divergence: either skip-then-rollback recovery exhausted
+    its ``zoo.train.max_rollbacks`` budget, or escalation was required
+    with no checkpoint to roll back to. Raised INSTEAD of looping
+    forever or silently training on garbage — the params published on
+    the model are the last known-good (restored) state."""
+
+
+class _RollbackRequested(RuntimeError):
+    """Internal escalation signal: more than
+    ``zoo.train.max_skips_per_epoch`` updates were discarded in one
+    epoch — reload the last good checkpoint and replay with the
+    offending data window skipped. Handled by ``_fit_with_retry``
+    under the rollback :class:`RetryBudget`; never escapes ``fit``."""
+
+    def __init__(self, skips: int, epoch: int):
+        super().__init__(
+            f"{skips} anomalous step(s) skipped in epoch {epoch} "
+            f"(zoo.train.max_skips_per_epoch exceeded)")
+        self.skips = skips
+        self.epoch = epoch
+
+
+#: shape of the "no fault" train.grads input — a module constant so the
+#: hot loop hands the SAME host array to every healthy dispatch instead
+#: of allocating one per step
+_NO_FAULT = np.zeros(2, np.float32)
 
 
 class CompiledSpec:
@@ -248,6 +280,117 @@ def _clone_tree(tree):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+class _SentinelMonitor:
+    """Host-side bookkeeping for the packed per-step sentinel flags
+    (``common/anomaly.py``; one int32 per step, ``(K,)`` per scan chunk).
+
+    Flag readbacks trail the dispatch stream by a small lag window so
+    observing them never syncs the pipeline the way an eager per-step
+    read would — the device-side skip already happened inside the step;
+    the host only needs the flags for metrics, the per-epoch skip
+    budget, and the rollback replay set, all of which tolerate a
+    few-dispatch delay. Everything here is deterministic: the chaos
+    harness reconciles the counters exactly against an injected
+    ``train.grads`` plan."""
+
+    #: dispatches a flag word may trail the stream before being read
+    LAG = 4
+
+    def __init__(self, loop: "TrainingLoop", cfg: anomaly.SentinelConfig):
+        self.loop = loop
+        self.cfg = cfg
+        self.pending: collections.deque = collections.deque()
+        self.epoch = 0
+        self.epoch_start = 0                # iteration at epoch start
+        self.epoch_skips = 0
+        self.epoch_flags: List[int] = []    # one per recorded loss
+        self.epoch_step_iters: List[int] = []   # global iter per loss
+
+    def begin_epoch(self, epoch: int, start_iter: int) -> None:
+        self.drain()                        # belongs to the PREVIOUS epoch
+        self.epoch = epoch
+        self.epoch_start = start_iter
+        self.epoch_skips = 0
+        self.epoch_flags = []
+        self.epoch_step_iters = []
+
+    def step_key(self, it: int):
+        """Replay-stable identity of a dispatched step: (epoch, ordinal
+        within the epoch). Global iteration numbers shift when a
+        mid-epoch snapshot restores (the epoch re-streams from batch 0
+        while the iteration counter resumes mid-epoch), but the batch
+        order per epoch is deterministic — the ordinal is what maps
+        back to the same data window on replay."""
+        return (self.epoch, it - self.epoch_start)
+
+    def push(self, first_iter: int, flags_dev) -> None:
+        """Queue one dispatch's flag output (scalar or (K,) vector)."""
+        shape = getattr(flags_dev, "shape", ())
+        k = int(shape[0]) if shape else 1
+        self.epoch_step_iters.extend(range(first_iter, first_iter + k))
+        self.pending.append((first_iter, flags_dev))
+        if len(self.pending) > self.LAG:
+            self._drain_one()
+
+    def note_replay_skip(self, k: int) -> None:
+        """``k`` steps of a rollback replay were not re-dispatched (the
+        offending data window) — counted as skipped, no loss recorded."""
+        self.loop._m_skipped.inc(k)
+
+    def drain(self) -> None:
+        while self.pending:
+            self._drain_one()
+
+    def _drain_one(self) -> None:
+        first_iter, flags_dev = self.pending.popleft()
+        words = np.atleast_1d(np.asarray(flags_dev))
+        for j, word in enumerate(words):
+            f = int(word)
+            self.epoch_flags.append(f)
+            if f & anomaly.GRAD_CLIPPED:
+                self.loop._m_clip.inc()
+            kinds = anomaly.kinds_of(f)
+            if not kinds:
+                continue
+            it = first_iter + j
+            for kind in kinds:
+                self.loop._m_anomaly[kind].inc()
+            self.loop._registry.emit(
+                "train.anomaly", iteration=it, epoch=self.epoch,
+                kinds=",".join(kinds), mode=self.cfg.mode,
+                action="skip" if self.cfg.mode == "recover" else "warn")
+            if self.cfg.mode == "recover":
+                self.loop._m_skipped.inc()
+                self.loop._anomalous_steps.add(self.step_key(it))
+                self.epoch_skips += 1
+                log.warning(
+                    "anomalous step at iteration %d (%s): update "
+                    "discarded (%d/%d skips this epoch)", it,
+                    ",".join(kinds), self.epoch_skips,
+                    self.cfg.max_skips_per_epoch)
+            else:
+                log.warning(
+                    "anomalous step at iteration %d (%s) — "
+                    "zoo.train.sentinel=warn: update APPLIED", it,
+                    ",".join(kinds))
+        if (self.cfg.mode == "recover"
+                and self.epoch_skips > self.cfg.max_skips_per_epoch):
+            raise _RollbackRequested(self.epoch_skips, self.epoch)
+
+    def loss_mask(self, n: int) -> np.ndarray:
+        """Valid-loss mask over this epoch's ``n`` recorded losses: in
+        recover mode an anomalous step's loss was never applied, so it
+        is excluded from the epoch mean (matching a run that never saw
+        the poison batch)."""
+        self.drain()
+        mask = np.ones(n, bool)
+        if self.cfg.mode == "recover":
+            for i, f in enumerate(self.epoch_flags[:n]):
+                if f & anomaly.ANOMALY_MASK:
+                    mask[i] = False
+        return mask
+
+
 # ---------------------------------------------------------------------------
 # The training loop (InternalDistriOptimizer / LocalOptimizer unified)
 # ---------------------------------------------------------------------------
@@ -320,6 +463,32 @@ class TrainingLoop:
         self._segment_count = 0     # loop-lifetime; first sample discarded
         self._boundary_ref = None
         self._apply_loss = None     # resolved once per loop (fused CE)
+        # anomaly sentinels (docs/guides/TRAINING.md "Anomaly detection
+        # & recovery"): config resolved once per loop like _apply_loss;
+        # the per-fit recovery state (flagged iterations, rollback
+        # budget) is (re)initialized at each fit() entry
+        self._sentinel: Optional[anomaly.SentinelConfig] = None
+        self._m_anomaly = {
+            kind: self._registry.counter(
+                "zoo_train_anomaly_total",
+                "anomalous training steps detected by the sentinels, by "
+                "kind (zoo.train.sentinel)", labels={"kind": kind})
+            for _bit, kind in anomaly.KIND_BITS}
+        self._m_skipped = self._registry.counter(
+            "zoo_train_skipped_steps_total",
+            "optimizer steps whose update was discarded (sentinel skip) "
+            "or not re-dispatched on rollback replay")
+        self._m_rollback = self._registry.counter(
+            "zoo_train_rollback_total",
+            "skip-budget escalations that reloaded the last good "
+            "checkpoint and replayed past the offending window")
+        self._m_clip = self._registry.counter(
+            "zoo_train_grad_clip_engaged_total",
+            "steps where zoo.train.grad_clip global-norm clipping "
+            "actually rescaled the gradients")
+        self._anomalous_steps: set = set()   # {(epoch, ordinal)} flagged
+        self._rollback_budget: Optional[RetryBudget] = None
+        self._rollback_pending = False
 
     # -- jitted steps -------------------------------------------------------
     #: the labels of the most recent fused-CE gauge write in this process —
@@ -406,27 +575,122 @@ class TrainingLoop:
             return lambda f: f
         return lambda f: jax.checkpoint(f, policy=policy)
 
-    def build_train_step(self):
+    def _sentinel_config(self) -> anomaly.SentinelConfig:
+        """Resolve the anomaly-sentinel/grad-clip knobs ONCE per loop
+        (like the fused-loss resolution): every step builder of a loop
+        must agree on the step signature, and with ``sentinel=off`` and
+        no clipping the builders emit the historical step exactly —
+        zero sentinel ops, bit-identical numerics."""
+        if self._sentinel is None:
+            self._sentinel = anomaly.resolve_config()
+            cfg = self._sentinel
+            if cfg.sentinel:
+                log.info(
+                    "anomaly sentinels armed (zoo.train.sentinel=%s): "
+                    "nan-loss/nan-grad checks + grad-norm spike at %gx "
+                    "EWMA%s%s", cfg.mode, cfg.spike_factor,
+                    "; updates from anomalous steps are DISCARDED, "
+                    "escalating to checkpoint rollback past "
+                    f"{cfg.max_skips_per_epoch} skips/epoch"
+                    if cfg.mode == "recover" else "",
+                    "; train.grads fault injection compiled in"
+                    if cfg.faults else "")
+        return self._sentinel
+
+    def _make_step_core(self):
+        """The per-step forward/backward/update shared by the single-step
+        and scan builders. Returns ``(core_fn, cfg)``.
+
+        With the sentinel layer inactive (``zoo.train.sentinel=off`` and
+        no ``zoo.train.grad_clip``) the core is EXACTLY the historical
+        step — no extra inputs, outputs, or ops, so the off mode
+        preserves step numerics bit-for-bit. Active, the core grows a
+        sentinel-state carry and a packed int32 flag output
+        (``common/anomaly.py``): non-finite loss, non-finite/spiking
+        global grad norm, clip engagement — computed on device inside
+        the same fused program, no extra host sync. In ``recover`` mode
+        an anomalous step's params/opt-state/net-state updates are
+        discarded on device (the carry keeps the pre-step values); the
+        host observes the flag later and handles budget escalation."""
         opt = self.optimizer
         apply_loss = self._loss_application()
         remat = self._remat_wrapper()
+        cfg = self._sentinel_config()
 
-        def step(params, opt_state, net_state, rng, x, y):
+        def backward(params, net_state, x, y, rng):
             def lfn(p):
                 l, ns = apply_loss(p, net_state, x, y, rng)
                 aux = _aux_loss_sum(ns)
                 return (l if aux is None else l + aux), ns
-            (l, ns), grads = jax.value_and_grad(remat(lfn),
-                                                has_aux=True)(params)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            opt_state = self._pin_opt_state(opt_state)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, ns, l
+            return jax.value_and_grad(remat(lfn), has_aux=True)(params)
 
+        if not cfg.active:
+            def plain(params, opt_state, net_state, rng, x, y):
+                (l, ns), grads = backward(params, net_state, x, y, rng)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                opt_state = self._pin_opt_state(opt_state)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, ns, l
+            return plain, cfg
+
+        def guarded(params, opt_state, net_state, sstate, rng, fault, x, y):
+            (l, ns), grads = backward(params, net_state, x, y, rng)
+            if cfg.faults:
+                # chaos only (zoo.faults.enabled at build time): apply
+                # the host-scheduled train.grads poison code on device
+                l, grads = anomaly.inject_grads(l, grads, fault[0],
+                                                fault[1])
+            gnorm = anomaly.global_norm(grads)
+            if cfg.sentinel:
+                flags, sstate = anomaly.check(l, gnorm, sstate,
+                                              cfg.spike_factor)
+            else:
+                flags = jnp.zeros((), jnp.int32)
+            if cfg.grad_clip > 0:
+                grads, engaged = anomaly.clip_by_global_norm(
+                    grads, gnorm, cfg.grad_clip)
+                flags = flags | jnp.where(engaged, anomaly.GRAD_CLIPPED,
+                                          0).astype(jnp.int32)
+            if cfg.mode == "recover":
+                # skip-batch: an anomalous step's update is not applied —
+                # params/opt-state/net-state keep their pre-step values
+                # (the optimizer count does not advance either, so the
+                # surviving trajectory matches a run that never saw the
+                # poison batch). lax.cond, not a where-select: the
+                # healthy path must run EXACTLY the plain update — a
+                # per-leaf select costs extra full passes over params +
+                # moments every step (measured ~30% on the NCF bench
+                # shape), while the untaken skip branch costs nothing
+                bad = (flags & anomaly.ANOMALY_MASK) > 0
+
+                def _apply(operand):
+                    p, o, g, new_ns = operand
+                    updates, new_opt = opt.update(g, o, p)
+                    new_opt = self._pin_opt_state(new_opt)
+                    return (optax.apply_updates(p, updates), new_opt,
+                            new_ns)
+
+                def _skip(operand):
+                    p, o, _g, _new_ns = operand
+                    return p, o, net_state
+
+                params, opt_state, net_state = jax.lax.cond(
+                    bad, _skip, _apply, (params, opt_state, grads, ns))
+            else:
+                updates, opt_state = opt.update(grads, opt_state, params)
+                opt_state = self._pin_opt_state(opt_state)
+                params = optax.apply_updates(params, updates)
+                net_state = ns
+            return params, opt_state, net_state, sstate, l, flags
+
+        return guarded, cfg
+
+    def build_train_step(self):
+        core, cfg = self._make_step_core()
         # instrument_jit == jax.jit + compile accounting: every first
         # compile lands in zoo_jit_compile_*, every recompile under a new
         # batch shape emits a jit.retrace event naming the path
-        self._train_step = instrument_jit(step, name="train.step",
+        self._train_step = instrument_jit(core, name="train.step",
                                           registry=self._registry,
                                           donate_argnums=(0, 1, 2))
         return self._train_step
@@ -436,27 +700,25 @@ class TrainingLoop:
         optimizer update) used by both the K-step chunk dispatch and the
         whole-epoch dispatch, so the two fused paths can never diverge
         numerically from each other or from the single-step path."""
-        opt = self.optimizer
-        apply_loss = self._loss_application()
-        remat = self._remat_wrapper()
+        core, cfg = self._make_step_core()
+
+        if not cfg.active:
+            def body(carry, batch):
+                params, opt_state, net_state, i = carry
+                x, y = batch
+                rng = jax.random.fold_in(base_rng, i)
+                params, opt_state, ns, l = core(params, opt_state,
+                                                net_state, rng, x, y)
+                return (params, opt_state, ns, i + 1), l
+            return body
 
         def body(carry, batch):
-            params, opt_state, net_state, i = carry
-            x, y = batch
+            params, opt_state, net_state, sstate, i = carry
+            x, y, fault = batch
             rng = jax.random.fold_in(base_rng, i)
-
-            def lfn(p):
-                l, ns = apply_loss(p, net_state, x, y, rng)
-                aux = _aux_loss_sum(ns)
-                return (l if aux is None else l + aux), ns
-
-            (l, ns), grads = jax.value_and_grad(remat(lfn),
-                                                has_aux=True)(params)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            opt_state = self._pin_opt_state(opt_state)
-            params = optax.apply_updates(params, updates)
-            return (params, opt_state, ns, i + 1), l
-
+            params, opt_state, ns, sstate, l, flags = core(
+                params, opt_state, net_state, sstate, rng, fault, x, y)
+            return (params, opt_state, ns, sstate, i + 1), (l, flags)
         return body
 
     def build_scan_step(self):
@@ -467,13 +729,27 @@ class TrainingLoop:
         one-Spark-job-per-iteration scheduling overhead
         (``wp-bigdl.md:171-173``: >10% of compute lost to task dispatch at
         scale): here the per-step Python/runtime dispatch cost is amortized
-        K-fold, leaving XLA a single fused program per chunk."""
+        K-fold, leaving XLA a single fused program per chunk. With the
+        sentinel layer active the chunk additionally carries the EWMA
+        state and returns a ``(K,)`` packed flag vector alongside the
+        ``(K,)`` losses — one readback, per-step granularity."""
+        cfg = self._sentinel_config()
 
-        def chunk(params, opt_state, net_state, base_rng, iter0, xs, ys):
-            (params, opt_state, net_state, _), losses = jax.lax.scan(
-                self._make_scan_body(base_rng),
-                (params, opt_state, net_state, iter0), (xs, ys))
-            return params, opt_state, net_state, losses
+        if not cfg.active:
+            def chunk(params, opt_state, net_state, base_rng, iter0, xs, ys):
+                (params, opt_state, net_state, _), losses = jax.lax.scan(
+                    self._make_scan_body(base_rng),
+                    (params, opt_state, net_state, iter0), (xs, ys))
+                return params, opt_state, net_state, losses
+        else:
+            def chunk(params, opt_state, net_state, sstate, base_rng,
+                      iter0, xs, ys, fault):
+                (params, opt_state, net_state, sstate, _), \
+                    (losses, flags) = jax.lax.scan(
+                        self._make_scan_body(base_rng),
+                        (params, opt_state, net_state, sstate, iter0),
+                        (xs, ys, fault))
+                return params, opt_state, net_state, sstate, losses, flags
 
         self._scan_step = instrument_jit(chunk, name="train.scan_chunk",
                                          registry=self._registry,
@@ -544,6 +820,12 @@ class TrainingLoop:
         shuffled view is re-laid-out once per epoch under the stacked batch
         sharding, so the per-step scan body stays identical to the chunked
         path (numerically the same rng schedule as well)."""
+        if self._sentinel_config().active:
+            raise RuntimeError(
+                "whole-epoch dispatch is unavailable with the anomaly-"
+                "sentinel/grad-clip layer active (zoo.train.sentinel / "
+                "zoo.train.grad_clip) — fit falls back to the streamed "
+                "path automatically")
         key = (n, batch_size, n_steps, shuffle)
         if key in self._epoch_fns:
             return self._epoch_fns[key]
@@ -596,6 +878,11 @@ class TrainingLoop:
         loss-readback round-trips are the remaining host cost after
         ``device_cache``; this amortizes them K-fold. The rng schedule is
         identical to the per-epoch path, so losses match bit-for-bit."""
+        if self._sentinel_config().active:
+            raise RuntimeError(
+                "fused-epoch dispatch is unavailable with the anomaly-"
+                "sentinel/grad-clip layer active (zoo.train.sentinel / "
+                "zoo.train.grad_clip)")
         key = (n, batch_size, n_steps, shuffle, n_epochs)
         if key in self._epoch_fns:
             return self._epoch_fns[key]
@@ -748,7 +1035,7 @@ class TrainingLoop:
                  meta={"epoch": loop_state.epoch,
                        "iteration": loop_state.iteration,
                        "epoch_finished": loop_state.epoch_finished},
-                 sync=sync)
+                 sync=sync, mesh=mesh_lib.mesh_metadata(self.mesh))
 
     def _close_active_ckpt_mgr(self, surface: bool) -> None:
         """Join the active manager's in-flight save. ``surface=True``
@@ -871,7 +1158,7 @@ class TrainingLoop:
                       "net_state": net_state},
                      meta={"epoch": epoch, "iteration": iteration,
                            "epoch_finished": epoch_finished},
-                     sync=True)
+                     sync=True, mesh=mesh_lib.mesh_metadata(self.mesh))
         except Exception:
             # going down either way; the newest committed snapshot
             # remains the resume point
@@ -885,7 +1172,22 @@ class TrainingLoop:
             f"shorter than the ~{eta:.2f}s to the next step boundary — "
             f"mid-epoch checkpoint cut at iteration {iteration}")
 
-    def _try_resume(self, mgr: CheckpointManager, params, opt_state, net_state):
+    def _fault_input(self) -> np.ndarray:
+        """Host-side ``train.grads`` fault scheduling: one site call per
+        dispatched optimizer step. Returns the ``[code, scale]`` pair the
+        compiled step consumes (``anomaly.inject_grads``) — zeros (the
+        shared no-fault constant) unless an active plan fires a
+        nan_loss/nan_grad/spike spec at this call index."""
+        spec = faults.inject("train.grads")
+        if spec is None:
+            return _NO_FAULT
+        code = anomaly.FAULT_CODES.get(spec.kind)
+        if code is None:        # e.g. a latency spec: already applied
+            return _NO_FAULT
+        return np.asarray([code, spec.scale], np.float32)
+
+    def _try_resume(self, mgr: CheckpointManager, params, opt_state,
+                    net_state, psh, repl, allow_regress: bool = False):
         """Restore the newest VALID snapshot (``Topology.scala:1220-1246``
         + manifest/checksum verification): a corrupt or uncommitted
         snapshot is quarantined and the restore falls back to the next
@@ -893,17 +1195,53 @@ class TrainingLoop:
         Returns (params, opt_state, net_state, meta) — inputs unchanged
         if there is nothing at or past the model's in-memory progress
         (never regress: a snapshot older than ``finished_iterations`` was
-        cut mid-epoch before further completed epochs)."""
+        cut mid-epoch before further completed epochs).
+
+        **Elastic restore**: snapshot leaves are host-side and
+        topology-free, so the restored trees are explicitly RE-PLACED
+        under the CURRENT mesh — params under ``psh`` (computed by
+        ``mesh_lib.param_shardings`` for this mesh, which re-validates
+        divisibility with the coalesced replicated-fallback warning),
+        net state replicated, optimizer state re-sharded through
+        ``_shard_opt_state`` (ZeRO moments re-partition over the new
+        ``data`` axis). A preempted ``{data:8}`` job therefore resumes
+        on ``{data:4}`` or ``{data:1}`` with bit-identical host values;
+        a mesh-metadata mismatch is REPORTED (log + ``ckpt.elastic_restore``
+        event), never silently mis-sharded."""
+        # allow_regress (the rollback path): going BACK past the model's
+        # in-memory progress is the point — the in-memory state is the
+        # diverging one being abandoned. The default keeps the
+        # never-regress guard (a stale mid-epoch snapshot must not undo
+        # later completed epochs on an ordinary resume/retry).
         out = mgr.restore_latest(
             {"params": params, "opt_state": opt_state,
              "net_state": net_state},
-            min_step=self.model.finished_iterations)
+            min_step=None if allow_regress
+            else self.model.finished_iterations)
         if out is None:
             return params, opt_state, net_state, None
         step, trees, meta = out
+        saved_mesh = meta.get("mesh")
+        cur_mesh = mesh_lib.mesh_metadata(self.mesh)
+        if saved_mesh is not None and saved_mesh != cur_mesh:
+            log.warning(
+                "elastic restore: ckpt-%d was saved under mesh %s "
+                "(%s device(s)) and is restoring under mesh %s "
+                "(%d device(s)) — host leaves re-placed under the "
+                "current shardings, optimizer state re-sharded",
+                step, mesh_lib.format_mesh(saved_mesh),
+                saved_mesh.get("devices", "?"),
+                mesh_lib.format_mesh(cur_mesh), cur_mesh["devices"])
+            self._registry.emit(
+                "ckpt.elastic_restore", step=step,
+                saved=mesh_lib.format_mesh(saved_mesh),
+                restored=mesh_lib.format_mesh(cur_mesh))
+        params = jax.device_put(trees["params"], psh)
+        opt_state = self._shard_opt_state(trees["opt_state"], psh, repl)
+        net_state = jax.device_put(trees["net_state"], repl)
         log.info("resumed from checkpoint ckpt-%d (epoch %s)", step,
                  meta.get("epoch"))
-        return trees["params"], trees["opt_state"], trees["net_state"], meta
+        return params, opt_state, net_state, meta
 
     # -- fit ---------------------------------------------------------------
     def fit(self, x, y, *, batch_size: int, nb_epoch: int,
@@ -934,6 +1272,18 @@ class TrainingLoop:
         window_sec = float(ctx.get("zoo.failure.retry_window_sec", 3600))
         attempts = 0
         window_start = time.time()
+        # per-fit self-healing state (zoo.train.sentinel=recover): the
+        # flagged-iteration set survives rollback attempts within this
+        # fit (the replay must skip the offending window), and the
+        # rollback RetryBudget bounds escalations so a persistent
+        # divergence raises TrainingDiverged instead of looping forever
+        sen = self._sentinel_config()
+        self._anomalous_steps = set()
+        self._rollback_pending = False
+        self._rollback_budget = (
+            RetryBudget(capacity=sen.max_rollbacks, deposit=0.0,
+                        name="train.rollback", registry=self._registry)
+            if sen.mode == "recover" else None)
         # the epoch target is fixed once, after any checkpoint resume inside
         # the first attempt — retries must not extend it
         target_holder: Dict[str, int] = {}
@@ -1017,6 +1367,39 @@ class TrainingLoop:
             except KeyboardInterrupt:
                 self._close_active_ckpt_mgr(surface=False)
                 raise
+            except _RollbackRequested as rb:
+                # skip-budget escalation (zoo.train.sentinel=recover):
+                # reload the last good snapshot and replay with the
+                # flagged window skipped — bounded by the per-fit
+                # rollback RetryBudget so a divergence the rollback
+                # cannot outrun fails loudly instead of looping forever
+                self._close_active_ckpt_mgr(surface=False)
+                mgr = self._ckpt_manager()
+                if mgr is None or mgr.latest() is None:
+                    raise TrainingDiverged(
+                        f"{rb} — and no checkpoint is configured/"
+                        f"committed to roll back to "
+                        f"(model.set_checkpoint enables recovery)") from rb
+                budget = self._rollback_budget
+                if budget is None or not budget.withdraw():
+                    raise TrainingDiverged(
+                        f"{rb} — rollback budget exhausted "
+                        f"(zoo.train.max_rollbacks); the model holds the "
+                        f"last known-good state") from rb
+                self._m_rollback.inc()
+                self._registry.emit("train.rollback", epoch=rb.epoch,
+                                    skips=rb.skips,
+                                    restore_step=mgr.latest(),
+                                    skipped_iters=len(self._anomalous_steps))
+                log.warning(
+                    "training diverging (%s); rolling back to ckpt-%s and "
+                    "replaying with %d flagged step(s) skipped", rb,
+                    mgr.latest(), len(self._anomalous_steps))
+                # the next _fit_impl attempt restores via _try_resume —
+                # with regression past the in-memory progress allowed
+                # (rolling BACK is the point) — and skips
+                # self._anomalous_steps on replay
+                self._rollback_pending = True
             except (ValueError, TypeError):
                 # user/config errors are not transient — the reference likewise
                 # excludes IllegalArgumentException from its retry loop
@@ -1085,6 +1468,12 @@ class TrainingLoop:
         # boundaries (see _fired_within)
         scan_steps = max(1, int(ctx.get("zoo.train.scan_steps", 1)))
 
+        # anomaly sentinels (docs/guides/TRAINING.md): resolved once per
+        # loop; active ⇒ the steps carry EWMA state + packed flags and
+        # the host runs a lagged flag monitor
+        sen = self._sentinel_config()
+        monitor = _SentinelMonitor(self, sen) if sen.active else None
+
         if model.params is None:
             model.init_weights(rng=rng, sample_input=fs.sample(1))
         if scan_steps > 1 and self._scan_step is None:
@@ -1131,15 +1520,26 @@ class TrainingLoop:
         self._active_ckpt_mgr = mgr
         ckpt_trigger = self._ckpt_trigger()
         if mgr is not None:
+            rollback = self._rollback_pending
+            self._rollback_pending = False
             params, opt_state, net_state, meta = self._try_resume(
-                mgr, params, opt_state, net_state)
+                mgr, params, opt_state, net_state, psh, repl,
+                allow_regress=rollback)
             if meta is not None and meta.get("epoch") is not None:
                 resumed_epoch = int(meta["epoch"]) - (
                     0 if meta.get("epoch_finished") else 1)
-                if resumed_epoch > model.finished_epochs:
+                # a rollback REGRESSES the in-memory progress to the
+                # restored snapshot — the abandoned later epochs retrain
+                # (with the flagged windows skipped)
+                if rollback or resumed_epoch > model.finished_epochs:
                     model.finished_epochs = resumed_epoch
                 model.finished_iterations = int(meta.get(
                     "iteration", model.finished_iterations))
+            elif rollback:
+                log.warning("rollback requested but no snapshot could be "
+                            "restored; continuing from the in-memory "
+                            "state (further anomalies will re-escalate "
+                            "within the rollback budget)")
         # sliced disk tier: one loop "epoch" is ONE slice pass; nb_epoch and
         # EveryEpoch-style triggers count FULL passes of num_of_slice slices
         # (DiskFeatureSet + ZooTrigger.scala:44-66 slice awareness)
@@ -1167,6 +1567,17 @@ class TrainingLoop:
 
         # device-cache fast path: dataset lives in HBM, one dispatch per epoch
         device_cache = bool(ctx.get("zoo.train.device_cache", False))
+        if device_cache and sen.active:
+            # sentinels observe per-step flags at dispatch boundaries and
+            # recovery needs the host in the loop; a whole-epoch dispatch
+            # would defer both to epoch granularity — fall back to the
+            # streamed path (documented in TRAINING.md)
+            log.warning(
+                "zoo.train.device_cache disabled for this fit: the "
+                "anomaly-sentinel/grad-clip layer is active "
+                "(zoo.train.sentinel=%s, zoo.train.grad_clip=%g); using "
+                "the streamed dispatch path", sen.mode, sen.grad_clip)
+            device_cache = False
         epoch_fn = None
         xs_dev = ys_dev = None
         # n_slices first: DiskFeatureSet.y is a property that would gather
@@ -1209,6 +1620,14 @@ class TrainingLoop:
 
         base_rng = rng if rng is not None else ctx.rng()
         throttle_cpu = jax.default_backend() == "cpu"
+        # sentinel EWMA carry (device scalars) — fresh per fit attempt:
+        # after a rollback the restored params' gradient scale is the
+        # baseline worth learning, not the diverging run's
+        sstate = anomaly.init_state() if sen.active else None
+        # the no-fault input for scan chunks, allocated ONCE per fit and
+        # sliced per dispatch (the single-step path shares _NO_FAULT)
+        no_fault_chunk = (np.zeros((scan_steps, 2), np.float32)
+                          if sen.active and scan_steps > 1 else None)
         history: Dict[str, List[float]] = {"loss": []}
         loop_state = TrainLoopState(iteration=model.finished_iterations,
                                     epoch=model.finished_epochs + 1)
@@ -1316,6 +1735,8 @@ class TrainingLoop:
             # clear the boundary flag: mid-epoch trigger checks must not see
             # the previous epoch's True (stale EveryEpoch/MaxEpoch fires)
             loop_state.epoch_finished = False
+            if monitor is not None:
+                monitor.begin_epoch(epoch, loop_state.iteration)
             if epoch_fn is not None:
                 prev_iter = loop_state.iteration
                 shuffle_rng = jax.random.key(fs.seed + ctx.seed + epoch)
@@ -1355,32 +1776,90 @@ class TrainingLoop:
                 stream = prefetch_to_device(batches, self.mesh)
             for bx_d, by_d in stream:
                 prev_iter = loop_state.iteration
-                if scan_steps > 1:
-                    k = jax.tree.leaves(bx_d)[0].shape[0]
-                    it0 = jnp.asarray(prev_iter, jnp.int32)
-                    t0 += self._maybe_compute_flops(
-                        self._scan_step,
-                        (params, opt_state, net_state, base_rng, it0,
-                         bx_d, by_d), k * batch_size)
-                    self._segment_begin(mgr, loop_state, params, opt_state,
+                k = jax.tree.leaves(bx_d)[0].shape[0] if scan_steps > 1 \
+                    else 1
+                if (monitor is not None and self._anomalous_steps
+                        and any(monitor.step_key(prev_iter + j)
+                                in self._anomalous_steps
+                                for j in range(k))):
+                    # rollback replay: the offending data window is NOT
+                    # re-dispatched (its steps were flagged before the
+                    # rollback); iteration still advances so the rng
+                    # schedule and trigger windows stay aligned with the
+                    # original attempt
+                    loop_state.iteration += k
+                    monitor.note_replay_skip(k)
+                    if mgr is not None and _fired_within(
+                            ckpt_trigger, loop_state, prev_iter):
+                        self._save_checkpoint(mgr, loop_state, params,
+                                              opt_state, net_state)
+                    self._maybe_preempt(mgr, loop_state, params, opt_state,
                                         net_state)
-                    params, opt_state, net_state, l = self._scan_step(
-                        params, opt_state, net_state, base_rng, it0,
-                        bx_d, by_d)
-                    self._segment_end()
+                    if _fired_within(end_trigger, loop_state, prev_iter):
+                        stop = True
+                        break
+                    continue
+                if scan_steps > 1:
+                    it0 = jnp.asarray(prev_iter, jnp.int32)
+                    if monitor is None:
+                        t0 += self._maybe_compute_flops(
+                            self._scan_step,
+                            (params, opt_state, net_state, base_rng, it0,
+                             bx_d, by_d), k * batch_size)
+                        self._segment_begin(mgr, loop_state, params,
+                                            opt_state, net_state)
+                        params, opt_state, net_state, l = self._scan_step(
+                            params, opt_state, net_state, base_rng, it0,
+                            bx_d, by_d)
+                        self._segment_end()
+                    else:
+                        fault = (np.stack([self._fault_input()
+                                           for _ in range(k)])
+                                 if sen.faults
+                                 else no_fault_chunk[:k])
+                        t0 += self._maybe_compute_flops(
+                            self._scan_step,
+                            (params, opt_state, net_state, sstate,
+                             base_rng, it0, bx_d, by_d, fault),
+                            k * batch_size)
+                        self._segment_begin(mgr, loop_state, params,
+                                            opt_state, net_state)
+                        (params, opt_state, net_state, sstate, l,
+                         flags) = self._scan_step(
+                             params, opt_state, net_state, sstate,
+                             base_rng, it0, bx_d, by_d, fault)
+                        self._segment_end()
+                        monitor.push(prev_iter, flags)
                     loop_state.iteration += k
                     n_seen += k * batch_size
                 else:
                     step_rng = jax.random.fold_in(base_rng, prev_iter)
-                    t0 += self._maybe_compute_flops(
-                        self._train_step,
-                        (params, opt_state, net_state, step_rng, bx_d, by_d),
-                        batch_size)
-                    self._segment_begin(mgr, loop_state, params, opt_state,
-                                        net_state)
-                    params, opt_state, net_state, l = self._train_step(
-                        params, opt_state, net_state, step_rng, bx_d, by_d)
-                    self._segment_end()
+                    if monitor is None:
+                        t0 += self._maybe_compute_flops(
+                            self._train_step,
+                            (params, opt_state, net_state, step_rng, bx_d,
+                             by_d), batch_size)
+                        self._segment_begin(mgr, loop_state, params,
+                                            opt_state, net_state)
+                        params, opt_state, net_state, l = self._train_step(
+                            params, opt_state, net_state, step_rng, bx_d,
+                            by_d)
+                        self._segment_end()
+                    else:
+                        fault = (self._fault_input() if sen.faults
+                                 else _NO_FAULT)
+                        t0 += self._maybe_compute_flops(
+                            self._train_step,
+                            (params, opt_state, net_state, sstate,
+                             step_rng, fault, bx_d, by_d), batch_size)
+                        self._segment_begin(mgr, loop_state, params,
+                                            opt_state, net_state)
+                        (params, opt_state, net_state, sstate, l,
+                         flags) = self._train_step(
+                             params, opt_state, net_state, sstate,
+                             step_rng, fault, bx_d, by_d)
+                        self._segment_end()
+                        monitor.push(prev_iter, flags)
                     loop_state.iteration += 1
                     n_seen += batch_size
                 losses.append(l)
@@ -1401,8 +1880,21 @@ class TrainingLoop:
                     stop = True
                     break
             completed = not stop  # stop=True means the epoch was cut short
-            epoch_loss = (float(jnp.mean(jnp.concatenate(
-                [jnp.atleast_1d(l) for l in losses]))) if losses else float("nan"))
+            if monitor is not None:
+                # drain every pending flag first (escalation may raise
+                # here, BEFORE the boundary checkpoint below); in recover
+                # mode skipped steps' losses were never applied and are
+                # excluded from the epoch mean
+                lv = (np.concatenate([np.atleast_1d(np.asarray(l))
+                                      for l in losses])
+                      if losses else np.zeros(0, np.float32))
+                lmask = monitor.loss_mask(len(lv))
+                epoch_loss = (float(lv[lmask].mean()) if lmask.any()
+                              else float("nan"))
+            else:
+                epoch_loss = (float(jnp.mean(jnp.concatenate(
+                    [jnp.atleast_1d(l) for l in losses])))
+                    if losses else float("nan"))
             dt = time.time() - t0
             self._observe_fit_metrics(n_seen // batch_size, dt, n_seen)
             history["loss"].append(epoch_loss)
@@ -1448,9 +1940,19 @@ class TrainingLoop:
                 loss_vec = (np.concatenate(
                     [np.atleast_1d(np.asarray(l)) for l in losses])
                     if losses else np.zeros(0))
-                start_it = loop_state.iteration - len(loss_vec)
+                if (monitor is not None
+                        and len(monitor.epoch_step_iters) == len(loss_vec)):
+                    # replay-skipped windows advance the iteration
+                    # counter without recording losses — the monitor's
+                    # per-step iteration log keeps each point on its
+                    # real x position
+                    loss_its = [i + 1 for i in monitor.epoch_step_iters]
+                else:
+                    start_it = loop_state.iteration - len(loss_vec)
+                    loss_its = [start_it + j + 1
+                                for j in range(len(loss_vec))]
                 for j, lv in enumerate(loss_vec):
-                    tb.add_scalar("Loss", float(lv), start_it + j + 1)
+                    tb.add_scalar("Loss", float(lv), loss_its[j])
                 tb.add_scalar("Throughput", record["throughput"],
                               loop_state.iteration)
                 lr = getattr(model, "_lr", None)

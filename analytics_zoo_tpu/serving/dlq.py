@@ -365,12 +365,22 @@ class DeadLetterQueue:
     def replay(self, backend, stream: str = INPUT_STREAM,
                segment: Optional[str] = None,
                uris: Optional[List[str]] = None,
-               include_open: bool = False) -> int:
+               include_open: bool = False,
+               rate: Optional[float] = None,
+               sleep=time.sleep) -> int:
         """Re-enqueue dead-lettered records onto the input stream with
         FRESH trace ids (``replay_of`` carries the original id so the
         event log links both lifetimes). At-most-once: each segment is
         renamed ``*.replayed`` BEFORE its first record is re-enqueued —
         a crash mid-replay under-delivers, never double-delivers.
+
+        ``rate`` (records/second, ``zoo-dlq replay --rate N``) paces the
+        re-enqueues on a fixed schedule (record i is enqueued no earlier
+        than ``i/rate`` seconds after the first) so a large replay
+        cannot itself stand the backlog above the server's shed
+        watermark and re-dead-letter the very records being recovered.
+        Unpaced replay (the default) is the drain-at-full-speed mode
+        for a server with shedding off or ample headroom.
 
         This instance's OWN active segment is sealed first (it holds the
         writer, so that is always safe); other ``.open`` segments on
@@ -381,6 +391,11 @@ class DeadLetterQueue:
         re-enqueues only matching records but still retires the whole
         segment — the skipped records are abandoned, and the count is
         logged loudly. Returns the number of records re-enqueued."""
+        if rate is not None and rate <= 0:
+            # validated before ANY side effect: sealing/renaming happens
+            # below, and a rejected argument must leave the directory
+            # exactly as it found it
+            raise ValueError(f"replay rate must be > 0 records/s ({rate})")
         with self._lock:
             self._seal_active_locked()
             targets = []
@@ -403,6 +418,7 @@ class DeadLetterQueue:
                     s = dict(s, name=os.path.basename(sealed))
                 targets.append(s["name"])
         replayed = skipped = 0
+        t0 = time.monotonic()
         for name in targets:
             path = os.path.join(self.directory, name)
             done = path + ".replayed"
@@ -426,6 +442,14 @@ class DeadLetterQueue:
                 }
                 if rec.get("trace"):
                     fields["replay_of"] = rec["trace"]
+                if rate is not None and replayed:
+                    # fixed schedule, not inter-record gaps: a slow xadd
+                    # does not compound the pace, and the total duration
+                    # is deterministic at (n-1)/rate from the first send
+                    due = t0 + replayed / rate
+                    delay = due - time.monotonic()
+                    if delay > 0:
+                        sleep(delay)
                 backend.xadd(stream, fields)
                 replayed += 1
         if skipped:

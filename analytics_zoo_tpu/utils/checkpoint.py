@@ -9,7 +9,12 @@ Format: one directory per snapshot (``ckpt-<iteration>/``) holding one
 ``.npz`` per pytree (params / opt_state / net_state — leaves in
 deterministic ``tree_flatten`` order, restored against a same-structure
 template) plus a ``manifest.json`` carrying per-tree CRC32 checksums, leaf
-counts/shapes/dtypes, and the resume metadata. The manifest is written
+counts/shapes/dtypes, the resume metadata, and (``mesh=``) the
+mesh/topology the snapshot was cut under — host leaves are
+topology-free, and the metadata is what lets an elastic restore onto a
+different device count/mesh shape say so instead of guessing
+(``pipeline/api/keras/training.py::_try_resume`` re-places the trees
+under the live mesh; malformed mesh metadata classifies as corruption). The manifest is written
 LAST (tmp file + ``os.replace``) and is the **commit marker**: a directory
 without one was never committed — a process killed mid-write can never
 produce a snapshot that a resume will trust. (This replaces the old
@@ -267,22 +272,28 @@ class CheckpointManager:
     # ---- save -------------------------------------------------------------
     def save(self, step: int, trees: Dict[str, Any],
              meta: Optional[Dict[str, Any]] = None,
-             sync: bool = False) -> str:
+             sync: bool = False, mesh: Optional[Dict[str, Any]] = None,
+             ) -> str:
         """Snapshot ``trees`` as ``ckpt-<step>``.
 
         Device arrays are fetched to host NOW (the step path pays one
         batched transfer); serialization + commit happen on a background
         writer unless ``sync=True``. Joins any previous in-flight save
         first — surfacing ITS failure — so at most one save is ever in
-        flight and failures are never silent. Returns the final snapshot
-        path (committed only once the manifest lands)."""
+        flight and failures are never silent. ``mesh`` (a
+        ``parallel.mesh.mesh_metadata`` dict) records the topology the
+        snapshot was cut under, enabling elastic cross-topology restore
+        — leaves are host-side and topology-free; the metadata lets a
+        restore under a different mesh say so instead of guessing.
+        Returns the final snapshot path (committed only once the
+        manifest lands)."""
         self.join()
         host = {name: _snapshot_leaves(tree) for name, tree in trees.items()}
         meta = {"step": step, **(meta or {})}
         final = self._dir(step)
         if sync:
             try:
-                self._write(step, host, meta, final)
+                self._write(step, host, meta, final, mesh)
             except Exception as e:
                 # Exception only: a KeyboardInterrupt/SystemExit mid-write
                 # must stay a BaseException (wrapping it would feed the
@@ -292,23 +303,24 @@ class CheckpointManager:
             return final
         box: dict = {"step": step}
         thread = threading.Thread(
-            target=self._write_guarded, args=(step, host, meta, final, box),
+            target=self._write_guarded,
+            args=(step, host, meta, final, mesh, box),
             name=f"ckpt-writer-{step}", daemon=True)
         with self._lock:
             self._pending = (thread, box)
         thread.start()
         return final
 
-    def _write_guarded(self, step, host, meta, final, box) -> None:
+    def _write_guarded(self, step, host, meta, final, mesh, box) -> None:
         try:
-            self._write(step, host, meta, final)
+            self._write(step, host, meta, final, mesh)
         except BaseException as e:   # surfaced via join(); never silent
             box["error"] = e
 
-    def _write(self, step, host, meta, final) -> None:
+    def _write(self, step, host, meta, final, mesh=None) -> None:
         t0 = time.perf_counter()
         try:
-            total = self._commit(step, host, meta, final)
+            total = self._commit(step, host, meta, final, mesh)
         except BaseException as e:
             self._m_save_fail.inc()
             self._registry.emit("ckpt.save_failure", step=step,
@@ -321,7 +333,7 @@ class CheckpointManager:
         self._registry.emit("ckpt.save", step=step, bytes=total, dur_s=dur)
         self._prune()
 
-    def _commit(self, step, host, meta, final) -> int:
+    def _commit(self, step, host, meta, final, mesh=None) -> int:
         """Write tree files, then the manifest (the commit marker) LAST.
         A crash at any earlier point leaves an uncommitted directory no
         restore will trust."""
@@ -346,6 +358,8 @@ class CheckpointManager:
                            for a in leaves]}
         manifest = {"version": _MANIFEST_VERSION, "step": step,
                     "meta": meta, "trees": tree_entries}
+        if mesh is not None:
+            manifest["mesh"] = mesh
         faults.inject("ckpt.manifest")
         tmp = os.path.join(final, MANIFEST + ".tmp")
         with open(tmp, "w") as f:
@@ -430,6 +444,17 @@ class CheckpointManager:
                     raise CheckpointCorruptError(
                         step, f"manifest entry for tree {name!r} is "
                               f"malformed")
+            mesh = manifest.get("mesh")
+            if mesh is not None:
+                # elastic restore decides placement from this — torn or
+                # hand-edited mesh metadata is corruption like any other
+                # (it must never silently mis-shard a restore)
+                if (not isinstance(mesh, dict)
+                        or not isinstance(mesh.get("axes"), dict)
+                        or not all(isinstance(v, int)
+                                   for v in mesh["axes"].values())):
+                    raise CheckpointCorruptError(
+                        step, "manifest mesh metadata is malformed")
             manifest["meta"]
             return manifest
         except CheckpointCorruptError:
@@ -571,7 +596,13 @@ class CheckpointManager:
                     step, f"{entry['file']}: {len(loaded)} leaves on disk, "
                           f"manifest says {len(entry['leaves'])}")
             trees[name] = _rebuild_tree(templates[name], loaded, path)
-        return trees, dict(manifest["meta"])
+        meta = dict(manifest["meta"])
+        if "mesh" in manifest:
+            # surfaced through restore meta so callers (the training
+            # loop's elastic _try_resume) can compare against the live
+            # mesh and report a topology change
+            meta["mesh"] = manifest["mesh"]
+        return trees, meta
 
     def _load_legacy(self, step: int, templates: Dict[str, Any],
                      ) -> Tuple[Dict[str, Any], Dict[str, Any]]:

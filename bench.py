@@ -671,6 +671,103 @@ def bench_int8_inference():
     return out
 
 
+def bench_sentinel():
+    """Anomaly-sentinel overhead at the value-model (NCF) shape
+    (ISSUE 10): recover-mode sentinels — on-device nan-loss/nan-grad/
+    spike checks, the packed flag output, and the skip selects — must
+    cost <3% step time vs the sentinel-free step, gated by
+    ``ABSOLUTE_CEILINGS["sentinel_overhead_pct"]``. Device-only
+    measurement: fused K-step scan dispatches, readback-fenced, median
+    of 5 timed windows per mode with the off/recover windows
+    INTERLEAVED (off, on, off, on, ...) so machine-load drift over the
+    run lands on both modes equally — back-to-back per-mode blocks let
+    a background-load swing between the blocks fake (or mask) the
+    delta — and the tunnel RTT can neither wash out nor fake it."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.common import anomaly as anomaly_lib
+    from analytics_zoo_tpu.common.context import get_zoo_context
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+    rng_np = np.random.default_rng(11)
+    n = SCAN_STEPS * BATCH
+    x = np.stack([rng_np.integers(1, N_USERS + 1, n).astype(np.int32),
+                  rng_np.integers(1, N_ITEMS + 1, n).astype(np.int32)],
+                 axis=1)
+    y = rng_np.integers(0, N_CLASSES, n).astype(np.int32)
+    xs = x.reshape(SCAN_STEPS, BATCH, 2)
+    ys = y.reshape(SCAN_STEPS, BATCH)
+
+    conf = get_zoo_context().conf
+    prev = conf.get("zoo.train.sentinel", "off")
+
+    def prepare(mode):
+        # conf poke + a FRESH loop: the sentinel config is resolved once
+        # per TrainingLoop, so each mode gets its own compiled step
+        conf["zoo.train.sentinel"] = mode
+        model = NeuralCF(N_USERS, N_ITEMS, N_CLASSES)
+        model.compile(optimizer="adam", loss="scce", lr=1e-3)
+        model.init_weights(sample_input=x[:BATCH])
+        loop = model._loop
+        fn = loop.build_scan_step()
+        repl = mesh_lib.replicated_sharding(loop.mesh)
+        stacked = mesh_lib.stacked_batch_sharding(loop.mesh)
+        params = jax.device_put(jax.tree.map(jnp.copy, model.params), repl)
+        net_state = jax.device_put(jax.tree.map(jnp.copy, model.net_state),
+                                   repl)
+        opt_state = jax.device_put(loop.optimizer.init(params), repl)
+        xs_d = jax.device_put(xs, stacked)
+        ys_d = jax.device_put(ys, stacked)
+        base_rng = jax.random.key(0)
+        it0 = jnp.asarray(0, jnp.int32)
+        sen_on = loop._sentinel_config().active
+        fault = np.zeros((SCAN_STEPS, 2), np.float32)
+        sstate = anomaly_lib.init_state() if sen_on else None
+
+        def dispatch(params, opt_state, net_state, sstate):
+            # donated args: re-feed outputs so buffers stay valid
+            if sen_on:
+                params, opt_state, net_state, sstate, losses, _fl = fn(
+                    params, opt_state, net_state, sstate, base_rng, it0,
+                    xs_d, ys_d, fault)
+            else:
+                params, opt_state, net_state, losses = fn(
+                    params, opt_state, net_state, base_rng, it0, xs_d,
+                    ys_d)
+            return params, opt_state, net_state, sstate, losses
+
+        box = [dispatch(params, opt_state, net_state, sstate)]  # compile
+        np.asarray(box[0][4])       # readback fence
+
+        def window(n_rep=3):
+            t0 = time.perf_counter()
+            for _ in range(n_rep):
+                box[0] = dispatch(*box[0][:4])
+            np.asarray(box[0][4])
+            return (time.perf_counter() - t0) / (n_rep * SCAN_STEPS) * 1e3
+
+        return window
+
+    try:
+        off_win = prepare("off")
+        on_win = prepare("recover")
+    finally:
+        conf["zoo.train.sentinel"] = prev
+    off_windows, on_windows = [], []
+    for _ in range(5):
+        off_windows.append(off_win())
+        on_windows.append(on_win())
+    off_ms = float(np.median(off_windows))
+    on_ms = float(np.median(on_windows))
+    overhead = (max(0.0, on_ms / off_ms - 1.0) * 100.0
+                if off_ms > 0 else 0.0)
+    return {"sentinel_off_step_ms": round(off_ms, 4),
+            "sentinel_on_step_ms": round(on_ms, 4),
+            "sentinel_overhead_pct": round(overhead, 2)}
+
+
 def bench_codec():
     """Serving wire-codec microbench: encode+decode round-trip throughput
     (MB/s of tensor payload) for the v2 raw little-endian format vs the
@@ -933,6 +1030,10 @@ def main():
     except Exception as e:
         print(f"# fused-CE microbench failed: {e!r}", file=sys.stderr)
     try:
+        out.update(bench_sentinel())
+    except Exception as e:
+        print(f"# sentinel overhead bench failed: {e!r}", file=sys.stderr)
+    try:
         out.update(bench_codec())
     except Exception as e:
         print(f"# serving codec bench failed: {e!r}", file=sys.stderr)
@@ -1050,7 +1151,13 @@ ABSOLUTE_FLOORS = {
 # can leak in; 1.1 keeps that from false-tripping while a real ≥30%
 # compute regression cannot hide
 ABSOLUTE_CEILINGS = {"int8_top1_delta_pct": 2.0,
-                     "device_step_ms": 1.1}
+                     "device_step_ms": 1.1,
+                     # recover-mode anomaly sentinels must stay under 3%
+                     # of step time at the value-model shape (ISSUE 10
+                     # acceptance) — both modes are measured device-only
+                     # in the same process, so the ratio excludes the
+                     # tunnel by construction
+                     "sentinel_overhead_pct": 3.0}
 
 
 def latest_bench_record():

@@ -202,6 +202,80 @@ def test_uri_filter_retires_whole_segment(tmp_path):
     assert q.replay(backend) == 0
 
 
+def test_replay_rate_pacing_schedule_and_validation(tmp_path):
+    """--rate N follows a fixed schedule (record i due at i/rate after
+    the first): the handed-out sleeps reconstruct it exactly, a slow
+    backend does not compound the pace, and a non-positive rate is
+    rejected before anything is retired."""
+    q = DeadLetterQueue(str(tmp_path), registry=MetricsRegistry())
+    _spill(q, 5, prefix="r")
+    q.close()
+    with pytest.raises(ValueError, match="rate"):
+        q.replay(LocalBackend(), rate=0)
+    assert q.depth == 5                     # nothing retired by the reject
+    slept = []
+    assert q.replay(LocalBackend(), rate=100.0,
+                    sleep=slept.append) == 5
+    # 4 gaps (first record goes immediately); each sleep lands the next
+    # record on its 10ms slot — monotonically growing residuals against
+    # the fixed t0 schedule, each at most its slot offset
+    assert len(slept) == 4
+    assert all(0 < s <= (i + 1) / 100.0 + 0.01
+               for i, s in enumerate(slept))
+
+
+def test_paced_replay_stays_under_shed_watermark(tmp_path):
+    """The ROADMAP follow-up closed: replaying a DLQ bigger than the shed
+    watermark into a LIVE shedding server, paced, must not re-trigger
+    shedding — every replayed record serves, zero sheds. (Unpaced, the
+    same replay stands the whole backlog above the watermark at once.)"""
+    from analytics_zoo_tpu.common.context import init_zoo_context
+    from analytics_zoo_tpu.observability import default_registry
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                           OutputQueue)
+
+    init_zoo_context()
+    m = Sequential()
+    m.add(Dense(2, input_shape=(4,), activation="softmax"))
+    m.init_weights()
+    im = InferenceModel().from_keras(m)
+    reg = MetricsRegistry()
+    backend = LocalBackend()
+
+    q = DeadLetterQueue(str(tmp_path), registry=MetricsRegistry())
+    tensors = _spill(q, 12, prefix="p")
+    q.close()
+
+    serving = ClusterServing(im, backend=backend, registry=reg,
+                             batch_size=4, block_ms=10, shed_watermark=4)
+    serving.start()
+    try:
+        # warm the jit cache first: a paced replay arriving during the
+        # first-batch compile would pile up behind it through no fault
+        # of the pacing
+        inq, outq = InputQueue(backend), OutputQueue(backend)
+        rng = np.random.default_rng(5)
+        inq.enqueue("warm-0", rng.normal(size=(4,)).astype(np.float32))
+        outq.query("warm-0", timeout=60.0)
+
+        # 12 records against watermark 4: paced at 25 rec/s the server
+        # (batch 4 per ≤10ms poll) drains between arrivals
+        assert q.replay(backend, rate=25.0) == 12
+        answered = {uri: outq.query(uri, timeout=30.0) for uri in tensors}
+    finally:
+        serving.stop(drain=False)
+    for uri, val in answered.items():
+        assert val is not None            # a value, not a shed error
+    snap = reg.snapshot()
+    shed = snap.get('zoo_serving_shed_total{reason="depth"}',
+                    {}).get("value", 0)
+    assert shed == 0, f"paced replay re-triggered shedding ({shed} shed)"
+    assert snap["zoo_serving_records_total"]["value"] == 13  # warm + 12
+
+
 # ---------------------------------------------------------------------------
 # zoo-dlq CLI (subprocess, like zoo-ckpt)
 # ---------------------------------------------------------------------------

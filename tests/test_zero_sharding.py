@@ -88,3 +88,54 @@ def test_zero_sharding_helper_picks_free_divisible_dim():
     sh2 = mesh_lib.zero_sharding_for(base, (dp + 1, 3), mesh)
     assert sh2 == base
     reset_zoo_context()
+
+
+def test_zero_sharding_elastic_restore_across_dp(tmp_path):
+    """Elastic restore under ZeRO-1 (ISSUE 10): a snapshot cut at
+    {data:8} with data-sharded moments resumes at {data:4} — the
+    restored optimizer state re-shards over the SMALLER data axis via
+    _shard_opt_state, training continues, and the post-resume loss
+    matches the uninterrupted {data:8} control."""
+    # control: 3 uninterrupted epochs at dp=8
+    reset_zoo_context()
+    init_zoo_context(conf={"zoo.train.zero_sharding": True})
+    x, y = _data()
+    mc = Sequential([Dense(32, activation="relu", input_shape=(16,)),
+                     Dense(2, activation="softmax")])
+    mc.compile(optimizer="adam", loss="scce", lr=0.01)
+    hc = mc.fit(x, y, batch_size=64, nb_epoch=3, shuffle=False)
+
+    # treatment: 2 epochs at dp=8 with checkpointing...
+    reset_zoo_context()
+    init_zoo_context(conf={"zoo.train.zero_sharding": True})
+    m = Sequential([Dense(32, activation="relu", input_shape=(16,)),
+                    Dense(2, activation="softmax")])
+    m.compile(optimizer="adam", loss="scce", lr=0.01)
+    m.set_checkpoint(str(tmp_path / "ckpt"))
+    m.fit(x, y, batch_size=64, nb_epoch=2, shuffle=False)
+
+    # ...then a "new process" on a 4-device mesh resumes epoch 3
+    mesh_lib.set_global_mesh(
+        mesh_lib.create_mesh(data=4, devices=jax.devices()[:4]))
+    m2 = Sequential([Dense(32, activation="relu", input_shape=(16,)),
+                     Dense(2, activation="softmax")])
+    m2.compile(optimizer="adam", loss="scce", lr=0.01)
+    m2.set_checkpoint(str(tmp_path / "ckpt"))
+    h = m2.fit(x, y, batch_size=64, nb_epoch=1, shuffle=False)
+    assert m2.finished_epochs == 3
+    np.testing.assert_allclose(h["loss"], hc["loss"][2:], rtol=1e-4,
+                               atol=1e-6)
+    # the moments really re-sharded over the NEW (4-wide) data axis
+    allowed = {d.id for d in jax.devices()[:4]}
+    sharded = 0
+    for leaf in jax.tree_util.tree_leaves(m2.opt_state):
+        if not isinstance(leaf, jax.Array) or leaf.ndim == 0:
+            continue
+        assert {d.id for d in leaf.sharding.device_set} <= allowed
+        spec = getattr(leaf.sharding, "spec", None)
+        if spec is not None and mesh_lib.DATA_AXIS in str(spec):
+            sharded += 1
+            shard_elems = max(s.data.size for s in leaf.addressable_shards)
+            assert shard_elems == leaf.size // 4
+    assert sharded >= 4, sharded
+    reset_zoo_context()

@@ -1740,3 +1740,94 @@ def test_zl012_suppression():
         "# zoolint: disable=ZL012 the equivalence oracle")
     assert not ids(lint_source(
         src, "analytics_zoo_tpu/pipeline/api/keras/objectives.py"), "ZL012")
+
+
+# ---------------------------------------------------------------------------
+# ZL013 — bare assert on traced values inside jit-staged bodies
+# ---------------------------------------------------------------------------
+
+ZL013_BAD = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(params, x):
+    y = jnp.dot(x, params)
+    assert y.sum() > 0, "positive activations"
+    return y
+"""
+
+ZL013_SCAN_BODY = """
+import jax
+import jax.numpy as jnp
+
+def run(xs):
+    def body(carry, x):
+        assert x > 0
+        return carry + x, carry
+    return jax.lax.scan(body, 0.0, xs)
+"""
+
+ZL013_CLEAN = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(params, x):
+    # static metadata asserts are fine — they really do run at trace time
+    assert x.ndim == 2
+    assert x.shape[0] % 8 == 0
+    assert params is not None
+    return jnp.dot(x, params)
+
+def host_side(x):
+    assert x.sum() > 0      # not jit-staged: eager, runs every call
+    return x
+"""
+
+
+def test_zl013_triggers_in_package_as_error():
+    fs = lint_source(ZL013_BAD,
+                     "analytics_zoo_tpu/pipeline/api/keras/training.py")
+    assert len(ids(fs, "ZL013")) == 1 and errors(fs)
+    msg = [f for f in fs if f.rule_id == "ZL013"][0].message
+    assert "checkify" in msg and "`y`" in msg
+
+
+def test_zl013_warning_outside_package():
+    fs = lint_source(ZL013_BAD, "examples/quick_start.py")
+    assert len(ids(fs, "ZL013")) == 1
+    assert not [f for f in fs if f.rule_id == "ZL013"
+                and f.severity == ERROR]
+
+
+def test_zl013_scan_body_params_are_traced():
+    fs = lint_source(ZL013_SCAN_BODY, "analytics_zoo_tpu/ops/x.py")
+    assert len(ids(fs, "ZL013")) == 1
+
+
+def test_zl013_clean_forms():
+    assert not ids(lint_source(
+        ZL013_CLEAN, "analytics_zoo_tpu/ops/attention.py"), "ZL013")
+
+
+def test_zl013_static_argnums_not_flagged():
+    src = """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def f(x, n):
+    assert n > 0          # static: a real Python int at trace time
+    return x * n
+"""
+    assert not ids(lint_source(
+        src, "analytics_zoo_tpu/ops/x.py"), "ZL013")
+
+
+def test_zl013_suppression():
+    src = ZL013_BAD.replace(
+        "assert y.sum() > 0, \"positive activations\"",
+        "assert y.sum() > 0  # zoolint: disable=ZL013 trace-time probe")
+    assert not ids(lint_source(
+        src, "analytics_zoo_tpu/pipeline/api/keras/training.py"), "ZL013")

@@ -84,6 +84,16 @@ DEFAULT_CONF: Dict[str, Any] = {
     #   to this append-only on-disk DLQ (scripts/zoo-dlq replays them)
     "zoo.serving.dlq_max_bytes": 64 << 20,  # DLQ disk bound; oldest sealed
     #   segment evicted first once exceeded
+    # -- fleet serving: consumer groups + coordinated backpressure ----------
+    "zoo.serving.consumer_group": "serving",  # stream consumer group each
+    #   replica joins ("" = legacy single-consumer consume-on-read)
+    "zoo.serving.claim_idle_ms": 30000,  # pending entries idle past this are
+    #   reclaimable by a surviving replica (crash-safe entry reclaim)
+    "zoo.serving.max_deliveries": 5,     # deliveries (read + reclaims) past
+    #   this dead-letter the entry instead of reclaiming it forever
+    "zoo.serving.fleet_backpressure": False,  # InputQueue.enqueue consults
+    #   the fleet registry and refuses/slows producers when EVERY live
+    #   replica reports itself saturated (FleetSaturatedError)
     "zoo.log.level": "INFO",
 }
 

@@ -33,7 +33,11 @@ Fault kinds:
 
 Sites are plain strings; the current catalog (grep ``faults.inject`` for
 ground truth): ``backend.xadd`` (``LocalBackend`` AND ``RedisBackend`` —
-chaos against a live server) / ``backend.xread`` / ``backend.stream_len``
+chaos against a live server) / ``backend.xread`` (fired by ``xread``
+AND ``xreadgroup`` — one site per serve-loop read in either mode) /
+``backend.xack`` (the post-settlement consumer-group ack; both
+backends) / ``backend.xclaim`` (the reclaim sweep's ``xautoclaim``;
+both backends) / ``backend.stream_len``
 / ``backend.set_result`` / ``backend.set_results`` (``LocalBackend``),
 ``serving.loop`` (top of each serve-loop iteration), ``serving.dispatch``
 (before every model call, retries included), ``serving.publish`` (one

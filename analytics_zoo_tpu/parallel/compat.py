@@ -1,0 +1,55 @@
+"""jax API compatibility shims for the parallel layer.
+
+The ONE place version drift between jax releases is absorbed
+(ROADMAP standing constraint): ``shard_map`` graduated from
+``jax.experimental.shard_map.shard_map`` to ``jax.shard_map``, and the
+replication-check keyword was renamed ``check_rep`` → ``check_vma``
+along the way. Callers in this package write the NEW spelling
+(``jax.shard_map``-style kwargs, ``check_vma=``); this module resolves
+whichever implementation the installed jax actually ships and maps the
+keyword accordingly, so ``parallel/ring_attention.py`` and
+``parallel/pipeline.py`` never need per-version branches of their own.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def _resolve():
+    """The installed jax's shard_map plus the name its signature uses
+    for the replication check (``check_vma`` on current jax,
+    ``check_rep`` on the older ``jax.experimental`` form; None when the
+    signature is not introspectable — kwarg passed through untouched)."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return fn, None
+    for name in ("check_vma", "check_rep"):
+        if name in params:
+            return fn, name
+    return fn, None
+
+
+_SHARD_MAP, _CHECK_KW = _resolve()
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """Feature-detected ``jax.shard_map``. Accepts the current-jax
+    keyword spelling (``check_vma=``) and forwards it under whatever
+    name the installed implementation expects; extra kwargs pass
+    through untouched."""
+    if check_vma is not None:
+        if _CHECK_KW is not None:
+            kwargs[_CHECK_KW] = check_vma
+        else:
+            kwargs["check_vma"] = check_vma
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

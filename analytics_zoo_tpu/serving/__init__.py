@@ -4,4 +4,5 @@ model serving with batching and backpressure (``serving/ClusterServing.scala``).
 from .backend import LocalBackend, QueueFullError, default_backend  # noqa: F401
 from .client import InputQueue, OutputQueue, ServingError  # noqa: F401
 from .dlq import DeadLetterQueue  # noqa: F401
+from .fleet import FleetSaturatedError, FleetView  # noqa: F401
 from .server import ClusterServing  # noqa: F401

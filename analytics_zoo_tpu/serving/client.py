@@ -214,13 +214,56 @@ def decode_payload(fields: Dict, key: str = "data") -> np.ndarray:
 class InputQueue:
     """Producer side: ``enqueue(uri, tensor)``. Blocks (up to ``timeout``)
     when the stream is at capacity — the backpressure the reference
-    implements by polling Redis used_memory against a threshold."""
+    implements by polling Redis used_memory against a threshold.
+
+    With ``fleet_backpressure`` on (or conf
+    ``zoo.serving.fleet_backpressure``), enqueue additionally consults
+    the fleet registry (``serving/fleet.py``; cached, bounded
+    staleness): when EVERY live replica reports itself saturated the
+    producer is first slowed (a backed-off wait up to
+    ``fleet_wait_s``) and then refused with
+    :class:`~analytics_zoo_tpu.serving.fleet.FleetSaturatedError` —
+    coordinated, fleet-level backpressure upstream of the stream, so
+    individual replicas' load shedding becomes the backstop rather
+    than the first line of defense."""
 
     def __init__(self, backend: Optional[LocalBackend] = None,
-                 stream: str = INPUT_STREAM, timeout: float = 30.0):
+                 stream: str = INPUT_STREAM, timeout: float = 30.0,
+                 fleet_backpressure: Optional[bool] = None,
+                 fleet_wait_s: float = 1.0,
+                 fleet_view=None):
         self.backend = backend if backend is not None else default_backend()
         self.stream = stream
         self.timeout = timeout
+        if fleet_backpressure is None:
+            from ..common.context import get_zoo_context
+            fleet_backpressure = bool(get_zoo_context().get(
+                "zoo.serving.fleet_backpressure", False))
+        self.fleet_backpressure = bool(fleet_backpressure)
+        self.fleet_wait_s = float(fleet_wait_s)
+        self._fleet_view = fleet_view
+        if self.fleet_backpressure and self._fleet_view is None:
+            from .fleet import FleetView
+            self._fleet_view = FleetView(self.backend, self.stream)
+
+    def _check_fleet(self) -> None:
+        """Slow, then refuse: wait (backed off) up to ``fleet_wait_s``
+        for the fleet to report headroom; raise once the budget is
+        spent. The cached view bounds the backend reads underneath."""
+        if not self.fleet_backpressure or self._fleet_view is None:
+            return
+        if not self._fleet_view.saturated():
+            return
+        from ..common.reliability import RetryPolicy
+        from .fleet import FleetSaturatedError
+        wait = RetryPolicy(base_delay=0.02, max_delay=0.2)
+        if not wait.wait_for(lambda: not self._fleet_view.saturated(),
+                             timeout=self.fleet_wait_s):
+            raise FleetSaturatedError(
+                f"fleet serving stream {self.stream!r} is saturated "
+                f"(every live replica above its shed watermark for "
+                f"{self.fleet_wait_s:.1f}s); enqueue refused — retry "
+                f"with backoff or scale the fleet")
 
     def enqueue(self, uri: str, data: np.ndarray,
                 trace: Optional[str] = None,
@@ -241,6 +284,7 @@ class InputQueue:
         caller has already timed out. Producers typically stamp
         ``int(time.time() * 1000) + budget_ms``. No stamp = no deadline
         (the pre-deadline contract, unchanged)."""
+        self._check_fleet()
         fields = encode_tensor(np.asarray(data))
         fields["uri"] = uri
         # falsy trace ("" from an unset upstream header) mints too —

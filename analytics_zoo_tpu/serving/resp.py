@@ -5,7 +5,9 @@ The reference hard-requires a Redis server plus the redis-py client
 (``pyzoo/zoo/serving/client.py:58-142``); here the backend speaks the actual
 wire protocol itself over one TCP socket, covering exactly the command
 subset the serving contract uses: XADD / XLEN / XREAD / XDEL (input
-stream), HSET / HGETALL / DEL / KEYS (``result:<uri>`` hashes), PING.
+stream), XGROUP / XREADGROUP / XACK / XPENDING / XCLAIM (consumer-group
+fleet serving), HSET / HGETALL / HDEL / DEL / KEYS (``result:<uri>``
+hashes + the fleet heartbeat hash), PING.
 RESP2 framing: arrays of bulk strings out, simple/bulk/integer/array
 replies in. Connections come from a small shared pool (created on demand,
 bounded by peak concurrency, like redis-py's): the serving loop's blocking
@@ -21,11 +23,14 @@ client's ``RetryPolicy`` (backoff + bounded attempts), counting each
 round in ``zoo_backend_reconnects_total{backend="resp"}``. The
 classification is per-op: every command in the serving contract is
 idempotent-in-effect (re-running XLEN/XREAD/HGETALL/KEYS/PING reads the
-same state; HSET re-writes the same fields; DEL/XDEL of a gone key is a
-no-op) EXCEPT ``XADD``, whose server-assigned entry id means a blind
-retry could enqueue — and serve, and bill — the same record twice.
-XADD therefore stays at-most-once: the error propagates to the producer,
-who owns the decision to re-enqueue. Pipelines retry as a unit only when
+same state; HSET re-writes the same fields; DEL/XDEL/HDEL/XACK of a gone
+key is a no-op; a retried XCLAIM finds nothing left idle) EXCEPT
+``XADD``, whose server-assigned entry id means a blind retry could
+enqueue — and serve, and bill — the same record twice, and
+``XREADGROUP``, whose delivery side effect (entries landing in the PEL)
+a lost reply would orphan twice over. Both stay at-most-once: the error
+propagates to the caller — the producer owns the re-enqueue decision,
+and the consumer's own reclaim sweep recovers a lost delivery. Pipelines retry as a unit only when
 every buffered command is idempotent; a retry discards all partial
 replies from the dead socket (they can never pair with the new
 connection's stream).
@@ -48,8 +53,16 @@ log = logging.getLogger("analytics_zoo_tpu.serving.resp")
 __all__ = ["RespClient", "RespError", "RespPipeline"]
 
 #: commands whose blind re-execution changes observable state — everything
-#: else in the serving contract may retry transparently (see module doc)
-_NON_IDEMPOTENT = frozenset({"XADD"})
+#: else in the serving contract may retry transparently (see module doc).
+#: XREADGROUP joins XADD: with ``>`` it DELIVERS new entries into the
+#: group's PEL — a reply lost in transit leaves them owned by this
+#: consumer, and a blind retry would pull a fresh set on top. The
+#: originals are not lost (the consumer's own reclaim sweep re-claims
+#: them once idle), so one attempt + propagate is the safe contract.
+#: XCLAIM stays retryable: an applied-then-dropped claim just means the
+#: retry finds nothing idle — the entries sit in OUR pel until the next
+#: sweep; nothing is double-applied.
+_NON_IDEMPOTENT = frozenset({"XADD", "XREADGROUP"})
 
 
 class RespError(RuntimeError):
@@ -318,8 +331,82 @@ class RespClient:
             out.append((name, decoded))
         return out
 
-    def xdel(self, stream: str, entry_id: str) -> int:
-        return int(self.command("XDEL", stream, entry_id))
+    def xdel(self, stream: str, *entry_ids: str) -> int:
+        return int(self.command("XDEL", stream, *entry_ids))
+
+    # -- consumer groups (docs/guides/SERVING.md) ----------------------------
+    def xgroup_create(self, stream: str, group: str) -> None:
+        """XGROUP CREATE from id 0 with MKSTREAM; raises RespError
+        (BUSYGROUP) when the group exists — ``RedisBackend`` swallows
+        that one, making creation idempotent at its layer."""
+        self.command("XGROUP", "CREATE", stream, group, "0", "MKSTREAM")
+
+    def xreadgroup(self, group: str, consumer: str,
+                   streams: Dict[str, str], count: Optional[int] = None,
+                   block: Optional[int] = None):
+        """Same reply shape as :meth:`xread`, read through a group
+        (non-idempotent: one attempt, see ``_NON_IDEMPOTENT``)."""
+        args: List = ["XREADGROUP", "GROUP", group, consumer]
+        if count is not None:
+            args += ["COUNT", count]
+        if block is not None:
+            args += ["BLOCK", block]
+        args += ["STREAMS"] + list(streams.keys()) + list(streams.values())
+        resp = self.command(*args)
+        if resp is None:
+            return []
+        out = []
+        for name, entries in resp:
+            decoded = []
+            for eid, kv in entries or []:
+                if kv is None:      # a deleted entry still in the PEL
+                    continue
+                fields = {kv[i]: kv[i + 1] for i in range(0, len(kv), 2)}
+                decoded.append((eid, fields))
+            out.append((name, decoded))
+        return out
+
+    def xack(self, stream: str, group: str, *entry_ids: str) -> int:
+        return int(self.command("XACK", stream, group, *entry_ids))
+
+    def xpending_range(self, stream: str, group: str, min_idle_ms: int,
+                       count: int) -> List[tuple]:
+        """Extended XPENDING, idle-filtered: ``[(id, consumer,
+        delivery_count), ...]`` for up to ``count`` entries idle at
+        least ``min_idle_ms`` — the reclaim sweep's candidate list."""
+        resp = self.command("XPENDING", stream, group, "IDLE",
+                            int(min_idle_ms), "-", "+", count) or []
+        return [(eid, consumer, int(times))
+                for eid, consumer, _idle, times in resp]
+
+    def xpending_summary(self, stream: str, group: str) -> Dict[str, int]:
+        """Summary XPENDING: per-consumer pending counts."""
+        resp = self.command("XPENDING", stream, group)
+        if not resp or resp[3] is None:
+            return {}
+        out: Dict[str, int] = {}
+        for consumer, n in resp[3]:
+            key = consumer.decode() if isinstance(consumer, bytes) \
+                else str(consumer)
+            out[key] = int(n)
+        return out
+
+    def xclaim(self, stream: str, group: str, consumer: str,
+               min_idle_ms: int, entry_ids: List[str]):
+        """``[(id, fields_or_None), ...]`` for the entries actually
+        transferred; ids whose idle clock was reset by a racing claimer
+        are simply absent from the reply."""
+        resp = self.command("XCLAIM", stream, group, consumer,
+                            int(min_idle_ms), *entry_ids) or []
+        out = []
+        for item in resp:
+            if item is None:
+                continue
+            eid, kv = item
+            fields = None if kv is None else \
+                {kv[i]: kv[i + 1] for i in range(0, len(kv), 2)}
+            out.append((eid, fields))
+        return out
 
     def hset(self, key: str, mapping: Dict) -> int:
         args: List = ["HSET", key]
@@ -330,6 +417,9 @@ class RespClient:
     def hgetall(self, key: str) -> Dict[bytes, bytes]:
         resp = self.command("HGETALL", key) or []
         return {resp[i]: resp[i + 1] for i in range(0, len(resp), 2)}
+
+    def hdel(self, key: str, *fields: str) -> int:
+        return int(self.command("HDEL", key, *fields))
 
     def delete(self, key: str) -> int:
         return int(self.command("DEL", key))

@@ -76,13 +76,35 @@ collapsing (RELIABILITY.md "Overload & degradation"):
   ``serving/dlq.py`` — crash-safe, CRC-framed, byte-bounded — and
   ``scripts/zoo-dlq replay`` re-enqueues them after the outage, so a
   result-store outage delays work instead of destroying it.
+
+And it scales HORIZONTALLY as a fleet (docs/guides/SERVING.md,
+"Consumer groups & fleet serving"): by default each replica joins the
+stream's consumer group under a unique ``consumer_name`` —
+``xreadgroup`` delivers every entry to exactly one replica and tracks
+it in the group's pending-entries set until the replica ACKS it *after
+settlement* (result publish landed, or the record was answered with an
+addressable error / shed / dead-lettered — a DLQ spill counts). A
+replica that dies between read and publish therefore loses NOTHING: a
+survivor's periodic reclaim sweep (``claim_idle_ms``) takes over the
+dead peer's pending entries (``zoo_serving_reclaimed_total{from=}``)
+and re-serves them; a re-served entry that was in fact already
+answered re-answers idempotently (same uri, same value). Replicas
+heartbeat depth/pending/utilization into the fleet registry
+(``serving/fleet.py``) — producers consult it for coordinated
+backpressure, ``start()`` uses it to refuse a mixed-mode fleet (a
+legacy consume-on-read server racing a group consumer would
+double-serve), and /statusz exposes it as the ``scaling`` block an
+autoscaler can act on.
 """
 
 from __future__ import annotations
 
 import collections
+import itertools
 import logging
+import os
 import queue
+import socket
 import threading
 import time
 import traceback
@@ -95,6 +117,7 @@ from ..common import faults
 from ..common.reliability import (AIMDController, CircuitBreaker,
                                   RetryBudget, RetryPolicy)
 from ..observability import default_registry, span
+from . import fleet as fleet_lib
 from .backend import LocalBackend, default_backend
 from .client import (INPUT_STREAM, decode_payload, encode_array,
                      encode_tensor, is_v2, validate_v2)
@@ -110,9 +133,12 @@ __all__ = ["ClusterServing"]
 #: the only clock the producer and server share); ``t_deq`` is this
 #: process's ``perf_counter`` at read time (monotonic — server-side phase
 #: durations must not jump on an NTP step). ``v2`` records the request's
-#: wire version so the publisher answers in the same format.
+#: wire version so the publisher answers in the same format. ``eid`` is
+#: the stream entry id — in consumer-group mode the handle the
+#: post-settlement ack needs (None in legacy consume-on-read mode,
+#: where the read already consumed the entry).
 _Rec = collections.namedtuple("_Rec", ("uri", "trace", "t_enq", "t_deq",
-                                       "v2"))
+                                       "v2", "eid"))
 
 #: a dispatched batch whose readback is deferred: ``collect`` blocks on
 #: the device transfer, ``arena`` (may be None) returns to the pool after
@@ -127,6 +153,10 @@ _Pending = collections.namedtuple("_Pending", ("recs", "collect", "t0",
 _Item = collections.namedtuple("_Item", ("rec", "fields", "wait", "hdr"))
 
 _PUB_STOP = object()    # publisher-queue sentinel: drain, then exit
+
+#: per-process uniquifier for auto-generated consumer names — several
+#: in-process replicas (tests, bench) must not collide on hostname+pid
+_CONSUMER_SEQ = itertools.count()
 
 #: arena fast-path ceiling: the pool preallocates ``batch_size`` rows
 #: from ONE validated header, so a single max-size hostile record would
@@ -243,7 +273,14 @@ class ClusterServing:
                  batch_controller: Optional[AIMDController] = None,
                  publish_breaker: Optional[CircuitBreaker] = None,
                  dlq: Optional[DeadLetterQueue] = None,
-                 dlq_dir: Optional[str] = None):
+                 dlq_dir: Optional[str] = None,
+                 consumer_group: Optional[str] = None,
+                 consumer_name: Optional[str] = None,
+                 claim_idle_ms: Optional[float] = None,
+                 claim_sweep_s: Optional[float] = None,
+                 max_deliveries: Optional[int] = None,
+                 heartbeat_s: float = 1.0,
+                 fleet_ttl_s: float = fleet_lib.DEFAULT_TTL_S):
         self.model = model          # InferenceModel (or any .predict(x))
         self.backend = backend if backend is not None else default_backend()
         self.batch_size = int(batch_size)
@@ -262,6 +299,8 @@ class ClusterServing:
         self._pub_maxsize = max(int(publish_queue), 1)
         self._pub_queue: Optional["queue.Queue"] = None
         self._pub_thread: Optional[threading.Thread] = None
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_lock = threading.Lock()
         self._pool: Optional[ThreadPoolExecutor] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -413,6 +452,72 @@ class ClusterServing:
                 max_bytes=int(self._conf("zoo.serving.dlq_max_bytes",
                                          64 << 20)),
                 registry=m) if dlq_dir else None
+        # -- consumer groups / fleet (docs/guides/SERVING.md) ---------------
+        #: the group this replica consumes under; "" = legacy single-
+        #: consumer consume-on-read (the pre-fleet wire behavior)
+        if consumer_group is None:
+            consumer_group = str(self._conf("zoo.serving.consumer_group",
+                                            "serving"))
+        self.consumer_group = consumer_group
+        #: group mode needs the backend's group surface; a foreign
+        #: minimal backend falls back to legacy mode with a log line
+        self._group_mode = bool(consumer_group) and all(
+            hasattr(self.backend, meth)
+            for meth in ("xgroup_create", "xreadgroup", "xack",
+                         "xautoclaim"))
+        if consumer_group and not self._group_mode:
+            log.info("backend %s has no consumer-group surface; serving "
+                     "in legacy single-consumer mode",
+                     type(self.backend).__name__)
+        #: this replica's identity in the group AND the fleet registry —
+        #: stable across supervisor restarts (the same identity re-claims
+        #: its own pending entries), unique across replicas by default
+        self.consumer_name = consumer_name if consumer_name else (
+            f"{socket.gethostname()}-{os.getpid()}-"
+            f"{next(_CONSUMER_SEQ)}")
+        #: pending entries idle past this are reclaimable by a survivor
+        self.claim_idle_ms = float(
+            self._conf("zoo.serving.claim_idle_ms", 30000)
+            if claim_idle_ms is None else claim_idle_ms)
+        if self.claim_idle_ms <= 0:
+            raise ValueError("claim_idle_ms must be > 0")
+        #: how often this replica sweeps for reclaimable entries —
+        #: default half the idle threshold, so a dead peer's entries
+        #: wait at most ~1.5x claim_idle_ms before a survivor takes over
+        self.claim_sweep_s = float(
+            max(self.claim_idle_ms / 2000.0, 0.01)
+            if claim_sweep_s is None else claim_sweep_s)
+        #: an entry delivered (read + reclaims) more than this many
+        #: times is poison hopping replica to replica: dead-letter it
+        #: addressably instead of reclaiming it forever
+        self.max_deliveries = int(
+            self._conf("zoo.serving.max_deliveries", 5)
+            if max_deliveries is None else max_deliveries)
+        self.heartbeat_s = float(heartbeat_s)
+        self.fleet_ttl_s = float(fleet_ttl_s)
+        self._m_acks = m.counter(
+            "zoo_serving_acks_total",
+            "stream entries acked (settled) out of the consumer group's "
+            "pending-entries set")
+        self._m_pending = m.gauge(
+            "zoo_serving_pending_entries",
+            "entries delivered to THIS consumer and not yet acked")
+        self._m_util = m.gauge(
+            "zoo_serving_utilization",
+            "busy-dispatch fraction of the serve loop between heartbeats "
+            "(0 = idle poll, 1 = saturated) — the autoscaler signal")
+        self._last_sweep = 0.0
+        self._last_hb = 0.0
+        self._busy_s = 0.0
+        self._util_anchors: Dict[str, Tuple[float, float]] = {}
+        self._killed = False
+
+    @property
+    def _mode(self) -> str:
+        """The fleet-registry mode string the mixed-version guard
+        compares: ``group:<name>`` or ``single``."""
+        return (f"group:{self.consumer_group}" if self._group_mode
+                else "single")
 
     @staticmethod
     def _conf(key: str, default):
@@ -486,7 +591,16 @@ class ClusterServing:
         thread = self._thread
         pub = self._pub_queue
         try:
-            depth = self.backend.stream_len(self.stream)
+            # same backlog semantics as _stream_depth/_heartbeat: on
+            # real Redis XLEN counts every replica's delivered-but-
+            # unacked entries, which would double-count the separately
+            # reported pending_entries and tell an autoscaler an idle
+            # fleet is backed up
+            if self._group_mode and hasattr(self.backend, "backlog_len"):
+                depth = self.backend.backlog_len(self.stream,
+                                                 self.consumer_group)
+            else:
+                depth = self.backend.stream_len(self.stream)
         except Exception as e:      # a dead backend must not 500 /healthz
             depth = None
             log.debug("stream_len failed on the scrape thread: %s", e)
@@ -524,16 +638,148 @@ class ClusterServing:
             overload["dlq_records"] = self._dlq._m_records.value
             overload["dlq_bytes"] = self._dlq._m_bytes.value
         info["serving"]["overload"] = overload
+        # the scaling block: what an autoscaler reads off /statusz —
+        # per-replica backlog, in-flight pending entries, and the
+        # busy-dispatch fraction since the last scrape
+        info["serving"]["scaling"] = {
+            "consumer": self.consumer_name,
+            "group": self.consumer_group if self._group_mode else None,
+            "stream_depth": depth,
+            "pending_entries": self._own_pending(),
+            "utilization": round(self._utilization("health"), 4),
+            "batch_size_target": overload["batch_size_target"],
+        }
         if self._crash_info:
             info["serving"]["last_crash"] = dict(self._crash_info)
         if down:
             info["status"] = "down"
         return info
 
+    def _own_pending(self) -> Optional[int]:
+        """THIS consumer's pending-entry count (delivered, unacked);
+        None in legacy mode or when the backend cannot answer."""
+        if not self._group_mode:
+            return None
+        try:
+            return int(self.backend.xpending(
+                self.stream, self.consumer_group).get(self.consumer_name, 0))
+        except Exception as e:
+            log.debug("xpending failed: %s", e)
+            return None
+
+    def _utilization(self, anchor: str) -> float:
+        """Busy-dispatch fraction of the serve loop since THIS anchor's
+        last reading (each consumer of the signal — /statusz scrapes,
+        fleet heartbeats — gets its own window). The loop accumulates
+        ``_busy_s`` over everything it does between blocking reads."""
+        now = time.perf_counter()
+        busy = self._busy_s
+        prev = self._util_anchors.get(anchor)
+        self._util_anchors[anchor] = (now, busy)
+        if prev is None or now - prev[0] <= 1e-6:
+            return 0.0
+        return min(max((busy - prev[1]) / (now - prev[0]), 0.0), 1.0)
+
+    def _heartbeat_loop(self) -> None:
+        """Dedicated heartbeat thread: keeps this replica's registry
+        entry fresh even while the serve loop is wedged in a long model
+        dispatch (the serve loop also beats opportunistically each
+        iteration — ``_last_hb`` bounds the combined cadence). Exits
+        with ``_stop``; a kill flips ``_killed`` first so the corpse
+        stops refreshing even before the event is seen."""
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self._heartbeat()
+            except Exception as e:
+                log.debug("background heartbeat failed: %s", e)
+
+    def _heartbeat(self, force: bool = False) -> None:
+        """Publish this replica's state into the fleet registry (bounded
+        cadence: ``heartbeat_s``) and refresh the pending/utilization
+        gauges. Runs on the serve loop AND the dedicated heartbeat
+        thread — ``_hb_lock`` serializes them: two concurrent beats
+        would both pass the cadence check and the second would read the
+        utilization anchor the first just wrote, publishing a spurious
+        0.0 for a busy replica (a wrong-direction autoscaler sample).
+        Failures log and drop."""
+        if self._killed:
+            return      # a corpse must not refresh its own heartbeat
+        with self._hb_lock:
+            now = time.monotonic()
+            if not force and now - self._last_hb < self.heartbeat_s:
+                return
+            self._last_hb = now
+            self._publish_heartbeat()
+
+    def _publish_heartbeat(self) -> None:
+        """One registry write + gauge refresh; caller holds ``_hb_lock``
+        and has already passed the cadence check."""
+        try:
+            depth = self._stream_depth()
+        except Exception:
+            depth = 0
+        pending = self._own_pending()
+        if pending is not None:
+            self._m_pending.set(pending)
+        util = self._utilization("heartbeat")
+        self._m_util.set(util)
+        fleet_lib.publish_member(self.backend, self.stream,
+                                 self.consumer_name, {
+            "mode": self._mode,
+            "depth": depth,
+            "pending": pending,
+            "watermark": self.shed_watermark,
+            # the replica's own saturation verdict — what fleet
+            # backpressure aggregates. Live work is backlog PLUS this
+            # replica's own in-flight (delivered, unacked) entries: a
+            # replica wedged in a long dispatch with a watermark-full
+            # queue behind it is saturated even though its backlog
+            # alone sits at the line
+            "saturated": bool(self.shed_watermark > 0
+                              and depth + (pending or 0)
+                              > self.shed_watermark),
+            "utilization": round(util, 4),
+            "batch_target": (self._batch_ctl.value if self.adaptive_batch
+                             else self.batch_size),
+        })
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ClusterServing":
         if self._thread is not None:
             raise RuntimeError("serving already started")
+        self._killed = False
+        self._busy_s = 0.0
+        self._util_anchors = {}
+        self._last_hb = 0.0
+        # mixed-version fleet guard: refuse to double-serve a stream a
+        # live peer consumes in an incompatible mode — BEFORE the first
+        # read can steal an entry out from under the other mode's
+        # accounting. Register FIRST, then check: check-then-register
+        # would let two incompatible replicas starting concurrently each
+        # pass the guard before either is visible; with our heartbeat
+        # already published, at least one of them sees the other and
+        # refuses (both refusing loudly beats both double-serving
+        # silently). The loser deregisters so it does not haunt the
+        # registry for a TTL. Raises loudly; the operator finishes the
+        # rollout one mode at a time (docs/guides/SERVING.md runbook).
+        self._heartbeat(force=True)     # registration: mode + first state
+        try:
+            fleet_lib.check_mode_conflict(self.backend, self.stream,
+                                          self.consumer_name, self._mode,
+                                          ttl_s=self.fleet_ttl_s)
+            if self._group_mode:
+                try:
+                    self.backend.xgroup_create(self.stream,
+                                               self.consumer_group)
+                except (ConnectionError, OSError) as e:
+                    raise RuntimeError(
+                        f"cannot create consumer group "
+                        f"{self.consumer_group!r} on stream "
+                        f"{self.stream!r}: {e}") from e
+        except Exception:
+            fleet_lib.remove_member(self.backend, self.stream,
+                                    self.consumer_name)
+            raise
         self._stop.clear()
         self._t_last_flush = None   # a restart must not span the downtime
         self._crash_info = {}
@@ -551,6 +797,15 @@ class ClusterServing:
             target=self._supervised, args=("serve", self._loop),
             daemon=True, name="cluster-serving")
         self._thread.start()
+        # liveness must not ride serve-loop progress: a cold-start
+        # compile or a multi-second model dispatch blocks the loop past
+        # the fleet TTL, and a stale heartbeat makes a BUSY replica look
+        # dead — peers would reclaim its in-flight entries early and a
+        # mixed-mode starter would see no live peer to conflict with
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name="cluster-serving-heartbeat")
+        self._hb_thread.start()
         return self
 
     def _supervised(self, name: str, body) -> None:
@@ -624,6 +879,44 @@ class ClusterServing:
         self._thread = None
         self._shutdown_workers(timeout)
         self._close_sinks()
+        # clean deregistration — a crash skips this and the fleet TTL
+        # reaps the stale heartbeat instead
+        fleet_lib.remove_member(self.backend, self.stream,
+                                self.consumer_name)
+
+    def kill(self, join: bool = True) -> None:
+        """Die like a SIGKILL — the chaos/testing surface behind the
+        fleet reclaim proof (``tests/test_fleet_chaos.py``).
+
+        Stops both loops WITHOUT settling anything: no drain, no result
+        publishes, no error answers, no acks, no fleet deregistration
+        (the heartbeat just goes stale past the TTL). In consumer-group
+        mode every entry this replica read but had not acked stays in
+        the group's pending-entries set under this consumer's name until
+        a surviving replica's reclaim sweep takes it over — exactly the
+        crash window the group semantics exist to close. In-flight
+        device work is abandoned (its replica permit with it). With
+        ``join`` the threads ARE joined and sinks closed so the
+        *process* stays clean — the simulated crash is at the
+        serving-protocol level, not the OS level; ``join=False`` only
+        flips the kill switch (a test whose model is still blocking the
+        loop unblocks it afterwards, then calls ``kill()`` again to
+        reap). Idempotent."""
+        self._killed = True
+        self._stop.set()
+        if not join:
+            return
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30.0)
+            if t.is_alive():
+                raise TimeoutError(
+                    "killed serve loop still running after 30s (model "
+                    "dispatch still blocked?); unblock it and call "
+                    "kill() again")
+            self._thread = None
+        self._shutdown_workers()
+        self._close_sinks()
 
     def _shutdown_workers(self, timeout: float = 30.0) -> None:
         """Join the publisher (after a drain-everything sentinel) and the
@@ -631,6 +924,10 @@ class ClusterServing:
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
+        hb = self._hb_thread
+        if hb is not None:
+            hb.join(timeout=timeout)    # exits on _stop; beats are short
+            self._hb_thread = None
         t, q = self._pub_thread, self._pub_queue
         if t is None:
             return
@@ -679,78 +976,107 @@ class ClusterServing:
         pendings: "collections.deque[_Pending]" = collections.deque()
         try:
             while not self._stop.is_set():
-                faults.inject("serving.loop")
-                # admission window: `want` records are admitted (oldest
-                # first — FIFO fairness); when the backlog stands above
-                # the shed watermark the read pulls the window's newest
-                # remainder too, purely to shed it — bounding the queue
-                # admitted records wait behind (their latency), while
-                # the shed ones get an immediate addressable error
-                # instead of a doomed wait
-                want = (self._batch_ctl.value if self.adaptive_batch
-                        else self.batch_size)
-                extra = 0
-                if self.shed_watermark > 0 \
-                        and self._breaker.state == CircuitBreaker.CLOSED:
-                    # the pre-read depth probe respects the read breaker:
-                    # while it is open/half-open the backend gets its
-                    # probe read only — an extra stream_len per poll
-                    # would burn a connection timeout against a dead
-                    # host, exactly what the breaker exists to stop
-                    overage = (self._stream_depth() - want
-                               - self.shed_watermark)
-                    if overage > 0:
-                        extra = min(overage, _SHED_MAX_PER_READ)
-                entries = self._read_entries(want + extra)
-                if not entries:
-                    self._drain(pendings)
-                    continue
-                if len(entries) > want:
-                    self._shed(entries[want:], reason="depth")
-                    entries = entries[:want]
-                # ONE stream_len per read feeds both the gauge and the
-                # drain checks below — we are the only consumer, so the
-                # backlog can only grow between here and those checks
-                # (a stale 0 errs toward flushing, never toward parking)
-                depth = self._stream_depth()
-                self._m_depth.set(depth)
-                recs, batch, arena, ragged = self._assemble(entries)
-                if self.adaptive_batch:
-                    self._update_batch_target(self._last_read_waits)
-                if not recs and not ragged:
-                    # every record in this read was undecodable: the same
-                    # drain signal applies — an empty stream means no next
-                    # batch will arrive to trigger the pending readbacks,
-                    # so they would otherwise park for up to block_ms
-                    if pendings and depth == 0:
+                it0 = time.perf_counter()
+                idle_s = 0.0
+                try:
+                    faults.inject("serving.loop")
+                    # admission window: `want` records are admitted
+                    # (oldest first — FIFO fairness); when the backlog
+                    # stands above the shed watermark the read pulls the
+                    # window's newest remainder too, purely to shed it —
+                    # bounding the queue admitted records wait behind
+                    # (their latency), while the shed ones get an
+                    # immediate addressable error instead of a doomed
+                    # wait
+                    want = (self._batch_ctl.value if self.adaptive_batch
+                            else self.batch_size)
+                    # reclaim sweep first: a dead peer's entries are the
+                    # OLDEST work in the system — they take this read's
+                    # admission slots ahead of fresh stream entries
+                    reclaimed = self._reclaim_sweep()
+                    want_read = max(want - len(reclaimed), 0)
+                    extra = 0
+                    if want_read > 0 and self.shed_watermark > 0 \
+                            and self._breaker.state == CircuitBreaker.CLOSED:
+                        # the pre-read depth probe respects the read
+                        # breaker: while it is open/half-open the backend
+                        # gets its probe read only — an extra depth probe
+                        # per poll would burn a connection timeout
+                        # against a dead host, exactly what the breaker
+                        # exists to stop
+                        overage = (self._stream_depth() - want_read
+                                   - self.shed_watermark)
+                        if overage > 0:
+                            extra = min(overage, _SHED_MAX_PER_READ)
+                    if want_read + extra > 0:
+                        t_read = time.perf_counter()
+                        entries = self._read_entries(want_read + extra)
+                        idle_s = time.perf_counter() - t_read
+                    else:
+                        entries = []
+                    if not entries and not reclaimed:
                         self._drain(pendings)
-                    continue
-                if ragged:
-                    # ragged shapes can't batch: drain the pipeline, then
-                    # serve one by one (rare path, keep it simple)
-                    self._drain(pendings)
-                    for rec, tensor in ragged:
-                        self._dispatch([rec], tensor[None], pendings)
+                        continue
+                    if len(entries) > want_read:
+                        self._shed(entries[want_read:], reason="depth")
+                        entries = entries[:want_read]
+                    entries = reclaimed + entries
+                    # ONE depth probe per read feeds both the gauge and
+                    # the drain checks below — group consumers only ADD
+                    # to each other's backlog view, so a stale 0 errs
+                    # toward flushing, never toward parking
+                    depth = self._stream_depth()
+                    self._m_depth.set(depth)
+                    recs, batch, arena, ragged = self._assemble(
+                        entries, n_reclaimed=len(reclaimed))
+                    if self.adaptive_batch:
+                        self._update_batch_target(self._last_read_waits)
+                    if not recs and not ragged:
+                        # every record in this read was undecodable: the
+                        # same drain signal applies — an empty stream
+                        # means no next batch will arrive to trigger the
+                        # pending readbacks, so they would otherwise park
+                        # for up to block_ms
+                        if pendings and depth == 0:
+                            self._drain(pendings)
+                        continue
+                    if ragged:
+                        # ragged shapes can't batch: drain the pipeline,
+                        # then serve one by one (rare path, keep it
+                        # simple)
                         self._drain(pendings)
-                if recs:
-                    self._dispatch(recs, batch, pendings, arena)
-                    while len(pendings) >= self.max_inflight:
-                        # the dispatch window: publish the oldest batch
-                        # once max_inflight are dispatched-but-unread
-                        self._flush(pendings.popleft())
-                    if pendings and depth == 0:
-                        # nothing left queued: the stream is drained and
-                        # there is no next batch to overlap with, so
-                        # deferring these readbacks would only add up to
-                        # block_ms of tail latency under trickle load
-                        # (ADVICE round 5). The queue length is the drain
-                        # signal — an under-full read is not (xread
-                        # returns on FIRST delivery, so under sustained
-                        # single-record load more work is usually queued
-                        # already and flushing would serialize the
-                        # pipeline), and a final exactly-full batch with
-                        # an empty queue must flush too
-                        self._drain(pendings)
+                        for rec, tensor in ragged:
+                            self._dispatch([rec], tensor[None], pendings)
+                            self._drain(pendings)
+                    if recs:
+                        self._dispatch(recs, batch, pendings, arena)
+                        while len(pendings) >= self.max_inflight:
+                            # the dispatch window: publish the oldest
+                            # batch once max_inflight are
+                            # dispatched-but-unread
+                            self._flush(pendings.popleft())
+                        if pendings and depth == 0:
+                            # nothing left queued: the stream is drained
+                            # and there is no next batch to overlap with,
+                            # so deferring these readbacks would only add
+                            # up to block_ms of tail latency under
+                            # trickle load (ADVICE round 5). The queue
+                            # length is the drain signal — an under-full
+                            # read is not (xread returns on FIRST
+                            # delivery, so under sustained single-record
+                            # load more work is usually queued already
+                            # and flushing would serialize the pipeline),
+                            # and a final exactly-full batch with an
+                            # empty queue must flush too
+                            self._drain(pendings)
+                finally:
+                    # utilization accounting: everything this iteration
+                    # did except the blocking read wait counts as busy;
+                    # the heartbeat publishes it (bounded cadence) into
+                    # the fleet registry and the gauges
+                    self._busy_s += max(
+                        time.perf_counter() - it0 - idle_s, 0.0)
+                    self._heartbeat()
         finally:
             self._drain(pendings)
 
@@ -778,8 +1104,19 @@ class ClusterServing:
                                 self.block_ms / 1000.0))
             return []
         try:
-            entries = self.backend.xread(self.stream, count,
-                                         block_ms=self.block_ms)
+            if self._group_mode:
+                # group read: the entry lands in the PEL under OUR name
+                # instead of being consumed — the ack (post-settlement)
+                # is what finally removes it. A transport error here MAY
+                # have delivered entries whose reply was lost; they sit
+                # in our own PEL and the reclaim sweep re-claims them
+                # once idle (XREADGROUP is never blind-retried).
+                entries = self.backend.xreadgroup(
+                    self.stream, self.consumer_group, self.consumer_name,
+                    count, block_ms=self.block_ms)
+            else:
+                entries = self.backend.xread(self.stream, count,
+                                             block_ms=self.block_ms)
         except (ConnectionError, OSError) as e:
             self._breaker.record_failure()
             log.warning("input-stream read failed (%s: %s); breaker %s",
@@ -803,12 +1140,125 @@ class ClusterServing:
         reads as 0, which errs toward flushing (never toward parking a
         dispatched batch behind a dead backend). A 0 also disables the
         shed overage for that iteration — admission control must never
-        shed on a backend blip's missing reading."""
+        shed on a backend blip's missing reading. In group mode this is
+        the UNDELIVERED backlog (``backlog_len``): on real Redis XLEN
+        still counts delivered-but-unacked entries, and counting our own
+        in-flight batch as queue depth would defeat the trickle-load
+        drain signal and inflate the shed overage."""
         try:
+            if self._group_mode and hasattr(self.backend, "backlog_len"):
+                return self.backend.backlog_len(self.stream,
+                                                self.consumer_group)
             return self.backend.stream_len(self.stream)
         except (ConnectionError, OSError) as e:
             log.debug("stream_len failed after a read: %s", e)
             return 0
+
+    def _reclaim_sweep(self) -> List[Tuple[str, dict]]:
+        """Take over pending entries whose owner has gone quiet
+        (``claim_idle_ms``) — a dead peer's in-flight reads, or our own
+        reads whose XREADGROUP reply was lost. Bounded cadence
+        (``claim_sweep_s``) and batch (``batch_size``). Reclaimed
+        entries re-enter the NORMAL pipeline — decode, dispatch,
+        publish, ack — so a record the dead peer had in fact already
+        answered simply re-answers idempotently (same uri, same
+        prediction). Entries past ``max_deliveries`` are poison hopping
+        replica to replica: answered with an addressable error and
+        settled instead of reclaiming forever. Transport failures log
+        and skip (the sweep retries next interval); a genuine bug still
+        escapes to the supervisor."""
+        if not self._group_mode:
+            return []
+        now = time.monotonic()
+        if now - self._last_sweep < self.claim_sweep_s:
+            return []
+        self._last_sweep = now
+        try:
+            claimed = self.backend.xautoclaim(
+                self.stream, self.consumer_group, self.consumer_name,
+                self.claim_idle_ms, count=self.batch_size)
+        except (ConnectionError, OSError) as e:
+            log.warning("reclaim sweep failed (%s: %s); retrying next "
+                        "interval", type(e).__name__, e)
+            return []
+        out: List[Tuple[str, dict]] = []
+        for eid, fields, prev, deliveries in claimed:
+            self.metrics.counter(
+                "zoo_serving_reclaimed_total",
+                "pending entries taken over from an idle consumer, by "
+                "previous owner",
+                labels={"from": prev}).inc()
+            self.metrics.emit("serving.reclaim", entry=eid,
+                              uri=fields.get("uri"),
+                              trace=fields.get("trace"),
+                              prev_consumer=prev, deliveries=deliveries)
+            if deliveries > self.max_deliveries:
+                log.error("entry %s (uri=%r) delivered %d times (max "
+                          "%d); dead-lettering instead of reclaiming "
+                          "forever", eid, fields.get("uri"), deliveries,
+                          self.max_deliveries)
+                self._m_dead_letter.inc()
+                self.metrics.emit("serving.dead_letter",
+                                  uri=fields.get("uri"),
+                                  trace=fields.get("trace"),
+                                  error="exceeded max deliveries")
+                self._settle_drop(
+                    fields, eid,
+                    error="dead-lettered: exceeded max deliveries")
+                continue
+            out.append((eid, fields))
+        return out
+
+    def _settle_drop(self, fields: dict, eid: Optional[str],
+                     error: str) -> None:
+        """Answer a record with an addressable error and ack it — the
+        settlement for records serving gives up on at READ time (no
+        dispatch, no trace phases in flight). The ack happens only when
+        the producer-visible answer landed (or there is no uri to
+        answer): an unanswered drop must stay pending so a later
+        reclaim can re-answer it."""
+        self._m_failures.inc()
+        self.metrics.counter(
+            "zoo_serving_failure_errors_total",
+            "failed records by error kind (model vs result-store)",
+            labels={"error": error}).inc()
+        uri = fields.get("uri")
+        if not uri:
+            self._ack([eid])
+            return
+        try:
+            self.backend.set_result(uri, {"error": error})
+        except Exception:
+            log.exception("error record for %r could not be written "
+                          "(backend down?); entry stays pending", uri)
+            return
+        self._ack([eid])
+
+    def _ack(self, eids) -> None:
+        """Settle entries out of the group's PEL — called ONLY after the
+        producer-visible outcome landed (result publish, addressable
+        error answer, shed answer, or a durable DLQ spill). An ack that
+        fails leaves the entries pending: a survivor (or this replica's
+        own next sweep) re-claims and re-answers them idempotently —
+        the at-least-once half of the exactly-once-settlement story.
+        Counts only entries actually removed, so a double ack (reclaim
+        raced a slow publish) never double-counts."""
+        if not self._group_mode:
+            return
+        eids = [e for e in eids if e]
+        if not eids:
+            return
+        try:
+            n = self.backend.xack(self.stream, self.consumer_group, *eids)
+        except Exception as e:
+            log.warning("ack of %d entries failed (%s: %s); they stay "
+                        "pending and will be re-served by a reclaim",
+                        len(eids), type(e).__name__, e)
+            self.metrics.emit("serving.ack_failed", entries=len(eids),
+                              error=f"{type(e).__name__}: {e}")
+            return
+        if n:
+            self._m_acks.inc(n)
 
     # -- overload: shedding + adaptive batch ---------------------------------
     def _shed(self, entries, reason: str) -> None:
@@ -832,12 +1282,19 @@ class ClusterServing:
             "failed records by error kind (model vs result-store)",
             labels={"error": "shed: server overloaded"}).inc(n)
         results = {}
-        for _eid, fields in entries:
+        addressable_eids = []
+        orphan_eids = []
+        for eid, fields in entries:
             uri = fields.get("uri")
             self.metrics.emit("serving.shed", reason=reason, uri=uri,
                               trace=fields.get("trace"))
             if uri:
                 results[uri] = {"error": "shed: server overloaded"}
+                addressable_eids.append(eid)
+            else:
+                orphan_eids.append(eid)
+        # no address, no answer to wait for: settled by the drop itself
+        self._ack(orphan_eids)
         if not results:
             return
         try:
@@ -849,7 +1306,10 @@ class ClusterServing:
                     self.backend.set_result(uri, fields)
         except Exception:
             log.exception("shed-error records for %d record(s) could not "
-                          "be written (backend down?)", len(results))
+                          "be written (backend down?); entries stay "
+                          "pending", len(results))
+            return
+        self._ack(addressable_eids)
 
     def _update_batch_target(self, waits) -> None:
         """One AIMD step per non-empty read. Breach = the publish
@@ -867,8 +1327,11 @@ class ClusterServing:
         self._m_batch_target.set(self._batch_ctl.update(breach))
 
     # -- batch assembly ------------------------------------------------------
-    def _assemble(self, entries):
+    def _assemble(self, entries, n_reclaimed: int = 0):
         """Decode one read into ``(recs, batch, arena, ragged)``.
+        The first ``n_reclaimed`` entries came from the reclaim sweep
+        (the loop prepends them) — they serve normally but are excluded
+        from the queue-wait signal (see ``_observe_queue_wait``).
 
         Fast path (every record wire-format v2 with one (shape, dtype),
         and ``batch_size`` rows of it within ``_MAX_ARENA_BYTES``):
@@ -886,8 +1349,9 @@ class ClusterServing:
         now_s = time.time()
         now_p = time.perf_counter()
         items: List[_Item] = []
-        for eid, fields in entries:
-            wait, t_enq = self._observe_queue_wait(eid, now_s)
+        for idx, (eid, fields) in enumerate(entries):
+            wait, t_enq = self._observe_queue_wait(
+                eid, now_s, reclaimed=idx < n_reclaimed)
             uri = fields.get("uri")
             if not uri:
                 # a decodable payload with a missing uri must be dropped
@@ -895,7 +1359,7 @@ class ClusterServing:
                 # uri with the wrong prediction, and there is no address
                 # to write an error record to
                 log.error("record with no uri dropped (entry id %s)", eid)
-                self._drop_undecodable(fields)
+                self._drop_undecodable(fields, eid)
                 continue
             verdict = self._deadline_verdict(fields, now_s)
             if verdict is not None:
@@ -903,7 +1367,8 @@ class ClusterServing:
                 # anything on a record whose producer has already given
                 # up (expired) or will have by the time a dispatch could
                 # answer it (doomed — deadline-aware admission control)
-                self._drop_expired(fields, doomed=(verdict == "doomed"))
+                self._drop_expired(fields, doomed=(verdict == "doomed"),
+                                   eid=eid)
                 continue
             hdr = None
             if is_v2(fields):
@@ -915,11 +1380,13 @@ class ClusterServing:
                     hdr = validate_v2(fields)
                 except Exception:
                     log.exception("undecodable record (uri=%r)", uri)
-                    self._drop_undecodable(fields)
+                    self._drop_undecodable(fields, eid)
                     continue
             items.append(_Item(
                 _Rec(uri, fields.get("trace"), t_enq, now_p,
-                     hdr is not None), fields, wait, hdr))
+                     hdr is not None,
+                     eid if self._group_mode else None),
+                fields, wait, hdr))
         # the adaptive-batch controller's live signal: THIS read's waits
         self._last_read_waits = [i.wait for i in items if i.wait is not None]
         recs: List[_Rec] = []
@@ -981,7 +1448,7 @@ class ClusterServing:
                 return decode_payload(item.fields)
             except Exception:
                 log.exception("undecodable record (uri=%r)", item.rec.uri)
-                self._drop_undecodable(item.fields)
+                self._drop_undecodable(item.fields, item.rec.eid)
                 return None
 
         if self._pool is not None and len(items) > 1:
@@ -1017,7 +1484,8 @@ class ClusterServing:
             return "doomed"
         return None
 
-    def _drop_expired(self, fields, doomed: bool = False) -> None:
+    def _drop_expired(self, fields, doomed: bool = False,
+                      eid: Optional[str] = None) -> None:
         """Answer an expired (or doomed — see ``_deadline_verdict``)
         record with the distinct ``deadline exceeded`` error — counted
         in its own family AND the error-labeled failure breakdown, so an
@@ -1044,13 +1512,19 @@ class ClusterServing:
                                     {"error": "deadline exceeded"})
         except Exception:
             log.exception("deadline-error record for %r could not be "
-                          "written (backend down?)", fields.get("uri"))
+                          "written (backend down?); entry stays pending",
+                          fields.get("uri"))
+            return
+        self._ack([eid])
 
-    def _drop_undecodable(self, fields) -> None:
+    def _drop_undecodable(self, fields, eid: Optional[str] = None) -> None:
         """Registry + event + (when addressable) an error record so the
         producer's ``query()`` fails fast instead of blocking out its
         full timeout. Runs on the serve loop: a result store refusing
-        the write must not escalate a dropped record into loop death."""
+        the write must not escalate a dropped record into loop death.
+        Settlement: the error answer landing (or there being no uri to
+        answer) acks the entry; a failed answer leaves it pending for a
+        reclaim to re-answer."""
         self._m_undecodable.inc()
         self.metrics.emit("serving.undecodable", uri=fields.get("uri"),
                           trace=fields.get("trace"))
@@ -1060,7 +1534,10 @@ class ClusterServing:
                                         {"error": "undecodable payload"})
             except Exception:
                 log.exception("undecodable-error record for %r could not "
-                              "be written (backend down?)", fields["uri"])
+                              "be written (backend down?); entry stays "
+                              "pending", fields["uri"])
+                return
+        self._ack([eid])
 
     def _emit_read_events(self, items) -> None:
         """The first two phase events per traced record; later phases
@@ -1075,19 +1552,30 @@ class ClusterServing:
                                   trace=rec.trace, uri=rec.uri,
                                   parent="enqueue", dur_s=item.wait)
 
-    def _observe_queue_wait(self, entry_id, now_s: float):
+    def _observe_queue_wait(self, entry_id, now_s: float,
+                            reclaimed: bool = False):
         """Enqueue→read wait from the stream entry id (both backends stamp
         ids as ``<epoch_ms>-<seq>``, the Redis-stream convention).
         Returns ``(wait_s, enqueue_epoch_s)`` for the per-request trace
         events, ``(None, None)`` on a foreign id scheme. A negative wait
         (client clock ahead of the server) clamps to zero and counts in
         ``zoo_serving_clock_skew_total`` instead of polluting the
-        distribution with a bogus near-zero-or-negative sample."""
+        distribution with a bogus near-zero-or-negative sample.
+        ``reclaimed`` entries report ``(None, t_enq)``: their age is
+        dominated by the dead peer's ``claim_idle_ms`` window, not this
+        replica's admission health — observing it would land a 30 s+
+        outlier in the queue-wait quantiles AND hand the adaptive-batch
+        AIMD controller a guaranteed-over-target p95, collapsing the
+        survivor's batch size exactly when it must absorb the dead
+        peer's load (the ``serving.reclaim`` event carries the entry id,
+        so the true age stays traceable)."""
         try:
             enq_ms = int(str(entry_id).split("-", 1)[0])
         except (TypeError, ValueError):
             return None, None   # foreign id scheme: skip, never break loop
         t_enq = enq_ms / 1000.0
+        if reclaimed:
+            return None, t_enq
         wait = now_s - t_enq
         if wait < 0:
             self._m_skew.inc()
@@ -1176,6 +1664,8 @@ class ClusterServing:
         error, not 'budget exhausted'). Runs synchronously on the serve
         loop: the crashed batch already forfeited its pipeline slot, and
         bounded-blocking here is the backpressure."""
+        if self._killed:
+            return
         if rows is None:
             self._record_failure(recs, parent="dequeue")
             return
@@ -1240,6 +1730,11 @@ class ClusterServing:
                     except Exception:
                         log.exception("DLQ spill failed for dead-lettered "
                                       "record %r", rec.uri)
+                    else:
+                        # the landed spill is the settlement: the DLQ
+                        # owns the work now, a reclaim must not re-serve
+                        # it under the operator's replay
+                        self._ack([rec.eid])
                 self._record_failure(
                     [rec], parent="dequeue",
                     error="dead-lettered: dispatch crashed repeatedly")
@@ -1264,6 +1759,8 @@ class ClusterServing:
         record count — the field that explains a latency outlier caused
         by riding in a large batch. Every successful dispatch also
         deposits into the shared retry budget (when one is attached)."""
+        if self._killed:
+            return
         if self._retry_budget is not None:
             self._retry_budget.on_success()
         n = len(recs)
@@ -1286,7 +1783,14 @@ class ClusterServing:
         refusing the error write must not kill either thread, and every
         record still gets its terminal event (emitted BEFORE the write,
         so a mid-batch write failure cannot leave later records
-        forever in-flight in a by-trace reconstruction)."""
+        forever in-flight in a by-trace reconstruction). Settlement:
+        each record whose error answer LANDED is acked; one whose write
+        failed stays pending, so a reclaim re-answers it once the
+        store recovers. Callers that already settled entries another
+        way (a durable DLQ spill) acked them first — the re-ack here
+        removes nothing and counts nothing."""
+        if self._killed:
+            return
         self._m_failures.inc(len(recs))
         # error-labeled breakdown in its OWN family (a labeled series
         # under zoo_serving_failures_total would double-count every
@@ -1298,6 +1802,7 @@ class ClusterServing:
             "failed records by error kind (model vs result-store)",
             labels={"error": error}).inc(len(recs))
         self.metrics.emit("serving.failure", records=len(recs), error=error)
+        answered = []
         for rec in recs:
             if rec.trace is not None:
                 self.metrics.emit("request", phase="failed", trace=rec.trace,
@@ -1307,6 +1812,9 @@ class ClusterServing:
             except Exception:
                 log.exception("error record for %r could not be written "
                               "(backend down?)", rec.uri)
+                continue
+            answered.append(rec.eid)
+        self._ack(answered)
 
     # -- readback + publish --------------------------------------------------
     def _flush(self, pending: _Pending) -> None:
@@ -1318,6 +1826,12 @@ class ClusterServing:
         so a stalled result backend backpressures the loop instead of
         buffering unboundedly."""
         recs, collect, t0, arena, inputs = pending
+        if self._killed:
+            # simulated crash: abandon the readback (no publish, no
+            # error answer, no ack) — a real SIGKILL would have died
+            # holding exactly this in-flight work
+            self._arena_pool.release(arena)
+            return
         try:
             with span("serving.flush", registry=self.metrics,
                       records=len(recs)):
@@ -1357,19 +1871,27 @@ class ClusterServing:
             return
         self._m_backlog.set(self._pub_queue.qsize())
 
-    def _spill_publish(self, recs, inputs, error: str) -> None:
+    def _spill_publish(self, recs, inputs, error: str) -> List[str]:
         """Spill a batch the publisher gave up on to the durable DLQ —
         the original request payloads, so ``zoo-dlq replay`` can re-serve
         them after the result store recovers. No-op without a DLQ (or
-        for batches dispatched before one was attached)."""
+        for batches dispatched before one was attached). A landed spill
+        IS settlement: the spilled entries are acked out of the group's
+        PEL here (the work is durably owned by the DLQ now — a reclaim
+        re-serving it would race the operator's replay)."""
         if self._dlq is None or inputs is None:
-            return
+            return []
+        spilled = []
         for i, rec in enumerate(recs):
             try:
                 self._dlq.append(rec.uri, inputs[i], reason="publish",
                                  trace=rec.trace, error=error)
             except Exception:
                 log.exception("DLQ spill failed for %r", rec.uri)
+                continue
+            spilled.append(rec.eid)
+        self._ack(spilled)
+        return spilled
 
     def _publisher_loop(self) -> None:
         """The dedicated publisher thread: drains the bounded queue in
@@ -1390,6 +1912,12 @@ class ClusterServing:
             if item is _PUB_STOP:
                 return
             recs, preds, t0, inputs = item
+            if self._killed:
+                # simulated crash (kill()): drop without publishing,
+                # answering, or acking — the entries stay pending for a
+                # surviving replica's reclaim
+                self._m_backlog.set(q.qsize())
+                continue
             if not self._pub_breaker.allow():
                 self._spill_publish(recs, inputs,
                                     error="publish breaker open")
@@ -1441,6 +1969,12 @@ class ClusterServing:
         else:   # foreign backend without the batched write
             for uri, fields in results.items():
                 self.backend.set_result(uri, fields)
+        # settlement: the results LANDED — ack the batch out of the
+        # group's PEL. Strictly after the publish (the lose-on-crash
+        # window this ordering closes); an ack lost here leaves the
+        # entries pending and a reclaim re-answers them idempotently —
+        # same uri, same prediction, the consumer sees one result.
+        self._ack([rec.eid for rec in recs])
         self.served += len(recs)
         self._batches += 1
         now = time.perf_counter()

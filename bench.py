@@ -879,6 +879,81 @@ def bench_serving():
     return rate
 
 
+def bench_serving_fleet():
+    """Fleet horizontal scaling: 1 vs 3 in-process ClusterServing
+    replicas sharing ONE LocalBackend stream under consumer-group
+    partitioning (serving/server.py, docs/guides/SERVING.md "Consumer
+    groups & fleet serving"). Each replica owns its own InferenceModel,
+    so the measured quantity is how well the serving DATA PLANE
+    (xreadgroup delivery, per-replica dispatch, post-publish acks)
+    spreads one stream across consumers — on the tunneled chip the
+    per-batch dispatch RTT dominates and overlaps across replicas, so
+    the expectation is near-linear; a flat number here means the stream
+    partitioning serialized."""
+    import threading
+
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                           LocalBackend, OutputQueue)
+
+    dim, n, batch = 64, 480, 32
+    rng = np.random.default_rng(11)
+    frames = rng.normal(size=(n, dim)).astype(np.float32)
+
+    def build_model():
+        m = Sequential([Dense(256, activation="relu", input_shape=(dim,)),
+                        Dense(8)])
+        m.init_weights()
+        return InferenceModel(concurrent_num=2).from_keras(m)
+
+    def run(replicas: int) -> float:
+        backend = LocalBackend(maxlen=4 * n)
+        servers = [ClusterServing(build_model(), backend=backend,
+                                  batch_size=batch, block_ms=10,
+                                  consumer_name=f"bench-{replicas}-{i}")
+                   .start() for i in range(replicas)]
+        inq, outq = InputQueue(backend), OutputQueue(backend)
+
+        def pass_once(tag: str) -> float:
+            t0 = time.perf_counter()
+
+            def producer(lo, hi):
+                for i in range(lo, hi):
+                    inq.enqueue(f"{tag}-{i}", frames[i])
+
+            threads = [threading.Thread(
+                target=producer, args=(j * n // 4, (j + 1) * n // 4))
+                for j in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for i in range(n):
+                out = outq.query(f"{tag}-{i}", timeout=120.0)
+                if out is None:
+                    raise RuntimeError(
+                        f"fleet serving record {tag}-{i} timed out — "
+                        f"throughput number would be void")
+            return n / (time.perf_counter() - t0)
+
+        try:
+            pass_once("warm")       # compile every replica's model
+            return float(np.median([pass_once(f"t{k}") for k in range(3)]))
+        finally:
+            for s in servers:
+                s.stop(drain=False)
+
+    r1 = run(1)
+    r3 = run(3)
+    return {
+        "serving_fleet_r1_records_per_sec": round(r1, 1),
+        "serving_fleet_r3_records_per_sec": round(r3, 1),
+        "serving_fleet_scaling_x": round(r3 / r1, 3),
+    }
+
+
 def main():
     from analytics_zoo_tpu import init_zoo_context
     from analytics_zoo_tpu.feature import FeatureSet
@@ -1041,6 +1116,10 @@ def main():
         out["serving_resnet50_records_per_sec"] = round(bench_serving(), 1)
     except Exception as e:
         print(f"# serving bench failed: {e!r}", file=sys.stderr)
+    try:
+        out.update(bench_serving_fleet())
+    except Exception as e:
+        print(f"# fleet serving bench failed: {e!r}", file=sys.stderr)
     # internal-counter snapshot rides along in every BENCH record: the
     # zoo_* registry families (serving counters/latencies, inference batch
     # times, train step times) make the end-to-end numbers diagnosable

@@ -1,0 +1,176 @@
+"""Backend-level consumer-group semantics (``serving/backend.py``) and
+the fleet registry primitives (``serving/fleet.py``) — the contracts the
+fleet chaos harness (``tests/test_fleet_chaos.py``) builds on:
+
+* exactly-one-consumer delivery, PEL tracking until ack, idempotent
+  acks,
+* idle-gated reclaim with atomic per-entry ownership transfer and
+  delivery counting,
+* heartbeat freshness (TTL), cached producer-side reads, the
+  all-live-members saturation rule, and the mixed-mode conflict check.
+"""
+
+import threading
+import time
+
+import pytest
+
+from analytics_zoo_tpu.serving.backend import LocalBackend
+from analytics_zoo_tpu.serving.fleet import (FleetView, check_mode_conflict,
+                                             live_members, publish_member,
+                                             remove_member)
+
+
+def _seed(backend, stream, n):
+    backend.xgroup_create(stream, "g")
+    return [backend.xadd(stream, {"uri": f"u{i}"}) for i in range(n)]
+
+
+def test_group_delivers_each_entry_to_exactly_one_consumer():
+    b = LocalBackend()
+    _seed(b, "s", 6)
+    e1 = b.xreadgroup("s", "g", "c1", 4, block_ms=10)
+    e2 = b.xreadgroup("s", "g", "c2", 4, block_ms=10)
+    assert [f["uri"] for _, f in e1] == ["u0", "u1", "u2", "u3"]
+    assert [f["uri"] for _, f in e2] == ["u4", "u5"]
+    # delivered entries left the undelivered backlog but are pending
+    assert b.stream_len("s") == 0
+    assert b.backlog_len("s", "g") == 0
+    assert b.pending_len("s", "g") == 6
+    assert b.xpending("s", "g") == {"c1": 4, "c2": 2}
+    # an empty group read blocks out its window, it does not re-deliver
+    assert b.xreadgroup("s", "g", "c3", 4, block_ms=10) == []
+
+
+def test_ack_settles_and_is_idempotent():
+    b = LocalBackend()
+    _seed(b, "s", 3)
+    entries = b.xreadgroup("s", "g", "c1", 3, block_ms=10)
+    ids = [eid for eid, _ in entries]
+    assert b.xack("s", "g", *ids[:2]) == 2
+    assert b.pending_len("s", "g") == 1
+    # re-ack counts zero — the double-ack after a DLQ spill must never
+    # double-count in zoo_serving_acks_total
+    assert b.xack("s", "g", *ids[:2]) == 0
+    assert b.xack("s", "g", ids[2]) == 1
+    assert b.pending_len("s", "g") == 0
+
+
+def test_autoclaim_respects_idle_threshold_and_count():
+    b = LocalBackend()
+    _seed(b, "s", 5)
+    b.xreadgroup("s", "g", "dead", 5, block_ms=10)
+    # nothing is idle enough yet
+    assert b.xautoclaim("s", "g", "new", 10_000, count=10) == []
+    time.sleep(0.03)
+    first = b.xautoclaim("s", "g", "new", 20.0, count=2)
+    assert len(first) == 2      # the count cap holds
+    assert all(prev == "dead" and times == 2
+               for _e, _f, prev, times in first)
+    # the claim reset their idle clocks: a second sweep sees only the
+    # remaining three
+    rest = b.xautoclaim("s", "g", "other", 20.0, count=10)
+    assert len(rest) == 3
+    assert b.xpending("s", "g") == {"new": 2, "other": 3}
+    # a reclaim of one's OWN entries works too (lost-reply recovery)
+    time.sleep(0.03)
+    own = b.xautoclaim("s", "g", "new", 20.0, count=10)
+    assert len(own) == 5
+    assert all(times == 3 for _e, _f, _p, times in own)
+
+
+def test_autoclaim_is_atomic_under_concurrent_sweeps():
+    b = LocalBackend()
+    _seed(b, "s", 40)
+    delivered = b.xreadgroup("s", "g", "dead", 40, block_ms=10)
+    time.sleep(0.03)
+    out = {}
+    barrier = threading.Barrier(2)
+
+    def sweep(name):
+        barrier.wait()
+        out[name] = {e for e, *_ in b.xautoclaim("s", "g", name, 20.0,
+                                                 count=40)}
+
+    ts = [threading.Thread(target=sweep, args=(n,)) for n in ("a", "b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert out["a"] | out["b"] == {e for e, _ in delivered}
+    assert out["a"] & out["b"] == set()
+
+
+def test_group_create_is_idempotent_and_scoped():
+    b = LocalBackend()
+    b.xgroup_create("s", "g")
+    b.xgroup_create("s", "g")           # no raise
+    _seed(b, "s", 2)
+    b.xreadgroup("s", "g", "c", 2, block_ms=10)
+    # a different (stream, group) key holds its own PEL
+    assert b.pending_len("s", "other") == 0
+    assert b.pending_len("other", "g") == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet registry
+# ---------------------------------------------------------------------------
+
+def test_fleet_membership_ttl_and_clean_removal():
+    b = LocalBackend()
+    publish_member(b, "s", "r1", {"mode": "group:g", "saturated": False})
+    publish_member(b, "s", "r2", {"mode": "group:g", "saturated": True})
+    members = live_members(b, "s", ttl_s=5.0)
+    assert set(members) == {"r1", "r2"}
+    # a stale heartbeat is a dead replica
+    assert live_members(b, "s", ttl_s=0.0) in ({}, {})
+    remove_member(b, "s", "r1")
+    assert set(live_members(b, "s", ttl_s=5.0)) == {"r2"}
+    # malformed payloads (a half-written heartbeat) are skipped
+    b.fleet_set("s", "broken", "{not json")
+    assert "broken" not in live_members(b, "s", ttl_s=5.0)
+
+
+def test_fleet_view_saturation_rule_and_cache():
+    b = LocalBackend()
+    view = FleetView(b, "s", cache_s=10.0, ttl_s=5.0)
+    # zero live members: the fleet is OPEN (pre-fleet deployments and
+    # producers racing server start must not be refused)
+    assert view.saturated() is False
+    publish_member(b, "s", "r1", {"saturated": True})
+    publish_member(b, "s", "r2", {"saturated": False})
+    view = FleetView(b, "s", cache_s=10.0, ttl_s=5.0)
+    # one replica with headroom keeps the fleet open
+    assert view.saturated() is False
+    publish_member(b, "s", "r2", {"saturated": True})
+    # the cached view holds its bounded-staleness answer...
+    assert view.saturated() is False
+    # ...and a fresh view (or an expired cache) sees the saturation
+    assert FleetView(b, "s", cache_s=0.0, ttl_s=5.0).saturated() is True
+
+
+def test_mode_conflict_detection():
+    b = LocalBackend()
+    publish_member(b, "s", "old", {"mode": "single"})
+    with pytest.raises(RuntimeError, match="mode conflict"):
+        check_mode_conflict(b, "s", "new", "group:serving")
+    # same mode: no conflict; own registration: never a conflict
+    check_mode_conflict(b, "s", "peer", "single")
+    check_mode_conflict(b, "s", "old", "group:serving")
+    # two DIFFERENT group names also conflict (each would assume it owns
+    # a complete delivery accounting of the stream)
+    publish_member(b, "s2", "a", {"mode": "group:g1"})
+    with pytest.raises(RuntimeError, match="mode conflict"):
+        check_mode_conflict(b, "s2", "b", "group:g2")
+    # a stale peer cannot veto
+    check_mode_conflict(b, "s", "new", "group:serving", ttl_s=0.0)
+
+
+def test_foreign_backend_without_fleet_surface_opts_out():
+    class Minimal:
+        pass
+
+    publish_member(Minimal(), "s", "r", {"mode": "single"})     # no raise
+    assert live_members(Minimal(), "s") == {}
+    check_mode_conflict(Minimal(), "s", "r", "group:g")         # no raise
+    assert FleetView(Minimal(), "s").saturated() is False

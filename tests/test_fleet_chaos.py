@@ -1,0 +1,464 @@
+"""Fleet chaos harness — N replicas on ONE stream under consumer-group
+semantics (docs/guides/SERVING.md "Consumer groups & fleet serving"),
+with replica death, lost acks, claim races, mixed-mode fleets, and
+coordinated fleet backpressure reconciled EXACTLY:
+
+* **kill one replica mid-stream** (after ``xreadgroup``, before its
+  publish): answered + shed + dead-lettered == produced, ZERO duplicate
+  result writes, ``zoo_serving_reclaimed_total`` equals the
+  kill-window pending count, and every kill-window record is traceable
+  to a ``serving.reclaim`` event — nothing a SIGKILL'd replica held in
+  flight is lost,
+* **ack lost after publish**: the entries stay pending and the
+  replica's own reclaim sweep re-answers them idempotently (same uri,
+  same prediction — the consumer sees one result),
+* **claim races**: two survivors sweeping the same dead peer's entries
+  — exactly one wins each entry, and an injected claim-side disconnect
+  is absorbed without a loop restart,
+* **mixed-version fleet**: a legacy single-consumer server and a
+  group-consumer server refuse to double-serve one stream — the second
+  ``start()`` fails loudly,
+* **fleet backpressure**: with every live replica saturated, producers
+  are refused AT ENQUEUE (``FleetSaturatedError``) and the replica's
+  ``zoo_serving_shed_total`` stays zero in a run where the blind-shed
+  control sheds.
+
+All waits are tiny (ms-scale claim idles and sweeps); query timeouts
+are safety nets, not sleeps.
+"""
+
+import collections
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.common.faults import FaultPlan
+from analytics_zoo_tpu.observability import MetricsRegistry, read_events
+from analytics_zoo_tpu.serving import (ClusterServing, FleetSaturatedError,
+                                       InputQueue, LocalBackend, OutputQueue)
+from analytics_zoo_tpu.serving.client import INPUT_STREAM
+from analytics_zoo_tpu.serving.fleet import FleetView
+
+GROUP = "serving"       # the default consumer group
+
+
+class _Double:
+    """Deterministic tiny model: every replica answers x * 2, so a
+    record served by ANY replica (original or reclaimer) yields the
+    identical result — what "re-answers idempotently" means."""
+
+    def predict(self, x):
+        return np.asarray(x) * 2.0
+
+
+class _Blocking(_Double):
+    """A model whose first dispatch parks until released — how a test
+    freezes a replica with entries in flight, deterministically."""
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def predict(self, x):
+        self.entered.set()
+        assert self.release.wait(timeout=30.0), "test never released model"
+        return super().predict(x)
+
+
+class _CountingBackend(LocalBackend):
+    """LocalBackend that counts result writes per uri — the
+    zero-duplicate-writes proof needs ground truth the registry cannot
+    give (a re-publish overwrites silently)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.writes = collections.Counter()
+
+    def set_result(self, uri, fields):
+        self.writes[uri] += 1
+        super().set_result(uri, fields)
+
+    def set_results(self, results):
+        for uri in results:
+            self.writes[uri] += 1
+        super().set_results(results)
+
+
+def _server(model, backend, reg, **kw):
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("block_ms", 20)
+    kw.setdefault("claim_idle_ms", 150)
+    kw.setdefault("claim_sweep_s", 0.03)
+    return ClusterServing(model, backend=backend, registry=reg, **kw)
+
+
+def _enqueue(backend, n, prefix="f"):
+    inq = InputQueue(backend)
+    rng = np.random.default_rng(17)
+    xs = {f"{prefix}-{i}": rng.normal(size=(6,)).astype(np.float32)
+          for i in range(n)}
+    for uri, x in xs.items():
+        inq.enqueue(uri, x)
+    return xs
+
+
+def _counter_total(snapshots, name):
+    """Sum one counter family across replica registries (all label
+    combinations)."""
+    return sum(v["value"] for snap in snapshots for k, v in snap.items()
+               if k.split("{", 1)[0] == name)
+
+
+def test_replica_killed_mid_stream_reconciles_exactly(tmp_path):
+    """The acceptance run: 3 replicas, one killed after ``xreadgroup``
+    but before publish. Everything the dead replica held in flight is
+    reclaimed and served by the survivors; the books balance to the
+    record."""
+    init_zoo_context()
+    backend = _CountingBackend()
+    xs = _enqueue(backend, 24)
+
+    # the victim reads its batch ALONE (survivors not started yet), so
+    # the kill window is deterministic: exactly batch_size entries,
+    # delivered to "victim", parked in its blocked dispatch
+    vm = _Blocking()
+    vreg = MetricsRegistry()
+    victim = _server(vm, backend, vreg, consumer_name="victim",
+                     claim_idle_ms=60000)
+    victim.set_json_events(str(tmp_path / "victim.jsonl"))
+    victim.start()
+    assert vm.entered.wait(10.0)
+    kill_window = backend.xpending(INPUT_STREAM, GROUP)
+    assert kill_window == {"victim": 4}
+
+    regs = [MetricsRegistry() for _ in range(2)]
+    survivors = []
+    for i, reg in enumerate(regs):
+        s = _server(_Double(), backend, reg, consumer_name=f"s{i}")
+        s.set_json_events(str(tmp_path / f"s{i}.jsonl"))
+        survivors.append(s.start())
+
+    # kill after xreadgroup, before publish: flip the kill switch while
+    # the dispatch is still parked, then release it — the dead replica
+    # computes its predictions but publishes, answers, and acks NOTHING
+    victim.kill(join=False)
+    vm.release.set()
+    victim.kill()
+
+    outq = OutputQueue(backend)
+    got = {uri: outq.query(uri, timeout=20.0) for uri in xs}
+    for s in survivors:
+        s.stop()
+
+    # zero lost records, every answer correct (reclaimed ones included)
+    for uri, x in xs.items():
+        assert got[uri] is not None, f"lost record {uri}"
+        np.testing.assert_allclose(got[uri], x * 2.0, rtol=1e-6)
+
+    snaps = [r.snapshot() for r in regs]
+    answered = _counter_total(snaps, "zoo_serving_records_total")
+    shed = _counter_total(snaps, "zoo_serving_shed_total")
+    dead = _counter_total(snaps, "zoo_serving_dead_letter_total")
+    # answered + shed + dead-lettered == produced, exactly — and the
+    # victim answered nothing
+    assert (answered, shed, dead) == (24, 0, 0)
+    assert victim.served == 0
+    # the reclaim ledger: exactly the kill window, all from the victim
+    assert _counter_total(snaps, "zoo_serving_reclaimed_total") == 4
+    for snap in snaps:
+        for key, v in snap.items():
+            if key.startswith("zoo_serving_reclaimed_total"):
+                assert key == 'zoo_serving_reclaimed_total{from="victim"}'
+    # every entry settled: 24 acks, empty PEL, zero duplicate writes
+    assert _counter_total(snaps, "zoo_serving_acks_total") == 24
+    assert backend.pending_len(INPUT_STREAM, GROUP) == 0
+    dup = {u: c for u, c in backend.writes.items() if c != 1}
+    assert not dup, f"duplicate result writes: {dup}"
+
+    # every kill-window record traceable to a reclaim event
+    reclaims = []
+    for i in range(2):
+        reclaims += read_events(str(tmp_path / f"s{i}.jsonl"),
+                                kind="serving.reclaim")
+    assert len(reclaims) == 4
+    killed_uris = {e["uri"] for e in reclaims}
+    assert all(e["prev_consumer"] == "victim" for e in reclaims)
+    assert killed_uris <= set(xs)
+
+    # zero orphaned traces: every record's trace ends in exactly one
+    # publish phase (the victim's partial enqueue/dequeue phases are
+    # superseded by the reclaimer's full set, never left dangling)
+    events = []
+    for name in ("victim", "s0", "s1"):
+        events += read_events(str(tmp_path / f"{name}.jsonl"),
+                              kind="request")
+    by_trace = {}
+    for e in events:
+        by_trace.setdefault(e["trace"], []).append(e["phase"])
+    assert len(by_trace) == 24
+    for trace, phases in by_trace.items():
+        assert phases.count("publish") == 1, (trace, phases)
+        assert "failed" not in phases, (trace, phases)
+
+
+def test_ack_lost_after_publish_reclaim_reanswers_idempotently():
+    """The ack is the LAST step: results published, then the ack write
+    drops (injected disconnect at ``backend.xack``). The entries stay
+    pending, the replica's own sweep re-claims them, the batch re-serves
+    and re-answers with the identical prediction, and the second ack
+    settles — the consumer sees one correct result, the books count the
+    re-answer."""
+    init_zoo_context(faults_enabled=True)
+    backend = LocalBackend()
+    xs = _enqueue(backend, 4, prefix="ack")
+    reg = MetricsRegistry()
+    plan = FaultPlan(seed=5).add("backend.xack", "disconnect", at=(0,))
+    serving = _server(_Double(), backend, reg, consumer_name="solo",
+                      claim_idle_ms=100, claim_sweep_s=0.02)
+    with faults.activate(plan):
+        serving.start()
+        try:
+            # settle: all 4 acked (the SECOND ack attempt, post-reclaim)
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                snap = reg.snapshot()
+                if snap.get("zoo_serving_acks_total",
+                            {"value": 0})["value"] >= 4:
+                    break
+                time.sleep(0.01)
+            outq = OutputQueue(backend)
+            got = {uri: outq.query(uri, timeout=10.0) for uri in xs}
+        finally:
+            serving.stop(drain=False)
+    assert plan.fired == [("backend.xack", "disconnect", 0)]
+    for uri, x in xs.items():
+        assert got[uri] is not None
+        np.testing.assert_allclose(got[uri], x * 2.0, rtol=1e-6)
+    snap = reg.snapshot()
+    # the whole batch re-answered: 4 original + 4 idempotent re-answers
+    assert snap["zoo_serving_records_total"]["value"] == 8
+    assert snap['zoo_serving_reclaimed_total{from="solo"}']["value"] == 4
+    assert snap["zoo_serving_acks_total"]["value"] == 4
+    assert snap["zoo_serving_failures_total"]["value"] == 0
+    assert backend.pending_len(INPUT_STREAM, GROUP) == 0
+
+
+def test_claim_race_two_survivors_exactly_one_wins():
+    """Two survivors sweep a dead peer's pending entries CONCURRENTLY:
+    the claim transfer is atomic per entry — the union covers every
+    entry, the intersection is empty."""
+    backend = LocalBackend()
+    backend.xgroup_create("race", "g")
+    for i in range(64):
+        backend.xadd("race", {"uri": f"r{i}"})
+    delivered = backend.xreadgroup("race", "g", "dead", 64, block_ms=10)
+    assert len(delivered) == 64
+    time.sleep(0.03)
+
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def claim(name):
+        barrier.wait()
+        results[name] = backend.xautoclaim("race", "g", name, 20.0,
+                                           count=64)
+
+    threads = [threading.Thread(target=claim, args=(n,))
+               for n in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ids_a = {eid for eid, *_ in results["a"]}
+    ids_b = {eid for eid, *_ in results["b"]}
+    assert ids_a | ids_b == {eid for eid, _ in delivered}
+    assert ids_a & ids_b == set()
+    # every claimed entry's prior owner was the dead consumer, and its
+    # delivery count advanced exactly once
+    for claimed in results.values():
+        assert all(prev == "dead" and times == 2
+                   for _eid, _f, prev, times in claimed)
+
+
+def test_claim_disconnect_absorbed_without_loop_restart():
+    """An injected disconnect at ``backend.xclaim`` costs one sweep
+    interval, not a loop crash: the next sweep reclaims, every record
+    serves."""
+    init_zoo_context(faults_enabled=True)
+    backend = LocalBackend()
+    # a dead peer's in-flight entries, seeded directly at the backend
+    xs = _enqueue(backend, 4, prefix="cl")
+    backend.xgroup_create(INPUT_STREAM, GROUP)
+    assert len(backend.xreadgroup(INPUT_STREAM, GROUP, "dead", 4,
+                                  block_ms=10)) == 4
+    time.sleep(0.03)
+    reg = MetricsRegistry()
+    plan = FaultPlan(seed=9).add("backend.xclaim", "disconnect", at=(0,))
+    serving = _server(_Double(), backend, reg, consumer_name="survivor",
+                      claim_idle_ms=20, claim_sweep_s=0.02)
+    with faults.activate(plan):
+        serving.start()
+        try:
+            outq = OutputQueue(backend)
+            got = {uri: outq.query(uri, timeout=10.0) for uri in xs}
+        finally:
+            serving.stop(drain=False)
+    assert plan.fired == [("backend.xclaim", "disconnect", 0)]
+    assert all(v is not None for v in got.values())
+    snap = reg.snapshot()
+    assert snap['zoo_serving_loop_restarts_total{loop="serve"}'][
+        "value"] == 0
+    assert snap['zoo_serving_reclaimed_total{from="dead"}']["value"] == 4
+
+
+def test_mixed_mode_fleet_fails_loudly_at_start():
+    """A legacy single-consumer server and a group-consumer server on
+    one stream double-serve each other's records — the second start()
+    must refuse, whichever order the modes arrive in."""
+    init_zoo_context()
+    backend = LocalBackend()
+    legacy = ClusterServing(_Double(), backend=backend, consumer_group="",
+                            consumer_name="old").start()
+    try:
+        grouped = ClusterServing(_Double(), backend=backend,
+                                 consumer_name="new")
+        with pytest.raises(RuntimeError, match="mode conflict"):
+            grouped.start()
+    finally:
+        legacy.stop(drain=False)
+    # the clean stop deregistered the legacy replica: the group server
+    # may now take over the stream
+    grouped = ClusterServing(_Double(), backend=backend,
+                             consumer_name="new").start()
+    try:
+        with pytest.raises(RuntimeError, match="mode conflict"):
+            ClusterServing(_Double(), backend=backend, consumer_group="",
+                           consumer_name="old-2").start()
+    finally:
+        grouped.stop(drain=False)
+
+
+def test_fleet_backpressure_refuses_producers_while_blind_control_sheds():
+    """The coordinated-backpressure proof. Same saturated setup twice:
+
+    * control — producers enqueue blind; the replica's admission
+      control sheds the overage (``zoo_serving_shed_total`` > 0),
+    * treatment — producers consult the fleet registry; every enqueue
+      during saturation is REFUSED upstream (``FleetSaturatedError``),
+      the replica never sheds, and the refused records enqueue fine
+      once the fleet drains.
+
+    The preloads differ deliberately: the control's 16 stands above the
+    shed point (batch 4 + watermark 6), the treatment's 10 sits in the
+    saturated-but-not-shedding band — fleet backpressure's whole job is
+    to keep the fleet in that band by refusing the records that would
+    have pushed it over."""
+    init_zoo_context()
+
+    def saturated_setup(n_preload):
+        backend = LocalBackend()
+        xs = _enqueue(backend, n_preload, prefix="bp")
+        model = _Blocking()
+        reg = MetricsRegistry()
+        serving = _server(model, backend, reg, consumer_name="rep",
+                          shed_watermark=6, heartbeat_s=0.01,
+                          fleet_ttl_s=30.0)
+        serving.start()         # registration heartbeat: depth 16 > 6
+        assert model.entered.wait(10.0)     # 4 in flight, 12 queued
+        return backend, xs, model, reg, serving
+
+    # -- control: blind producers, shedding is the only defense ----------
+    backend, xs, model, reg, serving = saturated_setup(16)
+    inq = InputQueue(backend, fleet_backpressure=False)
+    rng = np.random.default_rng(3)
+    extra = {f"bp-x{i}": rng.normal(size=(6,)).astype(np.float32)
+             for i in range(5)}
+    for uri, x in extra.items():
+        inq.enqueue(uri, x)     # depth 17: far above watermark + window
+    model.release.set()
+    outq = OutputQueue(backend)
+    answered, errors = {}, {}
+    for uri in list(xs) + list(extra):
+        try:
+            answered[uri] = outq.query(uri, timeout=15.0)
+        except Exception as e:          # shed records answer with errors
+            errors[uri] = str(e)
+    serving.stop(drain=False)
+    control_shed = _counter_total([reg.snapshot()], "zoo_serving_shed_total")
+    assert control_shed > 0, "control run never shed — setup is wrong"
+    assert len(errors) == control_shed  # every shed answered addressably
+
+    # -- treatment: fleet-aware producers are refused upstream ----------
+    backend, xs, model, reg, serving = saturated_setup(10)
+    view = FleetView(backend, INPUT_STREAM, cache_s=0.005, ttl_s=30.0)
+    inq = InputQueue(backend, fleet_backpressure=True, fleet_wait_s=0.05,
+                     fleet_view=view)
+    refused = 0
+    pending_extra = dict(extra)
+    for uri, x in pending_extra.items():
+        with pytest.raises(FleetSaturatedError):
+            inq.enqueue(uri, x)
+        refused += 1
+    assert refused == 5
+    model.release.set()
+    # the fleet drains; the heartbeat flips saturated off; the SAME
+    # producer's retries now land
+    deadline = time.monotonic() + 15.0
+    remaining = dict(pending_extra)
+    while remaining and time.monotonic() < deadline:
+        for uri, x in list(remaining.items()):
+            try:
+                inq.enqueue(uri, x)
+                del remaining[uri]
+            except FleetSaturatedError:
+                time.sleep(0.02)
+    assert not remaining, f"refused forever: {sorted(remaining)}"
+    outq = OutputQueue(backend)
+    got = {uri: outq.query(uri, timeout=15.0)
+           for uri in list(xs) + list(extra)}
+    serving.stop()
+    assert all(v is not None for v in got.values())
+    snap = reg.snapshot()
+    # the point of the exercise: zero sheds with backpressure upstream
+    assert _counter_total([snap], "zoo_serving_shed_total") == 0
+    assert snap["zoo_serving_failures_total"]["value"] == 0
+
+
+def test_statusz_scaling_block_reports_autoscaler_signal():
+    """/statusz carries the ``scaling`` block: consumer identity, stream
+    depth, pending entries, utilization (busy-dispatch fraction), and
+    the batch target — what an autoscaler polls."""
+    init_zoo_context()
+    backend = LocalBackend()
+    reg = MetricsRegistry()
+    serving = _server(_Double(), backend, reg, consumer_name="scale-me")
+    srv = serving.serve_metrics(port=0)
+    serving.start()
+    try:
+        xs = _enqueue(backend, 12, prefix="st")
+        outq = OutputQueue(backend)
+        got = {uri: outq.query(uri, timeout=10.0) for uri in xs}
+        assert all(v is not None for v in got.values())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/statusz", timeout=10) as r:
+            status = json.loads(r.read().decode())
+        scaling = status["serving"]["scaling"]
+        assert scaling["consumer"] == "scale-me"
+        assert scaling["group"] == GROUP
+        assert scaling["stream_depth"] == 0
+        assert scaling["pending_entries"] == 0      # all acked
+        assert 0.0 <= scaling["utilization"] <= 1.0
+        assert scaling["batch_size_target"] == 4
+        # the registry twins: gauges an off-host scraper reads
+        snap = reg.snapshot()
+        assert snap["zoo_serving_pending_entries"]["value"] == 0
+        assert 0.0 <= snap["zoo_serving_utilization"]["value"] <= 1.0
+        assert snap["zoo_serving_acks_total"]["value"] == 12
+    finally:
+        serving.stop(drain=False)

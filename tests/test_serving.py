@@ -669,6 +669,18 @@ def test_status_cli_surfaces_degradation(tmp_path):
         # two per-replica lines + one fleet line
         assert len(fleet_deg) == 3
         assert f"shed {shed0:.0f} depth" in fleet_deg[-1]
+        # the scaling/autoscaler surface: one per-replica scaling line
+        # and the fleet table with its utilization column
+        scaling = [ln for ln in r2.stdout.splitlines()
+                   if ln.startswith("scaling")]
+        assert len(scaling) == 2
+        assert all("util" in ln and "pending" in ln and "depth" in ln
+                   for ln in scaling)
+        header = next(ln for ln in r2.stdout.splitlines()
+                      if ln.split()[:5] == ["replica", "depth", "pending",
+                                            "util", "batch"])
+        assert header
+        assert "fleet mean" in r2.stdout
     finally:
         for s in servers:
             s.stop(drain=False)

@@ -34,6 +34,9 @@ class _State:
         self.streams = {}   # name -> list[(id, {bytes: bytes})]
         self.hashes = {}    # key -> {bytes: bytes}
         self.seq = 0
+        # (stream, group) -> {"last": last-delivered id,
+        #                     "pel": {id: [consumer, monotonic_ms, count]}}
+        self.groups = {}
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -149,13 +152,169 @@ class _Handler(socketserver.BaseRequestHandler):
         return self._array(out)
 
     def _do_xdel(self, st, args):
-        stream, eid = args[0].decode(), args[1]
+        stream, eids = args[0].decode(), set(args[1:])
         with st.lock:
             entries = st.streams.get(stream, [])
             before = len(entries)
-            st.streams[stream] = [(i, f) for i, f in entries if i != eid]
+            st.streams[stream] = [(i, f) for i, f in entries
+                                  if i not in eids]
             st.lock.notify_all()
             return b":%d\r\n" % (before - len(st.streams[stream]))
+
+    # -- consumer groups (the command subset RedisBackend's group
+    # surface touches: XGROUP CREATE / XREADGROUP / XACK / XPENDING
+    # summary + IDLE range / XCLAIM) --------------------------------------
+    @staticmethod
+    def _id_key(eid):
+        ms, _, seq = eid.partition(b"-")
+        return (int(ms), int(seq or 0))
+
+    def _do_xgroup(self, st, args):
+        if args[0].upper() != b"CREATE":
+            return b"-ERR unsupported XGROUP subcommand\r\n"
+        key = (args[1].decode(), args[2].decode())
+        with st.lock:
+            if key in st.groups:
+                return b"-BUSYGROUP Consumer Group name already exists\r\n"
+            st.groups[key] = {"last": b"0", "pel": {}}
+        return b"+OK\r\n"
+
+    def _do_xreadgroup(self, st, args):
+        assert args[0].upper() == b"GROUP"
+        group, consumer = args[1].decode(), args[2]
+        count, block = None, None
+        i = 3
+        streams = []
+        while i < len(args):
+            a = args[i].upper()
+            if a == b"COUNT":
+                count = int(args[i + 1]); i += 2
+            elif a == b"BLOCK":
+                block = int(args[i + 1]); i += 2
+            elif a == b"STREAMS":
+                rest = args[i + 1:]
+                streams = [s.decode() for s in rest[:len(rest) // 2]]
+                i = len(args)
+        deadline = time.monotonic() + (block or 0) / 1000.0
+        out = []
+        with st.lock:
+            while True:
+                for s in streams:
+                    g = st.groups.get((s, group))
+                    if g is None:
+                        continue
+                    entries = [(eid, f) for eid, f in st.streams.get(s, [])
+                               if self._id_key(eid)
+                               > self._id_key(g["last"])]
+                    if count is not None:
+                        entries = entries[:count]
+                    if not entries:
+                        continue
+                    now_ms = time.monotonic() * 1000.0
+                    for eid, _f in entries:
+                        g["last"] = eid
+                        g["pel"][eid] = [consumer, now_ms, 1]
+                    items = [self._array([
+                        self._bulk(eid),
+                        self._array([self._bulk(x) for kv in f.items()
+                                     for x in kv])])
+                        for eid, f in entries]
+                    out.append(self._array([self._bulk(s.encode()),
+                                            self._array(items)]))
+                if out or block is None:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                st.lock.wait(remaining)
+        if not out:
+            return b"*-1\r\n"
+        return self._array(out)
+
+    def _do_xack(self, st, args):
+        key = (args[0].decode(), args[1].decode())
+        with st.lock:
+            g = st.groups.get(key)
+            n = 0
+            if g is not None:
+                for eid in args[2:]:
+                    n += g["pel"].pop(eid, None) is not None
+        return b":%d\r\n" % n
+
+    def _do_xpending(self, st, args):
+        key = (args[0].decode(), args[1].decode())
+        with st.lock:
+            g = st.groups.get(key)
+            pel = dict(g["pel"]) if g else {}
+            now_ms = time.monotonic() * 1000.0
+            if len(args) == 2:      # summary form
+                if not pel:
+                    return self._array([b":0\r\n", b"$-1\r\n", b"$-1\r\n",
+                                        b"*-1\r\n"])
+                per = {}
+                for consumer, _t, _n in pel.values():
+                    per[consumer] = per.get(consumer, 0) + 1
+                ids = sorted(pel, key=self._id_key)
+                return self._array([
+                    b":%d\r\n" % len(pel),
+                    self._bulk(ids[0]), self._bulk(ids[-1]),
+                    self._array([self._array([self._bulk(c),
+                                              self._bulk(b"%d" % n)])
+                                 for c, n in per.items()])])
+            # extended form: [IDLE ms] - + count
+            i, min_idle = 2, 0
+            if args[i].upper() == b"IDLE":
+                min_idle = int(args[i + 1]); i += 2
+            count = int(args[i + 2])
+            rows = []
+            for eid in sorted(pel, key=self._id_key):
+                consumer, t_ms, times = pel[eid]
+                idle = now_ms - t_ms
+                if idle < min_idle:
+                    continue
+                rows.append(self._array([
+                    self._bulk(eid), self._bulk(consumer),
+                    b":%d\r\n" % int(idle), b":%d\r\n" % times]))
+                if len(rows) >= count:
+                    break
+            return self._array(rows)
+
+    def _do_xclaim(self, st, args):
+        stream, group = args[0].decode(), args[1].decode()
+        consumer, min_idle = args[2], int(args[3])
+        ids = args[4:]
+        out = []
+        with st.lock:
+            g = st.groups.get((stream, group))
+            if g is None:
+                return b"*0\r\n"
+            now_ms = time.monotonic() * 1000.0
+            by_id = dict(st.streams.get(stream, []))
+            for eid in ids:
+                pe = g["pel"].get(eid)
+                if pe is None or now_ms - pe[1] < min_idle:
+                    continue    # gone or claimed by a racing survivor
+                fields = by_id.get(eid)
+                if fields is None:
+                    # entry deleted from the stream: real redis drops it
+                    # from the PEL and omits it from the reply
+                    del g["pel"][eid]
+                    continue
+                g["pel"][eid] = [consumer, now_ms, pe[2] + 1]
+                out.append(self._array([
+                    self._bulk(eid),
+                    self._array([self._bulk(x) for kv in fields.items()
+                                 for x in kv])]))
+        return self._array(out)
+
+    def _do_hdel(self, st, args):
+        key = args[0].decode()
+        with st.lock:
+            h = st.hashes.get(key, {})
+            n = 0
+            for f in args[1:]:
+                n += h.pop(f, None) is not None
+        return b":%d\r\n" % n
 
     def _do_hset(self, st, args):
         key = args[0].decode()
@@ -259,6 +418,52 @@ def test_redis_backend_stream_and_result_contract(redis_port):
     b.set_results({"x": {"value": "1"}, "y": {"value": "2"}})
     allres = b.pop_all_results()
     assert allres == {"x": {"value": b"1"}, "y": {"value": b"2"}}
+
+
+def test_redis_backend_consumer_group_contract(redis_port):
+    """The group surface over the actual wire (XGROUP / XREADGROUP /
+    XACK / XPENDING / XCLAIM): exactly-one delivery, settlement deletes
+    the entry from the stream, and an idle peer's pending entries
+    transfer to a survivor with the previous owner reported."""
+    b = RedisBackend(port=redis_port, maxlen=100)
+    b.xgroup_create("grp_stream", "g")
+    b.xgroup_create("grp_stream", "g")      # BUSYGROUP swallowed
+    for i in range(4):
+        b.xadd("grp_stream", {"uri": f"u{i}", "data": b"\x00\xff"})
+    e1 = b.xreadgroup("grp_stream", "g", "c1", 2, block_ms=100)
+    e2 = b.xreadgroup("grp_stream", "g", "c2", 2, block_ms=100)
+    assert [f["uri"] for _, f in e1] == ["u0", "u1"]
+    assert [f["uri"] for _, f in e2] == ["u2", "u3"]
+    assert e1[0][1]["data"] == b"\x00\xff"      # payloads stay binary
+    # on real Redis XLEN still counts delivered-but-unacked entries;
+    # backlog_len is the undelivered view the serve loop keys on
+    assert b.backlog_len("grp_stream", "g") == 0
+    assert b.xpending("grp_stream", "g") == {"c1": 2, "c2": 2}
+    # settlement: XACK + XDEL — the acked entry leaves XLEN too
+    assert b.xack("grp_stream", "g", e1[0][0]) == 1
+    assert b.pending_len("grp_stream", "g") == 3
+    assert b.stream_len("grp_stream") == 3
+    assert b.xack("grp_stream", "g", e1[0][0]) == 0     # idempotent
+    # survivor reclaim: c2's entries go idle, c1 takes them over
+    time.sleep(0.05)
+    claimed = b.xautoclaim("grp_stream", "g", "c1", 30, count=10)
+    assert sorted(f["uri"] for _e, f, _p, _t in claimed) == \
+        ["u1", "u2", "u3"]
+    assert {p for _e, _f, p, _t in claimed} == {"c1", "c2"}
+    assert all(t == 2 for _e, _f, _p, t in claimed)
+    # the claim reset the idle clock: nothing left to take
+    assert b.xautoclaim("grp_stream", "g", "c3", 30, count=10) == []
+    assert b.xpending("grp_stream", "g") == {"c1": 3}
+
+
+def test_redis_backend_fleet_registry_round_trip(redis_port):
+    b = RedisBackend(port=redis_port)
+    b.fleet_set("fs", "r1", '{"mode": "group:g", "ts": 1}')
+    b.fleet_set("fs", "r2", '{"mode": "group:g", "ts": 2}')
+    assert b.fleet_all("fs") == {"r1": '{"mode": "group:g", "ts": 1}',
+                                 "r2": '{"mode": "group:g", "ts": 2}'}
+    b.fleet_del("fs", "r1")
+    assert set(b.fleet_all("fs")) == {"r2"}
 
 
 def test_redis_backend_backpressure(redis_port):

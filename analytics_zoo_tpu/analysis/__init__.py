@@ -4,19 +4,30 @@ An AST linter (no code execution, no jax import) with a pluggable rule
 registry, targeting the staged-computation hazards runtime tests miss:
 PRNG key reuse, host side effects and hidden syncs under ``jit``, Python
 branches on traced values, import-time device/mesh construction, swallowed
-exceptions in serving retry paths, missing buffer donation, and
-unbatched host→device transfers in loops.
+exceptions in serving retry paths, missing buffer donation, unbatched
+host→device transfers in loops, thread-shared state without lock
+discipline, and metric naming/cardinality drift — plus a whole-project
+**contract pass** (``--contracts``) that reconciles the runtime contract
+surfaces (metric registrations, conf keys, fault sites, rule ids)
+against their documented catalogs in both directions.
 
-CLI:     ``python -m analytics_zoo_tpu.analysis [paths...]``
-Gate:    ``tests/test_zoolint.py`` (tier-1) asserts zero errors.
+CLI:     ``python -m analytics_zoo_tpu.analysis [paths...] [--contracts]
+         [--format json]``
+Gate:    ``tests/test_zoolint.py`` (tier-1) asserts zero errors and a
+         clean contract reconciliation.
 Docs:    ``docs/guides/STATIC_ANALYSIS.md``
-Silence: ``# zoolint: disable=ZL001`` on the flagged line.
+Silence: ``# zoolint: disable=ZL001`` on the flagged line (or the first
+         line of the enclosing multi-line statement).
 """
 
 from .core import (ERROR, WARNING, Finding, ModuleContext, Rule, all_rules,
                    lint_file, lint_paths, lint_source, register)
+from .project import (ProjectContext, ProjectRule, all_project_rules,
+                      lint_project, register_project)
 from .cli import main
 
 __all__ = ["ERROR", "WARNING", "Finding", "ModuleContext", "Rule",
            "all_rules", "lint_file", "lint_paths", "lint_source",
-           "register", "main"]
+           "register", "ProjectContext", "ProjectRule",
+           "all_project_rules", "lint_project", "register_project",
+           "main"]

@@ -1,24 +1,50 @@
 """``python -m analytics_zoo_tpu.analysis`` — the zoolint command line.
 
-Exit status is 1 when any ERROR-severity finding survives suppression,
-0 otherwise (warnings never gate). With no paths it scans the installed
+Exit status: **0** clean, **1** when any ERROR-severity per-file
+finding survives suppression (warnings never gate), **2** ONLY when the
+``--contracts`` project pass (whole-package symbol index + the
+code↔docs contract reconciliation, rules ZL016–ZL020) itself finds
+drift, **3** on a usage error (typo'd path/flag/rule id — never
+mistakable for drift). With no paths it scans the installed
 ``analytics_zoo_tpu`` package plus the sibling ``tests/`` directory and
 ``bench.py`` when they exist — exactly what the CI gate
-(`tests/test_zoolint.py`) runs.
+(`tests/test_zoolint.py`) runs; under ``--contracts`` each package file
+is parsed once and shared between the per-file and project passes.
+
+``--format json`` emits one finding per line as a JSON object
+(``rule``/``file``/``line``/``severity``/``message``) for CI and editor
+consumption; the human summary line moves to stderr.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import List, Optional
 
-from .core import ERROR, all_rules, lint_paths
+from .core import (ERROR, all_rules, iter_py_files, lint_context,
+                   lint_file, lint_paths)
+from .project import ProjectContext, all_project_rules, lint_project
+
+
+class _Parser(argparse.ArgumentParser):
+    """Usage errors exit 3, not argparse's default 2 — under
+    ``--contracts`` exit 2 means "the contract surface drifted", and a
+    typo'd flag must not read as phantom catalog drift to CI."""
+
+    def error(self, message):
+        self.print_usage(sys.stderr)
+        self.exit(3, f"{self.prog}: error: {message}\n")
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def default_paths() -> List[str]:
-    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = package_root()
     root = os.path.dirname(pkg)
     paths = [pkg]
     # keep in sync with tests/test_zoolint.py's gate scan — the bare CLI
@@ -37,11 +63,12 @@ def _split_ids(value: Optional[str]) -> Optional[List[str]]:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    ap = argparse.ArgumentParser(
+    ap = _Parser(
         prog="zoolint",
         description="JAX/TPU-aware static analysis for analytics_zoo_tpu "
                     "(PRNG reuse, host effects under jit, hidden syncs, "
-                    "import-time device init, ...)")
+                    "import-time device init, ...) plus the --contracts "
+                    "project pass (code↔docs catalog reconciliation)")
     ap.add_argument("paths", nargs="*",
                     help="files or directories to scan (default: the "
                          "analytics_zoo_tpu package, tests/ and bench.py)")
@@ -51,6 +78,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="comma-separated rule ids to skip")
     ap.add_argument("--errors-only", action="store_true",
                     help="print (and count) only error-severity findings")
+    ap.add_argument("--contracts", action="store_true",
+                    help="also run the whole-project pass: package-wide "
+                         "symbol index, conf-key hygiene (ZL016) and the "
+                         "four code↔docs contract reconciliations "
+                         "(ZL017-ZL020); exit 0 clean / 2 findings")
+    ap.add_argument("--docs-root", metavar="DIR",
+                    help="repository root the --contracts catalogs are "
+                         "resolved under (docs/guides/*.md, docs/CONFIG.md; "
+                         "default: the directory containing the scanned "
+                         "package)")
+    ap.add_argument("--format", choices=("human", "json"), default="human",
+                    help="output format: human lines (default) or one "
+                         "JSON object per finding")
     ap.add_argument("--list-rules", action="store_true",
                     help="print every registered rule and exit")
     args = ap.parse_args(argv)
@@ -59,6 +99,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         for rule in all_rules():
             doc = " ".join((rule.__doc__ or "").split())
             print(f"{rule.id} [{rule.severity}] {doc}")
+        for rule in all_project_rules():
+            doc = " ".join((rule.__doc__ or "").split())
+            print(f"{rule.id} [{rule.severity}] [project] {doc}")
         return 0
 
     missing = [p for p in args.paths if not os.path.exists(p)]
@@ -68,21 +111,81 @@ def main(argv: Optional[List[str]] = None) -> int:
     select, ignore = _split_ids(args.select), _split_ids(args.ignore)
     # same green-gate hazard as a typo'd path: `--select ZL0O1` would run
     # zero rules and exit 0 (ZL000 is the reserved unparseable-file id)
-    known = {r.id for r in all_rules()} | {"ZL000"}
+    known = {r.id for r in all_rules()} \
+        | {r.id for r in all_project_rules()} | {"ZL000"}
     unknown = [i for i in (select or []) + (ignore or []) if i not in known]
     if unknown:
         ap.error(f"unknown rule id(s): {', '.join(unknown)} "
                  f"(see --list-rules)")
-    findings = lint_paths(args.paths or default_paths(),
-                          select=select, ignore=ignore)
+    # `--select ZL016` without --contracts would run the project-only
+    # rule never: zero findings, exit 0 — the same green-gate hazard as
+    # an unknown id, so fail just as loudly (--ignore stays harmless)
+    if not args.contracts:
+        proj_only = {r.id for r in all_project_rules()}
+        selected_proj = [i for i in (select or []) if i in proj_only]
+        if selected_proj:
+            ap.error(f"rule id(s) {', '.join(selected_proj)} run only "
+                     f"under the project pass — add --contracts")
+    paths = args.paths or default_paths()
+    project_findings: List = []
+    if not args.contracts:
+        findings = lint_paths(paths, select=select, ignore=ignore)
+    else:
+        # the contract surfaces govern SHIPPED package code: the project
+        # pass indexes the scanned directories that are package roots
+        # (an `__init__.py` at the top), so tests/ fixtures injecting
+        # synthetic sites/metrics never pollute the reconciliation —
+        # they are still covered by the per-file rules
+        dirs = [p for p in paths if os.path.isdir(p)]
+        pkgs = [p for p in dirs
+                if os.path.isfile(os.path.join(p, "__init__.py"))]
+        roots = pkgs or dirs or paths
+        docs_root = args.docs_root
+        if docs_root is None:
+            docs_root = os.path.dirname(
+                os.path.abspath(roots[0]) if roots else package_root())
+        project = ProjectContext(roots, docs_root=docs_root)
+        # per-file rules reuse the project's already-parsed modules —
+        # one parse per package file for both passes; files outside the
+        # package roots (tests/, bench.py) parse normally, and a broken
+        # package file falls through to lint_file so ZL000 is reported
+        # exactly once, by the per-file scan
+        findings = []
+        for path in iter_py_files(paths):
+            ctx = project.by_path.get(path)
+            findings.extend(
+                lint_context(ctx, select=select, ignore=ignore)
+                if ctx is not None
+                else lint_file(path, select=select, ignore=ignore))
+        project_findings = lint_project(
+            project=project, select=select, ignore=ignore,
+            report_unparseable=False)
+        findings = findings + project_findings
+        findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
     if args.errors_only:
         findings = [f for f in findings if f.severity == ERROR]
     for f in findings:
-        print(f.format())
+        if args.format == "json":
+            print(json.dumps({"rule": f.rule_id, "file": f.path,
+                              "line": f.line, "severity": f.severity,
+                              "message": f.message}, sort_keys=True))
+        else:
+            print(f.format())
     errors = sum(1 for f in findings if f.severity == ERROR)
     warnings = len(findings) - errors
-    print(f"zoolint: {errors} error(s), {warnings} warning(s), "
-          f"{len(all_rules())} rule(s)")
+    n_rules = len(all_rules()) + (len(all_project_rules())
+                                  if args.contracts else 0)
+    summary = (f"zoolint: {errors} error(s), {warnings} warning(s), "
+               f"{n_rules} rule(s)"
+               + (" [contracts]" if args.contracts else ""))
+    # json mode keeps stdout machine-parseable: one object per line
+    print(summary, file=sys.stderr if args.format == "json" else sys.stdout)
+    if args.contracts:
+        # the exit codes stay distinguishable: 2 = the CONTRACT surface
+        # drifted (project-pass findings), 1 = only per-file code
+        # hazards (same meaning as the plain scan), 0 = clean
+        if any(f.severity == ERROR for f in project_findings):
+            return 2
     return 1 if errors else 0
 
 

@@ -1,0 +1,854 @@
+"""Contract surfaces — the code↔docs reconciliation half of the zoolint
+project pass (``analysis/project.py``).
+
+The ROADMAP's standing constraints make four runtime surfaces
+*catalogued*: every metric family must have a row in
+docs/guides/OBSERVABILITY.md, every ``zoo.*`` conf key a row in
+docs/CONFIG.md (and a ``DEFAULT_CONF`` entry in ``common/context.py``),
+every fault site a row in docs/guides/RELIABILITY.md, and every zoolint
+rule a row in docs/guides/STATIC_ANALYSIS.md. After ten PRs those
+surfaces hold ~60 metric families, ~40 conf keys and a dozen fault
+sites — drift is a when-not-if bug class, and reviewer discipline does
+not scale to it. This module makes the catalogs build-time-checked:
+
+* **extractors** walk every module's AST and pull the call sites that
+  *create* the surface — ``registry.counter/gauge/histogram/summary``
+  registrations (constant, constant-folded and f-string names; literal
+  label sets, including comprehension-bound label values), conf-key
+  reads (``.get("zoo.x")`` / ``self._conf(...)`` / ``tri_state_conf``
+  / ``conf["zoo.x"]`` subscripts), ``faults.inject("site")`` calls
+  (import-resolved so only the real faults module counts), and zoolint
+  rule declarations (``id = "ZLxxx"`` class attributes);
+* **catalog parsers** read the first column of the relevant markdown
+  table (OBSERVABILITY.md "Metric catalog", CONFIG.md key table,
+  RELIABILITY.md fault-site table, STATIC_ANALYSIS.md rule table);
+* **reconciliation rules** (ZL016–ZL020, registered on the project
+  pass) report BOTH drift directions — code-not-documented anchors at
+  the offending call site, documented-not-in-code anchors at the stale
+  doc row.
+
+Run via ``python -m analytics_zoo_tpu.analysis --contracts`` (exit 0
+clean / 2 findings) or ``lint_project(...)`` in-process; the tier-1
+gate (``tests/test_zoolint.py``) holds the live package + docs to zero.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import ERROR, Finding, ModuleContext, dotted, folded_str
+from .project import ProjectContext, ProjectRule, register_project
+
+METRIC_KINDS = ("counter", "gauge", "histogram", "summary")
+
+#: a conf key string literal — the FULL string must look like one
+#: (substrings inside prose/error messages never match)
+_CONF_KEY_RE = re.compile(r"zoo(\.[a-z0-9_]+)+\Z")
+#: a fault-site string: lowercase dotted pair(s), e.g. ``backend.xread``
+_SITE_RE = re.compile(r"[a-z0-9_]+(\.[a-z0-9_]+)+\Z")
+_RULE_ID_RE = re.compile(r"ZL\d{3}\Z")
+
+
+# ---------------------------------------------------------------------------
+# code-side extraction
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class MetricSite:
+    """One ``registry.<kind>(name, ...)`` registration call."""
+
+    name: Optional[str]         # None = not statically resolvable
+    exact: bool                 # False = f-string holes folded to `*`
+    kind: str                   # counter | gauge | histogram | summary
+    path: str
+    line: int
+    label_keys: Tuple[str, ...]
+    #: label keys whose VALUE is not a constant and not bound by a loop
+    #: over a literal collection — the unbounded-cardinality hazard
+    dynamic_label_keys: Tuple[str, ...]
+    #: labels= passed but not as a dict literal (opaque to the scan)
+    opaque_labels: bool = False
+
+
+def _is_registry_recv(node: ast.AST) -> bool:
+    """Whether a call receiver looks like a MetricsRegistry — the
+    ``default_registry()`` factory or a registry-named binding
+    (``m``/``reg``/``registry``/``self.metrics``/``self._registry``).
+    Purely lexical on purpose: the convention is enforced by ZL015, so a
+    registry smuggled under a novel name shows up in review as "why is
+    this not scanned", not as a silent hole."""
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        return bool(d) and d.split(".")[-1] == "default_registry"
+    d = dotted(node)
+    if not d:
+        return False
+    leaf = d.split(".")[-1].lower()
+    return (leaf in ("m", "reg", "metrics")
+            or leaf == "registry" or leaf.endswith("_registry")
+            or leaf.endswith("_reg"))
+
+
+def _local_const_str(ctx: ModuleContext,
+                     at: ast.AST, name: str) -> Optional[Tuple[str, bool]]:
+    """Fold a Name argument through the single constant assignment it
+    refers to in an enclosing scope, if there is exactly one."""
+    scope = ctx._enclosing_scope(at)
+    while scope is not None:
+        found: List[Tuple[str, bool]] = []
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        f = folded_str(node.value)
+                        if f is not None:
+                            found.append(f)
+        if found:
+            texts = {f[0] for f in found}
+            if len(texts) == 1:
+                return found[0]
+            return None          # ambiguous rebinding: give up
+        if isinstance(scope, ast.Module):
+            return None
+        scope = ctx._enclosing_scope(scope)
+    return None
+
+
+def _fold_arg(ctx: ModuleContext, call: ast.Call,
+              node: ast.AST) -> Optional[Tuple[str, bool]]:
+    f = folded_str(node)
+    if f is not None:
+        return f
+    if isinstance(node, ast.Name):
+        return _local_const_str(ctx, call, node.id)
+    return None
+
+
+def _local_dict(ctx: ModuleContext, at: ast.AST,
+                name: str) -> Optional[ast.Dict]:
+    """The single local ``name = {...}`` dict-literal binding visible
+    from ``at``, if unambiguous (the ``labels = {...}; reg.gauge(...,
+    labels=labels)`` idiom)."""
+    scope = ctx._enclosing_scope(at)
+    while scope is not None:
+        found: List[ast.Dict] = []
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name \
+                            and isinstance(node.value, ast.Dict):
+                        found.append(node.value)
+        if found:
+            return found[0] if len(found) == 1 else None
+        if isinstance(scope, ast.Module):
+            return None
+        scope = ctx._enclosing_scope(scope)
+    return None
+
+
+def _loop_bound_literals(ctx: ModuleContext, node: ast.AST) -> Set[str]:
+    """Names bound, on ``node``'s parent chain, by a comprehension or
+    ``for`` statement iterating a LITERAL tuple/list/set of constants —
+    a label value fed from one is a bounded series set, not unbounded
+    cardinality (the ``for reason in ("depth", "deadline")`` idiom)."""
+    out: Set[str] = set()
+
+    def literal_iter(it: ast.AST) -> bool:
+        return (isinstance(it, (ast.Tuple, ast.List, ast.Set))
+                and all(isinstance(e, ast.Constant) for e in it.elts))
+
+    def add_target(t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                add_target(e)
+
+    cur = node
+    while cur is not None:
+        if isinstance(cur, (ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp)):
+            for gen in cur.generators:
+                if literal_iter(gen.iter):
+                    add_target(gen.target)
+        elif isinstance(cur, (ast.For, ast.AsyncFor)) \
+                and literal_iter(cur.iter):
+            add_target(cur.target)
+        cur = ctx.parent(cur)
+    return out
+
+
+def iter_metric_sites(ctx: ModuleContext) -> Iterator[MetricSite]:
+    """Every metric registration call in one module."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in METRIC_KINDS
+                and _is_registry_recv(node.func.value)):
+            continue
+        name_node: Optional[ast.AST] = None
+        if node.args:
+            name_node = node.args[0]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    name_node = kw.value
+        if name_node is None:
+            continue            # no name argument: not a registration
+        folded = _fold_arg(ctx, node, name_node)
+        keys: List[str] = []
+        dynamic: List[str] = []
+        opaque = False
+        for kw in node.keywords:
+            if kw.arg != "labels" or kw.value is None:
+                continue
+            label_dict = kw.value
+            if isinstance(label_dict, ast.Name):
+                # fold through a single local `labels = {...}` binding
+                label_dict = _local_dict(ctx, node, label_dict.id)
+            if not isinstance(label_dict, ast.Dict):
+                opaque = True
+                continue
+            bounded = _loop_bound_literals(ctx, node)
+            for k, v in zip(label_dict.keys, label_dict.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    key = k.value
+                else:
+                    key = "<dynamic>"
+                keys.append(key)
+                vf = folded_str(v)
+                if vf is not None and vf[1]:
+                    continue                        # constant value
+                if isinstance(v, ast.Name) and v.id in bounded:
+                    continue                        # literal-loop bound
+                if isinstance(v, ast.JoinedStr):
+                    # an f-string whose holes are all bounded loop names
+                    holes = [h.value for h in v.values
+                             if isinstance(h, ast.FormattedValue)]
+                    if all(isinstance(h, ast.Name) and h.id in bounded
+                           for h in holes):
+                        continue
+                dynamic.append(key)
+        yield MetricSite(
+            name=None if folded is None else folded[0],
+            exact=folded is not None and folded[1],
+            kind=node.func.attr, path=ctx.path, line=node.lineno,
+            label_keys=tuple(keys), dynamic_label_keys=tuple(dynamic),
+            opaque_labels=opaque)
+
+
+@dataclasses.dataclass
+class ConfRead:
+    key: str
+    path: str
+    line: int
+
+
+def _module_locals_named(ctx: ModuleContext, leaf: str) -> Set[str]:
+    """Local names plausibly bound to a module whose dotted path ends in
+    ``leaf`` (``import a.b.faults as f`` / ``from ..common import
+    faults``)."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == leaf or a.name.endswith("." + leaf):
+                    out.add(a.asname or a.name.split(".", 1)[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == leaf:
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _from_imported_of(ctx: ModuleContext, mod_leaf: str,
+                      func: str) -> Set[str]:
+    """Local names for ``from <...mod_leaf> import func [as alias]``."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == mod_leaf
+                or node.module.endswith("." + mod_leaf)):
+            for a in node.names:
+                if a.name == func:
+                    out.add(a.asname or a.name)
+    return out
+
+
+_CONF_CALL_ATTRS = ("get", "_conf")
+#: bare helper names accepted as conf reads (`_conf("zoo.k", d)` — the
+#: module-local wrapper idiom, cf. ops/fused_cross_entropy.py)
+_CONF_CALL_NAMES = ("_conf", "conf_get", "get_conf", "tri_state_conf")
+
+
+def iter_conf_reads(ctx: ModuleContext,
+                    project=None) -> Iterator[ConfRead]:
+    """``zoo.*`` conf-key reads: ``<x>.get("zoo.k", ...)`` /
+    ``self._conf("zoo.k", ...)`` / bare ``_conf("zoo.k", ...)`` wrappers
+    / ``tri_state_conf("zoo.k")`` calls and ``<x>["zoo.k"]`` subscript
+    loads. Only FULL-string key literals count — a key mentioned inside
+    an error message is prose, not a read. Under the project pass the
+    symbol index resolves what ``tri_state_conf`` refers to (relative
+    imports included); standalone use falls back to file-local
+    from-import matching."""
+    if project is not None:
+        tri_state = {local for local, fq in project.imports(ctx).items()
+                     if fq.split(".")[-1] == "tri_state_conf"}
+    else:
+        tri_state = _from_imported_of(ctx, "context", "tri_state_conf")
+    tri_state.update(_CONF_CALL_NAMES)
+    for node in ast.walk(ctx.tree):
+        key_node: Optional[ast.AST] = None
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            leaf = d.split(".")[-1] if d else None
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _CONF_CALL_ATTRS and node.args:
+                key_node = node.args[0]
+            elif leaf in tri_state and node.args:
+                key_node = node.args[0]
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            key_node = node.slice
+        if key_node is None:
+            continue
+        if isinstance(key_node, ast.Constant) \
+                and isinstance(key_node.value, str) \
+                and _CONF_KEY_RE.match(key_node.value):
+            yield ConfRead(key_node.value, ctx.path, node.lineno)
+
+
+@dataclasses.dataclass
+class ConfDefault:
+    key: str
+    path: str
+    line: int
+
+
+_DEFAULTS_NAMES = ("DEFAULT_CONF", "_DEFAULTS")
+
+
+def conf_defaults(ctx: ModuleContext) -> List[ConfDefault]:
+    """Entries of a module-level ``DEFAULT_CONF = {...}`` (or
+    ``_DEFAULTS = {...}``) dict literal — the bundled-defaults table the
+    conf surface reconciles against."""
+    out: List[ConfDefault] = []
+    for stmt in ctx.tree.body:
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if not any(isinstance(t, ast.Name) and t.id in _DEFAULTS_NAMES
+                   for t in targets):
+            continue
+        if not isinstance(value, ast.Dict):
+            continue
+        for k in value.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                    and _CONF_KEY_RE.match(k.value):
+                out.append(ConfDefault(k.value, ctx.path, k.lineno))
+    return out
+
+
+@dataclasses.dataclass
+class FaultSite:
+    site: Optional[str]
+    exact: bool
+    path: str
+    line: int
+
+
+def iter_fault_sites(ctx: ModuleContext,
+                     project=None) -> Iterator[FaultSite]:
+    """``faults.inject("site")`` call sites, import-resolved: the
+    receiver must be a module named ``faults`` (any package prefix) or a
+    bare ``inject`` from-imported off one — a foreign ``x.inject()`` is
+    never mistaken for fault instrumentation. Under the project pass the
+    package-wide symbol index is the authority (``from ..common import
+    faults`` resolves through the module's own dotted path); standalone
+    use falls back to file-local lexical matching."""
+    if project is not None:
+        faults_mods: Set[str] = set()
+        bare_inject: Set[str] = set()
+        for local, fq in project.imports(ctx).items():
+            parts = fq.split(".")
+            if parts[-1] == "faults":
+                faults_mods.add(local)
+            elif parts[-1] == "inject" and len(parts) >= 2 \
+                    and parts[-2] == "faults":
+                bare_inject.add(local)
+    else:
+        faults_mods = _module_locals_named(ctx, "faults")
+        bare_inject = _from_imported_of(ctx, "faults", "inject")
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        d = dotted(node.func)
+        if not d:
+            continue
+        hit = False
+        if "." in d:
+            prefix, leaf = d.rsplit(".", 1)
+            hit = leaf == "inject" and prefix in faults_mods
+        else:
+            hit = d in bare_inject
+        if not hit:
+            continue
+        folded = _fold_arg(ctx, node, node.args[0])
+        yield FaultSite(
+            site=None if folded is None else folded[0],
+            exact=folded is not None and folded[1],
+            path=ctx.path, line=node.lineno)
+
+
+@dataclasses.dataclass
+class RuleDecl:
+    rule_id: str
+    severity: str       # "error" | "warning" | "" (unknown)
+    path: str
+    line: int
+
+
+def iter_rule_decls(ctx: ModuleContext) -> Iterator[RuleDecl]:
+    """zoolint rule declarations: a class body assigning ``id =
+    "ZLxxx"`` (the registration decorator is not required — an
+    unregistered rule class is exactly the drift worth catching)."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        rule_id: Optional[Tuple[str, int]] = None
+        severity = ""
+        for stmt in node.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for t in stmt.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if t.id == "id" and isinstance(stmt.value, ast.Constant) \
+                        and isinstance(stmt.value.value, str) \
+                        and _RULE_ID_RE.match(stmt.value.value):
+                    rule_id = (stmt.value.value, stmt.lineno)
+                elif t.id == "severity":
+                    sd = dotted(stmt.value)
+                    if sd:
+                        severity = sd.split(".")[-1].lower()
+                    elif isinstance(stmt.value, ast.Constant):
+                        severity = str(stmt.value.value).lower()
+        if rule_id is not None:
+            yield RuleDecl(rule_id[0], severity or "error",
+                           ctx.path, rule_id[1])
+
+
+# ---------------------------------------------------------------------------
+# catalog (markdown) parsing
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DocEntry:
+    value: str
+    path: str
+    line: int
+    label_keys: Tuple[str, ...] = ()
+    row: str = ""               # the remaining cells, for severity checks
+
+
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+_LABEL_KEY_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_]*)=")
+
+
+def md_table_column(text: str, path: str,
+                    header: str) -> List[Tuple[str, int, str]]:
+    """``(first_cell, line, rest_of_row)`` for every row of every
+    markdown table whose header row's FIRST cell equals ``header``
+    (case-insensitive). Tolerates the escaped-pipe (``\\|``) cells the
+    catalogs use inside label enumerations."""
+    out: List[Tuple[str, int, str]] = []
+    lines = text.splitlines()
+    in_table = False
+    for i, raw in enumerate(lines, start=1):
+        line = raw.strip()
+        if not line.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in
+                 re.split(r"(?<!\\)\|", line.strip("|"))]
+        if not cells:
+            continue
+        if not in_table:
+            if cells[0].strip("* ").lower() == header.lower():
+                in_table = True     # header row; separator row follows
+            continue
+        if set(cells[0]) <= {"-", ":", " "}:
+            continue                # the |---|---| separator
+        out.append((cells[0], i,
+                    " | ".join(cells[1:]) if len(cells) > 1 else ""))
+    return out
+
+
+def _cell_tokens(cell: str) -> List[str]:
+    toks = _BACKTICK_RE.findall(cell)
+    return toks if toks else [cell.strip()]
+
+
+def parse_metric_catalog(path: str) -> Dict[str, DocEntry]:
+    """OBSERVABILITY.md "Metric catalog": family name (brace-stripped)
+    -> DocEntry with the documented label keys. A `/`-separated cell
+    documents several families in one row; duplicate rows merge."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    out: Dict[str, DocEntry] = {}
+    for cell, line, rest in md_table_column(text, path, "metric"):
+        for tok in _cell_tokens(cell):
+            name, _, braces = tok.partition("{")
+            name = name.strip()
+            if not re.match(r"[a-z][a-z0-9_]*\Z", name):
+                continue
+            keys = tuple(_LABEL_KEY_RE.findall(braces))
+            prev = out.get(name)
+            if prev is None:
+                out[name] = DocEntry(name, path, line, keys, rest)
+            else:
+                prev.label_keys = tuple(sorted(set(prev.label_keys)
+                                               | set(keys)))
+    return out
+
+
+def parse_conf_catalog(path: str) -> Dict[str, DocEntry]:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    out: Dict[str, DocEntry] = {}
+    for cell, line, rest in md_table_column(text, path, "key"):
+        for tok in _cell_tokens(cell):
+            if _CONF_KEY_RE.match(tok):
+                out.setdefault(tok, DocEntry(tok, path, line, (), rest))
+    return out
+
+
+def parse_site_catalog(path: str) -> Dict[str, DocEntry]:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    out: Dict[str, DocEntry] = {}
+    for cell, line, rest in md_table_column(text, path, "site"):
+        for tok in _cell_tokens(cell):
+            if _SITE_RE.match(tok):
+                out.setdefault(tok, DocEntry(tok, path, line, (), rest))
+    return out
+
+
+def parse_rule_catalog(path: str) -> Dict[str, DocEntry]:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    out: Dict[str, DocEntry] = {}
+    for cell, line, rest in md_table_column(text, path, "id"):
+        for tok in _cell_tokens(cell):
+            if _RULE_ID_RE.match(tok):
+                out.setdefault(tok, DocEntry(tok, path, line, (), rest))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# catalog location
+# ---------------------------------------------------------------------------
+
+#: surface -> catalog file name; looked up under <docs_root>/docs/guides,
+#: <docs_root>/docs, then <docs_root> itself (the drift-fixture layout)
+CATALOG_FILES = {
+    "metrics": "OBSERVABILITY.md",
+    "conf": "CONFIG.md",
+    "faults": "RELIABILITY.md",
+    "rules": "STATIC_ANALYSIS.md",
+}
+
+
+def find_catalog(docs_root: str, surface: str) -> Optional[str]:
+    name = CATALOG_FILES[surface]
+    for sub in (os.path.join("docs", "guides"), "docs", ""):
+        p = os.path.join(docs_root, sub, name) if sub \
+            else os.path.join(docs_root, name)
+        if os.path.isfile(p):
+            return p
+    return None
+
+
+def _missing_catalog(rule: "ProjectRule", project: ProjectContext,
+                     surface: str) -> Finding:
+    return Finding(
+        rule.id, ERROR,
+        os.path.join(project.docs_root or ".", CATALOG_FILES[surface]), 1,
+        f"{CATALOG_FILES[surface]} catalog not found under "
+        f"{project.docs_root!r} — the {surface} contract surface cannot "
+        f"be reconciled (pass --docs-root or create the catalog)")
+
+
+# ---------------------------------------------------------------------------
+# reconciliation rules (project pass)
+# ---------------------------------------------------------------------------
+
+def _wildcard_match(pattern: str, value: str) -> bool:
+    """Match an inexact (f-string-folded) name whose holes are ``*``."""
+    rx = ".*".join(re.escape(p) for p in pattern.split("*"))
+    return re.match(rx + r"\Z", value) is not None
+
+
+@register_project
+class ConfKeyHygiene(ProjectRule):
+    """**Conf-key hygiene (code↔code).** A ``zoo.*`` key read anywhere
+    that has no ``DEFAULT_CONF`` entry silently evaluates to the call
+    site's fallback — a typo'd or undeclared key ships as a no-op knob
+    (``zoo.seq.mode`` ran undeclared for three PRs exactly this way).
+    The reverse — a ``DEFAULT_CONF`` entry no code reads — is dead
+    configuration that keeps a stale promise in docs and env parsing.
+    Needs the whole-package read census, which no per-file rule can
+    see."""
+
+    id = "ZL016"
+    severity = ERROR
+
+    def check(self, project: ProjectContext) -> Iterator[Finding]:
+        defaults: Dict[str, ConfDefault] = {}
+        for ctx in project.modules:
+            for d in conf_defaults(ctx):
+                defaults.setdefault(d.key, d)
+        if not defaults:
+            return      # no defaults table in this tree: nothing to hold
+        reads: Dict[str, ConfRead] = {}
+        read_keys: Set[str] = set()
+        for ctx in project.modules:
+            for r in iter_conf_reads(ctx, project=project):
+                reads.setdefault(r.key, r)
+                read_keys.add(r.key)
+        for key, r in sorted(reads.items()):
+            if key not in defaults:
+                yield Finding(
+                    self.id, ERROR, r.path, r.line,
+                    f"conf key '{key}' is read here but has no "
+                    f"DEFAULT_CONF entry — an undeclared knob: env/yaml "
+                    f"spellings cannot canonicalize and the default "
+                    f"lives only at this call site")
+        for key, d in sorted(defaults.items()):
+            if key not in read_keys:
+                yield Finding(
+                    self.id, ERROR, d.path, d.line,
+                    f"DEFAULT_CONF entry '{key}' is never read anywhere "
+                    f"in the package — dead configuration (remove it or "
+                    f"wire the consumer)")
+
+
+@register_project
+class MetricCatalogDrift(ProjectRule):
+    """**Metric catalog reconciliation (code↔OBSERVABILITY.md).** Every
+    registered metric family must have a catalog row and vice versa,
+    and the documented label keys must match the registered ones — the
+    catalog is what operators alert on; a family missing from it is
+    invisible in practice, and a stale row is an alert that can never
+    fire."""
+
+    id = "ZL017"
+    severity = ERROR
+
+    def check(self, project: ProjectContext) -> Iterator[Finding]:
+        path = project.catalog_path("metrics")
+        if path is None:
+            yield _missing_catalog(self, project, "metrics")
+            return
+        doc = parse_metric_catalog(path)
+        code: Dict[str, List[MetricSite]] = {}
+        inexact: List[MetricSite] = []
+        for ctx in project.modules:
+            for s in iter_metric_sites(ctx):
+                if s.name is None:
+                    continue    # ZL015 reports unresolvable names
+                if s.exact:
+                    code.setdefault(s.name, []).append(s)
+                else:
+                    inexact.append(s)
+        covered: Set[str] = set()
+        for name, sites in sorted(code.items()):
+            s = sites[0]
+            if name not in doc:
+                yield Finding(
+                    self.id, ERROR, s.path, s.line,
+                    f"metric family '{name}' is registered here but has "
+                    f"no row in {os.path.basename(path)}'s metric "
+                    f"catalog — add one (name, type, meaning)")
+                continue
+            covered.add(name)
+            if any(st.opaque_labels for st in sites):
+                # some registration's labels are opaque to the scan
+                # (ZL015 flags the site); key comparison would be a
+                # guess — compare only what resolved
+                continue
+            code_keys = sorted({k for st in sites for k in st.label_keys})
+            doc_keys = sorted(doc[name].label_keys)
+            if doc_keys != code_keys:
+                yield Finding(
+                    self.id, ERROR, s.path, s.line,
+                    f"metric family '{name}' is registered with label "
+                    f"keys {code_keys} but cataloged with {doc_keys} "
+                    f"({os.path.basename(path)}:{doc[name].line})")
+        for s in inexact:
+            hits = [n for n in doc if _wildcard_match(s.name, n)]
+            if hits:
+                covered.update(hits)
+            else:
+                yield Finding(
+                    self.id, ERROR, s.path, s.line,
+                    f"metric family pattern '{s.name}' (f-string name) "
+                    f"matches no row in {os.path.basename(path)}'s "
+                    f"metric catalog")
+        for name, entry in sorted(doc.items()):
+            if name not in covered:
+                yield Finding(
+                    self.id, ERROR, entry.path, entry.line,
+                    f"metric family '{name}' is cataloged here but no "
+                    f"registration exists in the package — prune the "
+                    f"row or restore the metric")
+
+
+@register_project
+class ConfCatalogDrift(ProjectRule):
+    """**Conf catalog reconciliation (DEFAULT_CONF↔CONFIG.md).** Every
+    bundled default needs a CONFIG.md row (the operator-facing
+    reference) and every documented key a default — a documented knob
+    with no entry cannot be spelled via env/kwargs canonicalization, a
+    defaulted knob with no row is unusable in practice."""
+
+    id = "ZL018"
+    severity = ERROR
+
+    def check(self, project: ProjectContext) -> Iterator[Finding]:
+        path = project.catalog_path("conf")
+        if path is None:
+            yield _missing_catalog(self, project, "conf")
+            return
+        doc = parse_conf_catalog(path)
+        defaults: Dict[str, ConfDefault] = {}
+        for ctx in project.modules:
+            for d in conf_defaults(ctx):
+                defaults.setdefault(d.key, d)
+        for key, d in sorted(defaults.items()):
+            if key not in doc:
+                yield Finding(
+                    self.id, ERROR, d.path, d.line,
+                    f"conf key '{key}' has a DEFAULT_CONF entry but no "
+                    f"row in {os.path.basename(path)} — document it "
+                    f"(key, default, meaning)")
+        for key, entry in sorted(doc.items()):
+            if key not in defaults:
+                yield Finding(
+                    self.id, ERROR, entry.path, entry.line,
+                    f"conf key '{key}' is documented here but has no "
+                    f"DEFAULT_CONF entry in the package — prune the row "
+                    f"or add the default")
+
+
+@register_project
+class FaultSiteCatalogDrift(ProjectRule):
+    """**Fault-site catalog reconciliation (code↔RELIABILITY.md).**
+    Chaos plans target sites by name; a site missing from the catalog
+    is un-plannable, and a cataloged site no code fires makes a chaos
+    plan silently test nothing (its specs never fire and ``plan.fired``
+    reconciliation hides the gap only if the test author notices)."""
+
+    id = "ZL019"
+    severity = ERROR
+
+    def check(self, project: ProjectContext) -> Iterator[Finding]:
+        path = project.catalog_path("faults")
+        if path is None:
+            yield _missing_catalog(self, project, "faults")
+            return
+        doc = parse_site_catalog(path)
+        code: Dict[str, FaultSite] = {}
+        inexact: List[FaultSite] = []
+        for ctx in project.modules:
+            for s in iter_fault_sites(ctx, project=project):
+                if s.site is None:
+                    continue
+                if s.exact:
+                    code.setdefault(s.site, s)
+                else:
+                    inexact.append(s)
+        covered: Set[str] = set()
+        for site, s in sorted(code.items()):
+            if site in doc:
+                covered.add(site)
+            else:
+                yield Finding(
+                    self.id, ERROR, s.path, s.line,
+                    f"fault site '{site}' is injected here but has no "
+                    f"row in {os.path.basename(path)}'s fault-site "
+                    f"catalog — add one (site, fired by)")
+        for s in inexact:
+            hits = [n for n in doc if _wildcard_match(s.site, n)]
+            if hits:
+                covered.update(hits)
+            else:
+                yield Finding(
+                    self.id, ERROR, s.path, s.line,
+                    f"fault-site pattern '{s.site}' (f-string) matches "
+                    f"no row in {os.path.basename(path)}'s catalog")
+        for site, entry in sorted(doc.items()):
+            if site not in covered:
+                yield Finding(
+                    self.id, ERROR, entry.path, entry.line,
+                    f"fault site '{site}' is cataloged here but no "
+                    f"faults.inject call fires it — prune the row or "
+                    f"restore the instrumentation")
+
+
+@register_project
+class RuleCatalogDrift(ProjectRule):
+    """**Rule catalog reconciliation (code↔STATIC_ANALYSIS.md).** Every
+    zoolint rule class must have a STATIC_ANALYSIS.md table row with a
+    matching severity, and every documented id a declaration — the
+    table is the contract ``--list-rules`` and suppression reviews are
+    held against. ``ZL000`` (the reserved unparseable-file id) is
+    documented in prose and exempt."""
+
+    id = "ZL020"
+    severity = ERROR
+
+    def check(self, project: ProjectContext) -> Iterator[Finding]:
+        path = project.catalog_path("rules")
+        if path is None:
+            yield _missing_catalog(self, project, "rules")
+            return
+        doc = parse_rule_catalog(path)
+        code: Dict[str, RuleDecl] = {}
+        for ctx in project.modules:
+            for r in iter_rule_decls(ctx):
+                code.setdefault(r.rule_id, r)
+        for rid, r in sorted(code.items()):
+            if rid == "ZL000":
+                continue
+            if rid not in doc:
+                yield Finding(
+                    self.id, ERROR, r.path, r.line,
+                    f"rule {rid} is declared here but has no row in "
+                    f"{os.path.basename(path)}'s rule table")
+                continue
+            # compare against the severity CELL only — rule
+            # descriptions routinely contain both words ("error in
+            # serving/, warning elsewhere"), which would make a
+            # whole-row substring check vacuously pass
+            sev_cell = doc[rid].row.split(" | ")[0].lower()
+            if r.severity and r.severity not in sev_cell:
+                yield Finding(
+                    self.id, ERROR, r.path, r.line,
+                    f"rule {rid} declares severity '{r.severity}' but "
+                    f"its {os.path.basename(path)} row "
+                    f"(line {doc[rid].line}) severity cell says "
+                    f"{sev_cell!r}")
+        for rid, entry in sorted(doc.items()):
+            if rid != "ZL000" and rid not in code:
+                yield Finding(
+                    self.id, ERROR, entry.path, entry.line,
+                    f"rule {rid} is documented here but no rule class "
+                    f"declares it — prune the row or restore the rule")

@@ -100,6 +100,34 @@ def _const_ints(node: ast.AST) -> List[int]:
     return out
 
 
+def folded_str(node: ast.AST) -> Optional[Tuple[str, bool]]:
+    """``(text, exact)`` for a string-valued expression the analyzer can
+    fold statically: a plain constant (exact), an f-string whose
+    formatted holes become ``*`` wildcards (inexact), or a ``+``
+    concatenation of foldable parts. None for anything else. The metric
+    and fault-site extractors use this so a constant-folded or f-string
+    name still reconciles against its catalog instead of silently
+    dropping out of the scan."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, True
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        exact = True
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+                exact = False
+        return "".join(parts), exact
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = folded_str(node.left)
+        right = folded_str(node.right)
+        if left is not None and right is not None:
+            return left[0] + right[0], left[1] and right[1]
+    return None
+
+
 def param_names(fn: ast.AST) -> List[str]:
     a = fn.args
     return [p.arg for p in list(a.posonlyargs) + list(a.args)]
@@ -128,6 +156,7 @@ class ModuleContext:
         self._jax_names_cache: Optional[Tuple[Set[str],
                                               Dict[str, str]]] = None
         self._comments: Optional[Dict[int, str]] = None
+        self._stmt_starts: Optional[Dict[int, int]] = None
 
     # -- generic helpers ----------------------------------------------------
     def parent(self, node: ast.AST) -> Optional[ast.AST]:
@@ -164,6 +193,7 @@ class ModuleContext:
               "jax.numpy": {"jax.numpy"},
               "time": {"time"},
               "queue": {"queue"},
+              "threading": {"threading"},
               "logging": {"logging"}}
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Import):
@@ -468,8 +498,44 @@ class ModuleContext:
             self._comments = out
         return self._comments
 
-    def suppressed(self, finding: Finding) -> bool:
-        comment = self.comments.get(finding.line)
+    @property
+    def stmt_starts(self) -> Dict[int, int]:
+        """``physical line -> first line of the innermost multi-line
+        STATEMENT covering it`` — a ``# zoolint: disable`` on the line a
+        multi-line call starts on must also cover findings a rule
+        anchors to a later physical line of the same statement (e.g. a
+        ``labels={...}`` keyword three lines into a registration call).
+        Innermost wins so a suppression on an outer ``with`` does not
+        blanket every statement in its body."""
+        if self._stmt_starts is None:
+            out: Dict[int, int] = {}
+            for node in ast.walk(self.tree):
+                if not isinstance(node, ast.stmt):
+                    continue
+                end = getattr(node, "end_lineno", None) or node.lineno
+                if end <= node.lineno:
+                    continue
+                # body statements of a compound node map to themselves on
+                # a later pass; only the header span belongs to it. For
+                # simple multi-line statements (Assign/Expr/Return...)
+                # the whole range is the statement.
+                if isinstance(node, (ast.If, ast.For, ast.AsyncFor,
+                                     ast.While, ast.With, ast.AsyncWith,
+                                     ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef, ast.Try, ast.Match)):
+                    continue
+                for ln in range(node.lineno, end + 1):
+                    prev = out.get(ln)
+                    # innermost statement wins: a later (= more deeply
+                    # nested or more specific) start line replaces an
+                    # earlier one only when it starts later
+                    if prev is None or node.lineno > prev:
+                        out[ln] = node.lineno
+            self._stmt_starts = out
+        return self._stmt_starts
+
+    def _comment_suppresses(self, line: int, rule_id: str) -> bool:
+        comment = self.comments.get(line)
         if not comment:
             return False
         m = _SUPPRESS_RE.search(comment)
@@ -482,7 +548,17 @@ class ModuleContext:
             return m.group("eq") is None
         # trailing prose after the id list (`disable=ZL001 key reuse is
         # fine here`) is a justification, not part of the ids
-        return finding.rule_id in {s.strip() for s in ids.split(",")}
+        return rule_id in {s.strip() for s in ids.split(",")}
+
+    def suppressed(self, finding: Finding) -> bool:
+        if self._comment_suppresses(finding.line, finding.rule_id):
+            return True
+        # a marker on the FIRST line of a multi-line statement covers
+        # findings anchored to any later physical line of that statement
+        start = self.stmt_starts.get(finding.line)
+        if start is not None and start != finding.line:
+            return self._comment_suppresses(start, finding.rule_id)
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -537,18 +613,12 @@ def _zl000_kept(select: Optional[Iterable[str]],
     return "ZL000" not in set(ignore or ())
 
 
-def lint_source(source: str, path: str = "<string>",
-                select: Optional[Iterable[str]] = None,
-                ignore: Optional[Iterable[str]] = None) -> List[Finding]:
-    """All non-suppressed findings for one module's source text."""
-    try:
-        ctx = ModuleContext(path, source)
-    # ValueError: ast.parse rejects e.g. null bytes without a SyntaxError
-    except (SyntaxError, ValueError) as e:
-        if not _zl000_kept(select, ignore):
-            return []
-        return [Finding("ZL000", ERROR, path, getattr(e, "lineno", 1) or 1,
-                        f"syntax error: {getattr(e, 'msg', None) or e}")]
+def lint_context(ctx: ModuleContext,
+                 select: Optional[Iterable[str]] = None,
+                 ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+    """All non-suppressed per-file findings for an ALREADY-PARSED module
+    — the reuse surface the ``--contracts`` CLI path goes through so the
+    project pass and the per-file rules share one parse per file."""
     select = set(select) if select else None
     ignore = set(ignore) if ignore else set()
     out: List[Finding] = []
@@ -566,6 +636,21 @@ def lint_source(source: str, path: str = "<string>",
             out.append(f)
     out.sort(key=lambda f: (f.line, f.rule_id))
     return out
+
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[Iterable[str]] = None,
+                ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+    """All non-suppressed findings for one module's source text."""
+    try:
+        ctx = ModuleContext(path, source)
+    # ValueError: ast.parse rejects e.g. null bytes without a SyntaxError
+    except (SyntaxError, ValueError) as e:
+        if not _zl000_kept(select, ignore):
+            return []
+        return [Finding("ZL000", ERROR, path, getattr(e, "lineno", 1) or 1,
+                        f"syntax error: {getattr(e, 'msg', None) or e}")]
+    return lint_context(ctx, select=select, ignore=ignore)
 
 
 def lint_file(path: str, **kw) -> List[Finding]:

@@ -1,0 +1,224 @@
+"""zoolint project pass — whole-tree analysis on top of the per-file
+rules.
+
+The per-file rules (``rules.py``) see one module at a time; this second
+stage parses the WHOLE package into a :class:`ProjectContext` — every
+module's :class:`~.core.ModuleContext` plus a package-wide,
+import-resolved symbol index — and runs **project rules** against it:
+checks that structurally cannot be per-file, like "is this conf key
+read anywhere" (ZL016) or "does every metric registration have a
+catalog row" (the contract reconciliations in ``contracts.py``,
+ZL017–ZL020).
+
+The symbol index maps, for every module, each local name to the
+fully-qualified symbol it was imported as (relative imports resolved
+against the module's own dotted path), and each dotted module name to
+its context and top-level bindings. Rules use it to answer "what does
+``faults`` refer to in this file" without guessing from spelling.
+
+Entry points: :func:`lint_project` (in-process) and the CLI's
+``--contracts`` flag (exit 0 clean / 2 findings). Suppression works
+like the per-file pass: findings anchored in a ``.py`` file honor
+``# zoolint: disable=ZLxxx`` on their line (or the first line of the
+enclosing multi-line statement); findings anchored in a catalog ``.md``
+file are not suppressible — fix the doc instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, Iterator, List, Optional, Set
+
+from .core import (ERROR, Finding, ModuleContext, iter_py_files)
+
+
+class ProjectRule:
+    """One whole-project check. Like :class:`~.core.Rule` but
+    :meth:`check` receives the :class:`ProjectContext`."""
+
+    id: str = ""
+    severity: str = ERROR
+
+    def check(self, project: "ProjectContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+_PROJECT_REGISTRY: Dict[str, "ProjectRule"] = {}
+
+
+def register_project(cls):
+    """Class decorator adding one project rule to the registry."""
+    if not cls.id:
+        raise ValueError(f"{cls.__name__} has no id")
+    if cls.id in _PROJECT_REGISTRY:
+        raise ValueError(f"duplicate project rule id {cls.id}")
+    _PROJECT_REGISTRY[cls.id] = cls()
+    return cls
+
+
+def all_project_rules() -> List[ProjectRule]:
+    from . import contracts  # noqa: F401  (registers on first import)
+    return sorted(_PROJECT_REGISTRY.values(), key=lambda r: r.id)
+
+
+class ProjectContext:
+    """Every parsed module of a package tree + the shared cross-file
+    facts project rules query."""
+
+    def __init__(self, paths: Iterable[str],
+                 docs_root: Optional[str] = None):
+        self.docs_root = docs_root
+        self.modules: List[ModuleContext] = []
+        self.by_path: Dict[str, ModuleContext] = {}
+        self.by_name: Dict[str, ModuleContext] = {}
+        #: files the project pass could not parse (reported as ZL000)
+        self.unparseable: List[Finding] = []
+        self._mod_name: Dict[str, str] = {}      # path -> dotted module
+        self._imports: Dict[str, Dict[str, str]] = {}   # path -> local->fq
+        roots = list(paths)
+        for path in iter_py_files(roots):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                ctx = ModuleContext(path, source)
+            except (OSError, UnicodeDecodeError, SyntaxError, ValueError) \
+                    as e:
+                self.unparseable.append(Finding(
+                    "ZL000", ERROR, path, getattr(e, "lineno", 1) or 1,
+                    f"project pass cannot parse: "
+                    f"{getattr(e, 'msg', None) or e}"))
+                continue
+            self.modules.append(ctx)
+            self.by_path[path] = ctx
+            name = self._derive_module_name(path)
+            self._mod_name[path] = name
+            self.by_name[name] = ctx
+
+    # -- module naming ------------------------------------------------------
+    @staticmethod
+    def _derive_module_name(path: str) -> str:
+        """Dotted module name: walk up from the file through every
+        directory that carries an ``__init__.py`` — the package spine —
+        so the name matches what an importer would bind regardless of
+        which root the scan started from."""
+        apath = os.path.abspath(path)
+        parts = [os.path.splitext(os.path.basename(apath))[0]]
+        d = os.path.dirname(apath)
+        while os.path.isfile(os.path.join(d, "__init__.py")):
+            parts.append(os.path.basename(d))
+            nd = os.path.dirname(d)
+            if nd == d:
+                break
+            d = nd
+        name = ".".join(reversed(parts))
+        return name[:-len(".__init__")] if name.endswith(".__init__") \
+            else name
+
+    def module_name(self, ctx: ModuleContext) -> str:
+        return self._mod_name.get(ctx.path,
+                                  os.path.splitext(
+                                      os.path.basename(ctx.path))[0])
+
+    # -- import-resolved symbol index ---------------------------------------
+    def imports(self, ctx: ModuleContext) -> Dict[str, str]:
+        """``local name -> fully-qualified imported symbol`` for one
+        module, with relative imports resolved against the module's own
+        dotted path (``from ..common import faults`` inside
+        ``analytics_zoo_tpu.serving.server`` resolves to
+        ``analytics_zoo_tpu.common.faults``)."""
+        cached = self._imports.get(ctx.path)
+        if cached is not None:
+            return cached
+        mod = self.module_name(ctx)
+        # the package a relative import is anchored at: the module's
+        # parent for a plain module, the module itself for __init__
+        is_pkg = os.path.basename(ctx.path) == "__init__.py"
+        pkg_parts = mod.split(".") if is_pkg else mod.split(".")[:-1]
+        out: Dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        out[a.asname] = a.name
+                    else:
+                        top = a.name.split(".", 1)[0]
+                        out[top] = top
+            elif isinstance(node, ast.ImportFrom):
+                base: Optional[str]
+                if node.level:
+                    up = node.level - 1
+                    if up > len(pkg_parts):
+                        base = None     # beyond the scanned tree's root
+                    else:
+                        anchor = pkg_parts[:len(pkg_parts) - up]
+                        base = ".".join(
+                            anchor + ([node.module] if node.module
+                                      else []))
+                else:
+                    base = node.module
+                if base is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    out[a.asname or a.name] = f"{base}.{a.name}"
+        self._imports[ctx.path] = out
+        return out
+
+    def resolve(self, ctx: ModuleContext, name: str) -> Optional[str]:
+        """The fully-qualified symbol a (possibly dotted) local name
+        refers to in ``ctx``, or None when it is not import-bound (a
+        local def/assignment or a builtin)."""
+        head, _, rest = name.partition(".")
+        fq = self.imports(ctx).get(head)
+        if fq is None:
+            return None
+        return f"{fq}.{rest}" if rest else fq
+
+    def catalog_path(self, surface: str) -> Optional[str]:
+        from .contracts import find_catalog
+        if self.docs_root is None:
+            return None
+        return find_catalog(self.docs_root, surface)
+
+
+def lint_project(paths: Optional[Iterable[str]] = None,
+                 docs_root: Optional[str] = None,
+                 select: Optional[Iterable[str]] = None,
+                 ignore: Optional[Iterable[str]] = None,
+                 project: Optional["ProjectContext"] = None,
+                 report_unparseable: bool = True) -> List[Finding]:
+    """Run every project rule over the package tree rooted at ``paths``
+    (or a prebuilt ``project`` — the CLI reuses one so files parse once
+    for both passes); returns non-suppressed findings, sorted by
+    path/line/rule. ``report_unparseable=False`` drops the project
+    pass's own ZL000 findings — for callers whose per-file scan already
+    reported the same broken files."""
+    if project is None:
+        if paths is None:
+            raise ValueError("lint_project needs paths or a project")
+        project = ProjectContext(paths, docs_root=docs_root)
+    select_set = set(select) if select else None
+    ignore_set = set(ignore) if ignore else set()
+    out: List[Finding] = []
+    if report_unparseable and "ZL000" not in ignore_set and (
+            select_set is None or "ZL000" in select_set):
+        out.extend(project.unparseable)
+    seen: Set = set()
+    for rule in all_project_rules():
+        if select_set is not None and rule.id not in select_set:
+            continue
+        if rule.id in ignore_set:
+            continue
+        for f in rule.check(project):
+            key = (f.rule_id, f.path, f.line, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            ctx = project.by_path.get(f.path)
+            if ctx is not None and ctx.suppressed(f):
+                continue
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    return out

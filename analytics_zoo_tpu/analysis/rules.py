@@ -1,4 +1,6 @@
-"""zoolint rules ZL001–ZL013 — the JAX/TPU hazards that bite this stack.
+"""zoolint per-file rules ZL001–ZL015 — the JAX/TPU hazards that bite
+this stack (the whole-project rules ZL016–ZL020 live in ``project.py``/
+``contracts.py``).
 
 Every rule documents its rationale in the class docstring (surfaced by
 ``--list-rules`` and docs/guides/STATIC_ANALYSIS.md). Severities:
@@ -15,6 +17,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from .core import (ERROR, WARNING, Finding, ModuleContext, Rule, dotted,
@@ -1426,3 +1429,350 @@ class TracedAssert(Rule):
                         f"real data; use checkify.check/jax.debug, "
                         f"assert static metadata, or return a sentinel "
                         f"flag the host checks", severity=sev)
+
+
+# ---------------------------------------------------------------------------
+# ZL014 — thread-shared state without lock discipline
+# ---------------------------------------------------------------------------
+
+def _threading_ctor_names(ctx: ModuleContext,
+                          leaves: Tuple[str, ...]) -> Tuple[Set[str],
+                                                            Set[str]]:
+    """``(prefixes, bare)`` local spellings of ``threading.<leaf>`` for
+    the given leaves — module aliases (``import threading as th``) and
+    from-imports (``from threading import Thread as T``)."""
+    prefixes = set(ctx.aliases.get("threading", {"threading"}))
+    bare = {local for local, orig in ctx.from_imported("threading").items()
+            if orig in leaves}
+    return prefixes, bare
+
+
+def _is_threading_call(ctx: ModuleContext, node: ast.AST,
+                       leaves: Tuple[str, ...]) -> bool:
+    d = dotted(node)
+    if d is None:
+        return False
+    prefixes, bare = _threading_ctor_names(ctx, leaves)
+    if "." in d:
+        prefix, leaf = d.rsplit(".", 1)
+        return leaf in leaves and prefix in prefixes
+    return d in bare
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@register
+class ThreadSharedWriteDiscipline(Rule):
+    """**Thread-shared instance state without lock discipline.** A class
+    that runs several of its methods on different threads (the serving
+    server: serve loop + publisher + heartbeat/reclaim) and writes the
+    same instance attribute from more than one of those thread entry
+    points is relying on the GIL making each *individual* bytecode
+    atomic — read-modify-write sequences interleave, and the bug
+    surfaces only under production concurrency. Interprocedural within
+    the class: thread roots are the methods handed to
+    ``threading.Thread(target=..., args=(...))``, writes are attributed
+    through the intra-class call graph, and a write counts as guarded
+    only when every path to it holds the same ``threading.Lock``
+    attribute (``with self._lock:`` at the write or around every call
+    site leading to it). Error in the ``serving/`` and
+    ``pipeline/inference/`` paths, warning elsewhere."""
+
+    id = "ZL014"
+    severity = ERROR
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        sev = ERROR if _in_serving_hot_path(ctx.path) else WARNING
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node, sev)
+
+    # -- per-class facts ----------------------------------------------------
+    def _methods(self, cls: ast.ClassDef) -> Dict[str, ast.AST]:
+        return {n.name: n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def _lock_attrs(self, ctx: ModuleContext, cls: ast.ClassDef,
+                    methods: Dict[str, ast.AST]) -> Set[str]:
+        """Attributes assigned ``threading.Lock()``/``RLock()``/
+        ``Condition()`` anywhere in the class."""
+        out: Set[str] = set()
+        for fn in methods.values():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        _is_threading_call(ctx, node.value.func,
+                                           ("Lock", "RLock", "Condition")):
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            out.add(attr)
+        return out
+
+    def _thread_contexts(self, ctx: ModuleContext, cls: ast.ClassDef,
+                         methods: Dict[str, ast.AST]) -> List[Set[str]]:
+        """One entry per thread the class can spawn: the set of
+        own-method names a ``threading.Thread(...)`` creation may run
+        (the target plus any method reference passed through ``args=``
+        / ``kwargs=`` — the ``Thread(target=self._supervised,
+        args=("serve", self._loop))`` trampoline idiom). A creation
+        site lexically inside a loop (or comprehension) spawns the same
+        roots CONCURRENTLY with themselves — the worker-pool pattern —
+        so it contributes two contexts."""
+        out: List[Set[str]] = []
+        for fn in methods.values():
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and _is_threading_call(ctx, node.func, ("Thread",))):
+                    continue
+                roots: Set[str] = set()
+                for sub in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    for ref in ast.walk(sub):
+                        attr = _self_attr(ref)
+                        if attr and attr in methods:
+                            roots.add(attr)
+                if not roots:
+                    continue
+                out.append(roots)
+                cur = ctx.parent(node)
+                while cur is not None and cur is not fn:
+                    if isinstance(cur, (ast.For, ast.AsyncFor, ast.While,
+                                        ast.ListComp, ast.SetComp,
+                                        ast.GeneratorExp)):
+                        out.append(set(roots))   # N spawns race each other
+                        break
+                    cur = ctx.parent(cur)
+        return out
+
+    def _call_edges(self, methods: Dict[str, ast.AST],
+                    lock_attrs: Set[str]):
+        """``(caller, callee, locks_held_at_site)`` for every own-method
+        reference inside a method body — direct ``self.m()`` calls and
+        method references passed around as callbacks (conservative:
+        a referenced method may run)."""
+        edges = []
+        for name, fn in methods.items():
+            for node in ast.walk(fn):
+                attr = _self_attr(node)
+                if attr and attr in methods and attr != name and \
+                        isinstance(node.ctx, ast.Load):
+                    edges.append((name, attr,
+                                  self._locks_at(node, fn, lock_attrs)))
+        return edges
+
+    @staticmethod
+    def _locks_at(node: ast.AST, fn: ast.AST,
+                  lock_attrs: Set[str]) -> Set[str]:
+        """Lock attributes held at ``node`` — enclosing ``with
+        self.<lock>:`` blocks up to the method root."""
+        held: Set[str] = set()
+        cur = getattr(node, "_zl_parent", None)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr and attr in lock_attrs:
+                        held.add(attr)
+            cur = getattr(cur, "_zl_parent", None)
+        return held
+
+    def _check_class(self, ctx: ModuleContext, cls: ast.ClassDef,
+                     sev: str) -> Iterator[Finding]:
+        methods = self._methods(cls)
+        contexts = self._thread_contexts(ctx, cls, methods)
+        if len(contexts) < 2:
+            return      # fewer than two thread entry points: no sharing
+        lock_attrs = self._lock_attrs(ctx, cls, methods)
+        edges = self._call_edges(methods, lock_attrs)
+
+        # reachability per thread context over the call graph
+        reach: List[Set[str]] = []
+        for roots in contexts:
+            seen = set(roots)
+            frontier = list(roots)
+            while frontier:
+                cur = frontier.pop()
+                for caller, callee, _ in edges:
+                    if caller == cur and callee not in seen:
+                        seen.add(callee)
+                        frontier.append(callee)
+            reach.append(seen)
+        threaded: Set[str] = set().union(*reach)
+
+        # minimal locks guaranteed held on ENTRY to each method: the
+        # intersection over every known call site's (locks at site +
+        # caller's own guaranteed locks). Thread roots hold none; other
+        # methods start UNKNOWN and only take a value once a known
+        # caller reaches them — starting them at "no locks" instead
+        # would poison the meet (X & anything = X) and un-guard every
+        # callee of an always-locked helper
+        roots_all: Set[str] = set().union(*contexts)
+        inherited: Dict[str, Set[str]] = {m: set() for m in roots_all}
+        for _ in range(len(methods) + 1):
+            changed = False
+            for m in threaded - roots_all:
+                sites = [locks | inherited[caller]
+                         for caller, callee, locks in edges
+                         if callee == m and caller in inherited]
+                if not sites:
+                    continue            # no known caller yet
+                new = set.intersection(*sites)
+                if inherited.get(m) != new:
+                    inherited[m] = new
+                    changed = True
+            if not changed:
+                break
+
+        # writes: self.<attr> = / += / self.<attr>[k] = inside threaded
+        # methods, with the locks held at the write site
+        writes: Dict[str, List[Tuple[str, int, Set[str]]]] = {}
+        for name in threaded:
+            fn = methods.get(name)
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                targets: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None and isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                    if attr is None or attr in lock_attrs:
+                        continue
+                    held = self._locks_at(node, fn, lock_attrs) \
+                        | inherited.get(name, set())
+                    writes.setdefault(attr, []).append(
+                        (name, node.lineno, held))
+
+        for attr in sorted(writes):
+            ws = writes[attr]
+            hit = [i for i, r in enumerate(reach)
+                   if any(w[0] in r for w in ws)]
+            if len(hit) < 2:
+                continue
+            common = set.intersection(*(w[2] for w in ws))
+            if common:
+                continue
+            first = min(ws, key=lambda w: w[1])
+            methods_writing = sorted({w[0] for w in ws})
+            yield self.finding(
+                ctx, first[1],
+                f"attribute `self.{attr}` is written from "
+                f"{len(hit)} thread entry points "
+                f"({', '.join(methods_writing)}) without one shared "
+                f"threading.Lock guarding every write path — "
+                f"read-modify-write interleavings corrupt it under "
+                f"load; wrap the writes in `with self.<lock>:`",
+                severity=sev)
+
+
+# ---------------------------------------------------------------------------
+# ZL015 — metric naming / labeling convention drift
+# ---------------------------------------------------------------------------
+
+#: non-base-unit duration suffixes (OBSERVABILITY.md: durations are
+#: `_seconds`, quantile summaries `_quantiles_seconds`)
+_BAD_UNIT_SUFFIXES = ("_ms", "_msec", "_millis", "_milliseconds", "_us",
+                      "_micros", "_microseconds", "_ns", "_nanos",
+                      "_nanoseconds", "_mins", "_minutes", "_hours",
+                      "_days", "_sec", "_secs")
+
+
+@register
+class MetricNamingDrift(Rule):
+    """**Metric naming/labeling drift.** The OBSERVABILITY.md convention
+    (``zoo_<layer>_<what>[_unit]``; counters end ``_total``, durations
+    ``_seconds``, summaries ``_quantiles_seconds``) is what dashboards
+    and the catalog reconciliation key on — a misnamed family is
+    invisible to both. Worse is cardinality: a label whose value comes
+    from request data (a uri, a trace id) mints one series per distinct
+    value and grows the registry without bound — label values must be
+    constants, literal-loop enumerations, or a justified bounded set
+    (suppress with the rationale). Error in package code, warning
+    elsewhere."""
+
+    id = "ZL015"
+    severity = ERROR
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        from .contracts import iter_metric_sites
+        sev = ERROR if _in_package(ctx.path) else WARNING
+        for s in iter_metric_sites(ctx):
+            if s.name is None:
+                yield self.finding(
+                    ctx, s.line,
+                    "metric name is not statically resolvable — use a "
+                    "string constant (or constant f-string) so the "
+                    "catalog reconciliation can see the family",
+                    severity=sev)
+            else:
+                yield from self._name_findings(ctx, s, sev)
+            if s.opaque_labels:
+                yield self.finding(
+                    ctx, s.line,
+                    "labels= is not a dict literal — the label keys "
+                    "cannot be checked against the catalog; inline the "
+                    "dict", severity=sev)
+            for key in s.dynamic_label_keys:
+                yield self.finding(
+                    ctx, s.line,
+                    f"label '{key}' takes a runtime value here — "
+                    f"unbounded series cardinality if it derives from "
+                    f"request data; use constants or a literal "
+                    f"enumeration (or suppress with the bounded-set "
+                    f"rationale)", severity=sev)
+
+    def _name_findings(self, ctx: ModuleContext, s,
+                       sev: str) -> Iterator[Finding]:
+        name = s.name
+        plain = name.replace("*", "")
+        if not re.match(r"[a-z*][a-z0-9_*]*\Z", name):
+            yield self.finding(
+                ctx, s.line,
+                f"metric name '{name}' is not a valid Prometheus "
+                f"family name ([a-z][a-z0-9_]*)", severity=sev)
+            return
+        if not name.startswith("zoo_") and not name.startswith("*"):
+            yield self.finding(
+                ctx, s.line,
+                f"metric name '{name}' is not `zoo_`-prefixed — the "
+                f"package namespace every dashboard and the catalog "
+                f"key on", severity=sev)
+        wildcard_tail = name.endswith("*")
+        if s.kind == "counter" and not wildcard_tail \
+                and not name.endswith("_total"):
+            yield self.finding(
+                ctx, s.line,
+                f"counter '{name}' must end in `_total` (Prometheus "
+                f"rate() semantics key on the suffix)", severity=sev)
+        if s.kind in ("gauge", "histogram") and name.endswith("_total"):
+            yield self.finding(
+                ctx, s.line,
+                f"{s.kind} '{name}' ends in `_total` — that suffix "
+                f"promises a monotonic counter", severity=sev)
+        if s.kind == "summary" and not wildcard_tail \
+                and not name.endswith("_quantiles_seconds"):
+            yield self.finding(
+                ctx, s.line,
+                f"summary '{name}' must end in `_quantiles_seconds` "
+                f"(the histogram sibling keeps the bare `_seconds` "
+                f"name)", severity=sev)
+        for suf in _BAD_UNIT_SUFFIXES:
+            # `_per_<unit>` names are RATES (records_per_sec), not
+            # durations — the unit there is a denominator, not a quantity
+            if plain.endswith(suf) and "_per" + suf not in plain:
+                yield self.finding(
+                    ctx, s.line,
+                    f"metric name '{name}' uses a non-base unit "
+                    f"(`{suf}`) — durations are `_seconds` in the "
+                    f"catalog convention", severity=sev)
+                break

@@ -46,6 +46,8 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.pallas.block_sweep": False,     # one-shot on-device block sweep per kernel signature
     "zoo.pallas.vmem_budget_mb": 0,      # 0 = the per-core default (16 MiB) for block selection
     "zoo.rng.impl": "auto",              # auto (rbg on TPU) | default | rbg
+    "zoo.seq.mode": "ring",              # seq-parallel routing: ring | ulysses | auto
+    "zoo.seq.strict": False,             # fail (not warn) when attention can't ride the seq mesh
     "zoo.compute.dtype": "float32",      # float32 | bfloat16
     "zoo.train.scan_steps": 1,           # optimizer steps fused per dispatch (lax.scan)
     "zoo.train.device_cache": False,     # HBM-resident dataset, 1 dispatch/epoch

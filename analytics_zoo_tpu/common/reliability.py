@@ -111,7 +111,9 @@ class RetryBudget:
         self._lock = threading.Lock()
         self._m_exhausted = None
         if registry is not None:
-            self._m_exhausted = registry.counter(
+            # budget names are operator-chosen code identifiers (a
+            # handful per process), not request data
+            self._m_exhausted = registry.counter(  # zoolint: disable=ZL015 bounded label set
                 "zoo_retry_budget_exhausted_total",
                 "retries refused because the shared retry budget was "
                 "empty (a correlated outage draining the bucket)",
@@ -259,7 +261,9 @@ class RetryPolicy:
         last: Optional[BaseException] = None
         counter = None
         if registry is not None:
-            counter = registry.counter(
+            # op names are call-site string constants (one per
+            # retried operation), not request data
+            counter = registry.counter(  # zoolint: disable=ZL015 bounded label set
                 "zoo_retry_attempts_total",
                 "retries performed by reliability.RetryPolicy, by operation",
                 labels={"op": op})
@@ -371,7 +375,9 @@ class CircuitBreaker:
         self._registry = registry
         self._gauge = None
         if registry is not None:
-            self._gauge = registry.gauge(
+            # breaker names are code-defined identifiers, one per
+            # guarded dependency
+            self._gauge = registry.gauge(  # zoolint: disable=ZL015 bounded label set
                 "zoo_breaker_state",
                 "circuit state: 0 closed, 1 open, 2 half-open",
                 labels={"breaker": name})
@@ -385,7 +391,8 @@ class CircuitBreaker:
         if self._gauge is not None:
             self._gauge.set(_STATE_VALUE[new_state])
         if self._registry is not None:
-            self._registry.counter(
+            # breaker = code identifier, state = the 3-value enum
+            self._registry.counter(  # zoolint: disable=ZL015 bounded label set
                 "zoo_breaker_transitions_total",
                 "circuit state transitions, labeled by the state entered",
                 labels={"breaker": self.name, "state": new_state}).inc()

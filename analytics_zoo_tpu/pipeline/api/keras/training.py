@@ -468,8 +468,10 @@ class TrainingLoop:
         # the per-fit recovery state (flagged iterations, rollback
         # budget) is (re)initialized at each fit() entry
         self._sentinel: Optional[anomaly.SentinelConfig] = None
+        # kind iterates anomaly.KIND_BITS — a 3-entry module constant
+        # (nan_loss/nan_grad/spike), bounded just like a literal
         self._m_anomaly = {
-            kind: self._registry.counter(
+            kind: self._registry.counter(  # zoolint: disable=ZL015 bounded label set
                 "zoo_train_anomaly_total",
                 "anomalous training steps detected by the sentinels, by "
                 "kind (zoo.train.sentinel)", labels={"kind": kind})
@@ -517,7 +519,10 @@ class TrainingLoop:
         prev = TrainingLoop._last_fused_labels
         if spec is None:
             if prev is not None:
-                self._registry.gauge("zoo_train_fused_ce",
+                # prev = the head=/vocab= dict of the LAST engaged
+                # loop (zeroing the stale series); bounded by the
+                # model architectures built in-process
+                self._registry.gauge("zoo_train_fused_ce",  # zoolint: disable=ZL015 bounded label set
                                      self._FUSED_GAUGE_HELP,
                                      labels=prev).set(0)
                 TrainingLoop._last_fused_labels = None
@@ -533,9 +538,12 @@ class TrainingLoop:
         labels = {"head": spec.head.name,
                   "vocab": str(spec.head.output_dim)}
         if prev is not None and prev != labels:
-            self._registry.gauge("zoo_train_fused_ce",
+            # stale-series zeroing, same bounded head=/vocab= set
+            self._registry.gauge("zoo_train_fused_ce",  # zoolint: disable=ZL015 bounded label set
                                  self._FUSED_GAUGE_HELP, labels=prev).set(0)
-        self._registry.gauge("zoo_train_fused_ce", self._FUSED_GAUGE_HELP,
+        # head/vocab identify the fused head (catalog row documents
+        # the keys); bounded by the model architectures in-process
+        self._registry.gauge("zoo_train_fused_ce", self._FUSED_GAUGE_HELP,  # zoolint: disable=ZL015 bounded label set
                              labels=labels).set(1)
         TrainingLoop._last_fused_labels = labels
 

@@ -133,7 +133,19 @@ class DeadLetterQueue:
         self._m_replayed = m.counter(
             "zoo_serving_dlq_replayed_total",
             "dead-lettered records re-enqueued onto the input stream")
-        self._spilled = {}          # reason -> labeled counter (lazy)
+        # the dead-letter reasons are a closed label set; pre-creating
+        # the counters keeps the spill path allocation-free and the
+        # label values statically enumerable (zoolint ZL015). "other"
+        # absorbs foreign reason strings a future caller might pass —
+        # never misattributed to a real category, and the spilled
+        # record itself keeps the exact string
+        self._spilled = {
+            reason: m.counter(
+                "zoo_serving_dlq_spilled_total",
+                "records spilled to the on-disk dead-letter queue, by "
+                "dead-letter reason",
+                labels={"reason": reason})
+            for reason in ("dispatch", "publish", "other")}
         # incrementally-maintained totals: the append path must stay
         # O(1) — a full directory rescan per spill would go quadratic
         # during the very outage the DLQ exists to absorb. One scan at
@@ -219,15 +231,7 @@ class DeadLetterQueue:
             self._replayable += 1
             if self._disk_bytes > self.max_bytes:
                 self._evict_over_bound()
-        counter = self._spilled.get(reason)
-        if counter is None:
-            counter = self.metrics.counter(
-                "zoo_serving_dlq_spilled_total",
-                "records spilled to the on-disk dead-letter queue, by "
-                "dead-letter reason",
-                labels={"reason": reason})
-            self._spilled[reason] = counter
-        counter.inc()
+        self._spilled.get(reason, self._spilled["other"]).inc()
         self._refresh_gauges()
         self.metrics.emit("serving.dlq_spill", uri=uri, trace=trace,
                           reason=reason, error=error)

@@ -96,7 +96,7 @@ def test_cli_rejects_nonexistent_path():
          os.path.join(REPO, "no_such_dir_xyz")],
         capture_output=True, text=True, cwd=REPO,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
-    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert proc.returncode == 3, proc.stdout + proc.stderr
     assert "does not exist" in proc.stderr
 
 
@@ -144,7 +144,9 @@ def test_cli_rejects_unknown_rule_ids(tmp_path):
             [sys.executable, "-m", "analytics_zoo_tpu.analysis",
              flag, "ZL0O1", str(bad)],
             capture_output=True, text=True, cwd=REPO, env=env)
-        assert proc.returncode == 2, (flag, proc.stdout + proc.stderr)
+        # usage errors exit 3 — distinct from the --contracts drift
+        # code 2, so a typo'd invocation can never read as catalog drift
+        assert proc.returncode == 3, (flag, proc.stdout + proc.stderr)
         assert "unknown rule id" in proc.stderr, flag
     # a valid --select still gates
     proc = subprocess.run(
@@ -1831,3 +1833,719 @@ def test_zl013_suppression():
         "assert y.sum() > 0  # zoolint: disable=ZL013 trace-time probe")
     assert not ids(lint_source(
         src, "analytics_zoo_tpu/pipeline/api/keras/training.py"), "ZL013")
+
+
+# ---------------------------------------------------------------------------
+# ZL014 — thread-shared instance state without lock discipline
+# ---------------------------------------------------------------------------
+
+ZL014_BAD = """
+import threading
+
+class Server:
+    def __init__(self):
+        self._served = 0
+        self._t1 = None
+        self._t2 = None
+
+    def start(self):
+        self._t1 = threading.Thread(target=self._loop, daemon=True)
+        self._t2 = threading.Thread(target=self._publisher, daemon=True)
+        self._t1.start()
+        self._t2.start()
+
+    def _loop(self):
+        self._served += 1
+
+    def _publisher(self):
+        self._served += 1
+"""
+
+ZL014_CLEAN = """
+import threading
+
+class Server:
+    def __init__(self):
+        self._served = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+        threading.Thread(target=self._publisher, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self._served += 1
+
+    def _publisher(self):
+        with self._lock:
+            self._served += 1
+
+class SingleThread:
+    def __init__(self):
+        self._n = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self._n += 1        # one thread root: nothing shared
+
+    def stop(self):
+        self._n = 0
+"""
+
+
+def test_zl014_triggers_in_serving_as_error():
+    fs = lint_source(ZL014_BAD, "analytics_zoo_tpu/serving/x.py")
+    zl = [f for f in fs if f.rule_id == "ZL014"]
+    assert len(zl) == 1 and zl[0].severity == ERROR
+    assert "_served" in zl[0].message
+
+
+def test_zl014_warning_outside_hot_path():
+    fs = lint_source(ZL014_BAD, "analytics_zoo_tpu/utils/x.py")
+    zl = [f for f in fs if f.rule_id == "ZL014"]
+    assert len(zl) == 1 and zl[0].severity != ERROR
+
+
+def test_zl014_clean_locked_and_single_thread():
+    assert not ids(lint_source(
+        ZL014_CLEAN, "analytics_zoo_tpu/serving/x.py"), "ZL014")
+
+
+def test_zl014_trampoline_args_and_inherited_lock():
+    """Thread roots ride through ``args=`` (the `_supervised` trampoline
+    idiom), and a write in a helper is guarded when EVERY threaded call
+    path holds the lock — but unguarded when only one does."""
+    src = """
+import threading
+
+class S:
+    def __init__(self):
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self._run, args=("a", self._loop)).start()
+        threading.Thread(target=self._run, args=("b", self._pub)).start()
+
+    def _run(self, name, body):
+        body()
+
+    def _loop(self):
+        with self._lock:
+            self._bump()
+
+    def _pub(self):
+        {pub_body}
+
+    def _bump(self):
+        self._n += 1
+"""
+    clean = src.format(pub_body="with self._lock:\n            self._bump()")
+    assert not ids(lint_source(
+        clean, "analytics_zoo_tpu/serving/x.py"), "ZL014")
+    bad = src.format(pub_body="self._bump()")
+    zl = ids(lint_source(bad, "analytics_zoo_tpu/serving/x.py"), "ZL014")
+    assert len(zl) == 1
+
+
+def test_zl014_subscript_store_counts_as_write():
+    src = ZL014_BAD.replace("self._served += 1",
+                            'self._served = {}', 1)
+    src = src.replace("self._served += 1", 'self._served["k"] = 1')
+    fs = lint_source(src, "analytics_zoo_tpu/serving/x.py")
+    assert len(ids(fs, "ZL014")) == 1
+
+
+def test_zl014_suppression():
+    src = ZL014_BAD.replace(
+        "    def _loop(self):\n        self._served += 1",
+        "    def _loop(self):\n"
+        "        self._served += 1  "
+        "# zoolint: disable=ZL014 GIL-atomic int bump, display only")
+    assert not ids(lint_source(
+        src, "analytics_zoo_tpu/serving/x.py"), "ZL014")
+
+
+# ---------------------------------------------------------------------------
+# ZL015 — metric naming / labeling convention drift
+# ---------------------------------------------------------------------------
+
+ZL015_BAD = """
+def setup(reg, uri):
+    reg.counter("requests_total", "no zoo prefix")
+    reg.counter("zoo_serving_hits", "counter without _total")
+    reg.histogram("zoo_serving_wait_ms", "milliseconds are not seconds")
+    reg.summary("zoo_serving_lat_seconds", "summary suffix wrong")
+    reg.gauge("zoo_serving_done_total", "gauge wearing _total")
+    reg.counter("zoo_serving_by_uri_total", "per-request label",
+                labels={"uri": uri})
+"""
+
+ZL015_CLEAN = """
+def setup(reg):
+    reg.counter("zoo_serving_records_total", "ok")
+    reg.histogram("zoo_serving_wait_seconds", "ok")
+    reg.summary("zoo_serving_wait_quantiles_seconds", "ok")
+    reg.gauge("zoo_train_records_per_sec", "a rate, not a duration")
+    shed = {reason: reg.counter("zoo_serving_shed_total", "ok",
+                                labels={"reason": reason})
+            for reason in ("depth", "deadline")}
+    for name in ("serve", "publish"):
+        reg.counter("zoo_serving_loop_restarts_total", "ok",
+                    labels={"loop": name})
+    return shed
+"""
+
+
+def test_zl015_triggers_each_convention_violation():
+    fs = lint_source(ZL015_BAD, "analytics_zoo_tpu/observability/x.py")
+    zl = [f for f in fs if f.rule_id == "ZL015"]
+    assert len(zl) == 6 and all(f.severity == ERROR for f in zl)
+    msgs = " ".join(f.message for f in zl)
+    for frag in ("zoo_", "_total", "non-base unit",
+                 "_quantiles_seconds", "monotonic", "runtime value"):
+        assert frag in msgs, frag
+
+
+def test_zl015_warning_outside_package():
+    fs = lint_source(ZL015_BAD, "examples/metrics_demo.py")
+    zl = [f for f in fs if f.rule_id == "ZL015"]
+    assert zl and not [f for f in zl if f.severity == ERROR]
+
+
+def test_zl015_clean_literal_loops_and_rates():
+    assert not ids(lint_source(
+        ZL015_CLEAN, "analytics_zoo_tpu/observability/x.py"), "ZL015")
+
+
+def test_zl015_unresolvable_name_flagged():
+    src = ("def setup(reg, name):\n"
+           "    reg.counter(name, 'dynamic family name')\n")
+    fs = lint_source(src, "analytics_zoo_tpu/observability/x.py")
+    assert [f for f in fs if f.rule_id == "ZL015"
+            and "not statically resolvable" in f.message]
+
+
+def test_zl015_constant_folded_and_fstring_names():
+    src = ('NAME = "zoo_x_wait_ms"\n'
+           "def setup(reg, leaf):\n"
+           "    reg.histogram(NAME, 'folds through the constant')\n"
+           "    reg.counter(f\"zoo_{leaf}_reads_total\", 'wildcard ok')\n"
+           "    reg.counter(f\"{leaf}_reads_total\", 'prefix unknowable')\n")
+    fs = [f for f in lint_source(src, "analytics_zoo_tpu/obs/x.py")
+          if f.rule_id == "ZL015"]
+    # the folded constant name violates the unit rule; the leading-hole
+    # f-string cannot be prefix-checked (no finding), the zoo_-anchored
+    # one is fine
+    assert len(fs) == 1 and "non-base unit" in fs[0].message
+
+
+def test_zl015_suppression_on_multiline_statement():
+    """The marker on the registration's FIRST line covers the finding
+    even though labels={...} sits on a later physical line — the
+    multi-line statement suppression contract."""
+    src = ("def setup(reg, owner):\n"
+           "    reg.counter(  # zoolint: disable=ZL015 bounded by fleet\n"
+           "        'zoo_serving_reclaimed_total',\n"
+           "        'help',\n"
+           "        labels={'from': owner})\n")
+    assert not ids(lint_source(
+        src, "analytics_zoo_tpu/serving/x.py"), "ZL015")
+
+
+def test_multiline_statement_suppression_core():
+    """core-level contract: a finding anchored to a LATER physical line
+    of a multi-line statement is suppressed by a marker on the
+    statement's first line — and not by a marker on an unrelated
+    enclosing compound statement."""
+    src = ("import jax\n"
+           "def f(rng):\n"
+           "    a = jax.random.normal(rng, (2,))\n"
+           "    b = (a +  # zoolint: disable=ZL001 intentional replay\n"
+           "         jax.random.uniform(rng, (2,)))\n"
+           "    return b\n")
+    assert not ids(lint_source(src, "analytics_zoo_tpu/x.py"), "ZL001")
+    # same source without the marker still triggers, anchored to the
+    # LATER line (the second sampler)
+    bare = src.replace("  # zoolint: disable=ZL001 intentional replay", "")
+    zl = [f for f in lint_source(bare, "analytics_zoo_tpu/x.py")
+          if f.rule_id == "ZL001"]
+    assert zl and zl[0].line == 5
+    # a marker on an enclosing `with` head must NOT blanket body
+    # statements (innermost statement wins)
+    nested = ("import jax\n"
+              "def f(rng, cm):\n"
+              "    with cm:  # zoolint: disable=ZL001\n"
+              "        a = jax.random.normal(rng, (2,))\n"
+              "        b = jax.random.uniform(rng, (2,))\n"
+              "    return a + b\n")
+    assert ids(lint_source(nested, "analytics_zoo_tpu/x.py"), "ZL001")
+
+
+# ---------------------------------------------------------------------------
+# project pass: ZL016 conf hygiene + the contract reconciliation (ZL017-20)
+# tested against a seeded drift-fixture tree, independent of the live package
+# ---------------------------------------------------------------------------
+
+from analytics_zoo_tpu.analysis.project import lint_project  # noqa: E402
+
+
+def _mini_project(root, *, conf_read_undeclared=False, conf_dead=False,
+                  drop_metric_row=False, extra_metric_row=False,
+                  wrong_label_row=False, drop_conf_row=False,
+                  extra_conf_row=False, drop_site_row=False,
+                  extra_site_row=False, drop_rule_row=False,
+                  extra_rule_row=False, undocumented_metric=False,
+                  uninjected_code_site=False, undeclared_rule=False):
+    """A fake mini-package + mini-docs whose clean form reconciles
+    exactly; each flag seeds ONE direction of drift on one surface."""
+    pkg = root / "minipkg"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "faults.py").write_text(
+        "def inject(site):\n    return None\n")
+    (pkg / "context.py").write_text(
+        "DEFAULT_CONF = {\n"
+        '    "zoo.mini.alpha": 1,\n'
+        '    "zoo.mini.beta": False,\n'
+        + ('    "zoo.mini.dead": 0,\n' if conf_dead else "")
+        + "}\n")
+    (pkg / "code.py").write_text(
+        "from . import faults\n"
+        "\n"
+        "def _conf(key, default):\n"
+        "    return default\n"
+        "\n"
+        "def setup(reg, conf):\n"
+        '    reg.counter("zoo_mini_requests_total", "requests")\n'
+        '    for stage in ("read", "write"):\n'
+        '        reg.gauge("zoo_mini_depth", "backlog",\n'
+        '                  labels={"stage": stage})\n'
+        '    a = conf.get("zoo.mini.alpha", 1)\n'
+        '    b = _conf("zoo.mini.beta", False)\n'
+        + ('    c = conf.get("zoo.mini.gamma", 7)\n'
+           if conf_read_undeclared else "")
+        + ('    reg.counter("zoo_mini_ghost_total", "undocumented")\n'
+           if undocumented_metric else "")
+        + "    return a, b\n"
+        "\n"
+        "def serve(reg, leaf):\n"
+        '    reg.histogram(f"zoo_mini_{leaf}_seconds", "per-op wait")\n'
+        '    faults.inject("mini.read")\n'
+        + ('    faults.inject("mini.ghost")\n' if uninjected_code_site
+           else "")
+        + "    return None\n")
+    (pkg / "rules.py").write_text(
+        "class MiniRule:\n"
+        '    id = "ZL901"\n'
+        '    severity = "error"\n'
+        + ("class GhostRule:\n"
+           '    id = "ZL902"\n'
+           '    severity = "error"\n' if undeclared_rule else ""))
+
+    metric_rows = [
+        "| `zoo_mini_requests_total` | counter | requests |",
+        "| `zoo_mini_depth{stage=\"read\"\\|\"write\"}` | gauge | backlog |"
+        if not wrong_label_row else
+        "| `zoo_mini_depth{phase=...}` | gauge | backlog |",
+        "| `zoo_mini_op_seconds` | histogram | per-op wait (f-string) |",
+    ]
+    if drop_metric_row:
+        metric_rows = metric_rows[:2]   # drops the f-string-matched row
+    if extra_metric_row:
+        metric_rows.append("| `zoo_mini_vanished_total` | counter | gone |")
+    (root / "OBSERVABILITY.md").write_text(
+        "# Mini observability\n\n| metric | type | meaning |\n|---|---|---|\n"
+        + "\n".join(metric_rows) + "\n")
+
+    conf_rows = ["| `zoo.mini.alpha` | `1` | alpha |",
+                 "| `zoo.mini.beta` | `false` | beta |"]
+    if conf_dead:
+        conf_rows.append("| `zoo.mini.dead` | `0` | dead |")
+    if drop_conf_row:
+        conf_rows = conf_rows[1:]
+    if extra_conf_row:
+        conf_rows.append("| `zoo.mini.phantom` | `x` | phantom |")
+    (root / "CONFIG.md").write_text(
+        "# Mini config\n\n| Key | Default | Meaning |\n|---|---|---|\n"
+        + "\n".join(conf_rows) + "\n")
+
+    site_rows = ["| `mini.read` | the serve loop |"]
+    if drop_site_row:
+        site_rows = []
+    if extra_site_row:
+        site_rows.append("| `mini.phantom` | nothing fires it |")
+    (root / "RELIABILITY.md").write_text(
+        "# Mini reliability\n\n| site | fired by |\n|---|---|\n"
+        + "\n".join(site_rows) + "\n")
+
+    rule_rows = ["| ZL901 | error | the mini rule |"]
+    if drop_rule_row:
+        rule_rows = []
+    if extra_rule_row:
+        rule_rows.append("| ZL903 | error | documented, undeclared |")
+    (root / "STATIC_ANALYSIS.md").write_text(
+        "# Mini rules\n\n| ID | Severity | What |\n|----|---|---|\n"
+        + "\n".join(rule_rows) + "\n")
+    return pkg
+
+
+def _project_findings(root, pkg, **kw):
+    return lint_project([str(pkg)], docs_root=str(root), **kw)
+
+
+def test_contracts_clean_tree_reconciles(tmp_path):
+    pkg = _mini_project(tmp_path)
+    assert _project_findings(tmp_path, pkg) == []
+
+
+def test_zl016_read_without_default(tmp_path):
+    pkg = _mini_project(tmp_path, conf_read_undeclared=True)
+    fs = _project_findings(tmp_path, pkg, select=["ZL016"])
+    assert len(fs) == 1 and "zoo.mini.gamma" in fs[0].message
+    assert fs[0].path.endswith("code.py") and fs[0].severity == ERROR
+
+
+def test_zl016_default_never_read(tmp_path):
+    """conf_dead seeds the default AND its doc row, so ZL018 stays green
+    and the only finding is the dead-entry one, anchored at context.py."""
+    pkg = _mini_project(tmp_path, conf_dead=True)
+    fs = _project_findings(tmp_path, pkg)
+    assert ids(fs) == ["ZL016"]
+    assert "never read" in fs[0].message and fs[0].path.endswith("context.py")
+
+
+def test_zl016_suppression_on_read_line(tmp_path):
+    pkg = _mini_project(tmp_path, conf_read_undeclared=True)
+    code = (pkg / "code.py").read_text().replace(
+        'c = conf.get("zoo.mini.gamma", 7)',
+        'c = conf.get("zoo.mini.gamma", 7)  '
+        '# zoolint: disable=ZL016 staged rollout knob')
+    (pkg / "code.py").write_text(code)
+    assert not _project_findings(tmp_path, pkg, select=["ZL016"])
+
+
+def test_zl017_metric_code_without_doc(tmp_path):
+    pkg = _mini_project(tmp_path, undocumented_metric=True)
+    fs = _project_findings(tmp_path, pkg, select=["ZL017"])
+    assert len(fs) == 1 and "zoo_mini_ghost_total" in fs[0].message
+    assert fs[0].path.endswith("code.py")
+
+
+def test_zl017_metric_doc_without_code(tmp_path):
+    pkg = _mini_project(tmp_path, extra_metric_row=True)
+    fs = _project_findings(tmp_path, pkg, select=["ZL017"])
+    assert len(fs) == 1 and "zoo_mini_vanished_total" in fs[0].message
+    assert fs[0].path.endswith("OBSERVABILITY.md")
+
+
+def test_zl017_label_key_mismatch(tmp_path):
+    pkg = _mini_project(tmp_path, wrong_label_row=True)
+    fs = _project_findings(tmp_path, pkg, select=["ZL017"])
+    assert len(fs) == 1 and "label keys" in fs[0].message
+    assert "stage" in fs[0].message and "phase" in fs[0].message
+
+
+def test_zl017_fstring_name_reconciles_as_wildcard(tmp_path):
+    """`zoo_mini_{leaf}_seconds` must match the `zoo_mini_op_seconds`
+    row — and with the row dropped, the pattern itself is reported."""
+    pkg = _mini_project(tmp_path, drop_metric_row=True)
+    fs = _project_findings(tmp_path, pkg, select=["ZL017"])
+    assert len(fs) == 1
+    assert "zoo_mini_*_seconds" in fs[0].message
+    assert fs[0].path.endswith("code.py")
+
+
+def test_zl018_both_directions(tmp_path):
+    pkg = _mini_project(tmp_path, drop_conf_row=True, extra_conf_row=True)
+    fs = _project_findings(tmp_path, pkg, select=["ZL018"])
+    assert len(fs) == 2
+    missing = [f for f in fs if "zoo.mini.alpha" in f.message]
+    phantom = [f for f in fs if "zoo.mini.phantom" in f.message]
+    assert missing[0].path.endswith("context.py")
+    assert phantom[0].path.endswith("CONFIG.md")
+
+
+def test_zl019_both_directions(tmp_path):
+    pkg = _mini_project(tmp_path, uninjected_code_site=True,
+                        extra_site_row=True)
+    fs = _project_findings(tmp_path, pkg, select=["ZL019"])
+    assert len(fs) == 2
+    assert [f for f in fs if "mini.ghost" in f.message
+            and f.path.endswith("code.py")]
+    assert [f for f in fs if "mini.phantom" in f.message
+            and f.path.endswith("RELIABILITY.md")]
+
+
+def test_zl020_both_directions(tmp_path):
+    pkg = _mini_project(tmp_path, undeclared_rule=True, extra_rule_row=True)
+    fs = _project_findings(tmp_path, pkg, select=["ZL020"])
+    assert len(fs) == 2
+    assert [f for f in fs if "ZL902" in f.message
+            and f.path.endswith("rules.py")]
+    assert [f for f in fs if "ZL903" in f.message
+            and f.path.endswith("STATIC_ANALYSIS.md")]
+
+
+def test_zl020_severity_mismatch(tmp_path):
+    pkg = _mini_project(tmp_path)
+    doc = (tmp_path / "STATIC_ANALYSIS.md").read_text().replace(
+        "| ZL901 | error |", "| ZL901 | warning |")
+    (tmp_path / "STATIC_ANALYSIS.md").write_text(doc)
+    fs = _project_findings(tmp_path, pkg, select=["ZL020"])
+    assert len(fs) == 1 and "severity" in fs[0].message
+
+
+def test_contracts_missing_catalog_is_a_finding(tmp_path):
+    pkg = _mini_project(tmp_path)
+    (tmp_path / "RELIABILITY.md").unlink()
+    fs = _project_findings(tmp_path, pkg, select=["ZL019"])
+    assert len(fs) == 1 and "not found" in fs[0].message
+
+
+def test_project_pass_reports_unparseable_as_zl000(tmp_path):
+    pkg = _mini_project(tmp_path)
+    (pkg / "broken.py").write_text("def f(:\n")
+    fs = _project_findings(tmp_path, pkg)
+    assert ids(fs) == ["ZL000"]
+    assert not _project_findings(tmp_path, pkg, ignore=["ZL000"])
+
+
+# ---------------------------------------------------------------------------
+# CLI: --contracts exit-code contract + --format json
+# ---------------------------------------------------------------------------
+
+def _run_cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.analysis"] + args,
+        capture_output=True, text=True, cwd=cwd,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})
+
+
+def test_cli_contracts_exit_zero_on_clean_tree(tmp_path):
+    pkg = _mini_project(tmp_path)
+    proc = _run_cli(["--contracts", "--docs-root", str(tmp_path), str(pkg)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_contracts_exit_two_on_drift(tmp_path):
+    pkg = _mini_project(tmp_path, extra_conf_row=True)
+    proc = _run_cli(["--contracts", "--docs-root", str(tmp_path), str(pkg)])
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "ZL018" in proc.stdout
+
+
+def test_cli_contracts_gate_on_live_repo():
+    """The tier-1 contract gate: the live package + docs reconcile —
+    `scripts/zoolint --contracts` (the CI spelling) exits 0."""
+    proc = subprocess.run(
+        [os.path.join(REPO, "scripts", "zoolint"), "--contracts"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_format_json(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n"
+                   "def f(rng):\n"
+                   "    a = jax.random.normal(rng, (2,))\n"
+                   "    b = jax.random.normal(rng, (2,))\n"
+                   "    return a + b\n")
+    proc = _run_cli(["--format", "json", str(bad)])
+    assert proc.returncode == 1
+    import json as _json
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    objs = [_json.loads(ln) for ln in lines]   # every stdout line is JSON
+    assert len(objs) == 1
+    f = objs[0]
+    assert f["rule"] == "ZL001" and f["severity"] == "error"
+    assert f["file"] == str(bad) and f["line"] == 4 and f["message"]
+    # the human summary moved to stderr so stdout stays machine-parseable
+    assert "error(s)" in proc.stderr and "error(s)" not in proc.stdout
+
+
+def test_cli_format_json_with_contracts(tmp_path):
+    pkg = _mini_project(tmp_path, extra_site_row=True)
+    proc = _run_cli(["--contracts", "--format", "json",
+                     "--docs-root", str(tmp_path), str(pkg)])
+    assert proc.returncode == 2
+    import json as _json
+    objs = [_json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.strip()]
+    assert [o for o in objs if o["rule"] == "ZL019"
+            and o["file"].endswith("RELIABILITY.md")]
+
+
+def test_cli_select_accepts_project_rule_ids(tmp_path):
+    pkg = _mini_project(tmp_path, extra_conf_row=True, extra_site_row=True)
+    proc = _run_cli(["--contracts", "--select", "ZL018",
+                     "--docs-root", str(tmp_path), str(pkg)])
+    assert proc.returncode == 2
+    assert "ZL018" in proc.stdout and "ZL019" not in proc.stdout
+
+
+def test_list_rules_includes_project_rules():
+    proc = _run_cli(["--list-rules"])
+    assert proc.returncode == 0
+    for rid in ("ZL014", "ZL015", "ZL016", "ZL017", "ZL018", "ZL019",
+                "ZL020"):
+        assert rid in proc.stdout, rid
+
+
+# ---------------------------------------------------------------------------
+# review regressions: exit-code separation, project-only --select guard,
+# loop-spawned worker pools, the symbol index
+# ---------------------------------------------------------------------------
+
+def test_cli_contracts_code_hazard_exits_one_not_two(tmp_path):
+    """Under --contracts the exit codes stay distinguishable: a tree
+    whose catalogs reconcile but which carries a per-file code hazard
+    exits 1 (code hazard), not 2 (contract drift)."""
+    pkg = _mini_project(tmp_path)
+    (pkg / "hazard.py").write_text(
+        "import jax\n"
+        "def f(rng):\n"
+        "    a = jax.random.normal(rng, (2,))\n"
+        "    return a + jax.random.uniform(rng, (2,))\n")
+    proc = _run_cli(["--contracts", "--docs-root", str(tmp_path), str(pkg)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "ZL001" in proc.stdout
+    # and contract drift still wins the 2
+    (tmp_path / "CONFIG.md").write_text(
+        (tmp_path / "CONFIG.md").read_text()
+        + "| `zoo.mini.phantom` | `x` | phantom |\n")
+    proc = _run_cli(["--contracts", "--docs-root", str(tmp_path), str(pkg)])
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+def test_cli_select_project_rule_without_contracts_fails_loudly(tmp_path):
+    """`--select ZL016` without --contracts would run zero rules and
+    exit 0 forever — the green-gate hazard; it must error instead."""
+    pkg = _mini_project(tmp_path, conf_read_undeclared=True)
+    proc = _run_cli(["--select", "ZL016", str(pkg)])
+    assert proc.returncode == 3
+    assert "--contracts" in proc.stderr
+    # --ignore of a project id stays harmless on a plain scan
+    proc = _run_cli(["--ignore", "ZL016", str(pkg)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_zl014_loop_spawned_worker_pool():
+    """One Thread() call site inside a loop spawns N racing copies of
+    the same root — the worker-pool pattern must count as shared."""
+    src = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._done = 0
+
+    def start(self):
+        for _ in range(4):
+            threading.Thread(target=self._worker, daemon=True).start()
+
+    def _worker(self):
+        self._done += 1
+"""
+    zl = ids(lint_source(src, "analytics_zoo_tpu/serving/x.py"), "ZL014")
+    assert len(zl) == 1
+    # the same shape guarded by a lock stays clean
+    locked = src.replace(
+        "        self._done = 0",
+        "        self._done = 0\n        self._lock = threading.Lock()"
+    ).replace(
+        "    def _worker(self):\n        self._done += 1",
+        "    def _worker(self):\n"
+        "        with self._lock:\n            self._done += 1")
+    assert not ids(lint_source(
+        locked, "analytics_zoo_tpu/serving/x.py"), "ZL014")
+
+
+def test_project_symbol_index_resolves_relative_imports(tmp_path):
+    """The package-wide symbol index: relative imports resolve against
+    the module's own dotted path, and the faults extractor goes through
+    it under the project pass."""
+    from analytics_zoo_tpu.analysis.project import ProjectContext
+    from analytics_zoo_tpu.analysis.contracts import iter_fault_sites
+    pkg = tmp_path / "rootpkg"
+    sub = pkg / "sub"
+    sub.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "faults.py").write_text("def inject(site):\n    return None\n")
+    (sub / "__init__.py").write_text("")
+    (sub / "worker.py").write_text(
+        "from .. import faults\n"
+        "from ..faults import inject as fire\n"
+        "def go():\n"
+        '    faults.inject("sub.read")\n'
+        '    fire("sub.write")\n')
+    project = ProjectContext([str(pkg)])
+    ctx = project.by_name["rootpkg.sub.worker"]
+    imp = project.imports(ctx)
+    assert imp["faults"] == "rootpkg.faults"
+    assert imp["fire"] == "rootpkg.faults.inject"
+    assert project.resolve(ctx, "faults.inject") == "rootpkg.faults.inject"
+    sites = {s.site for s in iter_fault_sites(ctx, project=project)}
+    assert sites == {"sub.read", "sub.write"}
+    # a foreign x.inject() resolved by the index to a NON-faults module
+    # is excluded under the project pass
+    (sub / "other.py").write_text(
+        "from ..legacy import faults\n"     # resolves to rootpkg.legacy.faults
+        "from .helpers import inject\n"
+        "def go():\n"
+        '    inject("not.a.site")\n')
+    project2 = ProjectContext([str(pkg)])
+    ctx2 = project2.by_name["rootpkg.sub.other"]
+    assert not list(iter_fault_sites(ctx2, project=project2))
+
+
+def test_cli_contracts_unparseable_file_exits_one_reported_once(tmp_path):
+    """A broken package file is a CODE hazard: under --contracts it is
+    reported exactly once (ZL000, by the per-file scan) and exits 1 —
+    never 2, which is reserved for genuine contract drift."""
+    pkg = _mini_project(tmp_path)
+    (pkg / "broken.py").write_text("def f(:\n")
+    proc = _run_cli(["--contracts", "--docs-root", str(tmp_path), str(pkg)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert proc.stdout.count("ZL000") == 1, proc.stdout
+
+
+def test_zl020_severity_cell_not_fooled_by_description(tmp_path):
+    """A description mentioning both words ('error in serving/, warning
+    elsewhere') must not mask a flipped severity CELL."""
+    pkg = _mini_project(tmp_path)
+    doc = (tmp_path / "STATIC_ANALYSIS.md").read_text().replace(
+        "| ZL901 | error | the mini rule |",
+        "| ZL901 | warning | error in serving/, warning elsewhere |")
+    (tmp_path / "STATIC_ANALYSIS.md").write_text(doc)
+    fs = _project_findings(tmp_path, pkg, select=["ZL020"])
+    assert len(fs) == 1 and "severity" in fs[0].message
+    # and the matching cell with that same both-words description is clean
+    doc2 = doc.replace("| ZL901 | warning |", "| ZL901 | error |")
+    (tmp_path / "STATIC_ANALYSIS.md").write_text(doc2)
+    assert not _project_findings(tmp_path, pkg, select=["ZL020"])
+
+
+def test_contracts_single_parse_shares_module_contexts(tmp_path):
+    """The --contracts CLI parses each package file once: per-file
+    findings and project findings for the same tree agree with the
+    separately-computed lint_paths + lint_project union."""
+    pkg = _mini_project(tmp_path, conf_read_undeclared=True)
+    proc = _run_cli(["--contracts", "--format", "json",
+                     "--docs-root", str(tmp_path), str(pkg)])
+    import json as _json
+    got = {(o["rule"], o["file"], o["line"])
+           for o in map(_json.loads,
+                        (ln for ln in proc.stdout.splitlines()
+                         if ln.strip()))}
+    expected = {(f.rule_id, f.path, f.line)
+                for f in lint_paths([str(pkg)])} \
+        | {(f.rule_id, f.path, f.line)
+           for f in _project_findings(tmp_path, pkg)}
+    assert got == expected

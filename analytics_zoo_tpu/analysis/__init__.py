@@ -8,11 +8,14 @@ exceptions in serving retry paths, missing buffer donation, unbatched
 host→device transfers in loops, thread-shared state without lock
 discipline, and metric naming/cardinality drift — plus a whole-project
 **contract pass** (``--contracts``) that reconciles the runtime contract
-surfaces (metric registrations, conf keys, fault sites, rule ids)
-against their documented catalogs in both directions.
+surfaces (metric registrations, conf keys, fault sites incl. their test
+coverage, rule ids) against their documented catalogs in both
+directions, and a **device-semantics pass** (``device.py``, ZL021–ZL024)
+that abstract-interprets staged and Pallas code for dtype-promotion
+hazards, mesh-axis discipline, tile alignment and static VMEM budgets.
 
 CLI:     ``python -m analytics_zoo_tpu.analysis [paths...] [--contracts]
-         [--format json]``
+         [--changed-only [--base REF]] [--ci] [--format json]``
 Gate:    ``tests/test_zoolint.py`` (tier-1) asserts zero errors and a
          clean contract reconciliation.
 Docs:    ``docs/guides/STATIC_ANALYSIS.md``

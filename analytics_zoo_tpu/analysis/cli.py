@@ -3,13 +3,22 @@
 Exit status: **0** clean, **1** when any ERROR-severity per-file
 finding survives suppression (warnings never gate), **2** ONLY when the
 ``--contracts`` project pass (whole-package symbol index + the
-code↔docs contract reconciliation, rules ZL016–ZL020) itself finds
-drift, **3** on a usage error (typo'd path/flag/rule id — never
-mistakable for drift). With no paths it scans the installed
-``analytics_zoo_tpu`` package plus the sibling ``tests/`` directory and
-``bench.py`` when they exist — exactly what the CI gate
-(`tests/test_zoolint.py`) runs; under ``--contracts`` each package file
-is parsed once and shared between the per-file and project passes.
+code↔docs contract reconciliation, rules ZL016–ZL020 and ZL022's
+declaration direction) itself finds drift, **3** on a usage error
+(typo'd path/flag/rule id — never mistakable for drift). With no paths
+it scans the installed ``analytics_zoo_tpu`` package plus the sibling
+``tests/`` directory and ``bench.py`` when they exist — exactly what
+the CI gate (`tests/test_zoolint.py`) runs; under ``--contracts`` each
+package file is parsed once and shared between the per-file and
+project passes.
+
+``--changed-only`` scopes the per-file scan to files changed against
+the merge-base with ``--base`` (default ``main``) plus untracked files
+— fast local iteration; outside a git repo it degrades to the full
+scan. ``--ci`` is the one-invocation CI entry point: per-file +
+``--contracts`` with findings mirrored as JSON lines to a results
+file, configured by a committed ``.zoolint.json`` — the tier-1 gate
+and external CI run the identical command (``scripts/zoolint --ci``).
 
 ``--format json`` emits one finding per line as a JSON object
 (``rule``/``file``/``line``/``severity``/``message``) for CI and editor
@@ -21,8 +30,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from .core import (ERROR, all_rules, iter_py_files, lint_context,
                    lint_file, lint_paths)
@@ -62,6 +72,65 @@ def _split_ids(value: Optional[str]) -> Optional[List[str]]:
     return [v.strip() for v in value.split(",") if v.strip()]
 
 
+def _git(anchor: Optional[str], *cmd: str):
+    return subprocess.run(
+        ["git"] + (["-C", anchor] if anchor else []) + list(cmd),
+        capture_output=True, text=True)
+
+
+def git_changed_files(base: str,
+                      anchor: Optional[str] = None) -> Optional[Set[str]]:
+    """Realpaths of files changed in the working tree against the
+    merge-base with ``base`` (untracked files included). ``anchor`` is a
+    directory inside the repo the SCANNED tree belongs to — resolving
+    from the process cwd instead would, from an unrelated repo, produce
+    a changed set containing none of the scanned files and read as a
+    silent green. None when git is unavailable or no work tree is found
+    — the caller degrades to the full scan."""
+    try:
+        top = _git(anchor, "rev-parse", "--show-toplevel")
+    except OSError:
+        return None
+    if top.returncode != 0:
+        return None
+    root = top.stdout.strip()
+    names: Set[str] = set()
+    mb = _git(anchor, "merge-base", base, "HEAD")
+    ref = mb.stdout.strip() if mb.returncode == 0 else None
+    if ref is None:
+        # unknown base ref (fresh clone, renamed default branch):
+        # diff against HEAD so local edits still scope, and say so
+        print(f"zoolint: --base {base} has no merge-base here; "
+              f"diffing against HEAD", file=sys.stderr)
+        ref = "HEAD"
+    diff = _git(anchor, "diff", "--name-only", ref)
+    if diff.returncode == 0:
+        names.update(ln for ln in diff.stdout.splitlines() if ln.strip())
+    untracked = _git(anchor, "ls-files", "--others", "--exclude-standard")
+    if untracked.returncode == 0:
+        names.update(ln for ln in untracked.stdout.splitlines()
+                     if ln.strip())
+    return {os.path.realpath(os.path.join(root, n)) for n in names}
+
+
+def _find_ci_config(paths: List[str]) -> Optional[str]:
+    """``.zoolint.json`` next to the scanned tree: the cwd, then the
+    directory holding the first scanned path, then the package root's
+    parent (the repo root in the default layout)."""
+    candidates = [os.getcwd()]
+    if paths:
+        candidates.append(os.path.dirname(os.path.abspath(paths[0]))
+                          if os.path.isfile(paths[0])
+                          else os.path.abspath(paths[0]))
+        candidates.append(os.path.dirname(os.path.abspath(paths[0])))
+    candidates.append(os.path.dirname(package_root()))
+    for d in candidates:
+        p = os.path.join(d, ".zoolint.json")
+        if os.path.isfile(p):
+            return p
+    return None
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = _Parser(
         prog="zoolint",
@@ -88,12 +157,60 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "resolved under (docs/guides/*.md, docs/CONFIG.md; "
                          "default: the directory containing the scanned "
                          "package)")
+    ap.add_argument("--tests-root", metavar="DIR",
+                    help="tests tree for the --contracts coverage "
+                         "reconciliations (ZL019's every-site-exercised "
+                         "census; default: a scanned 'tests' directory, "
+                         "else <docs-root>/tests when it exists)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="scope the per-file scan to files changed vs the "
+                         "merge-base with --base (plus untracked files); "
+                         "outside a git repo the full scan runs")
+    ap.add_argument("--base", metavar="REF", default="main",
+                    help="git ref --changed-only diffs against "
+                         "(default: main)")
+    ap.add_argument("--ci", action="store_true",
+                    help="CI mode: per-file scan + --contracts in one "
+                         "invocation, findings mirrored as JSON lines to "
+                         "the results file from .zoolint.json (exit "
+                         "contract 0/1/2/3) — the entry point the tier-1 "
+                         "gate runs")
+    ap.add_argument("--results", metavar="FILE",
+                    help="(--ci) override the JSON results file")
     ap.add_argument("--format", choices=("human", "json"), default="human",
                     help="output format: human lines (default) or one "
                          "JSON object per finding")
     ap.add_argument("--list-rules", action="store_true",
                     help="print every registered rule and exit")
     args = ap.parse_args(argv)
+
+    results_path = args.results
+    if args.ci:
+        args.contracts = True
+        cfg_path = _find_ci_config(args.paths)
+        if cfg_path is not None:
+            try:
+                with open(cfg_path, encoding="utf-8") as f:
+                    cfg = json.load(f)
+            except (OSError, ValueError) as e:
+                ap.error(f"cannot read {cfg_path}: {e}")
+            cfg_dir = os.path.dirname(os.path.abspath(cfg_path))
+
+            def _rel(p):
+                return p if os.path.isabs(p) else os.path.join(cfg_dir, p)
+
+            if not args.paths and cfg.get("paths"):
+                args.paths = [_rel(p) for p in cfg["paths"]]
+            if args.docs_root is None and cfg.get("docs_root"):
+                args.docs_root = _rel(cfg["docs_root"])
+            if args.tests_root is None and cfg.get("tests_root"):
+                args.tests_root = _rel(cfg["tests_root"])
+            if results_path is None and cfg.get("results"):
+                results_path = _rel(cfg["results"])
+            if args.select is None and cfg.get("select"):
+                args.select = ",".join(cfg["select"])
+            if args.ignore is None and cfg.get("ignore"):
+                args.ignore = ",".join(cfg["ignore"])
 
     if args.list_rules:
         for rule in all_rules():
@@ -121,15 +238,42 @@ def main(argv: Optional[List[str]] = None) -> int:
     # rule never: zero findings, exit 0 — the same green-gate hazard as
     # an unknown id, so fail just as loudly (--ignore stays harmless)
     if not args.contracts:
-        proj_only = {r.id for r in all_project_rules()}
+        # ZL022 registers in BOTH registries (use direction per-file,
+        # declaration direction in the project pass) — only ids with no
+        # per-file half are project-only
+        proj_only = {r.id for r in all_project_rules()} \
+            - {r.id for r in all_rules()}
         selected_proj = [i for i in (select or []) if i in proj_only]
         if selected_proj:
             ap.error(f"rule id(s) {', '.join(selected_proj)} run only "
                      f"under the project pass — add --contracts")
     paths = args.paths or default_paths()
+    changed: Optional[Set[str]] = None
+    if args.changed_only:
+        # anchor git at the SCANNED tree, not the process cwd — a cwd in
+        # an unrelated repo would otherwise scope to that repo's diff
+        # and silently scan nothing
+        first = os.path.abspath(paths[0])
+        anchor = first if os.path.isdir(first) else os.path.dirname(first)
+        changed = git_changed_files(args.base, anchor=anchor)
+        if changed is None:
+            print("zoolint: --changed-only outside a git repo (or git "
+                  "unavailable) — running the full scan", file=sys.stderr)
+
+    def scan_files():
+        for p in iter_py_files(paths):
+            if changed is None or os.path.realpath(p) in changed:
+                yield p
+
     project_findings: List = []
     if not args.contracts:
-        findings = lint_paths(paths, select=select, ignore=ignore)
+        if changed is None:
+            findings = lint_paths(paths, select=select, ignore=ignore)
+        else:
+            findings = []
+            for path in scan_files():
+                findings.extend(lint_file(path, select=select,
+                                          ignore=ignore))
     else:
         # the contract surfaces govern SHIPPED package code: the project
         # pass indexes the scanned directories that are package roots
@@ -144,14 +288,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         if docs_root is None:
             docs_root = os.path.dirname(
                 os.path.abspath(roots[0]) if roots else package_root())
-        project = ProjectContext(roots, docs_root=docs_root)
+        tests_root = args.tests_root
+        if tests_root is None:
+            named_tests = [p for p in dirs
+                           if os.path.basename(
+                               os.path.abspath(p)) == "tests"]
+            if named_tests:
+                tests_root = named_tests[0]
+            elif os.path.isdir(os.path.join(docs_root, "tests")):
+                tests_root = os.path.join(docs_root, "tests")
+        project = ProjectContext(roots, docs_root=docs_root,
+                                 tests_root=tests_root)
         # per-file rules reuse the project's already-parsed modules —
         # one parse per package file for both passes; files outside the
         # package roots (tests/, bench.py) parse normally, and a broken
         # package file falls through to lint_file so ZL000 is reported
-        # exactly once, by the per-file scan
+        # exactly once, by the per-file scan. --changed-only scopes the
+        # per-file half only — the contract surfaces are whole-tree by
+        # construction.
         findings = []
-        for path in iter_py_files(paths):
+        for path in scan_files():
             ctx = project.by_path.get(path)
             findings.extend(
                 lint_context(ctx, select=select, ignore=ignore)
@@ -164,11 +320,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
     if args.errors_only:
         findings = [f for f in findings if f.severity == ERROR]
+
+    def _jsonl(f) -> str:
+        return json.dumps({"rule": f.rule_id, "file": f.path,
+                           "line": f.line, "severity": f.severity,
+                           "message": f.message}, sort_keys=True)
+
+    if args.ci and results_path:
+        try:
+            with open(results_path, "w", encoding="utf-8") as out:
+                for f in findings:
+                    out.write(_jsonl(f) + "\n")
+        except OSError as e:
+            # an unwritable results file must not mask the scan verdict
+            print(f"zoolint: cannot write results file "
+                  f"{results_path}: {e}", file=sys.stderr)
     for f in findings:
         if args.format == "json":
-            print(json.dumps({"rule": f.rule_id, "file": f.path,
-                              "line": f.line, "severity": f.severity,
-                              "message": f.message}, sort_keys=True))
+            print(_jsonl(f))
         else:
             print(f.format())
     errors = sum(1 for f in findings if f.severity == ERROR)

@@ -750,11 +750,15 @@ class ConfCatalogDrift(ProjectRule):
 
 @register_project
 class FaultSiteCatalogDrift(ProjectRule):
-    """**Fault-site catalog reconciliation (code↔RELIABILITY.md).**
+    """**Fault-site reconciliation (code↔RELIABILITY.md↔tests/).**
     Chaos plans target sites by name; a site missing from the catalog
     is un-plannable, and a cataloged site no code fires makes a chaos
     plan silently test nothing (its specs never fire and ``plan.fired``
-    reconciliation hides the gap only if the test author notices)."""
+    reconciliation hides the gap only if the test author notices). The
+    third direction (on when a tests root is configured) closes the
+    loop: every injected site must appear in the tests tree's string
+    census — a new fault site without deterministic chaos coverage
+    fails ``--contracts`` instead of riding on reviewer discipline."""
 
     id = "ZL019"
     severity = ERROR
@@ -801,6 +805,23 @@ class FaultSiteCatalogDrift(ProjectRule):
                     f"fault site '{site}' is cataloged here but no "
                     f"faults.inject call fires it — prune the row or "
                     f"restore the instrumentation")
+        # third direction (needs a tests root): every package site must
+        # be EXERCISED by at least one test — the ROADMAP's
+        # deterministic-chaos-coverage convention, machine-checked. A
+        # chaos plan necessarily spells the site name as a string
+        # (`plan.add("backend.xread", ...)`), so a site absent from the
+        # tests tree's string census ships a recovery path no test runs.
+        census = project.tests_string_census()
+        if census is not None:
+            for site, s in sorted(code.items()):
+                if site not in census:
+                    yield Finding(
+                        self.id, ERROR, s.path, s.line,
+                        f"fault site '{site}' is injected here but no "
+                        f"test mentions it — add deterministic chaos "
+                        f"coverage (a FaultPlan targeting '{site}' with "
+                        f"an exact plan.fired reconciliation) so the "
+                        f"recovery path does not ship untested")
 
 
 @register_project

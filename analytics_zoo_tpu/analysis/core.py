@@ -597,6 +597,7 @@ def register(cls):
 
 def all_rules() -> List[Rule]:
     from . import rules  # noqa: F401  (registers on first import)
+    from . import device  # noqa: F401  (device-semantics rules ZL021-ZL024)
     return sorted(_REGISTRY.values(), key=lambda r: r.id)
 
 

@@ -1,0 +1,1308 @@
+"""zoolint device-semantics pass — rules ZL021–ZL024.
+
+The per-file rules in ``rules.py`` flag *structural* staged-computation
+hazards (host effects, traced branches, missing donation). This third
+stage adds a lightweight **abstract interpreter** over jit-staged and
+Pallas-kernel code: a straight-line walk that tracks constant-foldable
+integers, dtypes, tile-alignment facts and ``pad_to_multiple`` padding
+through assignments and this codebase's known call idioms (``round_up``,
+``min``/``max`` clamps, ``x // m * m`` floors, local helper calls one
+level deep). On top of it:
+
+* **ZL021** — dtype-promotion hazards in staged bodies: explicit float64
+  dtype introductions (silently truncated under TPU x64-off), bf16/fp16
+  reductions and MXU dots without an explicit f32 accumulation, and
+  ``lax.scan`` carries initialized in a 16-bit dtype yet accumulated
+  into (the fused-CE f32-carry discipline, generalized).
+* **ZL022** — mesh-axis discipline: every axis name appearing in a
+  ``PartitionSpec``/collective must come from the declared axis
+  vocabulary extracted from the package's mesh module
+  (``parallel/mesh.py``) or an in-file ``Mesh(...)`` construction; the
+  project pass adds the reverse direction (declared-but-never-used
+  axes, warning severity).
+* **ZL023** — Pallas tile alignment: block-shape dims in ``BlockSpec``/
+  ``pltpu.VMEM`` must be *provably* on the LANES/SUBLANES tile floors —
+  ``round_up``-wrapped expressions, ``// m * m`` floors and
+  already-aligned constants prove out; a raw ``min()`` clamp that can
+  land off the floor is exactly the Mosaic-only-fails-on-TPU bug class
+  PR 8's review caught by hand.
+* **ZL024** — static VMEM budget: a provable LOWER bound on a
+  ``pallas_call``'s double-buffered operand windows + outputs + scratch
+  is priced with the **same footprint estimator the runtime autotuner
+  uses** (``ops/pallas/common.kernel_vmem_bytes``, loaded standalone —
+  no jax import) against the 16 MiB per-core default; a kernel that
+  provably cannot fit fails lint instead of a TPU run.
+
+The estimator module is loaded straight off ``ops/pallas/common.py``
+with ``importlib`` (no package ``__init__`` chain, so the linter stays
+jax-free); when the file is missing (linting a foreign tree) the
+tile-floor constants fall back to the hardware values and ZL024 skips.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import math
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import (ERROR, WARNING, Finding, ModuleContext, Rule, dotted,
+                   register)
+from .project import ProjectContext, ProjectRule, register_project
+
+# ---------------------------------------------------------------------------
+# the shared footprint estimator (ops/pallas/common.py), loaded standalone
+# ---------------------------------------------------------------------------
+
+_FALLBACK_LANES = 128
+_FALLBACK_SUBLANES = 8
+_common_mod = None
+_common_tried = False
+
+
+def footprint_module():
+    """The live ``ops/pallas/common.py`` module — the SAME estimator the
+    runtime autotuner prices blocks with — loaded standalone so no jax
+    (or package ``__init__``) import happens. None when unavailable."""
+    global _common_mod, _common_tried
+    if _common_tried:
+        return _common_mod
+    _common_tried = True
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ops", "pallas", "common.py")
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "_zoolint_pallas_common", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _common_mod = mod
+    # a missing/broken estimator degrades ZL024 to a skip — the per-file
+    # alignment rules keep running on the fallback tile constants
+    except Exception:  # zoolint: disable=ZL007
+        _common_mod = None
+    return _common_mod
+
+
+def _tile_floors() -> Tuple[int, int]:
+    mod = footprint_module()
+    if mod is not None:
+        return int(mod.LANES), int(mod.SUBLANES)
+    return _FALLBACK_LANES, _FALLBACK_SUBLANES
+
+
+# ---------------------------------------------------------------------------
+# dtype resolution
+# ---------------------------------------------------------------------------
+
+_F64 = {"float64"}
+_F16 = {"bfloat16", "float16"}
+_CANON = {"double": "float64", "half": "float16", "single": "float32"}
+_DTYPE_LEAVES = {"float64", "double", "float32", "single", "bfloat16",
+                 "float16", "half", "int8", "int16", "int32", "int64",
+                 "uint8", "uint16", "uint32", "uint64", "bool_",
+                 "complex64", "complex128"}
+_ITEMSIZE = {"float64": 8, "complex64": 8, "complex128": 16, "int64": 8,
+             "uint64": 8, "float32": 4, "int32": 4, "uint32": 4,
+             "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+             "int8": 1, "uint8": 1, "bool_": 1}
+
+
+def dtype_of_node(ctx: ModuleContext, node: ast.AST) -> Optional[str]:
+    """The canonical dtype a dtype-denoting expression names:
+    ``jnp.float64`` / ``np.bfloat16`` / ``"float64"`` string literals /
+    names from-imported off numpy or jax.numpy. None when the expression
+    is not a recognizable dtype."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        leaf = node.value
+        if leaf in _DTYPE_LEAVES:
+            return _CANON.get(leaf, leaf)
+        return None
+    d = dotted(node)
+    if not d:
+        return None
+    if "." in d:
+        prefix, leaf = d.rsplit(".", 1)
+        if leaf in _DTYPE_LEAVES and (
+                prefix in ctx.aliases.get("numpy", ())
+                or prefix in ctx.aliases.get("jax.numpy", ())):
+            return _CANON.get(leaf, leaf)
+        return None
+    for mod in ("numpy", "jax.numpy"):
+        orig = ctx.from_imported(mod).get(d)
+        if orig in _DTYPE_LEAVES:
+            return _CANON.get(orig, orig)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the abstract interpreter
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Abs:
+    """One abstract value: what the interpreter could prove about an
+    expression. ``align`` is a divisor the value is provably a multiple
+    of; ``low`` a provable positive lower bound; ``clamped`` marks a
+    ``min()``-style derivation whose result may have left the tile floor
+    (cleared again by ``round_up``/``// m * m``); ``from_shape`` marks a
+    dim pulled straight off an array's ``.shape`` (a whole-axis block
+    dim, which Mosaic pads — exempt from alignment proofs); ``pads``
+    carries ``pad_to_multiple`` facts (axis -> multiple) on arrays."""
+
+    const: Optional[int] = None
+    dtype: Optional[str] = None
+    align: int = 1
+    low: int = 1
+    clamped: bool = False
+    from_shape: bool = False
+    pads: Optional[Dict[int, int]] = None
+    elts: Optional[List["Abs"]] = None      # tuple values (returns, literals)
+
+    @staticmethod
+    def of_const(v: int) -> "Abs":
+        return Abs(const=v, align=max(abs(v), 1), low=max(v, 1))
+
+
+_REDUCERS = ("sum", "mean", "prod", "cumsum", "cumprod")
+_DOTS = ("dot", "matmul", "dot_general", "tensordot")
+
+
+class Interp:
+    """Straight-line abstract interpretation of one function (or the
+    module top level): a forward statement walk building ``name -> Abs``.
+    Branch arms apply in order (the join is last-writer-wins — fine for
+    *proofs*: a fact is only used to prove alignment/dtype, and an
+    over-written fact merely loses precision). Local helper calls
+    resolve one level deep so ``_prep``-style tuple returns carry their
+    alignment facts to the caller."""
+
+    def __init__(self, ctx: ModuleContext, depth: int = 0):
+        self.ctx = ctx
+        self.depth = depth
+        self._module_env: Optional[Dict[str, Abs]] = None
+        # names import-bound to the hardware tile constants — cached ON
+        # the context: three rules and every resolved helper call build
+        # an Interp, and re-walking the tree per instance is O(calls ×
+        # tree) for a fact that never changes
+        cached = getattr(ctx, "_zl_tile_names", None)
+        if cached is None:
+            cached = {}
+            lanes, sublanes = _tile_floors()
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ImportFrom):
+                    for a in node.names:
+                        if a.name == "LANES":
+                            cached[a.asname or a.name] = lanes
+                        elif a.name == "SUBLANES":
+                            cached[a.asname or a.name] = sublanes
+            ctx._zl_tile_names = cached  # type: ignore[attr-defined]
+        self._tile_names: Dict[str, int] = cached
+
+    # -- environments -------------------------------------------------------
+    def module_env(self) -> Dict[str, Abs]:
+        if self._module_env is None:
+            self._module_env = {}
+            self._walk_stmts(self.ctx.tree.body, self._module_env)
+        return self._module_env
+
+    def env_of(self, fn: ast.AST) -> Dict[str, Abs]:
+        env: Dict[str, Abs] = {}
+        body = fn.body if not isinstance(fn, ast.Lambda) else []
+        self._walk_stmts(body, env)
+        return env
+
+    def _walk_stmts(self, stmts, env: Dict[str, Abs]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue        # separate scope
+            if isinstance(stmt, ast.Assign):
+                self._assign(stmt.targets, stmt.value, env)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self._assign([stmt.target], stmt.value, env)
+            elif isinstance(stmt, ast.AugAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                synth = ast.BinOp(left=ast.Name(id=stmt.target.id,
+                                                ctx=ast.Load()),
+                                  op=stmt.op, right=stmt.value)
+                self._bind(env, stmt.target.id, self._binop_abs(
+                    stmt.op, self.eval(synth.left, env),
+                    self.eval(stmt.value, env)))
+            elif isinstance(stmt, (ast.If, ast.For, ast.AsyncFor,
+                                   ast.While)):
+                self._walk_stmts(stmt.body, env)
+                self._walk_stmts(stmt.orelse, env)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._walk_stmts(stmt.body, env)
+            elif isinstance(stmt, ast.Try):
+                self._walk_stmts(stmt.body, env)
+                for h in stmt.handlers:
+                    self._walk_stmts(h.body, env)
+                self._walk_stmts(stmt.finalbody, env)
+
+    @staticmethod
+    def _bind(env: Dict[str, Abs], name: str, val: Abs) -> None:
+        """Bind with a dtype-conflict demotion: the env is last-writer-
+        wins (flow-insensitive), which is fine for *proofs* but not for
+        *accusations* — ZL021 flags on a tracked 16-bit dtype, and a
+        name rebound f32-then-bf16 must not retroactively accuse the
+        earlier f32 use. Two CONCRETE, different dtypes on one name
+        demote it to unknown; everything else keeps the last writer."""
+        old = env.get(name)
+        if old is not None and old.dtype and val.dtype \
+                and old.dtype != val.dtype:
+            val = dataclasses.replace(val, dtype=None)
+        env[name] = val
+
+    def _assign(self, targets, value, env: Dict[str, Abs]) -> None:
+        val = self.eval(value, env)
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self._bind(env, t.id, val)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                if val.elts is not None and len(val.elts) == len(t.elts):
+                    for sub, sv in zip(t.elts, val.elts):
+                        if isinstance(sub, ast.Name):
+                            self._bind(env, sub.id, sv)
+                elif self._is_shape_expr(value):
+                    pads = val.pads or {}
+                    for i, sub in enumerate(t.elts):
+                        if isinstance(sub, ast.Name):
+                            env[sub.id] = Abs(from_shape=True,
+                                              align=pads.get(i, 1),
+                                              low=max(pads.get(i, 1), 1))
+
+    @staticmethod
+    def _is_shape_expr(node: ast.AST) -> bool:
+        return isinstance(node, ast.Attribute) and node.attr == "shape"
+
+    # -- expression evaluation ----------------------------------------------
+    def eval(self, node: ast.AST, env: Dict[str, Abs]) -> Abs:
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return Abs()
+            if isinstance(node.value, int):
+                return Abs.of_const(node.value)
+            if isinstance(node.value, float):
+                return Abs()
+            return Abs()
+        if isinstance(node, ast.Name):
+            if node.id in self._tile_names:
+                return Abs.of_const(self._tile_names[node.id])
+            if node.id in env:
+                return env[node.id]
+            return self.module_env().get(node.id, Abs())
+        if isinstance(node, ast.Attribute):
+            d = dotted(node)
+            if d and d.split(".")[-1] in self._tile_names:
+                return Abs.of_const(self._tile_names[d.split(".")[-1]])
+            # module-constant via alias (mesh_lib.LANES-style) stays
+            # unresolved here; dtype leaves are handled by dtype_of_node
+            return Abs()
+        if isinstance(node, ast.BinOp):
+            return self._binop_abs(node.op, self.eval(node.left, env),
+                                   self.eval(node.right, env),
+                                   node=node, env=env)
+        if isinstance(node, ast.UnaryOp):
+            inner = self.eval(node.operand, env)
+            if isinstance(node.op, ast.USub) and inner.const is not None:
+                return Abs.of_const(-inner.const)
+            return Abs(dtype=inner.dtype)
+        if isinstance(node, ast.IfExp):
+            a = self.eval(node.body, env)
+            b = self.eval(node.orelse, env)
+            return self._join(a, b)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return Abs(elts=[self.eval(e, env) for e in node.elts])
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            if self._is_shape_expr(base):
+                arr = self.eval(base.value, env)
+                idx = self.eval(node.slice, env)
+                pads = arr.pads or {}
+                if idx.const is not None and idx.const in pads:
+                    m = pads[idx.const]
+                    return Abs(from_shape=True, align=m, low=m)
+                return Abs(from_shape=True)
+            seq = self.eval(base, env)
+            idx = self.eval(node.slice, env)
+            if seq.elts is not None and idx.const is not None \
+                    and 0 <= idx.const < len(seq.elts):
+                return seq.elts[idx.const]
+            return Abs()
+        if isinstance(node, ast.Call):
+            return self._call_abs(node, env)
+        return Abs()
+
+    def _join(self, a: Abs, b: Abs) -> Abs:
+        return Abs(const=a.const if a.const == b.const else None,
+                   dtype=a.dtype if a.dtype == b.dtype else None,
+                   align=math.gcd(a.align, b.align) or 1,
+                   low=min(a.low, b.low),
+                   clamped=a.clamped or b.clamped,
+                   from_shape=a.from_shape and b.from_shape)
+
+    def _binop_abs(self, op, a: Abs, b: Abs, node=None, env=None) -> Abs:
+        dtype = self._promote(a.dtype, b.dtype)
+        if isinstance(op, (ast.Add, ast.Sub)):
+            const = None
+            if a.const is not None and b.const is not None:
+                const = a.const + b.const if isinstance(op, ast.Add) \
+                    else a.const - b.const
+            out = Abs(const=const, dtype=dtype,
+                      align=math.gcd(a.align, b.align) or 1,
+                      clamped=a.clamped or b.clamped)
+            if isinstance(op, ast.Add):
+                out.low = a.low + b.low
+            if const is not None:
+                out.align = max(abs(const), 1)
+                out.low = max(const, 1)
+            return out
+        if isinstance(op, ast.Mult):
+            # the `x // m * m` floor pattern proves alignment to m
+            if node is not None and isinstance(node.left, ast.BinOp) \
+                    and isinstance(node.left.op, ast.FloorDiv) \
+                    and b.const is not None and b.const > 0:
+                return Abs(align=b.const, low=b.const, dtype=dtype)
+            const = None
+            if a.const is not None and b.const is not None:
+                const = a.const * b.const
+            return Abs(const=const, dtype=dtype,
+                       align=max(a.align * b.align, 1),
+                       low=max(a.low * b.low, 1),
+                       clamped=a.clamped or b.clamped)
+        if isinstance(op, ast.FloorDiv):
+            if a.const is not None and b.const is not None and b.const:
+                return Abs.of_const(a.const // b.const)
+            # a bare floor-div is the block-halving hazard until a
+            # `* m` / round_up re-floors it
+            return Abs(clamped=True, dtype=dtype)
+        if isinstance(op, ast.Mod):
+            if a.const is not None and b.const is not None and b.const:
+                return Abs.of_const(a.const % b.const)
+            return Abs(dtype=dtype)
+        return Abs(dtype=dtype)
+
+    @staticmethod
+    def _promote(a: Optional[str], b: Optional[str]) -> Optional[str]:
+        if a == b:
+            return a
+        if a in _F16 and b in ("float32", "float64"):
+            return b
+        if b in _F16 and a in ("float32", "float64"):
+            return a
+        return None
+
+    # -- calls ---------------------------------------------------------------
+    def _call_abs(self, node: ast.Call, env: Dict[str, Abs]) -> Abs:
+        d = dotted(node.func)
+        leaf = d.split(".")[-1] if d else None
+        args = [self.eval(a, env) for a in node.args
+                if not isinstance(a, ast.Starred)]
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+
+        if leaf in ("round_up", "_round_up") and len(args) >= 2 \
+                and args[1].const is not None and args[1].const > 0:
+            m = args[1].const
+            const = None
+            if args[0].const is not None:
+                const = -(-args[0].const // m) * m
+            return Abs(const=const, align=m if const is None
+                       else max(const, 1), low=max(m, 1))
+        if leaf == "min" and "." not in (d or "") and args:
+            consts = [a.const for a in args]
+            if all(c is not None for c in consts):
+                return Abs.of_const(min(consts))
+            return Abs(align=math.gcd(*[a.align for a in args])
+                       if len(args) > 1 else args[0].align,
+                       low=min(a.low for a in args),
+                       clamped=True,
+                       dtype=args[0].dtype if len(args) == 1 else None)
+        if leaf == "max" and "." not in (d or "") and args:
+            consts = [a.const for a in args]
+            if all(c is not None for c in consts):
+                return Abs.of_const(max(consts))
+            return Abs(align=math.gcd(*[a.align for a in args])
+                       if len(args) > 1 else args[0].align,
+                       low=max(a.low for a in args),
+                       clamped=any(a.clamped for a in args))
+        if leaf == "pad_to_multiple" and len(node.args) >= 3:
+            base = args[0]
+            axis, mult = args[1], args[2]
+            pads = dict(base.pads or {})
+            if axis.const is not None and mult.const is not None:
+                pads[axis.const] = mult.const
+            return Abs(dtype=base.dtype, pads=pads or None)
+        if leaf == "astype" and isinstance(node.func, ast.Attribute) \
+                and node.args:
+            dt = dtype_of_node(self.ctx, node.args[0])
+            recv = self.eval(node.func.value, env)
+            return Abs(dtype=dt or recv.dtype, pads=recv.pads,
+                       from_shape=recv.from_shape)
+        if leaf in ("reshape", "transpose", "swapaxes", "ravel"):
+            recv = self.eval(node.func.value, env) \
+                if isinstance(node.func, ast.Attribute) else Abs()
+            return Abs(dtype=recv.dtype)
+        # dtype-introducing array constructors
+        if leaf in ("zeros", "ones", "full", "empty", "asarray", "array",
+                    "arange", "zeros_like", "ones_like", "full_like"):
+            dt = None
+            if "dtype" in kw:
+                dt = dtype_of_node(self.ctx, kw["dtype"])
+            elif node.args:
+                for cand in node.args[1:]:
+                    dt = dtype_of_node(self.ctx, cand)
+                    if dt:
+                        break
+            return Abs(dtype=dt)
+        if leaf in _DOTS and "preferred_element_type" in kw:
+            return Abs(dtype=dtype_of_node(self.ctx,
+                                           kw["preferred_element_type"]))
+        if leaf in _REDUCERS and "dtype" in kw:
+            return Abs(dtype=dtype_of_node(self.ctx, kw["dtype"]))
+        # a dtype-object call like np.float64(x) yields that dtype
+        dt = dtype_of_node(self.ctx, node.func)
+        if dt is not None:
+            return Abs(dtype=dt)
+        # one level of local-helper resolution: tuple returns carry
+        # their alignment facts to the caller's unpack (_prep-style)
+        if self.depth < 1 and isinstance(node.func, ast.Name):
+            fn = self.ctx._resolve_local_fn(node, node.func.id)
+            if fn is not None and not isinstance(fn, ast.Lambda):
+                return self._eval_callee(fn, node, env)
+        return Abs()
+
+    def _eval_callee(self, fn, call: ast.Call,
+                     env: Dict[str, Abs]) -> Abs:
+        sub = Interp(self.ctx, depth=self.depth + 1)
+        sub._module_env = self._module_env
+        cenv: Dict[str, Abs] = {}
+        params = [p.arg for p in list(fn.args.posonlyargs)
+                  + list(fn.args.args)]
+        # defaults right-align onto the positional params
+        defaults = fn.args.defaults
+        for name, dflt in zip(params[len(params) - len(defaults):],
+                              defaults):
+            cenv[name] = self.eval(dflt, env)
+        for name, arg in zip(params, call.args):
+            if not isinstance(arg, ast.Starred):
+                cenv[name] = self.eval(arg, env)
+        for k in call.keywords:
+            if k.arg in params:
+                cenv[k.arg] = self.eval(k.value, env)
+        sub._walk_stmts(fn.body, cenv)
+        rets = [n for n in ast.walk(fn) if isinstance(n, ast.Return)
+                and n.value is not None
+                and not self.ctx.in_nested_scope(n, fn)]
+        out: Optional[Abs] = None
+        for r in rets:
+            val = sub.eval(r.value, cenv)
+            out = val if out is None else self._join_ret(out, val)
+        return out or Abs()
+
+    def _join_ret(self, a: Abs, b: Abs) -> Abs:
+        if a.elts is not None and b.elts is not None \
+                and len(a.elts) == len(b.elts):
+            return Abs(elts=[self._join(x, y)
+                             for x, y in zip(a.elts, b.elts)])
+        return self._join(a, b)
+
+
+# ---------------------------------------------------------------------------
+# staged-function discovery (jit + scan bodies + pallas kernels)
+# ---------------------------------------------------------------------------
+
+def _pallas_names(ctx: ModuleContext
+                  ) -> Tuple[Set[str], Set[str], Dict[str, str]]:
+    """``(pallas_prefixes, tpu_prefixes, bare)`` — local names bound to
+    the ``jax.experimental.pallas`` module, its ``tpu`` submodule, and
+    ``local name -> original`` for bare from-imports of
+    ``BlockSpec``/``pallas_call``/``VMEM``."""
+    pallas: Set[str] = set()
+    tpu: Set[str] = set()
+    bare: Dict[str, str] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.experimental.pallas":
+                    pallas.add(a.asname or "jax.experimental.pallas")
+                elif a.name == "jax.experimental.pallas.tpu":
+                    tpu.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module == "jax.experimental":
+                for a in node.names:
+                    if a.name == "pallas":
+                        pallas.add(a.asname or a.name)
+            elif node.module == "jax.experimental.pallas":
+                for a in node.names:
+                    if a.name == "tpu":
+                        tpu.add(a.asname or a.name)
+                    elif a.name in ("BlockSpec", "pallas_call"):
+                        bare[a.asname or a.name] = a.name
+            elif node.module == "jax.experimental.pallas.tpu":
+                for a in node.names:
+                    if a.name == "VMEM":
+                        bare[a.asname or a.name] = a.name
+    return pallas, tpu, bare
+
+
+def _is_pallas_attr(ctx: ModuleContext, node: ast.AST,
+                    leafs: Tuple[str, ...]) -> bool:
+    pallas, tpu, bare = _pallas_cached(ctx)
+    d = dotted(node)
+    if not d:
+        return False
+    if "." in d:
+        prefix, leaf = d.rsplit(".", 1)
+        return leaf in leafs and (prefix in pallas or prefix in tpu)
+    return bare.get(d) in leafs
+
+
+def _pallas_cached(ctx: ModuleContext):
+    # cached ON the context — an id()-keyed global dict would hand a
+    # recycled id the previous module's aliases after GC
+    got = getattr(ctx, "_zl_pallas_names", None)
+    if got is None:
+        got = _pallas_names(ctx)
+        ctx._zl_pallas_names = got  # type: ignore[attr-defined]
+    return got
+
+
+def uses_pallas(ctx: ModuleContext) -> bool:
+    pallas, tpu, bare = _pallas_cached(ctx)
+    return bool(pallas or tpu or bare)
+
+
+def pallas_kernel_fns(ctx: ModuleContext) -> List[ast.AST]:
+    """Functions handed to ``pl.pallas_call`` — directly or through
+    ``functools.partial(kernel, ...)``."""
+    out: List[ast.AST] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _is_pallas_attr(ctx, node.func, ("pallas_call",))):
+            continue
+        if not node.args:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Call):        # functools.partial(kernel,..)
+            d = dotted(target.func)
+            if d and d.split(".")[-1] == "partial" and target.args:
+                target = target.args[0]
+        if isinstance(target, ast.Name):
+            fn = ctx._resolve_local_fn(node, target.id)
+            if fn is not None:
+                out.append(fn)
+    return out
+
+
+def staged_fns(ctx: ModuleContext) -> List[ast.AST]:
+    """Every function whose body runs on-device: jit-staged, scan-family
+    bodies, and pallas kernels."""
+    seen: Set[int] = set()
+    out: List[ast.AST] = []
+    for info in ctx.jitted.values():
+        if id(info.fn) not in seen:
+            seen.add(id(info.fn))
+            out.append(info.fn)
+    for fn in list(ctx.scan_bodies) + pallas_kernel_fns(ctx):
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append(fn)
+    return out
+
+
+def _in_package(path: str) -> bool:
+    if os.path.exists(path):
+        path = os.path.abspath(path)
+    p = path.replace("\\", "/")
+    return "/analytics_zoo_tpu/" in p or p.startswith("analytics_zoo_tpu/")
+
+
+# ---------------------------------------------------------------------------
+# ZL021 — dtype-promotion hazards in staged bodies
+# ---------------------------------------------------------------------------
+
+#: call positions that INTRODUCE a dtype (a comparison like
+#: ``x.dtype == jnp.float64`` is a guard, not an introduction)
+_DTYPE_CTORS = ("zeros", "ones", "full", "empty", "asarray", "array",
+                "arange", "zeros_like", "ones_like", "full_like",
+                "astype", "convert_element_type")
+
+
+@register
+class DtypePromotionHazard(Rule):
+    """Dtype-promotion hazards inside jit-staged / scan / pallas-kernel
+    bodies: (1) an explicit **float64** introduction — under the TPU
+    default (x64 off) jax silently truncates it to float32, and with
+    ``jax_enable_x64`` the MXU runs it at a fraction of rate; (2) a
+    **bf16/fp16 reduction or MXU dot without f32 accumulation** — the
+    sum accumulates in the 16-bit type and loses mass at long-context
+    lengths (pass ``dtype=jnp.float32`` / ``preferred_element_type``);
+    (3) a **16-bit ``lax.scan`` carry that is accumulated into** — the
+    fused-CE discipline is an f32 carry (``jnp.zeros(..., jnp.float32)``
+    or ``.astype(jnp.float32)`` on the init) rounded once after the
+    scan. Error in package code, warning elsewhere."""
+
+    id = "ZL021"
+    severity = ERROR
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        sev = ERROR if _in_package(ctx.path) else WARNING
+        interp = Interp(ctx)
+        for fn in staged_fns(ctx):
+            env = interp.env_of(fn)
+            yield from self._scan_body_nodes(ctx, interp, fn, env, sev)
+        yield from self._scan_carries(ctx, interp, sev)
+
+    # -- (1) float64 introductions + (2) 16-bit accumulation ----------------
+    def _scan_body_nodes(self, ctx, interp, fn, env, sev):
+        body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                leaf = d.split(".")[-1] if d else None
+                kw = {k.arg: k.value for k in node.keywords if k.arg}
+                # float64 introduction
+                intro: Optional[ast.AST] = None
+                if "dtype" in kw:
+                    intro = kw["dtype"]
+                elif leaf == "astype" and node.args:
+                    intro = node.args[0]
+                elif leaf in _DTYPE_CTORS and len(node.args) >= 2:
+                    intro = node.args[-1]
+                elif dtype_of_node(ctx, node.func) in _F64 and node.args:
+                    intro = node.func      # np.float64(x) constructor
+                if intro is not None and dtype_of_node(ctx, intro) in _F64:
+                    yield self.finding(
+                        ctx, node.lineno,
+                        "float64 introduced in a jit-staged body: under "
+                        "the TPU default (x64 off) this silently "
+                        "truncates to float32; with jax_enable_x64 it "
+                        "cripples MXU rate — use float32 (accumulate in "
+                        "f32, not f64)", sev)
+                    continue
+                # 16-bit reduction without f32 accumulation
+                if leaf in _REDUCERS and "dtype" not in kw:
+                    operand = None
+                    if d and "." in d and node.args:
+                        prefix = d.rsplit(".", 1)[0]
+                        if prefix in ctx.aliases.get("jax.numpy", ()) \
+                                or prefix in ctx.aliases.get("numpy", ()):
+                            operand = node.args[0]
+                        elif isinstance(node.func, ast.Attribute):
+                            operand = node.func.value  # x.sum() method
+                    elif isinstance(node.func, ast.Attribute):
+                        operand = node.func.value
+                    if operand is not None \
+                            and interp.eval(operand, env).dtype in _F16:
+                        yield self.finding(
+                            ctx, node.lineno,
+                            f"{leaf}() over a bfloat16/float16 value "
+                            f"accumulates in the 16-bit dtype — mass "
+                            f"is lost at scale; pass dtype=jnp.float32 "
+                            f"(or upcast the operand) and round once "
+                            f"at the end", sev)
+                        continue
+                # 16-bit MXU dot without preferred_element_type
+                if leaf in _DOTS and "preferred_element_type" not in kw:
+                    ops = node.args[:2]
+                    if any(interp.eval(o, env).dtype in _F16
+                           for o in ops):
+                        yield self.finding(
+                            ctx, node.lineno,
+                            f"{leaf}() on bfloat16/float16 operands "
+                            f"without preferred_element_type=jnp."
+                            f"float32 — the MXU accumulates at full "
+                            f"rate in f32 for free; without it the "
+                            f"product rounds per-tile in 16 bits", sev)
+
+    # -- (3) 16-bit scan carries -------------------------------------------
+    def _scan_carries(self, ctx, interp, sev):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if not d or d.split(".")[-1] != "scan" \
+                    or "lax" not in d.split("."):
+                continue
+            if len(node.args) < 2:
+                continue
+            body = None
+            if isinstance(node.args[0], ast.Name):
+                body = ctx._resolve_local_fn(node, node.args[0].id)
+            if body is None or isinstance(body, ast.Lambda):
+                continue
+            scope = ctx._enclosing_scope(node)
+            caller_env = interp.env_of(scope) \
+                if not isinstance(scope, ast.Module) \
+                else interp.module_env()
+            init = node.args[1]
+            init_elts: List[Abs]
+            if isinstance(init, (ast.Tuple, ast.List)):
+                init_elts = [interp.eval(e, caller_env)
+                             for e in init.elts]
+            else:
+                folded = interp.eval(init, caller_env)
+                # a tuple init bound through a name folds to its elements
+                init_elts = folded.elts if folded.elts is not None \
+                    else [folded]
+            params = [p.arg for p in body.args.args]
+            if not params:
+                continue
+            carry_name = params[0]
+            # map carry slots: `a, b = carry` unpack, or the carry used
+            # whole (single-component init)
+            slots: Dict[str, int] = {}
+            for stmt in body.body:
+                if isinstance(stmt, ast.Assign) \
+                        and isinstance(stmt.value, ast.Name) \
+                        and stmt.value.id == carry_name \
+                        and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0],
+                                       (ast.Tuple, ast.List)):
+                    for i, t in enumerate(stmt.targets[0].elts):
+                        if isinstance(t, ast.Name):
+                            slots[t.id] = i
+            if len(init_elts) == 1:
+                slots.setdefault(carry_name, 0)
+            # body signature `def f(carry, x)` where carry IS a tuple
+            # param destructured via subscripts — skip (unresolvable)
+            for stmt in ast.walk(body):
+                target = None
+                if isinstance(stmt, ast.AugAssign) \
+                        and isinstance(stmt.op, ast.Add) \
+                        and isinstance(stmt.target, ast.Name):
+                    target = stmt.target.id
+                elif isinstance(stmt, ast.Assign) \
+                        and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name) \
+                        and isinstance(stmt.value, ast.BinOp) \
+                        and isinstance(stmt.value.op, ast.Add):
+                    tname = stmt.targets[0].id
+                    sides = (stmt.value.left, stmt.value.right)
+                    if any(isinstance(s, ast.Name) and s.id == tname
+                           for s in sides):
+                        target = tname
+                if target is None or target not in slots:
+                    continue
+                slot = slots[target]
+                if slot >= len(init_elts):
+                    continue
+                if init_elts[slot].dtype in _F16:
+                    yield self.finding(
+                        ctx, stmt.lineno,
+                        f"scan carry '{target}' is initialized in "
+                        f"{init_elts[slot].dtype} and accumulated into "
+                        f"— every fold rounds to 16 bits; keep the "
+                        f"carry f32 (init with jnp.float32 / .astype("
+                        f"jnp.float32)) and round once after the scan",
+                        sev)
+
+
+# ---------------------------------------------------------------------------
+# ZL022 — mesh-axis discipline
+# ---------------------------------------------------------------------------
+
+def _module_str_consts(tree: ast.Module) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, ast.Constant) \
+                and isinstance(stmt.value.value, str):
+            out[stmt.targets[0].id] = stmt.value.value
+    return out
+
+
+def _fold_axis_names(node: ast.AST, consts: Dict[str, str],
+                     tree: ast.Module) -> List[str]:
+    """Axis-name strings out of a Mesh axis-names argument: a tuple/list
+    of string literals and/or names resolving through module string
+    constants; a bare Name resolving to a module-level tuple constant."""
+    out: List[str] = []
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                d = dotted(e)
+                if d and d.split(".")[-1] in consts:
+                    out.append(consts[d.split(".")[-1]])
+    elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append(node.value)
+    elif isinstance(node, ast.Name):
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == node.id:
+                out.extend(_fold_axis_names(stmt.value, consts, tree))
+    return out
+
+
+def extract_axis_decls(ctx: ModuleContext
+                       ) -> Tuple[Dict[str, int], Dict[str, str]]:
+    """``(vocabulary, axis_constants)`` for one module: axis names
+    declared by a jax ``Mesh(devices, (names...))`` / ``make_mesh``
+    construction (line = the construction), and the module string
+    constants that spell them (``DATA_AXIS = "data"``) so references via
+    ``mesh_lib.DATA_AXIS`` resolve."""
+    consts = _module_str_consts(ctx.tree)
+    vocab: Dict[str, int] = {}
+    mods, froms = ctx.jax_names
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if not d:
+            continue
+        leaf = d.split(".")[-1]
+        is_mesh = False
+        if leaf in ("Mesh", "make_mesh"):
+            if "." in d:
+                prefix = d.rsplit(".", 1)[0]
+                # `jax.sharding.Mesh` with only `import jax`: the prefix
+                # root resolves, not the full dotted prefix
+                is_mesh = prefix in mods or prefix.split(".", 1)[0] in mods
+            else:
+                is_mesh = froms.get(d) in ("Mesh", "make_mesh")
+        if not is_mesh:
+            continue
+        axis_arg: Optional[ast.AST] = None
+        if len(node.args) >= 2:
+            axis_arg = node.args[1]
+        for k in node.keywords:
+            if k.arg in ("axis_names", "axis_name"):
+                axis_arg = k.value
+        if axis_arg is None:
+            continue
+        for name in _fold_axis_names(axis_arg, consts, ctx.tree):
+            vocab.setdefault(name, node.lineno)
+    axis_consts = {n: v for n, v in consts.items() if v in vocab}
+    return vocab, axis_consts
+
+
+#: package-relative locations an axis vocabulary module may live at
+_MESH_MODULE_CANDIDATES = (os.path.join("parallel", "mesh.py"), "mesh.py")
+_VOCAB_CACHE: Dict[str, Tuple[Dict[str, int], Dict[str, str], str]] = {}
+
+
+def _package_root(path: str) -> Optional[str]:
+    """Topmost directory on ``path``'s parent chain that still carries an
+    ``__init__.py`` — the scanned file's package root."""
+    d = os.path.dirname(os.path.abspath(path))
+    root = None
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        root = d
+        nd = os.path.dirname(d)
+        if nd == d:
+            break
+        d = nd
+    return root
+
+
+def package_axis_vocabulary(path: str
+                            ) -> Tuple[Dict[str, int], Dict[str, str], str]:
+    """The axis vocabulary of the package ``path`` belongs to: parsed
+    from ``<pkg>/parallel/mesh.py`` (or ``<pkg>/mesh.py``), cached per
+    package root. Returns ``(vocab, axis_constants, mesh_path)``."""
+    root = _package_root(path)
+    if root is None:
+        return {}, {}, ""
+    cached = _VOCAB_CACHE.get(root)
+    if cached is not None:
+        return cached
+    vocab: Dict[str, int] = {}
+    consts: Dict[str, str] = {}
+    mesh_path = ""
+    for cand in _MESH_MODULE_CANDIDATES:
+        p = os.path.join(root, cand)
+        if not os.path.isfile(p):
+            continue
+        try:
+            with open(p, encoding="utf-8") as f:
+                mctx = ModuleContext(p, f.read())
+        # an unparseable mesh module: the per-file scan reports ZL000
+        except Exception:  # zoolint: disable=ZL007
+            continue
+        v, c = extract_axis_decls(mctx)
+        if v:
+            vocab.update(v)
+            consts.update(c)
+            mesh_path = p
+            break
+    _VOCAB_CACHE[root] = (vocab, consts, mesh_path)
+    return vocab, consts, mesh_path
+
+
+_COLLECTIVES = {"psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+                "all_gather": 1, "ppermute": 1, "all_to_all": 1,
+                "psum_scatter": 1, "pbroadcast": 1, "pshuffle": 1,
+                "axis_index": 0, "axis_size": 0}
+
+
+@dataclasses.dataclass
+class AxisUse:
+    axis: str
+    line: int
+    where: str          # "PartitionSpec" | the collective name
+
+
+def iter_axis_uses(ctx: ModuleContext,
+                   consts: Dict[str, str]) -> Iterator[AxisUse]:
+    """Every resolvable mesh-axis reference in one module: string
+    literals (and ``consts``-resolved names) inside ``PartitionSpec``
+    calls and collective ``axis_name`` arguments. Unresolvable names
+    (parameters, foreign variables) are skipped — precision over
+    recall on an error-severity rule."""
+    mods, froms = ctx.jax_names
+
+    def resolve(e: ast.AST) -> Optional[str]:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            return e.value
+        d = dotted(e)
+        if d and d.split(".")[-1] in consts:
+            return consts[d.split(".")[-1]]
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if not d:
+            continue
+        leaf = d.split(".")[-1]
+        is_pspec = False
+        if leaf == "PartitionSpec":
+            prefix = d.rsplit(".", 1)[0] if "." in d else ""
+            is_pspec = not prefix or prefix in mods \
+                or prefix.split(".", 1)[0] in mods
+        elif "." not in d and froms.get(d) == "PartitionSpec":
+            is_pspec, leaf = True, "PartitionSpec"
+        if is_pspec:
+            for arg in node.args:
+                elts = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) \
+                    else [arg]
+                for e in elts:
+                    axis = resolve(e)
+                    if axis is not None:
+                        yield AxisUse(axis, node.lineno, "PartitionSpec")
+            continue
+        if leaf in _COLLECTIVES and "lax" in d.split("."):
+            axis_arg: Optional[ast.AST] = None
+            pos = _COLLECTIVES[leaf]
+            if len(node.args) > pos:
+                axis_arg = node.args[pos]
+            for k in node.keywords:
+                if k.arg == "axis_name":
+                    axis_arg = k.value
+            if axis_arg is None:
+                continue
+            elts = axis_arg.elts \
+                if isinstance(axis_arg, (ast.Tuple, ast.List)) \
+                else [axis_arg]
+            for e in elts:
+                axis = resolve(e)
+                if axis is not None:
+                    yield AxisUse(axis, node.lineno, leaf)
+
+
+@register
+class MeshAxisDiscipline(Rule):
+    """Mesh-axis discipline (use direction). Every axis name a
+    ``PartitionSpec`` or collective (``psum``/``all_gather``/
+    ``ppermute``/...) references must come from the declared axis
+    vocabulary — the ``Mesh(...)`` axis names extracted from the
+    package's ``parallel/mesh.py`` (plus any in-file mesh
+    construction). A misspelled or stale axis (``P('data', 'modell')``)
+    passes every single-chip CPU test and only explodes at trace time
+    on a multi-chip mesh CI doesn't have. Inert when no mesh
+    construction is visible. The project pass (``--contracts``) adds
+    the reverse direction: declared axes nothing references, at
+    warning severity."""
+
+    id = "ZL022"
+    severity = ERROR
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        vocab, consts = extract_axis_decls(ctx)
+        pvocab, pconsts, mesh_path = package_axis_vocabulary(ctx.path)
+        # the file's own mesh module declares for itself
+        if os.path.abspath(ctx.path) == os.path.abspath(mesh_path or ""):
+            pvocab, pconsts = {}, {}
+        vocab = {**pvocab, **vocab}
+        consts = {**pconsts, **consts}
+        if not vocab:
+            return
+        sev = ERROR if _in_package(ctx.path) else WARNING
+        known = sorted(vocab)
+        for use in iter_axis_uses(ctx, consts):
+            if use.axis not in vocab:
+                yield self.finding(
+                    ctx, use.line,
+                    f"axis '{use.axis}' in {use.where} is not in the "
+                    f"declared mesh axis vocabulary {known} "
+                    f"{'(' + os.path.basename(mesh_path) + ')' if mesh_path else ''}"
+                    f" — a misspelled/stale axis only fails at trace "
+                    f"time on a multi-chip mesh", sev)
+
+
+@register_project
+class MeshAxisVocabularyDrift(ProjectRule):
+    """Mesh-axis discipline (declaration direction, project pass): a
+    declared mesh axis that no ``PartitionSpec``/collective anywhere in
+    the package references is a dead topology axis — either the
+    consumer drifted away (the sharding silently became a no-op) or
+    the axis should be pruned. Warning severity: a deliberately
+    reserved axis is legitimate, but it should be visible."""
+
+    id = "ZL022"
+    severity = ERROR        # the rule's headline severity (use direction)
+
+    def check(self, project: ProjectContext) -> Iterator[Finding]:
+        vocab: Dict[str, Tuple[str, int]] = {}
+        consts: Dict[str, str] = {}
+        for ctx in project.modules:
+            v, c = extract_axis_decls(ctx)
+            for name, line in v.items():
+                vocab.setdefault(name, (ctx.path, line))
+            consts.update(c)
+        if not vocab:
+            return
+        used: Set[str] = set()
+        for ctx in project.modules:
+            for use in iter_axis_uses(ctx, consts):
+                used.add(use.axis)
+        for axis, (path, line) in sorted(vocab.items()):
+            if axis not in used:
+                yield Finding(
+                    self.id, WARNING, path, line,
+                    f"mesh axis '{axis}' is declared here but no "
+                    f"PartitionSpec or collective anywhere references "
+                    f"it — dead topology axis (prune it, or the "
+                    f"consumer drifted)")
+
+
+# ---------------------------------------------------------------------------
+# ZL023 — Pallas tile alignment
+# ---------------------------------------------------------------------------
+
+@register
+class PallasTileAlignment(Rule):
+    """Pallas block-shape tile alignment. The last two dims of every
+    ``BlockSpec`` block shape and ``pltpu.VMEM`` scratch shape must be
+    *provably* on the hardware tile floors (trailing dim a multiple of
+    LANES=128, second-to-last of SUBLANES=8): aligned constants,
+    ``round_up(x, floor)`` wraps, ``x // m * m`` floors, and dims taken
+    whole off an array's ``.shape`` (Mosaic pads whole-axis blocks) all
+    prove out. Flagged: constants off the floor, and clamp derivations
+    (``min(block, t)``, bare ``// 2`` halving) that can leave the floor
+    — the exact bug class that compiles on the interpreter and dies in
+    Mosaic only on a real TPU. Error in package code, warning
+    elsewhere."""
+
+    id = "ZL023"
+    severity = ERROR
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not uses_pallas(ctx):
+            return
+        lanes, sublanes = _tile_floors()
+        sev = ERROR if _in_package(ctx.path) else WARNING
+        interp = Interp(ctx)
+        env_cache: Dict[int, Dict[str, Abs]] = {}
+
+        def env_for(node) -> Dict[str, Abs]:
+            scope = ctx._enclosing_scope(node)
+            while isinstance(scope, ast.ClassDef):
+                scope = ctx._enclosing_scope(scope)
+            key = id(scope)
+            if key not in env_cache:
+                env_cache[key] = interp.module_env() \
+                    if isinstance(scope, ast.Module) \
+                    else interp.env_of(scope)
+            return env_cache[key]
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            shape_node: Optional[ast.AST] = None
+            what = ""
+            if _is_pallas_attr(ctx, node.func, ("BlockSpec",)):
+                if node.args:
+                    shape_node = node.args[0]
+                for k in node.keywords:
+                    if k.arg == "block_shape":
+                        shape_node = k.value
+                what = "BlockSpec block shape"
+            elif _is_pallas_attr(ctx, node.func, ("VMEM",)) and node.args:
+                shape_node = node.args[0]
+                what = "VMEM scratch shape"
+            if not isinstance(shape_node, (ast.Tuple, ast.List)) \
+                    or len(shape_node.elts) < 1:
+                continue
+            env = env_for(node)
+            dims = shape_node.elts
+            checks = [(dims[-1], lanes, "last")]
+            if len(dims) >= 2:
+                checks.append((dims[-2], sublanes, "second-to-last"))
+            for dim, floor, pos in checks:
+                a = interp.eval(dim, env)
+                if a.from_shape:
+                    continue        # whole-axis dim: Mosaic pads it
+                if a.const is not None:
+                    if a.const > floor and a.const % floor != 0:
+                        yield self.finding(
+                            ctx, node.lineno,
+                            f"{what}: {pos} dim {a.const} is not a "
+                            f"multiple of the tile floor ({floor}) — "
+                            f"Mosaic rejects it on compiled TPU runs "
+                            f"(the interpreter does not care); "
+                            f"round_up() it", sev)
+                    continue
+                if a.clamped and a.align % floor != 0:
+                    yield self.finding(
+                        ctx, node.lineno,
+                        f"{what}: {pos} dim is derived through a raw "
+                        f"clamp (min()/floor-div) that can leave the "
+                        f"{floor}-tile floor — wrap it in round_up(..., "
+                        f"{'LANES' if floor == lanes else 'SUBLANES'}) "
+                        f"like select_attention_blocks does", sev)
+
+
+# ---------------------------------------------------------------------------
+# ZL024 — static VMEM budget
+# ---------------------------------------------------------------------------
+
+def _local_list(ctx: ModuleContext, at: ast.AST,
+                name: str) -> Optional[ast.AST]:
+    """The single local ``name = [...]`` list-literal binding visible
+    from ``at`` (conditional ``.append`` calls are invisible — fine for
+    a LOWER-bound footprint)."""
+    scope = ctx._enclosing_scope(at)
+    while scope is not None:
+        found: List[ast.AST] = []
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name \
+                            and isinstance(node.value, (ast.List,
+                                                        ast.Tuple)):
+                        found.append(node.value)
+        if found:
+            return found[0] if len(found) == 1 else None
+        if isinstance(scope, ast.Module):
+            return None
+        scope = ctx._enclosing_scope(scope)
+    return None
+
+
+@register
+class PallasStaticVmemBudget(Rule):
+    """Static VMEM budget for ``pallas_call`` sites. A provable LOWER
+    bound on the kernel's footprint — double-buffered operand/output
+    windows + scratch, every unknown dim priced at the tile floor and
+    unknown dtypes at 1 byte — is computed with the SAME parameterized
+    estimator the runtime block autotuner uses
+    (``ops/pallas/common.kernel_vmem_bytes``; the flash-attention
+    selector, the fused-CE clamp and this rule share one formula) and
+    held against the 16 MiB per-core default budget. A site whose
+    guaranteed-minimum footprint already exceeds the budget cannot
+    compile on a default TPU core at ANY signature — it fails lint
+    instead of a TPU run. Error in package code, warning elsewhere."""
+
+    id = "ZL024"
+    severity = ERROR
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not uses_pallas(ctx):
+            return
+        mod = footprint_module()
+        if mod is None:
+            return              # no estimator available: skip, not guess
+        sev = ERROR if _in_package(ctx.path) else WARNING
+        interp = Interp(ctx)
+
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_pallas_attr(ctx, node.func, ("pallas_call",))):
+                continue
+            scope = ctx._enclosing_scope(node)
+            env = interp.module_env() if isinstance(scope, ast.Module) \
+                else interp.env_of(scope)
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            operands = self._spec_windows(ctx, interp, env, node,
+                                          kw.get("in_specs"))
+            outputs = self._spec_windows(ctx, interp, env, node,
+                                         kw.get("out_specs"))
+            scratch = self._scratch_windows(ctx, interp, env, node,
+                                            kw.get("scratch_shapes"))
+            if not (operands or outputs or scratch):
+                continue
+            footprint = mod.kernel_vmem_bytes(
+                operands=operands, outputs=outputs, scratch=scratch)
+            budget = int(mod.VMEM_BYTES_DEFAULT)
+            if footprint > budget:
+                yield self.finding(
+                    ctx, node.lineno,
+                    f"pallas_call's windows are provably at least "
+                    f"{footprint / 2 ** 20:.1f} MiB of VMEM "
+                    f"(double-buffered operands + outputs + scratch, "
+                    f"unknown dims priced at the tile floor) — over "
+                    f"the {budget // 2 ** 20} MiB per-core budget the "
+                    f"runtime autotuner fits kernels into; shrink the "
+                    f"block shapes or stream the operand", sev)
+
+    def _items(self, ctx, at, spec_node) -> List[ast.AST]:
+        if spec_node is None:
+            return []
+        if isinstance(spec_node, ast.Name):
+            spec_node = _local_list(ctx, at, spec_node.id)
+        if isinstance(spec_node, (ast.List, ast.Tuple)):
+            return list(spec_node.elts)
+        if isinstance(spec_node, ast.Call):
+            return [spec_node]
+        return []
+
+    def _lower_dims(self, interp, env, shape_node) -> Optional[List[int]]:
+        if not isinstance(shape_node, (ast.Tuple, ast.List)):
+            return None
+        return [max(interp.eval(e, env).low, 1)
+                for e in shape_node.elts]
+
+    def _spec_windows(self, ctx, interp, env, at, spec_node):
+        out = []
+        for item in self._items(ctx, at, spec_node):
+            if not (isinstance(item, ast.Call)
+                    and _is_pallas_attr(ctx, item.func, ("BlockSpec",))):
+                continue
+            shape_node = item.args[0] if item.args else None
+            for k in item.keywords:
+                if k.arg == "block_shape":
+                    shape_node = k.value
+            dims = self._lower_dims(interp, env, shape_node)
+            if dims:
+                out.append((tuple(dims), 1))    # unknown dtype: 1 B floor
+        return out
+
+    def _scratch_windows(self, ctx, interp, env, at, spec_node):
+        out = []
+        for item in self._items(ctx, at, spec_node):
+            if not (isinstance(item, ast.Call)
+                    and _is_pallas_attr(ctx, item.func, ("VMEM",))):
+                continue
+            dims = self._lower_dims(interp, env,
+                                    item.args[0] if item.args else None)
+            if not dims:
+                continue
+            itemsize = 1
+            if len(item.args) >= 2:
+                dt = dtype_of_node(ctx, item.args[1])
+                if dt:
+                    itemsize = _ITEMSIZE.get(dt, 1)
+            out.append((tuple(dims), itemsize))
+        return out

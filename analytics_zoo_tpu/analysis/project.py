@@ -59,6 +59,7 @@ def register_project(cls):
 
 def all_project_rules() -> List[ProjectRule]:
     from . import contracts  # noqa: F401  (registers on first import)
+    from . import device  # noqa: F401  (ZL022's declaration direction)
     return sorted(_PROJECT_REGISTRY.values(), key=lambda r: r.id)
 
 
@@ -67,8 +68,13 @@ class ProjectContext:
     facts project rules query."""
 
     def __init__(self, paths: Iterable[str],
-                 docs_root: Optional[str] = None):
+                 docs_root: Optional[str] = None,
+                 tests_root: Optional[str] = None):
         self.docs_root = docs_root
+        #: tests tree for the coverage reconciliations (ZL019's
+        #: site-census direction); None = those checks stay off
+        self.tests_root = tests_root
+        self._tests_census: Optional[Set[str]] = None
         self.modules: List[ModuleContext] = []
         self.by_path: Dict[str, ModuleContext] = {}
         self.by_name: Dict[str, ModuleContext] = {}
@@ -182,9 +188,36 @@ class ProjectContext:
             return None
         return find_catalog(self.docs_root, surface)
 
+    # -- tests-tree string census -------------------------------------------
+    def tests_string_census(self) -> Optional[Set[str]]:
+        """Every exact string constant appearing anywhere in the parsed
+        ``tests_root`` tree — the coverage census ZL019 reconciles fault
+        sites against (a site exercised by a chaos plan necessarily
+        spells its name as a string in some test). None when no tests
+        root was configured; a broken test file is skipped (pytest
+        fails it far more loudly than a census could)."""
+        if self.tests_root is None:
+            return None
+        if self._tests_census is None:
+            census: Set[str] = set()
+            for path in iter_py_files([self.tests_root]):
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        tree = ast.parse(f.read(), filename=path)
+                except (OSError, UnicodeDecodeError, SyntaxError,
+                        ValueError):
+                    continue
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Constant) \
+                            and isinstance(node.value, str):
+                        census.add(node.value)
+            self._tests_census = census
+        return self._tests_census
+
 
 def lint_project(paths: Optional[Iterable[str]] = None,
                  docs_root: Optional[str] = None,
+                 tests_root: Optional[str] = None,
                  select: Optional[Iterable[str]] = None,
                  ignore: Optional[Iterable[str]] = None,
                  project: Optional["ProjectContext"] = None,
@@ -192,13 +225,15 @@ def lint_project(paths: Optional[Iterable[str]] = None,
     """Run every project rule over the package tree rooted at ``paths``
     (or a prebuilt ``project`` — the CLI reuses one so files parse once
     for both passes); returns non-suppressed findings, sorted by
-    path/line/rule. ``report_unparseable=False`` drops the project
-    pass's own ZL000 findings — for callers whose per-file scan already
-    reported the same broken files."""
+    path/line/rule. ``tests_root`` switches on the test-coverage
+    reconciliations (ZL019's site census). ``report_unparseable=False``
+    drops the project pass's own ZL000 findings — for callers whose
+    per-file scan already reported the same broken files."""
     if project is None:
         if paths is None:
             raise ValueError("lint_project needs paths or a project")
-        project = ProjectContext(paths, docs_root=docs_root)
+        project = ProjectContext(paths, docs_root=docs_root,
+                                 tests_root=tests_root)
     select_set = set(select) if select else None
     ignore_set = set(ignore) if ignore else set()
     out: List[Finding] = []

@@ -1,12 +1,40 @@
-"""Shared helpers for the pallas kernel package."""
+"""Shared helpers for the pallas kernel package: the hardware tile
+constants, alignment/padding utilities, and the **parameterized VMEM
+footprint estimator** every block selector prices kernels with.
+
+The estimator (:func:`kernel_vmem_bytes` + the per-kernel wrappers
+:func:`attention_vmem_bytes` / :func:`ce_vmem_bytes`) is the single
+source of truth for "does this block configuration fit VMEM": the
+flash-attention autotuner (``flash_attention.select_attention_blocks`` /
+``_sweep_candidates``), the fused-CE forward's budget clamp
+(``cross_entropy.fused_ce_forward``) and zoolint's static ZL024 check
+(``analysis/device.py``) all call the same functions, so a kernel edit
+cannot silently change the runtime budget math without the lint-time
+check moving with it (``tests/test_pallas.py`` property-tests the
+agreement over the autotuner's full candidate set).
+
+IMPORT CONTRACT: this module must stay importable WITHOUT jax — zoolint
+loads it standalone (``importlib`` straight off the file, no package
+``__init__`` chain) to price pallas_call sites at lint time, and the
+linter is jax-free by design. jax imports live inside the functions
+that need them (:func:`pad_to_multiple`); everything else is pure-int
+arithmetic.
+"""
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
+from typing import Iterable, Optional, Sequence, Tuple
 
 LANES = 128     # lane width (TPU min tile last dim)
 SUBLANES = 8    # sublane width (TPU min tile second-to-last dim)
+
+#: per-core VMEM (the pallas guide's ~16 MB/core); overridable per run via
+#: ``zoo.pallas.vmem_budget_mb`` for chips with a different budget
+VMEM_BYTES_DEFAULT = 16 * 1024 * 1024
+#: fraction of VMEM the block selectors hand a kernel — the rest stays
+#: with the compiler (spills, the backward's second operand window,
+#: semaphores)
+VMEM_USABLE_FRACTION = 0.5
 
 
 def round_up(n: int, mult: int) -> int:
@@ -14,12 +42,122 @@ def round_up(n: int, mult: int) -> int:
     return ((n + mult - 1) // mult) * mult
 
 
-def pad_to_multiple(x: jax.Array, axis: int, mult: int) -> jax.Array:
+def pad_to_multiple(x, axis: int, mult: int):
     """Zero-pad ``axis`` up to the next multiple of ``mult`` (no-op when
     already aligned)."""
+    import jax.numpy as jnp  # lazy: keep this module importable sans jax
     rem = (-x.shape[axis]) % mult
     if rem == 0:
         return x
     cfg = [(0, 0)] * x.ndim
     cfg[axis] = (0, rem)
     return jnp.pad(x, cfg)
+
+
+def vmem_budget_bytes() -> int:
+    """The live per-core VMEM budget: ``zoo.pallas.vmem_budget_mb`` when a
+    zoo context is constructible and sets it, else the 16 MiB default."""
+    try:
+        from ...common.context import get_zoo_context
+        mb = float(get_zoo_context().get("zoo.pallas.vmem_budget_mb", 0) or 0)
+        if mb > 0:
+            return int(mb * 1024 * 1024)
+    # no context constructible (odd device counts, standalone lint load)
+    # — the default budget holds
+    except Exception:  # zoolint: disable=ZL007
+        pass
+    return VMEM_BYTES_DEFAULT
+
+
+def vmem_usable_bytes(budget_bytes: Optional[int] = None) -> int:
+    """The slice of the budget a kernel may claim for its windows."""
+    budget = budget_bytes if budget_bytes is not None else vmem_budget_bytes()
+    return int(budget * VMEM_USABLE_FRACTION)
+
+
+_ShapeBytes = Tuple[Sequence[int], int]     # ((dims...), itemsize)
+
+
+def _tile_widened(shape: Sequence[int]) -> int:
+    """Element count of ``shape`` with the trailing dim widened to the
+    lane tile floor and the second-to-last to the sublane floor — how the
+    hardware actually lays a VMEM window out."""
+    dims = [max(int(d), 1) for d in shape]
+    if not dims:
+        return 1
+    dims[-1] = round_up(dims[-1], LANES)
+    if len(dims) >= 2:
+        dims[-2] = round_up(dims[-2], SUBLANES)
+    total = 1
+    for d in dims:
+        total *= d
+    return total
+
+
+def kernel_vmem_bytes(operands: Iterable[_ShapeBytes] = (),
+                      outputs: Iterable[_ShapeBytes] = (),
+                      scratch: Iterable[_ShapeBytes] = (),
+                      compute: Iterable[_ShapeBytes] = (),
+                      copies: int = 2) -> int:
+    """Parameterized per-grid-cell VMEM footprint: operand and output
+    windows are double-buffered (``copies``, the pallas pipeline's
+    prefetch depth), scratch and transient compute tiles are single.
+    Every shape is widened to the hardware tile floors. Entries are
+    ``(shape, itemsize)`` pairs."""
+    total = 0
+    for shape, itemsize in operands:
+        total += copies * _tile_widened(shape) * itemsize
+    for shape, itemsize in outputs:
+        total += copies * _tile_widened(shape) * itemsize
+    for shape, itemsize in scratch:
+        total += _tile_widened(shape) * itemsize
+    for shape, itemsize in compute:
+        total += _tile_widened(shape) * itemsize
+    return total
+
+
+def attention_vmem_bytes(block_q: int, block_k: int, d: int, itemsize: int,
+                         has_mask: bool = False) -> int:
+    """Estimated per-grid-cell VMEM of the flash-attention forward kernel
+    (the backward's tiles are the same sizes): q/k/v operand windows +
+    the acc/m/l scratch carries + o/lse outputs + the f32 score and
+    probability compute tiles. ``block_k`` prices at the lane floor even
+    as a sublane-position window dim because the (block_q, block_k)
+    score tile needs it lane-aligned anyway."""
+    d_eff = round_up(max(d, 1), LANES)
+    bq = round_up(max(block_q, 1), SUBLANES)
+    bk = round_up(max(block_k, 1), LANES)
+    ops = [((bq, d_eff), itemsize),             # q window
+           ((bk, d_eff), itemsize),             # k window
+           ((bk, d_eff), itemsize)]             # v window
+    if has_mask:
+        ops.append(((SUBLANES, bk), 4))         # key-padding mask slice
+    outs = [((bq, d_eff), itemsize),            # o
+            ((bq, LANES), 4)]                   # lse
+    scr = [((bq, d_eff), 4),                    # acc
+           ((bq, LANES), 4), ((bq, LANES), 4)]  # running max / denom
+    comp = [((bq, bk), 4), ((bq, bk), 4)]       # s and p tiles, f32
+    return kernel_vmem_bytes(operands=ops, outputs=outs, scratch=scr,
+                             compute=comp)
+
+
+def ce_vmem_bytes(block_n: int, block_v: int, hidden: int, itemsize: int,
+                  has_bias: bool = True) -> int:
+    """Estimated per-grid-cell VMEM of the fused-CE forward kernel
+    (``cross_entropy.fused_ce_forward``): h/w operand windows (+ the f32
+    bias slice and the int32 label broadcast) + the m/l/label-logit
+    scratch carries + lse/ll outputs + the f32 logits and probability
+    compute tiles."""
+    h_eff = round_up(max(hidden, 1), LANES)
+    bn = round_up(max(block_n, 1), SUBLANES)
+    bv = round_up(max(block_v, 1), LANES)
+    ops = [((bn, h_eff), itemsize),             # h window
+           ((h_eff, bv), itemsize),             # w window
+           ((bn, LANES), 4)]                    # labels (int32 broadcast)
+    if has_bias:
+        ops.append(((SUBLANES, bv), 4))         # f32 bias slice
+    outs = [((bn, LANES), 4), ((bn, LANES), 4)]     # lse / label logit
+    scr = [((bn, LANES), 4), ((bn, LANES), 4), ((bn, LANES), 4)]
+    comp = [((bn, bv), 4), ((bn, bv), 4)]       # logits and p tiles, f32
+    return kernel_vmem_bytes(operands=ops, outputs=outs, scratch=scr,
+                             compute=comp)

@@ -33,9 +33,31 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .common import LANES as _LANES
 from .common import SUBLANES as _SUBLANES
-from .common import pad_to_multiple, round_up
+from .common import (ce_vmem_bytes, pad_to_multiple, round_up,
+                     vmem_usable_bytes)
 
 __all__ = ["fused_ce_forward"]
+
+
+def _budget_blocks(block_n: int, block_v: int, hidden_padded: int,
+                   itemsize: int, has_bias: bool):
+    """Shrink ``(block_n, block_v)`` until the kernel's estimated
+    footprint — the SAME shared formula the flash-attention autotuner
+    prices with (``common.ce_vmem_bytes``) — fits the usable VMEM
+    budget. Deterministic in the abstract signature, so jit caches stay
+    stable; every shrink step re-lands on the tile floors (the
+    flash-attention discipline)."""
+    budget = vmem_usable_bytes()
+    while (ce_vmem_bytes(block_n, block_v, hidden_padded, itemsize,
+                         has_bias) > budget
+           and (block_n > _SUBLANES or block_v > _LANES)):
+        if block_v >= 2 * block_n and block_v > _LANES:
+            block_v = max(_LANES, block_v // 2 // _LANES * _LANES)
+        elif block_n > _SUBLANES:
+            block_n = max(_SUBLANES, block_n // 2 // _SUBLANES * _SUBLANES)
+        else:
+            block_v = max(_LANES, block_v // 2 // _LANES * _LANES)
+    return block_n, block_v
 
 
 def _ce_fwd_kernel(h_ref, w_ref, b_ref, lab_ref, lse_ref, ll_ref, m_ref,
@@ -113,9 +135,14 @@ def fused_ce_forward(h: jax.Array, w: jax.Array, b: Optional[jax.Array],
     v = w.shape[1]
     # blocks stay on the hardware tile floors (Mosaic needs sublane/lane
     # alignment on compiled TPU runs — the interpreter would not care);
-    # the row/vocab padding below absorbs the overshoot
+    # the row/vocab padding below absorbs the overshoot. A wide hidden
+    # dim then shrinks the blocks until the kernel's estimated footprint
+    # fits the usable VMEM budget (shared estimator, common.py).
     block_n = round_up(min(block_n, max(n, 1)), _SUBLANES)
     block_v = round_up(min(block_v, max(v, 1)), _LANES)
+    block_n, block_v = _budget_blocks(
+        block_n, block_v, round_up(max(hidden, 1), _LANES),
+        jnp.dtype(h.dtype).itemsize, b is not None)
     hp = pad_to_multiple(pad_to_multiple(h, 0, block_n), 1, _LANES)
     wp = pad_to_multiple(pad_to_multiple(w, 0, _LANES), 1, block_v)
     lp = jnp.pad(labels.astype(jnp.int32), (0, hp.shape[0] - n),

@@ -42,7 +42,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .common import LANES as _LANES
 from .common import SUBLANES as _SUBLANES
-from .common import pad_to_multiple
+from .common import attention_vmem_bytes, pad_to_multiple, vmem_usable_bytes
 from .common import round_up as _round_up
 
 __all__ = ["flash_attention", "select_attention_blocks"]
@@ -50,6 +50,9 @@ __all__ = ["flash_attention", "select_attention_blocks"]
 
 # ---------------------------------------------------------------------------
 # block autotuning: VMEM-budget heuristic + optional one-shot on-device sweep
+# (the footprint formula itself is the SHARED estimator in common.py —
+# cross_entropy's clamp and zoolint's static ZL024 check price with the
+# same function, property-tested in tests/test_pallas.py)
 # ---------------------------------------------------------------------------
 
 #: preferred default, swept on a v5e (causal, D=64, T=32k, fwd+bwd):
@@ -57,45 +60,16 @@ __all__ = ["flash_attention", "select_attention_blocks"]
 #: 24.4-24.9 for k-blocks of 1024/2048 — the larger k block amortizes the
 #: per-k-step carry fold without outgrowing VMEM
 _PREFERRED_BLOCKS = (256, 512)
-#: per-core VMEM (the pallas guide's ~16 MB/core); overridable per run via
-#: ``zoo.pallas.vmem_budget_mb`` for chips with a different budget
-_VMEM_BYTES_DEFAULT = 16 * 1024 * 1024
-#: fraction of VMEM the selector hands the kernel — the rest stays with the
-#: compiler (spills, the backward's second operand window, semaphores)
-_VMEM_USABLE_FRACTION = 0.5
 
 #: abstract signature -> (block_q, block_k), resolved once per process
 _BLOCK_CACHE: dict = {}
 
-
-def _vmem_budget_bytes() -> int:
-    try:
-        from ...common.context import get_zoo_context
-        mb = float(get_zoo_context().get("zoo.pallas.vmem_budget_mb", 0) or 0)
-        if mb > 0:
-            return int(mb * 1024 * 1024)
-    # no context constructible (odd device counts) — default budget holds
-    except Exception:  # zoolint: disable=ZL007
-        pass
-    return _VMEM_BYTES_DEFAULT
-
-
-def _kernel_vmem_bytes(block_q: int, block_k: int, d: int, itemsize: int,
-                       has_mask: bool = False) -> int:
-    """Estimated per-grid-cell VMEM of the forward kernel (the backward's
-    tiles are the same sizes): double-buffered operand windows + scratch +
-    the f32 score/probability compute tiles. ``d`` widens to the 128-lane
-    tile floor like the hardware does."""
-    d_eff = _round_up(max(d, 1), _LANES)
-    bq = _round_up(block_q, _SUBLANES)
-    bk = _round_up(block_k, _LANES)
-    operands = 2 * (bq * d_eff + 2 * bk * d_eff) * itemsize
-    if has_mask:
-        operands += 2 * _SUBLANES * bk * 4
-    scratch = bq * d_eff * 4 + 2 * bq * _LANES * 4
-    outputs = 2 * (bq * d_eff * itemsize + bq * _LANES * 4)
-    compute = 2 * bq * bk * 4      # s and p tiles, f32
-    return operands + scratch + outputs + compute
+#: back-compat aliases — the estimator and budget constants moved to
+#: ``common.py`` so the fused-CE clamp and the zoolint device pass share
+#: one formula
+_kernel_vmem_bytes = attention_vmem_bytes
+from .common import VMEM_BYTES_DEFAULT as _VMEM_BYTES_DEFAULT  # noqa: E402
+from .common import VMEM_USABLE_FRACTION as _VMEM_USABLE_FRACTION  # noqa: E402
 
 
 def select_attention_blocks(t_q: int, t_kv: int, d: int, dtype,
@@ -106,8 +80,8 @@ def select_attention_blocks(t_q: int, t_kv: int, d: int, dtype,
     the larger block until the kernel's estimated footprint fits the
     budget. Deterministic — a pure function of the abstract signature, so
     the jit cache is stable."""
-    budget = budget_bytes if budget_bytes is not None else int(
-        _vmem_budget_bytes() * _VMEM_USABLE_FRACTION)
+    budget = budget_bytes if budget_bytes is not None else \
+        vmem_usable_bytes()
     itemsize = jnp.dtype(dtype).itemsize
     bq, bk = _PREFERRED_BLOCKS
     bq = max(_SUBLANES, min(bq, _round_up(max(t_q, 1), _SUBLANES)))
@@ -128,7 +102,7 @@ def select_attention_blocks(t_q: int, t_kv: int, d: int, dtype,
 
 def _sweep_candidates(t_q: int, t_kv: int, d: int, itemsize: int,
                       has_mask: bool, heuristic):
-    budget = int(_vmem_budget_bytes() * _VMEM_USABLE_FRACTION)
+    budget = vmem_usable_bytes()
     out = []
     for bq, bk in (heuristic, (256, 512), (128, 512), (256, 256),
                    (512, 512), (128, 1024)):
@@ -240,7 +214,7 @@ def _auto_blocks(q_shape, t_kv: int, dtype, causal: bool, has_mask: bool,
     # the live budget is part of the key — re-initializing the context
     # with zoo.pallas.vmem_budget_mb must take effect at the next call,
     # not silently keep blocks sized for the old budget
-    budget = int(_vmem_budget_bytes() * _VMEM_USABLE_FRACTION)
+    budget = vmem_usable_bytes()
     base = (t_q, t_kv, d, dt.name, causal, has_mask)
     sig = (budget, "sweep", b, h) + base if sweep else (budget,) + base
     cached = _BLOCK_CACHE.get(sig)
@@ -356,8 +330,12 @@ def _fwd_kernel(*refs, scale: float, block_q: int, block_k: int, t_q: int,
 def _prep(q, k, v, mask, block_q, block_k):
     b, h, t_q, d = q.shape
     t_kv = k.shape[2]
-    block_q = min(block_q, max(t_q, 1))
-    block_k = min(block_k, max(t_kv, 1))
+    # the short-sequence clamp must land back ON the tile floors: a raw
+    # min() against an unaligned T (t_q=100 -> block_q=100) hands Mosaic
+    # an untileable block on compiled TPU runs — the padding below
+    # absorbs the round-up, and the kernels mask past t_q/t_kv
+    block_q = _round_up(min(block_q, max(t_q, 1)), _SUBLANES)
+    block_k = _round_up(min(block_k, max(t_kv, 1)), _LANES)
     qr = pad_to_multiple(q.reshape(b * h, t_q, d), 1, block_q)
     kr = pad_to_multiple(k.reshape(b * h, t_kv, d), 1, block_k)
     vr = pad_to_multiple(v.reshape(b * h, t_kv, d), 1, block_k)

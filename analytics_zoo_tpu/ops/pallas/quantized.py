@@ -20,7 +20,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from .common import LANES as _LANES
+from .common import SUBLANES as _SUBLANES
 from .common import pad_to_multiple
+from .common import round_up as _round_up
 
 __all__ = ["int8_matmul"]
 
@@ -46,8 +49,12 @@ def int8_matmul(x: jax.Array, w_q: jax.Array, scales: jax.Array,
     if kdim != k2 or scales.shape != (n,):
         raise ValueError(f"shape mismatch: x {x.shape}, w_q {w_q.shape}, "
                          f"scales {scales.shape}")
-    block_m = min(block_m, max(m, 1))
-    block_n = min(block_n, max(n, 1))
+    # the short-matrix clamp re-lands on the tile floors — a raw min()
+    # against an unaligned M/N (m=100 -> block_m=100) hands Mosaic an
+    # untileable block on compiled TPU runs; the padding below absorbs
+    # the round-up and the [:m, :n] slice drops it again
+    block_m = _round_up(min(block_m, max(m, 1)), _SUBLANES)
+    block_n = _round_up(min(block_n, max(n, 1)), _LANES)
 
     xp = pad_to_multiple(x, 0, block_m)
     wp = pad_to_multiple(w_q, 1, block_n)

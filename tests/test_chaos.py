@@ -424,3 +424,39 @@ def test_retry_budget_caps_solo_redispatches_fleet_wide():
     assert snap["zoo_serving_dead_letter_total"]["value"] == 2
     assert snap['zoo_retry_attempts_total{op="serving.dispatch"}'][
         "value"] == 1
+
+
+def test_stream_len_fault_site_fires_and_reconciles():
+    """Deterministic coverage of the `backend.stream_len` site: the
+    depth-probe path surfaces an injected disconnect as the builtin
+    ConnectionError (what the serve loop's breaker classifies), exactly
+    once, exactly at the planned call index."""
+    init_zoo_context(faults_enabled=True)
+    backend = LocalBackend()
+    _enqueue(backend, 2, prefix="sl")
+    plan = FaultPlan(seed=11).add("backend.stream_len", "disconnect",
+                                  at=(1,))
+    with faults.activate(plan):
+        assert backend.stream_len("tensor_stream") == 2   # call 0: clean
+        with pytest.raises(ConnectionError):               # call 1: planned
+            backend.stream_len("tensor_stream")
+        assert backend.stream_len("tensor_stream") == 2   # call 2: clean
+    assert plan.fired == [("backend.stream_len", "disconnect", 1)]
+
+
+def test_set_result_fault_site_fires_and_reconciles():
+    """Deterministic coverage of the `backend.set_result` site (the
+    per-record error/shed answer path, distinct from the batched
+    `backend.set_results`): a planned error fires once and a retried
+    write lands — the addressable-answer path stays recoverable."""
+    init_zoo_context(faults_enabled=True)
+    backend = LocalBackend()
+    plan = FaultPlan(seed=12).add("backend.set_result", "error", at=(0,))
+    with faults.activate(plan):
+        with pytest.raises(Exception):
+            backend.set_result("sr-0", {"error": "shed: overloaded"})
+        backend.set_result("sr-0", {"error": "shed: overloaded"})
+    assert plan.fired == [("backend.set_result", "error", 0)]
+    outq = OutputQueue(backend)
+    with pytest.raises(ServingError, match="shed"):
+        outq.query("sr-0", timeout=5.0)

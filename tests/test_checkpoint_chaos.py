@@ -122,6 +122,26 @@ def test_kill_mid_write_is_never_committed_and_falls_back(tmp_path):
     assert snap["zoo_ckpt_restore_fallback_total"]["value"] == 1
 
 
+def test_manifest_write_crash_never_commits(tmp_path):
+    """A crash while WRITING the manifest body (the `ckpt.manifest`
+    site, one step before the rename commit point) also leaves the
+    snapshot uncommitted — no marker, invisible to latest(), and the
+    failure surfaces as CheckpointSaveError with the site reconciled
+    against the plan."""
+    init_zoo_context(faults_enabled=True)
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path), keep=0, registry=reg)
+    plan = FaultPlan(seed=21).add("ckpt.manifest", "error", at=(0,))
+    with faults.activate(plan):
+        with pytest.raises(CheckpointSaveError):
+            mgr.save(4, {"params": _tree()}, sync=True)
+    assert plan.fired == [("ckpt.manifest", "error", 0)]
+    assert not os.path.exists(str(tmp_path / "ckpt-4" / "manifest.json"))
+    assert mgr.latest() is None
+    snap = reg.snapshot()
+    assert snap["zoo_ckpt_save_failures_total"]["value"] == 1
+
+
 def test_manifest_commit_crash_never_commits(tmp_path):
     """A crash at the manifest rename (the commit point itself) leaves
     manifest.json.tmp but no marker — uncommitted, exactly as if the

@@ -2549,3 +2549,547 @@ def test_contracts_single_parse_shares_module_contexts(tmp_path):
         | {(f.rule_id, f.path, f.line)
            for f in _project_findings(tmp_path, pkg)}
     assert got == expected
+
+
+# ---------------------------------------------------------------------------
+# device-semantics pass (ZL021-ZL024): trigger / clean / suppression per rule
+# ---------------------------------------------------------------------------
+
+PKG = "analytics_zoo_tpu/x.py"
+
+ZL021_F64 = """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x):
+    return x + jnp.zeros((2,), jnp.float64)
+"""
+
+ZL021_RED = """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x):
+    y = x.astype(jnp.bfloat16)
+    return jnp.sum(y)
+"""
+
+ZL021_DOT = """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x, w):
+    y = x.astype(jnp.bfloat16)
+    return jnp.matmul(y, w)
+"""
+
+ZL021_CARRY = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+def outer(xs):
+    def body(carry, x):
+        acc, n = carry
+        acc = acc + x
+        return (acc, n + 1), x
+    init = (jnp.zeros((4,), jnp.bfloat16), 0)
+    return lax.scan(body, init, xs)
+"""
+
+
+def test_zl021_float64_and_16bit_accumulation_trigger():
+    assert ids(lint_source(ZL021_F64, PKG), "ZL021")
+    assert ids(lint_source(ZL021_RED, PKG), "ZL021")
+    assert ids(lint_source(ZL021_DOT, PKG), "ZL021")
+    # np.float64 constructor form
+    ctor = ("import jax\nimport numpy as np\n"
+            "@jax.jit\ndef f(x):\n    return x * np.float64(0.5)\n")
+    assert ids(lint_source(ctor, PKG), "ZL021")
+    # all error severity in package code, warning outside
+    assert errors(lint_source(ZL021_F64, PKG))
+    assert not errors(lint_source(ZL021_F64, "scratch/x.py"))
+
+
+def test_zl021_scan_carry_trigger_and_f32_upcast_clean():
+    zl = [f for f in lint_source(ZL021_CARRY, PKG) if f.rule_id == "ZL021"]
+    assert len(zl) == 1 and "carry" in zl[0].message
+    # the f32-upcast discipline on the SAME bf16 source is clean
+    clean = ZL021_CARRY.replace(
+        "jnp.zeros((4,), jnp.bfloat16)",
+        "jnp.zeros((4,), jnp.bfloat16).astype(jnp.float32)")
+    assert not ids(lint_source(clean, PKG), "ZL021")
+    # an f32 init is clean outright (the fused-CE dw0 pattern)
+    f32 = ZL021_CARRY.replace("jnp.bfloat16", "jnp.float32")
+    assert not ids(lint_source(f32, PKG), "ZL021")
+
+
+def test_zl021_clean_forms():
+    # f32 accumulate spellings: dtype= on the reduction,
+    # preferred_element_type on the dot, f64 only OUTSIDE staged code
+    src = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+@jax.jit
+def f(x, w):
+    y = x.astype(jnp.bfloat16)
+    s = jnp.sum(y, dtype=jnp.float32)
+    p = jax.lax.dot_general(y, w, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return s + jnp.sum(p)
+def host_stats(a):
+    return np.asarray(a, np.float64).mean()
+"""
+    assert not ids(lint_source(src, PKG), "ZL021")
+
+
+def test_zl021_suppression():
+    src = ZL021_F64.replace(
+        "    return x + jnp.zeros((2,), jnp.float64)",
+        "    return x + jnp.zeros((2,), jnp.float64)  "
+        "# zoolint: disable=ZL021 f64 parity oracle on CPU")
+    assert not ids(lint_source(src, PKG), "ZL021")
+
+
+ZL022_MESH = """
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+DATA = "data"
+def build(devs):
+    return Mesh(np.array(devs).reshape(2, 2), (DATA, "model"))
+"""
+
+
+def test_zl022_unknown_axis_at_use_triggers():
+    src = ZL022_MESH + """
+def shard():
+    return P("data", "modell")
+"""
+    zl = [f for f in lint_source(src, PKG) if f.rule_id == "ZL022"]
+    assert len(zl) == 1 and "modell" in zl[0].message and errors(zl)
+    # collectives are covered too
+    src2 = ZL022_MESH + """
+import jax
+def reduce(x):
+    return jax.lax.psum(x, "modle")
+"""
+    zl2 = [f for f in lint_source(src2, PKG) if f.rule_id == "ZL022"]
+    assert len(zl2) == 1 and "psum" in zl2[0].message
+
+
+def test_zl022_clean_and_const_resolution():
+    src = ZL022_MESH + """
+def shard():
+    return P(DATA, "model")
+"""
+    assert not ids(lint_source(src, PKG), "ZL022")
+    # no mesh construction visible anywhere -> inert, never guessing
+    lone = ("from jax.sharding import PartitionSpec as P\n"
+            "def shard():\n    return P('custom')\n")
+    assert not ids(lint_source(lone, "/abs/elsewhere/x.py"), "ZL022")
+
+
+def test_zl022_package_vocabulary_resolves_from_mesh_module(tmp_path):
+    """A file deep in a package resolves the axis vocabulary from
+    <pkgroot>/parallel/mesh.py — the live-repo layout."""
+    pkg = tmp_path / "pkg"
+    (pkg / "parallel").mkdir(parents=True)
+    (pkg / "sub").mkdir()
+    for d in (pkg, pkg / "parallel", pkg / "sub"):
+        (d / "__init__.py").write_text("")
+    (pkg / "parallel" / "mesh.py").write_text(
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        'DATA_AXIS = "data"\n'
+        'MODEL_AXIS = "model"\n'
+        "def create(devs):\n"
+        "    return Mesh(np.array(devs).reshape(2, 2),\n"
+        "                (DATA_AXIS, MODEL_AXIS))\n")
+    user = pkg / "sub" / "layer.py"
+    user.write_text(
+        "from jax.sharding import PartitionSpec as P\n"
+        "from ..parallel.mesh import MODEL_AXIS\n"
+        "def spec():\n"
+        "    return P(None, MODEL_AXIS), P('modell')\n")
+    fs = lint_paths([str(user)])
+    zl = [f for f in fs if f.rule_id == "ZL022"]
+    assert len(zl) == 1 and "modell" in zl[0].message
+    # severity: outside analytics_zoo_tpu/ it is a warning
+    assert not errors(zl)
+
+
+def test_zl022_suppression():
+    src = ZL022_MESH + """
+def shard():
+    return P("data", "modell")  # zoolint: disable=ZL022 foreign mesh interop
+"""
+    assert not ids(lint_source(src, PKG), "ZL022")
+
+
+ZL023_CONST = """
+import jax
+from jax.experimental import pallas as pl
+def f(x):
+    return pl.pallas_call(k, grid=(4,),
+        in_specs=[pl.BlockSpec((100, 200), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+"""
+
+ZL023_CLAMP = """
+import jax
+from jax.experimental import pallas as pl
+def f(x, block):
+    t = x.shape[0]
+    block = min(block, t)
+    return pl.pallas_call(k, grid=(4,),
+        in_specs=[pl.BlockSpec((block, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+"""
+
+
+def test_zl023_misaligned_constant_triggers():
+    zl = [f for f in lint_source(ZL023_CONST, PKG) if f.rule_id == "ZL023"]
+    # (100, 200): second-to-last off the 8 floor AND last off the 128
+    # floor; the aligned out_specs contribute nothing
+    assert len(zl) == 2 and all(f.severity == ERROR for f in zl)
+
+
+def test_zl023_raw_clamp_triggers_round_up_clean():
+    zl = [f for f in lint_source(ZL023_CLAMP, PKG) if f.rule_id == "ZL023"]
+    assert zl and all("clamp" in f.message for f in zl)
+    # round_up-wrapping the SAME clamp is recognized as aligned
+    clean = ZL023_CLAMP.replace(
+        "from jax.experimental import pallas as pl",
+        "from jax.experimental import pallas as pl\n"
+        "from analytics_zoo_tpu.ops.pallas.common import round_up"
+    ).replace("min(block, t)", "round_up(min(block, t), 8)")
+    assert not ids(lint_source(clean, PKG), "ZL023")
+    # the `// m * m` floor idiom proves out too
+    floored = ZL023_CLAMP.replace("min(block, t)",
+                                  "min(block, t) // 8 * 8")
+    assert not ids(lint_source(floored, PKG), "ZL023")
+
+
+def test_zl023_whole_axis_shape_dims_exempt():
+    src = """
+import jax
+from jax.experimental import pallas as pl
+def f(x):
+    m, kdim = x.shape
+    return pl.pallas_call(k, grid=(4,),
+        in_specs=[pl.BlockSpec((8, kdim), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, kdim), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+"""
+    assert not ids(lint_source(src, PKG), "ZL023")
+
+
+def test_zl023_suppression():
+    src = ZL023_CONST.replace(
+        "        in_specs=[pl.BlockSpec((100, 200), lambda i: (i, 0))],",
+        "        in_specs=[pl.BlockSpec((100, 200), lambda i: (i, 0))],"
+        "  # zoolint: disable=ZL023 interpret-only reference kernel")
+    zl = [f for f in lint_source(src, PKG) if f.rule_id == "ZL023"]
+    assert not zl
+
+
+ZL024_BLOWUP = """
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+def f(x):
+    return pl.pallas_call(k, grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((4096, 4096), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+"""
+
+
+def test_zl024_provable_blowup_triggers_and_fitting_clean():
+    zl = [f for f in lint_source(ZL024_BLOWUP, PKG) if f.rule_id == "ZL024"]
+    assert len(zl) == 1 and "MiB" in zl[0].message and errors(zl)
+    clean = ZL024_BLOWUP.replace("(4096, 4096)", "(256, 128)")
+    assert not ids(lint_source(clean, PKG), "ZL024")
+    # symbolic dims price at the tile floor — never a false positive
+    sym = ZL024_BLOWUP.replace("(4096, 4096)", "(n, n)").replace(
+        "def f(x):", "def f(x):\n    n = x.shape[0]")
+    assert not ids(lint_source(sym, PKG), "ZL024")
+
+
+def test_zl024_uses_the_shared_runtime_estimator():
+    """The rule prices with ops/pallas/common.kernel_vmem_bytes — the
+    exact function the runtime autotuner uses (loaded standalone, no
+    jax import)."""
+    from analytics_zoo_tpu.analysis.device import footprint_module
+    mod = footprint_module()
+    assert mod is not None
+    import analytics_zoo_tpu.ops.pallas.common as runtime_common
+    assert mod.kernel_vmem_bytes(
+        operands=[((8, 128), 2)], scratch=[((4096, 4096), 4)]) == \
+        runtime_common.kernel_vmem_bytes(
+            operands=[((8, 128), 2)], scratch=[((4096, 4096), 4)])
+    assert mod.VMEM_BYTES_DEFAULT == runtime_common.VMEM_BYTES_DEFAULT
+
+
+def test_zl024_suppression():
+    src = ZL024_BLOWUP.replace(
+        "    return pl.pallas_call(k, grid=(4,),",
+        "    return pl.pallas_call(k, grid=(4,),"
+        "  # zoolint: disable=ZL024 manual DMA streams the scratch")
+    assert not ids(lint_source(src, PKG), "ZL024")
+
+
+def test_device_rules_live_package_scans_clean():
+    """ZL021-ZL024 over the live package + tests + bench: zero errors —
+    every real finding was fixed (the _prep/int8_matmul clamp rounding)
+    or carries a justified suppression."""
+    findings = lint_paths(
+        [os.path.join(REPO, "analytics_zoo_tpu"),
+         os.path.join(REPO, "tests"), os.path.join(REPO, "bench.py")],
+        select=["ZL021", "ZL022", "ZL023", "ZL024"])
+    errs = errors(findings)
+    assert not errs, "device-pass errors:\n" + "\n".join(
+        f.format() for f in errs)
+
+
+# ---------------------------------------------------------------------------
+# ZL022 project direction + ZL019 coverage census (drift-fixture tree)
+# ---------------------------------------------------------------------------
+
+def _mini_mesh_tree(root, *, ghost_axis=False, use_model=True):
+    """A mini package declaring a 2-axis mesh; `ghost_axis` adds a third
+    axis nothing uses (the declaration-direction trigger)."""
+    pkg = root / "meshpkg"
+    (pkg / "parallel").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "parallel" / "__init__.py").write_text("")
+    axes = '("data", "model", "ghost")' if ghost_axis \
+        else '("data", "model")'
+    (pkg / "parallel" / "mesh.py").write_text(
+        "import numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "def create(devs):\n"
+        f"    return Mesh(np.array(devs), {axes})\n")
+    (pkg / "layers.py").write_text(
+        "from jax.sharding import PartitionSpec as P\n"
+        "def spec():\n"
+        "    return P('data'" + (", 'model'" if use_model else "")
+        + ")\n")
+    return pkg
+
+
+def test_zl022_project_declared_axis_never_used_warns(tmp_path):
+    pkg = _mini_mesh_tree(tmp_path, ghost_axis=True)
+    fs = lint_project([str(pkg)], docs_root=str(tmp_path),
+                      select=["ZL022"])
+    assert len(fs) == 1
+    assert "ghost" in fs[0].message and fs[0].severity == "warning"
+    assert fs[0].path.endswith("mesh.py")
+
+
+def test_zl022_project_all_axes_used_is_clean(tmp_path):
+    pkg = _mini_mesh_tree(tmp_path)
+    assert not lint_project([str(pkg)], docs_root=str(tmp_path),
+                            select=["ZL022"])
+
+
+def test_zl019_site_without_test_coverage(tmp_path):
+    """The third ZL019 direction: a package fault site absent from the
+    tests tree's string census fails --contracts; adding a test that
+    spells the site clears it."""
+    pkg = _mini_project(tmp_path)
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_mini.py").write_text(
+        "def test_read_chaos():\n"
+        '    assert "mini.read" != ""\n')
+    assert not lint_project([str(pkg)], docs_root=str(tmp_path),
+                            tests_root=str(tests), select=["ZL019"])
+    # a NEW site without coverage turns the gate red, anchored at the
+    # inject call
+    code = (pkg / "code.py").read_text().replace(
+        '    faults.inject("mini.read")',
+        '    faults.inject("mini.read")\n'
+        '    faults.inject("mini.write")')
+    (pkg / "code.py").write_text(code)
+    (tmp_path / "RELIABILITY.md").write_text(
+        (tmp_path / "RELIABILITY.md").read_text()
+        + "| `mini.write` | the write path |\n")
+    fs = lint_project([str(pkg)], docs_root=str(tmp_path),
+                      tests_root=str(tests), select=["ZL019"])
+    assert len(fs) == 1 and "mini.write" in fs[0].message
+    assert "no test mentions it" in fs[0].message
+    assert fs[0].path.endswith("code.py")
+    # without a tests root the census stays off (backward compatible)
+    assert not lint_project([str(pkg)], docs_root=str(tmp_path),
+                            select=["ZL019"])
+
+
+def test_zl019_live_every_site_has_chaos_coverage():
+    """The live reconciliation: every faults.inject site in the package
+    appears in tests/ — new sites must ship with chaos coverage."""
+    fs = lint_project([os.path.join(REPO, "analytics_zoo_tpu")],
+                      docs_root=REPO,
+                      tests_root=os.path.join(REPO, "tests"),
+                      select=["ZL019"])
+    assert not fs, "\n".join(f.format() for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# --changed-only and --ci
+# ---------------------------------------------------------------------------
+
+def _git(cwd, *args):
+    return subprocess.run(["git"] + list(args), cwd=str(cwd),
+                          capture_output=True, text=True)
+
+
+def test_changed_only_scopes_to_git_diff(tmp_path):
+    """--changed-only scans ONLY files changed vs the merge-base (plus
+    untracked): a violation in a committed-clean file is not reported,
+    the uncommitted one is."""
+    repo = tmp_path / "r"
+    repo.mkdir()
+    assert _git(repo, "init", "-q", "-b", "main").returncode == 0
+    _git(repo, "config", "user.email", "t@t")
+    _git(repo, "config", "user.name", "t")
+    (repo / "committed.py").write_text(
+        "import jax\n"
+        "def f(rng):\n"
+        "    a = jax.random.normal(rng, (2,))\n"
+        "    return a + jax.random.uniform(rng, (2,))\n")
+    _git(repo, "add", "committed.py")
+    assert _git(repo, "commit", "-qm", "init").returncode == 0
+    (repo / "fresh.py").write_text(
+        "import jax\n"
+        "def g(rng):\n"
+        "    a = jax.random.normal(rng, (3,))\n"
+        "    return a + jax.random.uniform(rng, (3,))\n")
+    proc = subprocess.run(
+        [os.path.join(REPO, "scripts", "zoolint"),
+         "--changed-only", "--base", "main", "."],
+        capture_output=True, text=True, cwd=str(repo),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "fresh.py" in proc.stdout
+    assert "committed.py" not in proc.stdout
+    # a committed edit counts as changed vs the merge-base too
+    (repo / "committed.py").write_text(
+        (repo / "committed.py").read_text() + "\n# touched\n")
+    proc = subprocess.run(
+        [os.path.join(REPO, "scripts", "zoolint"),
+         "--changed-only", "--base", "main", "."],
+        capture_output=True, text=True, cwd=str(repo),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert "committed.py" in proc.stdout
+
+
+def test_changed_only_outside_git_falls_back_to_full_scan(tmp_path):
+    (tmp_path / "bad.py").write_text(
+        "import jax\n"
+        "def f(rng):\n"
+        "    a = jax.random.normal(rng, (2,))\n"
+        "    return a + jax.random.uniform(rng, (2,))\n")
+    proc = subprocess.run(
+        [os.path.join(REPO, "scripts", "zoolint"), "--changed-only",
+         str(tmp_path)],
+        capture_output=True, text=True, cwd="/",
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "GIT_CEILING_DIRECTORIES": "/"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "full scan" in proc.stderr
+    assert "ZL001" in proc.stdout
+
+
+def test_ci_mode_is_the_tier1_gate():
+    """THE tier-1 gate entry point: `scripts/zoolint --ci` — per-file +
+    --contracts + JSON results file in one invocation — exits 0 on the
+    live repo, and the results file holds one JSON object per finding
+    (warnings included, machine-readable for external CI)."""
+    results = os.path.join(REPO, ".zoolint-results.json")
+    if os.path.exists(results):
+        os.remove(results)
+    proc = subprocess.run(
+        [os.path.join(REPO, "scripts", "zoolint"), "--ci"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert os.path.exists(results)
+    import json as _json
+    with open(results, encoding="utf-8") as f:
+        objs = [_json.loads(ln) for ln in f if ln.strip()]
+    assert all({"rule", "file", "line", "severity", "message"}
+               <= set(o) for o in objs)
+    # zero errors is the gate; warnings may legitimately appear
+    assert not [o for o in objs if o["severity"] == "error"]
+
+
+def test_ci_mode_exit_contract(tmp_path):
+    """--ci keeps the 0/1/2/3 contract: contract drift exits 2, a code
+    hazard exits 1, and the results file carries the findings."""
+    import json as _json
+    pkg = _mini_project(tmp_path, extra_conf_row=True)
+    assert pkg.name == "minipkg"
+    (tmp_path / ".zoolint.json").write_text(_json.dumps({
+        "paths": ["minipkg"], "docs_root": ".",
+        "results": "out.jsonl"}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.analysis", "--ci"],
+        capture_output=True, text=True, cwd=str(tmp_path),
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    with open(str(tmp_path / "out.jsonl"), encoding="utf-8") as f:
+        objs = [_json.loads(ln) for ln in f if ln.strip()]
+    assert [o for o in objs if o["rule"] == "ZL018"]
+
+
+def test_zl021_conflicting_dtype_rebind_not_accused():
+    """Flow-insensitivity must not accuse: a name rebound f32-then-bf16
+    keeps the earlier, correct f32 reduction clean (two concrete
+    conflicting dtypes demote the name to unknown)."""
+    src = """
+import jax
+import jax.numpy as jnp
+@jax.jit
+def f(x):
+    y = x.astype(jnp.float32)
+    s = jnp.sum(y)
+    y = x.astype(jnp.bfloat16)
+    return s + jnp.max(y)
+"""
+    assert not ids(lint_source(src, PKG), "ZL021")
+
+
+def test_changed_only_anchors_git_at_scanned_tree(tmp_path):
+    """--changed-only must resolve the diff from the SCANNED tree's
+    repo, not the process cwd — from a cwd inside an unrelated repo the
+    scan previously scoped to that repo's (empty) diff and read green."""
+    target = tmp_path / "target"
+    target.mkdir()
+    assert _git(target, "init", "-q", "-b", "main").returncode == 0
+    _git(target, "config", "user.email", "t@t")
+    _git(target, "config", "user.name", "t")
+    (target / "clean.py").write_text("x = 1\n")
+    _git(target, "add", "clean.py")
+    assert _git(target, "commit", "-qm", "init").returncode == 0
+    (target / "bad.py").write_text(
+        "import jax\n"
+        "def f(rng):\n"
+        "    a = jax.random.normal(rng, (2,))\n"
+        "    return a + jax.random.uniform(rng, (2,))\n")
+    other = tmp_path / "other"
+    other.mkdir()
+    assert _git(other, "init", "-q", "-b", "main").returncode == 0
+    proc = subprocess.run(
+        [os.path.join(REPO, "scripts", "zoolint"),
+         "--changed-only", "--base", "main", str(target)],
+        capture_output=True, text=True, cwd=str(other),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "bad.py" in proc.stdout

@@ -82,6 +82,13 @@ DEFAULT_CONF: Dict[str, Any] = {
     #   live backlog/queue-wait signals (zoo_serving_batch_size_target)
     "zoo.serving.queue_wait_target_ms": 500,  # queue-wait breach target the
     #   AIMD controller backs off against
+    # -- serving device path: bucketing + multiplexing (SERVING.md) ---------
+    "zoo.serving.shape_buckets": "",     # compiled-shape dispatch buckets, a
+    #   comma-joined list of batch row counts ("" = powers of two up to
+    #   batch_size); ragged reads pad up to a bucket instead of retracing jit
+    "zoo.serving.dtype": "float32",      # serving precision path for models
+    #   the server wraps (KerasNet lane specs): float32 | bfloat16 | int8
+    #   (int8 = weight-only quantized inference, fp32 results on the wire)
     "zoo.serving.dlq_dir": "",           # non-empty: spill dead-lettered records
     #   to this append-only on-disk DLQ (scripts/zoo-dlq replays them)
     "zoo.serving.dlq_max_bytes": 64 << 20,  # DLQ disk bound; oldest sealed

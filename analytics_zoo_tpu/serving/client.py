@@ -267,7 +267,8 @@ class InputQueue:
 
     def enqueue(self, uri: str, data: np.ndarray,
                 trace: Optional[str] = None,
-                deadline_ms: Optional[int] = None) -> str:
+                deadline_ms: Optional[int] = None,
+                model: Optional[str] = None) -> str:
         """Enqueue one record (wire-format v2: raw bytes + dtype/shape).
         Every record is stamped with a Dapper-style ``trace`` id (16 hex
         chars; pass ``trace=`` to adopt a caller's id, e.g. an upstream
@@ -283,7 +284,13 @@ class InputQueue:
         exceeded`` error instead of spending dispatch on a request whose
         caller has already timed out. Producers typically stamp
         ``int(time.time() * 1000) + budget_ms``. No stamp = no deadline
-        (the pre-deadline contract, unchanged)."""
+        (the pre-deadline contract, unchanged).
+
+        ``model`` routes the record to one named lane of a multiplexed
+        server (several models on one stream — ``ClusterServing`` with a
+        ``{name: model}`` dict). No stamp = the server's primary lane; a
+        name the server does not host is answered with a distinct
+        ``unknown model`` error rather than dispatched anywhere."""
         self._check_fleet()
         fields = encode_tensor(np.asarray(data))
         fields["uri"] = uri
@@ -292,6 +299,8 @@ class InputQueue:
         fields["trace"] = trace or new_trace_id()
         if deadline_ms is not None:
             fields["deadline_ms"] = str(int(deadline_ms))
+        if model:
+            fields["model"] = str(model)
         return self.backend.xadd(self.stream, fields, timeout=self.timeout)
 
 

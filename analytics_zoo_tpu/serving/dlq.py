@@ -198,12 +198,15 @@ class DeadLetterQueue:
     # -- append --------------------------------------------------------------
     def append(self, uri: str, tensor, reason: str,
                trace: Optional[str] = None,
-               error: Optional[str] = None) -> None:
+               error: Optional[str] = None,
+               model: Optional[str] = None) -> None:
         """Spill one dead-lettered record durably. ``tensor`` is the
         original request payload (any ndarray-like); ``reason`` labels
-        the spill counter (``dispatch`` / ``publish``). Raises on an
-        unwritable directory — the CALLER decides whether losing the
-        record is acceptable (the serve loop logs and answers the
+        the spill counter (``dispatch`` / ``publish``); ``model`` is the
+        lane the record was routed to on a multiplexed server — replay
+        re-stamps it so the record goes back to the SAME model. Raises
+        on an unwritable directory — the CALLER decides whether losing
+        the record is acceptable (the serve loop logs and answers the
         producer either way)."""
         fields = encode_tensor(np.asarray(tensor))
         rec = {
@@ -217,6 +220,8 @@ class DeadLetterQueue:
             "shape": fields["shape"],
             "v": fields["v"],
         }
+        if model:
+            rec["model"] = str(model)
         payload = json.dumps(rec, separators=(",", ":")).encode("utf-8")
         line = b"%08x " % (zlib.crc32(payload) & 0xFFFFFFFF) + payload + b"\n"
         with self._lock:
@@ -446,6 +451,10 @@ class DeadLetterQueue:
                 }
                 if rec.get("trace"):
                     fields["replay_of"] = rec["trace"]
+                if rec.get("model"):
+                    # multiplexed servers route by this field: the
+                    # replayed record must land on the SAME lane
+                    fields["model"] = rec["model"]
                 if rate is not None and replayed:
                     # fixed schedule, not inter-record gaps: a slow xadd
                     # does not compound the pace, and the total duration

@@ -4,12 +4,40 @@
 * the reference runs a Spark-streaming micro-batch per trigger; here one
   background thread drains the input stream and pushes through a jitted
   ``InferenceModel`` (replica-queue concurrency inside),
-* requests are batched up to ``batch_size`` per dispatch — padding to a
-  fixed shape inside ``InferenceModel.predict`` keeps ONE compiled program
-  regardless of how many requests arrived (dynamic batch sizes would
-  recompile per unique size),
+* requests are batched up to ``batch_size`` per dispatch and padded up
+  to a small fixed set of **compiled-shape buckets** (powers of two up
+  to ``batch_size`` by default; conf ``zoo.serving.shape_buckets``), so
+  ragged traffic reuses a handful of compiled programs instead of
+  retracing jit per distinct size — padding rows repeat the last real
+  record and are sliced off before publish
+  (``zoo_serving_bucket_pad_rows_total``),
 * backpressure comes from the bounded stream (``LocalBackend.xadd`` blocks),
   replacing the reference's Redis-memory watermark polling.
+
+The pipeline is organised as **per-model lanes** (the reference's
+InferenceModel is explicitly a multi-backend, multi-model runtime —
+``InferenceModel.scala:30-67``): one ``ClusterServing`` hosts one or
+several named models, records are routed by the optional ``model`` wire
+field (absent → the primary lane), and each lane owns its own dispatch
+window, pooled batch arenas, AIMD batch target, and **dispatch circuit
+breaker** — a model that keeps crashing degrades ITS lane (fast-fail to
+addressable errors + DLQ spills once the breaker opens) while the other
+lanes keep serving. Admission under overload is **weighted-fair**: when
+the shed watermark forces a cut, each lane keeps a share of the
+admission window proportional to its configured weight. ``/statusz``
+carries a ``models`` block (per-lane batch target, bucket hit-rate,
+breaker state) that ``cluster-serving-status`` renders per replica and
+as a fleet rollup.
+
+Dispatch is **continuous** (the Orca/Clipper lineage — continuous
+batching with per-model adaptive windows): admission is decoupled from
+the device step. While any lane has work in flight the loop polls the
+stream without blocking, so records that arrive during a device step are
+admitted into the *next* dispatch instead of waiting out a read window.
+A lane's admitted buffer also carries records ACROSS iterations while
+its breaker's half-open probe is in flight (recoverable work waits
+instead of shedding) and across a supervised loop restart. The device
+only idles when the stream is truly empty.
 
 The host path is pipelined three ways (the Clipper / TF-Serving lineage:
 codec and publish work stay off the dispatch critical path):
@@ -140,17 +168,22 @@ __all__ = ["ClusterServing"]
 _Rec = collections.namedtuple("_Rec", ("uri", "trace", "t_enq", "t_deq",
                                        "v2", "eid"))
 
-#: a dispatched batch whose readback is deferred: ``collect`` blocks on
-#: the device transfer, ``arena`` (may be None) returns to the pool after
-#: readback proves the device consumed the input buffer. ``inputs`` is a
+#: a dispatched batch whose readback is deferred: ``lane`` owns the
+#: window and arena pool, ``collect`` blocks on the device transfer,
+#: ``arena`` (may be None) returns to the lane's pool after readback
+#: proves the device consumed the input buffer. ``recs`` holds the REAL
+#: records — the dispatched batch may be bucket-padded past ``len(recs)``
+#: and the padding rows are sliced off before publish. ``inputs`` is a
 #: DLQ-only copy of the batch's request tensors (None with no DLQ
 #: attached) so a publish give-up can spill the original payloads.
-_Pending = collections.namedtuple("_Pending", ("recs", "collect", "t0",
-                                               "arena", "inputs"))
+_Pending = collections.namedtuple("_Pending", ("lane", "recs", "collect",
+                                               "t0", "arena", "inputs"))
 
-#: one read-time candidate: the record, its raw fields, its queue wait,
-#: and — for a validated v2 record — the (payload, dtype, shape) header.
-_Item = collections.namedtuple("_Item", ("rec", "fields", "wait", "hdr"))
+#: one admitted record: the record, its raw fields, its queue wait, and
+#: its tensor — as a validated v2 (payload, dtype, shape) header (``hdr``)
+#: or, for a legacy v1 record, the decoded array (``arr``).
+_Item = collections.namedtuple("_Item", ("rec", "fields", "wait", "hdr",
+                                         "arr"))
 
 _PUB_STOP = object()    # publisher-queue sentinel: drain, then exit
 
@@ -186,6 +219,46 @@ _PUB_PUT_TIMEOUT_S = 30.0
 #: milliseconds. Past the warm-up the compile outlier cannot move the
 #: median.
 _DOOMED_MIN_OBS = 16
+
+#: the continuous-batching busy poll: while any lane has work in flight
+#: the stream read uses this block instead of ``block_ms``, so records
+#: arriving during a device step join the NEXT dispatch. 1 ms, not 0 —
+#: a 0 means "block forever" to real Redis XREAD.
+_BUSY_POLL_MS = 1
+
+#: serving dtype paths a lane may request for a model the SERVER wraps
+#: (conf ``zoo.serving.dtype``); pre-built predict models carry their
+#: own precision and pass through untouched
+_LANE_DTYPES = ("float32", "bfloat16", "bf16", "int8")
+
+
+def _parse_buckets(spec, batch_size: int):
+    """The lane's compiled-shape dispatch buckets: a sorted tuple of
+    batch row counts, always topped by ``batch_size`` (a full read must
+    fit a bucket). Empty/0/None spec = powers of two up to
+    ``batch_size``; a comma-joined string or int sequence names explicit
+    buckets. Every bucket must sit in [1, batch_size] — a bucket the
+    arena cannot hold would be a silent lie about compile counts."""
+    sizes = []
+    if spec:
+        if isinstance(spec, str):
+            sizes = [int(s) for s in spec.split(",") if s.strip()]
+        elif isinstance(spec, (list, tuple)):
+            sizes = [int(s) for s in spec]
+        else:
+            raise ValueError(f"shape_buckets must be a comma-joined "
+                             f"string or int sequence, got {spec!r}")
+        for s in sizes:
+            if not 1 <= s <= batch_size:
+                raise ValueError(
+                    f"shape bucket {s} outside [1, batch_size={batch_size}]")
+    if not sizes:
+        b = 1
+        while b < batch_size:
+            sizes.append(b)
+            b *= 2
+    sizes.append(batch_size)
+    return tuple(sorted(set(sizes)))
 
 
 class _ArenaPool:
@@ -243,6 +316,82 @@ class _ArenaPool:
                     del self._free[k]
 
 
+class _Lane:
+    """One model's serving lane — the per-model half of the pipeline
+    state the serve loop multiplexes: the admitted-record buffer
+    (records read off the stream, waiting for their next device step),
+    the dispatch window (``pendings``), pooled batch arenas, the AIMD
+    batch target, the dispatch circuit breaker (a model that keeps
+    crashing fast-fails ITS records without stalling the other lanes),
+    and the per-model accounting behind ``/statusz``'s ``models``
+    block. Records are routed here by the ``model`` wire field; the
+    primary (first-configured) lane takes unlabeled records."""
+
+    def __init__(self, name: str, model, weight: float, dtype: str,
+                 buckets, batch_size: int, max_inflight: int,
+                 batch_ctl: Optional[AIMDController],
+                 breaker: Optional[CircuitBreaker], metrics,
+                 initial_target: int):
+        self.name = name
+        self.model = model
+        self.weight = float(weight)
+        if self.weight <= 0:
+            raise ValueError(f"lane {name!r}: admission weight must be > 0")
+        self.dtype = dtype or "float32"
+        self.buckets = buckets
+        self.pendings: "collections.deque[_Pending]" = collections.deque()
+        self.buffer: "collections.deque[_Item]" = collections.deque()
+        self.arena_pool = _ArenaPool(batch_size, cap=max_inflight + 2)
+        self.batch_ctl = batch_ctl if batch_ctl is not None \
+            else AIMDController(floor=1, ceiling=batch_size)
+        #: guards THIS model's dispatches: consecutive crashes open it
+        #: and the lane fast-fails (addressable error + DLQ spill)
+        #: instead of burning the shared loop on a dead model; the
+        #: half-open probe dispatches one real batch. The default
+        #: threshold sits above the poison-isolation retry budget so a
+        #: single poison batch never trips a healthy model's lane.
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            name=f"serving.dispatch.{name}", failure_threshold=16,
+            reset_timeout=2.0, registry=metrics)
+        self.last_read_waits: List[float] = []
+        self.dispatches = 0      # assembled batches (bucket hit-rate base)
+        self.bucket_hits = 0     # assembled with no padding rows
+        labels = {"model": name}
+        # model = the configured lane set, bounded by deployment config
+        self.m_records = metrics.counter(  # zoolint: disable=ZL015 bounded label set
+            "zoo_serving_model_records_total",
+            "records answered with a prediction, per model lane",
+            labels=labels)
+        self.m_dispatches = metrics.counter(  # zoolint: disable=ZL015 bounded label set
+            "zoo_serving_model_dispatches_total",
+            "batches dispatched to the device, per model lane",
+            labels=labels)
+        self.m_pad_rows = metrics.counter(  # zoolint: disable=ZL015 bounded label set
+            "zoo_serving_bucket_pad_rows_total",
+            "padding rows added to reach a compiled bucket shape "
+            "(sliced off before publish, never answered), per model lane",
+            labels=labels)
+        self.m_target = metrics.gauge(  # zoolint: disable=ZL015 bounded label set
+            "zoo_serving_model_batch_target",
+            "per-model adaptive batch target (AIMD; equals batch_size "
+            "when adaptive_batch is off)", labels=labels)
+        self.m_target.set(initial_target)
+
+    def bucket_for(self, k: int) -> int:
+        """The smallest compiled-shape bucket holding ``k`` rows."""
+        for b in self.buckets:
+            if b >= k:
+                return b
+        return self.buckets[-1]
+
+    def bucket_hit_rate(self) -> Optional[float]:
+        """Fraction of assembled batches that needed no padding rows;
+        None before the first dispatch."""
+        if not self.dispatches:
+            return None
+        return self.bucket_hits / self.dispatches
+
+
 class ClusterServing:
     """Owns the serve loop: xread → batched predict → result writes.
 
@@ -271,6 +420,11 @@ class ClusterServing:
                  adaptive_batch: Optional[bool] = None,
                  queue_wait_target_s: Optional[float] = None,
                  batch_controller: Optional[AIMDController] = None,
+                 weights: Optional[Dict[str, float]] = None,
+                 dtype: Optional[str] = None,
+                 shape_buckets=None,
+                 dispatch_breakers: Optional[Dict[str,
+                                                  CircuitBreaker]] = None,
                  publish_breaker: Optional[CircuitBreaker] = None,
                  dlq: Optional[DeadLetterQueue] = None,
                  dlq_dir: Optional[str] = None,
@@ -281,7 +435,10 @@ class ClusterServing:
                  max_deliveries: Optional[int] = None,
                  heartbeat_s: float = 1.0,
                  fleet_ttl_s: float = fleet_lib.DEFAULT_TTL_S):
-        self.model = model          # InferenceModel (or any .predict(x))
+        #: one model (any ``.predict(x)``) or ``{name: model-or-spec}``
+        #: for a multiplexed server — normalized into lanes below, after
+        #: the shared knobs/metrics they hang off exist
+        self._model_spec = model
         self.backend = backend if backend is not None else default_backend()
         self.batch_size = int(batch_size)
         self.stream = stream
@@ -294,8 +451,6 @@ class ClusterServing:
         #: two-deep pipeline's memory bound (one in flight, one being
         #: assembled)
         self.max_inflight = max(int(max_inflight), 1)
-        self._arena_pool = _ArenaPool(self.batch_size,
-                                      cap=self.max_inflight + 2)
         self._pub_maxsize = max(int(publish_queue), 1)
         self._pub_queue: Optional["queue.Queue"] = None
         self._pub_thread: Optional[threading.Thread] = None
@@ -425,15 +580,82 @@ class ClusterServing:
         self.queue_wait_target_s = float(
             self._conf("zoo.serving.queue_wait_target_ms", 500) / 1000.0
             if queue_wait_target_s is None else queue_wait_target_s)
-        self._batch_ctl = batch_controller if batch_controller is not None \
-            else AIMDController(floor=1, ceiling=self.batch_size)
+        # -- per-model lanes (multiplexing; docs/guides/SERVING.md) ---------
+        #: serving dtype path for models the server wraps (KerasNet lane
+        #: specs go through InferenceModel on this precision; conf
+        #: ``zoo.serving.dtype``); prebuilt predict models pass through
+        self.dtype = str(self._conf("zoo.serving.dtype", "float32")
+                         if dtype is None else dtype)
+        if self.dtype not in _LANE_DTYPES:
+            raise ValueError(f"unknown serving dtype {self.dtype!r}; "
+                             f"use one of {_LANE_DTYPES}")
+        #: compiled-shape dispatch buckets shared by every lane (conf
+        #: ``zoo.serving.shape_buckets``; "" = powers of two)
+        self.shape_buckets = _parse_buckets(
+            self._conf("zoo.serving.shape_buckets", "")
+            if shape_buckets is None else shape_buckets, self.batch_size)
+        specs = (self._model_spec if isinstance(self._model_spec, dict)
+                 else {"default": self._model_spec})
+        if not specs:
+            raise ValueError("ClusterServing needs at least one model")
+        weights = dict(weights or {})
+        dispatch_breakers = dict(dispatch_breakers or {})
+        for param, keys in (("weights", weights),
+                            ("dispatch_breakers", dispatch_breakers)):
+            unknown = set(keys) - {str(n) for n in specs}
+            if unknown:
+                # a typo here would silently flatten the operator's
+                # intended admission ratio (or substitute the default
+                # breaker) — refuse loudly instead
+                raise ValueError(
+                    f"{param} names unknown lane(s) {sorted(unknown)}; "
+                    f"configured lanes: {sorted(str(n) for n in specs)}")
+        self._lanes: "collections.OrderedDict[str, _Lane]" = \
+            collections.OrderedDict()
+        for i, (name, spec) in enumerate(specs.items()):
+            name = str(name)
+            if not name:
+                raise ValueError("lane names must be non-empty strings")
+            opts = dict(spec) if isinstance(spec, dict) else {"model": spec}
+            if "model" not in opts:
+                raise ValueError(f"lane {name!r}: spec dict needs a "
+                                 f"'model' entry")
+            lane_dtype = str(opts.get("dtype") or self.dtype)
+            if lane_dtype not in _LANE_DTYPES:
+                raise ValueError(f"lane {name!r}: unknown dtype "
+                                 f"{lane_dtype!r}; use one of {_LANE_DTYPES}")
+            self._lanes[name] = _Lane(
+                name=name,
+                model=self._wrap_model(opts["model"], lane_dtype),
+                weight=weights.get(name, opts.get("weight", 1.0)),
+                dtype=lane_dtype,
+                buckets=self.shape_buckets,
+                batch_size=self.batch_size,
+                max_inflight=self.max_inflight,
+                # the ctor's batch_controller names the PRIMARY lane's
+                # controller (single-model back-compat)
+                batch_ctl=(batch_controller if i == 0 else None),
+                breaker=dispatch_breakers.get(name),
+                metrics=m,
+                initial_target=self.batch_size)
+        #: the primary lane: first configured — takes records without a
+        #: ``model`` wire field, and backs the single-model aliases
+        self._primary = next(iter(self._lanes))
+        primary = self._lanes[self._primary]
+        self.model = primary.model          # single-model back-compat
+        self._batch_ctl = primary.batch_ctl
+        self._arena_pool = primary.arena_pool
         self._m_batch_target = m.gauge(
             "zoo_serving_batch_size_target",
-            "adaptive per-read batch target (AIMD; equals batch_size "
-            "when adaptive_batch is off)")
-        self._m_batch_target.set(self._batch_ctl.value if self.adaptive_batch
-                                 else self.batch_size)
-        self._last_read_waits: List[float] = []  # queue waits, newest read
+            "adaptive per-read batch target of the primary lane (AIMD; "
+            "equals batch_size when adaptive_batch is off; per-lane "
+            "targets in zoo_serving_model_batch_target)")
+        init_target = (self._batch_ctl.value if self.adaptive_batch
+                       else self.batch_size)
+        self._m_batch_target.set(init_target)
+        for lane in self._lanes.values():
+            lane.m_target.set(lane.batch_ctl.value if self.adaptive_batch
+                              else self.batch_size)
         #: guards publisher writes: repeated publish failures trip it so
         #: an outage fast-fails to the DLQ instead of burning the publish
         #: queue's drain time on a dead result store
@@ -526,6 +748,45 @@ class ClusterServing:
         knob actually defaults from it."""
         from ..common.context import get_zoo_context
         return get_zoo_context().get(key, default)
+
+    @staticmethod
+    def _wrap_model(model, dtype: str):
+        """The int8/bf16 serving wiring: a lane spec naming a bare
+        KerasNet (``.apply``/``.params``, no predict surface) is wrapped
+        in an ``InferenceModel`` on the lane's dtype path —
+        ``dtype="int8"`` loads the existing int8 weight-only inference
+        path (int8 weights in HBM, fp32 activations AND fp32 results on
+        the wire). Anything already exposing ``.predict_async`` (an
+        ``InferenceModel``, or any custom async model) carries its own
+        precision and passes through untouched, as does any foreign
+        ``.predict`` object without the KerasNet surface. Imported
+        lazily: only a KerasNet spec pulls jax in."""
+        if hasattr(model, "predict_async"):
+            return model
+        if hasattr(model, "apply") and hasattr(model, "params"):
+            from ..pipeline.inference import InferenceModel
+            im = InferenceModel(concurrent_num=2)
+            if dtype == "int8":
+                return im.from_keras(model, quantize="int8")
+            if dtype in ("bfloat16", "bf16"):
+                return im.from_keras(model, dtype="bfloat16")
+            return im.from_keras(model)
+        return model
+
+    def _lane_target(self, lane: _Lane) -> int:
+        """The lane's current per-dispatch batch target."""
+        return (lane.batch_ctl.value if self.adaptive_batch
+                else self.batch_size)
+
+    def _lane_name(self, fields) -> Optional[str]:
+        """Route one record's ``model`` wire field to a lane name; no
+        field → the primary lane; an unconfigured name → None (answered
+        with the addressable ``unknown model`` error, never dispatched)."""
+        name = fields.get("model")
+        if not name:
+            return self._primary
+        name = str(name)
+        return name if name in self._lanes else None
 
     def set_tensorboard(self, log_dir: str,
                         app_name: str = "serving") -> "ClusterServing":
@@ -649,6 +910,26 @@ class ClusterServing:
             "utilization": round(self._utilization("health"), 4),
             "batch_size_target": overload["batch_size_target"],
         }
+        # the models block: one row per lane — what the status CLI
+        # renders per replica and rolls up fleet-wide. Reads are cheap
+        # snapshot fields (counters, deque lengths, breaker state); the
+        # scrape thread never touches a dispatch.
+        models = {}
+        for name, lane in self._lanes.items():
+            hit = lane.bucket_hit_rate()
+            models[name] = {
+                "weight": lane.weight,
+                "dtype": lane.dtype,
+                "batch_target": self._lane_target(lane),
+                "buckets": list(lane.buckets),
+                "bucket_hit_rate": None if hit is None else round(hit, 4),
+                "breaker": lane.breaker.state,
+                "records": lane.m_records.value,
+                "pad_rows": lane.m_pad_rows.value,
+                "buffered": len(lane.buffer),
+                "inflight": len(lane.pendings),
+            }
+        info["serving"]["models"] = models
         if self._crash_info:
             info["serving"]["last_crash"] = dict(self._crash_info)
         if down:
@@ -970,35 +1251,42 @@ class ClusterServing:
 
     # -- the loop -----------------------------------------------------------
     def _loop(self) -> None:
-        """The dispatch pipeline: up to ``max_inflight`` batches run their
-        device time + dispatch round-trip while the next batch is read
-        and decoded on the host (``predict_async`` enqueues the XLA work
-        and defers only the readback). On a tunneled/remote device the
-        round-trip dominates the batch budget, so overlapping it with
-        host work roughly doubles sustainable throughput; the window
-        bounds how many batches can be in flight (memory bound)."""
-        pendings: "collections.deque[_Pending]" = collections.deque()
+        """The continuous dispatch pipeline: per lane, up to
+        ``max_inflight`` batches run their device time + dispatch
+        round-trip while the next batch is read, routed, and assembled
+        on the host (``predict_async`` enqueues the XLA work and defers
+        only the readback). Admission is decoupled from the device step:
+        while ANY lane has work in flight (or records buffered behind a
+        half-open breaker probe / a restarted loop) the stream read is
+        a non-blocking poll (``_BUSY_POLL_MS``), so records arriving
+        during a device step join the NEXT dispatch instead of waiting
+        out a read window — the device idles only when the stream is
+        truly empty."""
+        lanes = self._lanes
         try:
             while not self._stop.is_set():
                 it0 = time.perf_counter()
                 idle_s = 0.0
                 try:
                     faults.inject("serving.loop")
+                    busy = any(l.pendings or l.buffer
+                               for l in lanes.values())
                     # admission window: `want` records are admitted
-                    # (oldest first — FIFO fairness); when the backlog
-                    # stands above the shed watermark the read pulls the
-                    # window's newest remainder too, purely to shed it —
-                    # bounding the queue admitted records wait behind
-                    # (their latency), while the shed ones get an
+                    # (oldest first — FIFO fairness; weighted-fair
+                    # across lanes under shed pressure); when the
+                    # backlog stands above the shed watermark the read
+                    # pulls the window's newest remainder too, purely to
+                    # shed it — bounding the queue admitted records wait
+                    # behind (their latency), while the shed ones get an
                     # immediate addressable error instead of a doomed
                     # wait
-                    want = (self._batch_ctl.value if self.adaptive_batch
-                            else self.batch_size)
+                    want = sum(self._lane_target(l) for l in lanes.values())
                     # reclaim sweep first: a dead peer's entries are the
                     # OLDEST work in the system — they take this read's
                     # admission slots ahead of fresh stream entries
                     reclaimed = self._reclaim_sweep()
-                    want_read = max(want - len(reclaimed), 0)
+                    buffered = sum(len(l.buffer) for l in lanes.values())
+                    want_read = max(want - len(reclaimed) - buffered, 0)
                     extra = 0
                     if want_read > 0 and self.shed_watermark > 0 \
                             and self._breaker.state == CircuitBreaker.CLOSED:
@@ -1014,16 +1302,20 @@ class ClusterServing:
                             extra = min(overage, _SHED_MAX_PER_READ)
                     if want_read + extra > 0:
                         t_read = time.perf_counter()
-                        entries = self._read_entries(want_read + extra)
+                        entries = self._read_entries(
+                            want_read + extra,
+                            block_ms=_BUSY_POLL_MS if busy else None)
                         idle_s = time.perf_counter() - t_read
                     else:
                         entries = []
-                    if not entries and not reclaimed:
-                        self._drain(pendings)
+                    if not entries and not reclaimed and not buffered:
+                        self._drain_all()
                         continue
                     if len(entries) > want_read:
-                        self._shed(entries[want_read:], reason="depth")
-                        entries = entries[:want_read]
+                        admitted, shed = self._admit_fair(entries,
+                                                          want_read)
+                        self._shed(shed, reason="depth")
+                        entries = admitted
                     entries = reclaimed + entries
                     # ONE depth probe per read feeds both the gauge and
                     # the drain checks below — group consumers only ADD
@@ -1031,48 +1323,17 @@ class ClusterServing:
                     # toward flushing, never toward parking
                     depth = self._stream_depth()
                     self._m_depth.set(depth)
-                    recs, batch, arena, ragged = self._assemble(
-                        entries, n_reclaimed=len(reclaimed))
-                    if self.adaptive_batch:
-                        self._update_batch_target(self._last_read_waits)
-                    if not recs and not ragged:
-                        # every record in this read was undecodable: the
-                        # same drain signal applies — an empty stream
-                        # means no next batch will arrive to trigger the
-                        # pending readbacks, so they would otherwise park
-                        # for up to block_ms
-                        if pendings and depth == 0:
-                            self._drain(pendings)
-                        continue
-                    if ragged:
-                        # ragged shapes can't batch: drain the pipeline,
-                        # then serve one by one (rare path, keep it
-                        # simple)
-                        self._drain(pendings)
-                        for rec, tensor in ragged:
-                            self._dispatch([rec], tensor[None], pendings)
-                            self._drain(pendings)
-                    if recs:
-                        self._dispatch(recs, batch, pendings, arena)
-                        while len(pendings) >= self.max_inflight:
-                            # the dispatch window: publish the oldest
-                            # batch once max_inflight are
-                            # dispatched-but-unread
-                            self._flush(pendings.popleft())
-                        if pendings and depth == 0:
-                            # nothing left queued: the stream is drained
-                            # and there is no next batch to overlap with,
-                            # so deferring these readbacks would only add
-                            # up to block_ms of tail latency under
-                            # trickle load (ADVICE round 5). The queue
-                            # length is the drain signal — an under-full
-                            # read is not (xread returns on FIRST
-                            # delivery, so under sustained single-record
-                            # load more work is usually queued already
-                            # and flushing would serialize the pipeline),
-                            # and a final exactly-full batch with an
-                            # empty queue must flush too
-                            self._drain(pendings)
+                    routed = self._route(entries,
+                                         n_reclaimed=len(reclaimed))
+                    for name, items in routed.items():
+                        lane = lanes[name]
+                        lane.buffer.extend(items)
+                        lane.last_read_waits = [
+                            i.wait for i in items if i.wait is not None]
+                        if self.adaptive_batch and items:
+                            self._update_batch_target(lane)
+                    for lane in lanes.values():
+                        self._pump(lane, depth)
                 finally:
                     # utilization accounting: everything this iteration
                     # did except the blocking read wait counts as busy;
@@ -1082,17 +1343,89 @@ class ClusterServing:
                         time.perf_counter() - it0 - idle_s, 0.0)
                     self._heartbeat()
         finally:
-            self._drain(pendings)
+            # exit — clean stop, crash (the supervisor may restart us),
+            # or kill: dispatch what was already admitted (the records
+            # were read; in legacy mode dropping them would lose them),
+            # then flush every in-flight batch. A kill abandons instead
+            # (the crash window the group reclaim exists to close), and
+            # a failing final pump must not mask the original exception.
+            for lane in lanes.values():
+                if not self._killed:
+                    try:
+                        self._pump(lane, 0)
+                    except Exception:
+                        log.exception("final pump of lane %r failed",
+                                      lane.name)
+                self._drain(lane.pendings)
 
     def _drain(self, pendings) -> None:
         """Flush every pending batch, oldest first."""
         while pendings:
             self._flush(pendings.popleft())
 
-    def _read_entries(self, count: Optional[int] = None):
+    def _drain_all(self) -> None:
+        """Flush every lane's dispatch window (the stream-empty drain
+        signal: no next batch will arrive to overlap with, so deferring
+        readbacks would only add tail latency under trickle load)."""
+        for lane in self._lanes.values():
+            self._drain(lane.pendings)
+
+    def _admit_fair(self, entries, want_read: int):
+        """Split an over-watermark read into ``(admitted, shed)``.
+
+        Single lane: pure FIFO — the window's oldest ``want_read``
+        records are admitted, the newest remainder shed (the original
+        admission-control contract). Multiple lanes: **weighted-fair** —
+        each lane keeps a quota of the admission window proportional to
+        its configured weight (largest-remainder rounding, so quotas sum
+        to exactly ``want_read``), filled oldest-first from its OWN
+        records; quota a lane leaves unused (less traffic than its
+        share) redistributes to the remaining records in global FIFO
+        order. Records addressed to no configured lane ride for free —
+        they cost one error write, not a dispatch slot."""
+        if len(self._lanes) == 1:
+            return entries[:want_read], entries[want_read:]
+        names = [self._lane_name(fields) for _eid, fields in entries]
+        by_lane: Dict[str, List[int]] = {}
+        for idx, name in enumerate(names):
+            if name is not None:
+                by_lane.setdefault(name, []).append(idx)
+        total_w = sum(l.weight for l in self._lanes.values())
+        shares = {n: want_read * l.weight / total_w
+                  for n, l in self._lanes.items()}
+        quota = {n: int(s) for n, s in shares.items()}
+        rem = want_read - sum(quota.values())
+        for n in sorted(shares, key=lambda n: (-(shares[n] - quota[n]), n)):
+            if rem <= 0:
+                break
+            quota[n] += 1
+            rem -= 1
+        admitted = {idx for idx, name in enumerate(names) if name is None}
+        taken = 0
+        for n, idxs in by_lane.items():
+            keep = idxs[:quota.get(n, 0)]
+            admitted.update(keep)
+            taken += len(keep)
+        leftover = want_read - taken
+        if leftover > 0:
+            for idx, name in enumerate(names):
+                if leftover <= 0:
+                    break
+                if name is not None and idx not in admitted:
+                    admitted.add(idx)
+                    leftover -= 1
+        keep_list = [e for i, e in enumerate(entries) if i in admitted]
+        shed_list = [e for i, e in enumerate(entries) if i not in admitted]
+        return keep_list, shed_list
+
+    def _read_entries(self, count: Optional[int] = None,
+                      block_ms: Optional[int] = None):
         """One breaker-guarded stream read of up to ``count`` entries
         (default ``batch_size``; admission control reads more when there
-        is overage to shed, adaptive batching less). Transport failures
+        is overage to shed, adaptive batching less). ``block_ms``
+        overrides the configured read block — the continuous-batching
+        busy poll passes ``_BUSY_POLL_MS`` so in-flight work is never
+        parked behind a full read window. Transport failures
         (``ConnectionError``/``OSError`` — a dropped Redis connection)
         are absorbed HERE: they count against the breaker and return an
         empty read instead of killing the loop, so a blip costs one poll
@@ -1103,6 +1436,8 @@ class ClusterServing:
         silently."""
         if count is None:
             count = self.batch_size
+        if block_ms is None:
+            block_ms = self.block_ms
         if not self._breaker.allow():
             self._stop.wait(min(max(self._breaker.probe_in(), 0.001),
                                 self.block_ms / 1000.0))
@@ -1117,10 +1452,10 @@ class ClusterServing:
                 # once idle (XREADGROUP is never blind-retried).
                 entries = self.backend.xreadgroup(
                     self.stream, self.consumer_group, self.consumer_name,
-                    count, block_ms=self.block_ms)
+                    count, block_ms=block_ms)
             else:
                 entries = self.backend.xread(self.stream, count,
-                                             block_ms=self.block_ms)
+                                             block_ms=block_ms)
         except (ConnectionError, OSError) as e:
             self._breaker.record_failure()
             log.warning("input-stream read failed (%s: %s); breaker %s",
@@ -1319,44 +1654,47 @@ class ClusterServing:
             return
         self._ack(addressable_eids)
 
-    def _update_batch_target(self, waits) -> None:
-        """One AIMD step per non-empty read. Breach = the publish
-        backlog above half its bound (the publisher is falling behind)
-        OR this READ's queue-wait p95 above target (records are aging
-        in the stream). The current read's waits — not the cumulative
-        digest — drive the controller: control needs a live signal that
-        recovers when the overload clears, and it keeps the trajectory
-        a pure function of the traffic (deterministic under test)."""
+    def _update_batch_target(self, lane: _Lane) -> None:
+        """One AIMD step per lane per non-empty read. Breach = the
+        publish backlog above half its bound (the publisher is falling
+        behind) OR this READ's queue-wait p95 for THIS lane's records
+        above target (records are aging in the stream). The current
+        read's waits — not the cumulative digest — drive the
+        controller: control needs a live signal that recovers when the
+        overload clears, and it keeps the trajectory a pure function of
+        the traffic (deterministic under test)."""
         backlog = 0 if self._pub_queue is None else self._pub_queue.qsize()
         breach = backlog > self._pub_maxsize // 2
+        waits = lane.last_read_waits
         if not breach and waits:
             w = sorted(waits)
             breach = w[-(-len(w) * 95 // 100) - 1] > self.queue_wait_target_s
-        self._m_batch_target.set(self._batch_ctl.update(breach))
+        target = lane.batch_ctl.update(breach)
+        lane.m_target.set(target)
+        if lane.name == self._primary:
+            self._m_batch_target.set(target)
 
-    # -- batch assembly ------------------------------------------------------
-    def _assemble(self, entries, n_reclaimed: int = 0):
-        """Decode one read into ``(recs, batch, arena, ragged)``.
-        The first ``n_reclaimed`` entries came from the reclaim sweep
-        (the loop prepends them) — they serve normally but are excluded
-        from the queue-wait signal (see ``_observe_queue_wait``).
+    # -- routing + batch assembly --------------------------------------------
+    def _route(self, entries, n_reclaimed: int = 0):
+        """Validate one read and route each record to its lane:
+        ``{lane_name: [_Item, ...]}``, read order preserved. The first
+        ``n_reclaimed`` entries came from the reclaim sweep (the loop
+        prepends them) — they serve normally but are excluded from the
+        queue-wait signal (see ``_observe_queue_wait``).
 
-        Fast path (every record wire-format v2 with one (shape, dtype),
-        and ``batch_size`` rows of it within ``_MAX_ARENA_BYTES``):
-        headers are validated inline — cheap string parses and a byte-
-        length check, so nothing can fail mid-copy — then the decode
-        workers memcpy each payload into its row of a pooled arena;
-        ``batch`` is a view of the filled rows. Fallback (any v1 record,
-        mixed shapes, or an oversized arena): decode every payload to an
-        array (worker pool
-        for the base64+.npy work) and ``np.stack``; shape misfits come
-        back in ``ragged`` for one-by-one serving. Undecodable records
-        are dropped here with an addressable error record, BEFORE their
-        enqueue/dequeue trace events are emitted — a dropped record
-        leaves no dangling trace."""
+        Per record: queue wait observed, then the cheap drops — missing
+        uri, unknown ``model``, expired/doomed deadline, undecodable
+        payload — all answered BEFORE any trace event is emitted, so a
+        dropped record leaves no dangling trace. v2 headers are
+        validated inline (the shared accept rule, ``client.validate_v2``
+        — after it passes the later arena copy is a pure memcpy that
+        cannot fail); legacy v1 payloads are decoded here on the worker
+        pool (the base64+``.npy`` work releases the GIL). The two
+        enqueue/dequeue phase events are emitted at admission."""
         now_s = time.time()
         now_p = time.perf_counter()
-        items: List[_Item] = []
+        staged: List[Tuple[str, _Item]] = []
+        v1_idx: List[int] = []
         for idx, (eid, fields) in enumerate(entries):
             wait, t_enq = self._observe_queue_wait(
                 eid, now_s, reclaimed=idx < n_reclaimed)
@@ -1368,6 +1706,10 @@ class ClusterServing:
                 # to write an error record to
                 log.error("record with no uri dropped (entry id %s)", eid)
                 self._drop_undecodable(fields, eid)
+                continue
+            lane_name = self._lane_name(fields)
+            if lane_name is None:
+                self._drop_unknown_model(fields, eid)
                 continue
             verdict = self._deadline_verdict(fields, now_s)
             if verdict is not None:
@@ -1381,58 +1723,151 @@ class ClusterServing:
             hdr = None
             if is_v2(fields):
                 try:
-                    # the shared accept rule (client.validate_v2): after
-                    # it passes, the row copy is a pure memcpy that
-                    # cannot fail — nothing can kill the serve loop
-                    # mid-arena
                     hdr = validate_v2(fields)
                 except Exception:
                     log.exception("undecodable record (uri=%r)", uri)
                     self._drop_undecodable(fields, eid)
                     continue
-            items.append(_Item(
+            else:
+                v1_idx.append(len(staged))
+            staged.append((lane_name, _Item(
                 _Rec(uri, fields.get("trace"), t_enq, now_p,
                      hdr is not None,
                      eid if self._group_mode else None),
-                fields, wait, hdr))
-        # the adaptive-batch controller's live signal: THIS read's waits
-        self._last_read_waits = [i.wait for i in items if i.wait is not None]
-        recs: List[_Rec] = []
-        batch = arena = None
-        ragged: List[Tuple[_Rec, np.ndarray]] = []
-        if (items and all(i.hdr is not None for i in items)
-                and len({(i.hdr[2], i.hdr[1].str) for i in items}) == 1
-                and len(items[0].hdr[0]) * self.batch_size
-                <= _MAX_ARENA_BYTES):
-            _, dt, shape = items[0].hdr
-            arena = self._arena_pool.acquire(shape, dt)
-            self._copy_rows(arena, [i.hdr for i in items])
-            recs = [i.rec for i in items]
-            batch = arena[:len(recs)]
-            self._emit_read_events(items)
-        elif items:
-            decoded = self._decode_all(items)
-            good = [(i, a) for i, a in zip(items, decoded) if a is not None]
-            if good:
-                self._emit_read_events([i for i, _ in good])
+                fields, wait, hdr, None)))
+        if v1_idx:
+            def decode_one(i):
+                name, item = staged[i]
                 try:
-                    batch = np.stack([a for _, a in good])
-                    recs = [i.rec for i, _ in good]
-                except ValueError:
-                    ragged = [(i.rec, a) for i, a in good]
+                    arr = np.asarray(decode_payload(item.fields))
+                except Exception:
+                    log.exception("undecodable record (uri=%r)",
+                                  item.rec.uri)
+                    self._drop_undecodable(item.fields, item.rec.eid)
+                    return None
+                return (name, item._replace(arr=arr))
+
+            if self._pool is not None and len(v1_idx) > 1:
+                decoded = list(self._pool.map(decode_one, v1_idx))
+            else:
+                decoded = [decode_one(i) for i in v1_idx]
+            for i, repl in zip(v1_idx, decoded):
+                staged[i] = repl
+        routed: "collections.OrderedDict[str, List[_Item]]" = \
+            collections.OrderedDict((n, []) for n in self._lanes)
+        for pair in staged:
+            if pair is None:
+                continue        # a v1 record that failed its decode
+            name, item = pair
+            routed[name].append(item)
+        self._emit_read_events(
+            [i for items in routed.values() for i in items])
         self._m_decode.observe(time.perf_counter() - now_p)
-        return recs, batch, arena, ragged
+        return routed
 
-    def _copy_rows(self, arena: np.ndarray, hdrs) -> None:
-        """Memcpy each validated v2 payload into its arena row, split
-        across the decode workers in contiguous slices (numpy releases
-        the GIL for the copies)."""
+    def _take_run(self, lane: _Lane) -> List[_Item]:
+        """Pop the lane's front run of same-(shape, dtype) records, up
+        to its batch target — one dispatchable batch. Mixed-shape
+        traffic splits into consecutive uniform runs (each run gets its
+        own bucket-padded arena), so an odd-shaped record costs its own
+        dispatch, never a misassembled batch."""
+        target = max(self._lane_target(lane), 1)
+        items: List[_Item] = []
+        key0 = None
+        while lane.buffer and len(items) < target:
+            item = lane.buffer[0]
+            if item.hdr is not None:
+                key = (item.hdr[2], item.hdr[1].str)
+            else:
+                key = (item.arr.shape, item.arr.dtype.str)
+            if key0 is None:
+                key0 = key
+            elif key != key0:
+                break
+            items.append(lane.buffer.popleft())
+        return items
+
+    @staticmethod
+    def _item_array(item: _Item) -> np.ndarray:
+        """One admitted record's tensor (zero-copy view for v2)."""
+        if item.arr is not None:
+            return item.arr
+        payload, dt, shape = item.hdr
+        return np.frombuffer(payload, dtype=dt).reshape(shape)
+
+    def _lane_assemble(self, lane: _Lane, items: List[_Item]):
+        """Assemble one uniform run into ``(recs, batch, arena)``.
+
+        Normal path: a pooled arena row per record plus **bucket
+        padding** — the batch is padded up to the lane's smallest
+        compiled-shape bucket ≥ ``len(items)`` by repeating the last
+        real row, so ragged traffic reuses a handful of compiled
+        programs instead of retracing per distinct size. Padding rows
+        are accounted (``zoo_serving_bucket_pad_rows_total``) and
+        sliced off at readback — they never publish. Oversized rows
+        (``batch_size`` of them would exceed ``_MAX_ARENA_BYTES``)
+        assemble via ``np.stack`` with no arena and no padding: the
+        allocation stays proportional to the bytes actually received."""
+        t0 = time.perf_counter()
+        first = items[0]
+        if first.hdr is not None:
+            _, dt, shape = first.hdr
+            rowbytes = len(first.hdr[0])
+        else:
+            dt, shape = first.arr.dtype, first.arr.shape
+            rowbytes = first.arr.nbytes
+        k = len(items)
+        recs = [i.rec for i in items]
+        if rowbytes * self.batch_size > _MAX_ARENA_BYTES:
+            batch = np.stack([self._item_array(i) for i in items])
+            lane.dispatches += 1
+            lane.bucket_hits += 1   # no padding on the fallback path
+            self._m_decode.observe(time.perf_counter() - t0)
+            return recs, batch, None
+        bucket = lane.bucket_for(k)
+        arena = lane.arena_pool.acquire(shape, dt)
+        self._copy_rows(arena, items)
+        if bucket > k:
+            arena[k:bucket] = arena[k - 1]
+            lane.m_pad_rows.inc(bucket - k)
+        else:
+            lane.bucket_hits += 1
+        lane.dispatches += 1
+        self._m_decode.observe(time.perf_counter() - t0)
+        return recs, arena[:bucket], arena
+
+    def _copy_rows(self, arena: np.ndarray, items: List[_Item]) -> None:
+        """Copy each record's tensor into its arena row, split across
+        the decode workers in contiguous slices. Consecutive v2 payloads
+        in a slice are joined and copied with ONE ``np.copyto`` onto a
+        flat arena view — a single GIL-releasing memcpy, no Python-level
+        per-row loop (the in-process fleet scaling fix: per-row
+        assignments serialized replicas on the GIL); already-decoded v1
+        rows copy individually (rare path)."""
+        k = len(items)
+        # explicit row element count, never reshape(-1): a zero-size row
+        # (shape "0" validates) makes -1 ambiguous and the raise would
+        # escape a decode worker into the serve loop
+        row_elems = int(np.prod(arena.shape[1:], dtype=np.int64))
+        flat = arena.reshape(arena.shape[0], row_elems)
+
         def copy_slice(lo: int, hi: int) -> None:
-            for row in range(lo, hi):
-                payload, dt, shape = hdrs[row]
-                arena[row] = np.frombuffer(payload, dtype=dt).reshape(shape)
+            i = lo
+            while i < hi:
+                item = items[i]
+                if item.hdr is None:
+                    np.copyto(arena[i], item.arr)
+                    i += 1
+                    continue
+                j = i + 1
+                while j < hi and items[j].hdr is not None:
+                    j += 1
+                buf = (items[i].hdr[0] if j == i + 1
+                       else b"".join(items[m].hdr[0] for m in range(i, j)))
+                src = np.frombuffer(buf, dtype=arena.dtype)
+                np.copyto(flat[i:j], src.reshape(j - i, row_elems))
+                i = j
 
-        k = len(hdrs)
         if self._pool is not None and self.decode_workers > 1 \
                 and k >= 2 * self.decode_workers:
             step = -(-k // self.decode_workers)
@@ -1443,25 +1878,86 @@ class ClusterServing:
         else:
             copy_slice(0, k)
 
-    def _decode_all(self, items):
-        """Legacy/mixed path: decode every payload to its own array, in
-        parallel on the worker pool (the base64 + ``.npy`` work releases
-        the GIL). Failures are dropped with an addressable error record
-        and come back as None."""
-        def one(item: _Item):
-            try:
-                if item.hdr is not None:   # v2: already validated, no re-parse
-                    payload, dt, shape = item.hdr
-                    return np.frombuffer(payload, dtype=dt).reshape(shape)
-                return decode_payload(item.fields)
-            except Exception:
-                log.exception("undecodable record (uri=%r)", item.rec.uri)
-                self._drop_undecodable(item.fields, item.rec.eid)
-                return None
+    def _pump(self, lane: _Lane, depth: int) -> None:
+        """Dispatch a lane's admitted records in bucket-shaped batches —
+        the continuous half of the pipeline: everything buffered (this
+        read's records plus any carried over a half-open probe or loop
+        restart) rides the next device step NOW. The lane's dispatch
+        breaker gates the model: while OPEN, buffered records fast-fail
+        to addressable errors (+ durable DLQ spills) instead of burning
+        the shared loop — the other lanes keep dispatching; while the
+        HALF-OPEN probe is in flight, records wait buffered for its
+        verdict. Tail rule: with the stream empty and nothing left to
+        overlap, the window drains (the trickle-load latency
+        contract)."""
+        if self._killed:
+            return
+        blocked = False
+        while lane.buffer:
+            if not lane.breaker.allow():
+                if lane.breaker.state == CircuitBreaker.OPEN:
+                    self._lane_fail_fast(lane)
+                else:
+                    # half-open with the probe batch still in flight:
+                    # leave the records buffered — they ride the next
+                    # step once the probe resolves at its readback
+                    # (fail-fasting them would shed recoverable work on
+                    # the mend)
+                    blocked = True
+                break
+            items = self._take_run(lane)
+            if not items:
+                break
+            recs, batch, arena = self._lane_assemble(lane, items)
+            self._dispatch(lane, recs, batch, arena)
+            while len(lane.pendings) >= self.max_inflight:
+                # the dispatch window: publish the oldest batch once
+                # max_inflight are dispatched-but-unread
+                self._flush(lane.pendings.popleft())
+        if lane.pendings and (blocked
+                              or (depth == 0 and not lane.buffer)):
+            # two reasons to flush now rather than defer: (a) the
+            # stream is drained and there is no next batch to overlap
+            # with, so deferring readbacks would only add up to
+            # block_ms of tail latency under trickle load (ADVICE
+            # round 5); (b) dispatch is blocked on the half-open
+            # probe's verdict — nothing else resolves it, and under
+            # sustained traffic the buffer would otherwise grow
+            # unboundedly behind an unflushed probe
+            self._drain(lane.pendings)
 
-        if self._pool is not None and len(items) > 1:
-            return list(self._pool.map(one, items))
-        return [one(i) for i in items]
+    def _lane_fail_fast(self, lane: _Lane) -> None:
+        """The lane's dispatch breaker is open: answer everything it has
+        admitted with the distinct addressable ``model unavailable``
+        error — durably spilled to the DLQ first when one is attached
+        (reason ``dispatch``; ``zoo-dlq replay`` re-enqueues them with
+        their ``model`` field intact once the model recovers). This is
+        the isolation half of multiplexing: a dead model degrades ITS
+        lane while the loop keeps serving the others."""
+        items = list(lane.buffer)
+        lane.buffer.clear()
+        if not items:
+            return
+        recs = [i.rec for i in items]
+        self.metrics.emit("serving.lane_fail_fast", model=lane.name,
+                          records=len(recs), breaker=lane.breaker.state)
+        if self._dlq is not None:
+            spilled = []
+            for item in items:
+                try:
+                    self._dlq.append(item.rec.uri, self._item_array(item),
+                                     reason="dispatch",
+                                     trace=item.rec.trace,
+                                     error="model unavailable",
+                                     model=lane.name)
+                except Exception:
+                    log.exception("DLQ spill failed for fast-failed "
+                                  "record %r", item.rec.uri)
+                    continue
+                spilled.append(item.rec.eid)
+            self._ack(spilled)
+        self._record_failure(recs, parent="dequeue",
+                             error="model unavailable")
 
     def _deadline_verdict(self, fields, now_s: float) -> Optional[str]:
         """``"expired"`` when the record's producer-stamped
@@ -1547,6 +2043,35 @@ class ClusterServing:
                 return
         self._ack([eid])
 
+    def _drop_unknown_model(self, fields, eid: Optional[str] = None) -> None:
+        """Answer a record routed to no configured lane with the distinct
+        addressable ``unknown model`` error — before any trace event, so
+        the drop leaves no dangling trace. The requested name goes to
+        the log/event only (the failure-error label set stays closed).
+        Settlement mirrors ``_drop_undecodable``: the ack lands only
+        once the error answer did."""
+        self._m_failures.inc()
+        self.metrics.counter(
+            "zoo_serving_failure_errors_total",
+            "failed records by error kind (model vs result-store)",
+            labels={"error": "unknown model"}).inc()
+        log.error("record %r names unknown model %r (lanes: %s)",
+                  fields.get("uri"), fields.get("model"),
+                  ", ".join(self._lanes))
+        self.metrics.emit("serving.unknown_model", uri=fields.get("uri"),
+                          trace=fields.get("trace"),
+                          model=fields.get("model"))
+        if fields.get("uri"):
+            try:
+                self.backend.set_result(fields["uri"],
+                                        {"error": "unknown model"})
+            except Exception:
+                log.exception("unknown-model error record for %r could "
+                              "not be written (backend down?); entry "
+                              "stays pending", fields["uri"])
+                return
+        self._ack([eid])
+
     def _emit_read_events(self, items) -> None:
         """The first two phase events per traced record; later phases
         (dispatch, publish) link back via the trace id + parent field."""
@@ -1593,20 +2118,24 @@ class ClusterServing:
         return wait, t_enq
 
     # -- dispatch ------------------------------------------------------------
-    def _dispatch(self, recs, batch, pendings, arena=None) -> None:
-        """Enqueue the device work; appends a ``_Pending`` to ``pendings``
-        (async models) or publishes immediately (sync models). Tries a
-        NON-blocking async dispatch first: with a single replica permit
-        (``concurrent_num=1``) dispatching before collecting our own
-        pending batches would deadlock, so on a busy model pending
-        batches are flushed oldest-first (releasing their permits) and
-        the dispatch retried, blocking only once the window is empty.
-        Models without predict_async (the server accepts any
-        ``.predict``) compute synchronously — there is nothing to
-        overlap, so the window is drained BEFORE the blocking predict
-        and this batch publishes immediately (deferring either would
-        only add latency)."""
+    def _dispatch(self, lane: _Lane, recs, batch, arena=None) -> None:
+        """Enqueue the device work on the lane's model; appends a
+        ``_Pending`` to the lane's window (async models) or publishes
+        immediately (sync models). ``batch`` may be bucket-padded past
+        ``len(recs)`` — the padding rows ride the dispatch and are
+        sliced off at readback. Tries a NON-blocking async dispatch
+        first: with a single replica permit (``concurrent_num=1``)
+        dispatching before collecting our own pending batches would
+        deadlock, so on a busy model pending batches are flushed
+        oldest-first (releasing their permits) and the dispatch retried,
+        blocking only once the window is empty. Models without
+        predict_async (the server accepts any ``.predict``) compute
+        synchronously — there is nothing to overlap, so the window is
+        drained BEFORE the blocking predict and this batch publishes
+        immediately (deferring either would only add latency). Outcomes
+        feed the lane's dispatch breaker."""
         t0 = time.perf_counter()
+        pendings = lane.pendings
         arena_owned = True
         # durable dead letters need the ORIGINAL request payloads at
         # publish time (the arena is recycled after readback): one
@@ -1615,7 +2144,7 @@ class ClusterServing:
                   and batch is not None else None)
         try:
             faults.inject("serving.dispatch")
-            async_fn = getattr(self.model, "predict_async", None)
+            async_fn = getattr(lane.model, "predict_async", None)
             if async_fn is not None:
                 collect = self._probe_dispatch(async_fn, batch, len(recs))
                 while collect is None and pendings:
@@ -1625,41 +2154,63 @@ class ClusterServing:
                     collect = self._probe_dispatch(async_fn, batch,
                                                    len(recs))
                 if collect is None:
+                    # a replica permit may be held by ANOTHER lane's
+                    # pending batch (lane specs may alias one model):
+                    # release every window before a blocking dispatch
+                    # on this single thread could deadlock the loop
+                    self._drain_all()
+                    collect = self._probe_dispatch(async_fn, batch,
+                                                   len(recs))
+                if collect is None:
                     with span("serving.dispatch", registry=self.metrics,
                               records=len(recs)):
                         collect = async_fn(batch)
+                # breaker success is recorded at READBACK (_flush), not
+                # here: an async model's real failures surface at
+                # collect(), and a success stamped at enqueue time would
+                # interleave with them and keep resetting the
+                # consecutive-failure count — the breaker would never
+                # open on a model that crashes every readback
+                lane.m_dispatches.inc()
                 self._emit_dispatch(recs, t0)
                 arena_owned = False
-                pendings.append(_Pending(recs, collect, t0, arena, inputs))
+                pendings.append(_Pending(lane, recs, collect, t0, arena,
+                                         inputs))
                 return
             self._drain(pendings)
             with span("serving.dispatch", registry=self.metrics,
                       records=len(recs)):
-                preds = self.model.predict(batch)
+                preds = lane.model.predict(batch)
+            # breaker success lands in _flush below (one signal source)
+            lane.m_dispatches.inc()
             self._emit_dispatch(recs, t0)
             arena_owned = False
-            self._flush(_Pending(recs, (lambda: preds), t0, arena, inputs))
+            self._flush(_Pending(lane, recs, (lambda: preds), t0, arena,
+                                 inputs))
         except Exception as e:
-            log.exception("inference dispatch failed for %d records; "
-                          "retrying one record at a time", len(recs))
+            lane.breaker.record_failure()
+            log.exception("inference dispatch failed for %d records "
+                          "(model %r); retrying one record at a time",
+                          len(recs), lane.name)
             # copy each record's input out BEFORE the arena goes back to
             # the pool — a later read may overwrite it mid-retry
             rows = None
             if batch is not None and self.dispatch_retries > 0:
                 rows = [np.array(batch[i:i + 1]) for i in range(len(recs))]
             if arena_owned:
-                self._arena_pool.release(arena)
-            self._retry_or_dead_letter(recs, rows, pendings, cause=e)
+                lane.arena_pool.release(arena)
+            self._retry_or_dead_letter(lane, recs, rows, cause=e)
 
-    def _predict_once(self, batch):
+    @staticmethod
+    def _predict_once(model, batch):
         """One synchronous model call for the retry path (the server
         accepts models exposing either surface)."""
-        predict = getattr(self.model, "predict", None)
+        predict = getattr(model, "predict", None)
         if predict is not None:
             return predict(batch)
-        return self.model.predict_async(batch)()
+        return model.predict_async(batch)()
 
-    def _retry_or_dead_letter(self, recs, rows, pendings,
+    def _retry_or_dead_letter(self, lane: _Lane, recs, rows,
                               cause: Optional[BaseException] = None) -> None:
         """After a batch dispatch crash: re-dispatch each record ALONE,
         up to ``dispatch_retries`` times. One poison record (a payload
@@ -1677,10 +2228,11 @@ class ClusterServing:
         if rows is None:
             self._record_failure(recs, parent="dequeue")
             return
-        # release the window's replica permits first: a blocking solo
-        # predict with every permit tied up in pendings would deadlock
-        # exactly like the dispatch-before-flush order this loop avoids
-        self._drain(pendings)
+        # release EVERY window's replica permits first: a blocking solo
+        # predict with a permit tied up in any lane's pendings (lane
+        # specs may alias one model) would deadlock exactly like the
+        # dispatch-before-flush order this loop avoids
+        self._drain_all()
         retry_counter = self.metrics.counter(
             "zoo_retry_attempts_total",
             "retries performed by reliability.RetryPolicy, by operation",
@@ -1704,15 +2256,18 @@ class ClusterServing:
                     faults.inject("serving.dispatch")
                     with span("serving.dispatch", registry=self.metrics,
                               records=1):
-                        preds = np.asarray(self._predict_once(row))
+                        preds = np.asarray(self._predict_once(lane.model,
+                                                              row))
                 except Exception as e:
+                    lane.breaker.record_failure()
                     err = e
                     log.warning("solo re-dispatch of %r failed "
                                 "(attempt %d/%d): %s", rec.uri, attempt + 1,
                                 self.dispatch_retries, e)
                     continue
+                lane.breaker.record_success()
                 self._emit_dispatch([rec], t1)
-                self._pub_put([rec], preds, t1, row)
+                self._pub_put(lane, [rec], preds, t1, row)
                 err = None
                 break
             if err is not None:
@@ -1726,7 +2281,8 @@ class ClusterServing:
                               self.dispatch_retries + 1)
                 self._m_dead_letter.inc()
                 self.metrics.emit("serving.dead_letter", uri=rec.uri,
-                                  trace=rec.trace, error=str(err))
+                                  trace=rec.trace, error=str(err),
+                                  model=lane.name)
                 # durable: the poison payload spills to the on-disk DLQ
                 # (operators replay it after a fix) BEFORE the producer
                 # is answered — the answer is a receipt, the spill is
@@ -1734,7 +2290,8 @@ class ClusterServing:
                 if self._dlq is not None:
                     try:
                         self._dlq.append(rec.uri, row[0], reason="dispatch",
-                                         trace=rec.trace, error=str(err))
+                                         trace=rec.trace, error=str(err),
+                                         model=lane.name)
                     except Exception:
                         log.exception("DLQ spill failed for dead-lettered "
                                       "record %r", rec.uri)
@@ -1831,21 +2388,23 @@ class ClusterServing:
         """Block on a dispatched batch's readback, then hand the results
         to the async publisher — encode + result-store writes + publish
         bookkeeping happen off the serve loop's critical path. The batch
-        arena returns to the pool here: after readback the device has
-        fully consumed the input buffer. The publisher queue is bounded,
-        so a stalled result backend backpressures the loop instead of
-        buffering unboundedly."""
-        recs, collect, t0, arena, inputs = pending
+        arena returns to its lane's pool here: after readback the device
+        has fully consumed the input buffer. Bucket-padding rows are
+        sliced off the predictions here — they never reach the
+        publisher. The publisher queue is bounded, so a stalled result
+        backend backpressures the loop instead of buffering
+        unboundedly."""
+        lane, recs, collect, t0, arena, inputs = pending
         if self._killed:
             # simulated crash: abandon the readback (no publish, no
             # error answer, no ack) — a real SIGKILL would have died
             # holding exactly this in-flight work
-            self._arena_pool.release(arena)
+            lane.arena_pool.release(arena)
             return
         try:
             with span("serving.flush", registry=self.metrics,
                       records=len(recs)):
-                preds = np.asarray(collect())
+                preds = np.asarray(collect())[:len(recs)]
             if arena is not None and np.may_share_memory(preds, arena):
                 # a sync model may answer with a VIEW of its input (the
                 # server accepts any .predict) — the publisher encodes
@@ -1853,15 +2412,21 @@ class ClusterServing:
                 # must be copied out before release
                 preds = preds.copy()
         except Exception:
+            lane.breaker.record_failure()
             log.exception("inference failed for %d records; writing errors",
                           len(recs))
             self._record_failure(recs, parent="dispatch")
             return
         finally:
-            self._arena_pool.release(arena)
-        self._pub_put(recs, preds, t0, inputs)
+            lane.arena_pool.release(arena)
+        # the breaker's success signal: the readback LANDED — for an
+        # async model this is where real inference failures would have
+        # surfaced, so this (and not dispatch enqueue) is what may reset
+        # the consecutive-failure count / close a half-open probe
+        lane.breaker.record_success()
+        self._pub_put(lane, recs, preds, t0, inputs)
 
-    def _pub_put(self, recs, preds, t0: float, inputs) -> None:
+    def _pub_put(self, lane: _Lane, recs, preds, t0: float, inputs) -> None:
         """Hand one batch to the publisher, bounded: a publisher wedged
         on a stalled result store must surface as addressable failures
         (and DLQ spills) after ``_PUB_PUT_TIMEOUT_S``, not park the
@@ -1869,19 +2434,21 @@ class ClusterServing:
         still the normal backpressure — the timeout only fires once the
         stall outlasts any healthy drain."""
         try:
-            self._pub_queue.put((recs, preds, t0, inputs),
+            self._pub_queue.put((lane, recs, preds, t0, inputs),
                                 timeout=_PUB_PUT_TIMEOUT_S)
         except queue.Full:
             log.error("publisher queue still full after %.0fs (result "
                       "backend stalled?); failing %d record(s) "
                       "addressably", _PUB_PUT_TIMEOUT_S, len(recs))
-            self._spill_publish(recs, inputs, error="publish queue full")
+            self._spill_publish(recs, inputs, error="publish queue full",
+                                model=lane.name)
             self._record_failure(recs, parent="dispatch",
                                  error="result publish failed")
             return
         self._m_backlog.set(self._pub_queue.qsize())
 
-    def _spill_publish(self, recs, inputs, error: str) -> List[str]:
+    def _spill_publish(self, recs, inputs, error: str,
+                       model: Optional[str] = None) -> List[str]:
         """Spill a batch the publisher gave up on to the durable DLQ —
         the original request payloads, so ``zoo-dlq replay`` can re-serve
         them after the result store recovers. No-op without a DLQ (or
@@ -1895,7 +2462,7 @@ class ClusterServing:
         for i, rec in enumerate(recs):
             try:
                 self._dlq.append(rec.uri, inputs[i], reason="publish",
-                                 trace=rec.trace, error=error)
+                                 trace=rec.trace, error=error, model=model)
             except Exception:
                 log.exception("DLQ spill failed for %r", rec.uri)
                 continue
@@ -1921,7 +2488,7 @@ class ClusterServing:
             item = q.get()
             if item is _PUB_STOP:
                 return
-            recs, preds, t0, inputs = item
+            lane, recs, preds, t0, inputs = item
             if self._killed:
                 # simulated crash (kill()): drop without publishing,
                 # answering, or acking — the entries stay pending for a
@@ -1930,13 +2497,14 @@ class ClusterServing:
                 continue
             if not self._pub_breaker.allow():
                 self._spill_publish(recs, inputs,
-                                    error="publish breaker open")
+                                    error="publish breaker open",
+                                    model=lane.name)
                 self._record_failure(recs, parent="dispatch",
                                      error="result publish failed")
                 self._m_backlog.set(q.qsize())
                 continue
             try:
-                self._publish(recs, preds, t0)
+                self._publish(lane, recs, preds, t0)
             except Exception as e:
                 # a publish failure must not kill the drain thread —
                 # spill durably, then answer the batch with addressable
@@ -1946,14 +2514,15 @@ class ClusterServing:
                 log.exception("publish failed for %d records; writing "
                               "error records", len(recs))
                 self._spill_publish(recs, inputs,
-                                    error=f"{type(e).__name__}: {e}")
+                                    error=f"{type(e).__name__}: {e}",
+                                    model=lane.name)
                 self._record_failure(recs, parent="dispatch",
                                      error="result publish failed")
             else:
                 self._pub_breaker.record_success()
             self._m_backlog.set(q.qsize())
 
-    def _publish(self, recs, preds, t0: float) -> None:
+    def _publish(self, lane: _Lane, recs, preds, t0: float) -> None:
         """Encode + write one batch's results and do the publish-side
         bookkeeping: counters (records/batches), batch-size, encode and
         dispatch→publish latency histograms, per-record publish phase
@@ -1992,6 +2561,7 @@ class ClusterServing:
         self._last_flush_wall = now_wall
         latency = max(now - t0, 0.0)
         self._m_records.inc(len(recs))
+        lane.m_records.inc(len(recs))
         self._m_batches.inc()
         self._m_batch_size.observe(len(recs))
         self._m_dispatch.observe(latency)
@@ -2009,7 +2579,8 @@ class ClusterServing:
                     e2e_s=(max(now_wall - rec.t_enq, 0.0)
                            if rec.t_enq is not None else None))
         self.metrics.emit("serving.flush", records=len(recs),
-                          batch=self._batches, latency_s=latency)
+                          batch=self._batches, latency_s=latency,
+                          model=lane.name)
         if self._summary is not None:
             t_prev = self._t_last_flush
             self._t_last_flush = now

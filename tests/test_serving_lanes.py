@@ -1,0 +1,606 @@
+"""Multi-model serving lanes (ISSUE 14): routing by the ``model`` wire
+field, per-lane failure isolation (one model's poison/dispatch outage
+never stalls or dead-letters the other's records), weighted-fair
+admission under shed pressure, compiled-shape bucketing (ragged traffic
+compiles at most once per bucket — the retrace counter is the proof —
+and padding rows never leak into published results), the int8 serving
+dtype path, and the ``/statusz`` ``models`` block + its CLI rendering."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.common.reliability import CircuitBreaker
+from analytics_zoo_tpu.observability import MetricsRegistry, read_events
+from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+from analytics_zoo_tpu.serving import (ClusterServing, DeadLetterQueue,
+                                       InputQueue, LocalBackend, OutputQueue,
+                                       ServingError)
+
+
+def _toy_net():
+    init_zoo_context()
+    m = Sequential()
+    m.add(Dense(4, input_shape=(6,), activation="relu"))
+    m.add(Dense(3, activation="softmax"))
+    m.init_weights()
+    return m
+
+
+class _Scale:
+    """Deterministic sync model: x * factor — a lane's answers are
+    attributable to the lane that computed them."""
+
+    def __init__(self, factor):
+        self.factor = float(factor)
+
+    def predict(self, x):
+        return np.asarray(x) * self.factor
+
+
+class _Boom:
+    """A model whose every dispatch crashes — the poison lane."""
+
+    def predict(self, x):
+        raise RuntimeError("boom")
+
+
+def _query_all(backend, uris, timeout=30.0):
+    outq = OutputQueue(backend)
+    out = {}
+    for uri in uris:
+        try:
+            out[uri] = ("value", outq.query(uri, timeout=timeout))
+        except ServingError as e:
+            out[uri] = ("error", str(e))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+def test_multimodel_routing_round_trip():
+    """Two lanes on one stream: records routed by the ``model`` field
+    get THAT lane's prediction; unlabeled records route to the primary
+    (first-configured) lane; per-model counters split the total."""
+    reg = MetricsRegistry()
+    backend = LocalBackend()
+    serving = ClusterServing({"double": _Scale(2.0), "triple": _Scale(3.0)},
+                             backend=backend, batch_size=4,
+                             registry=reg).start()
+    inq = InputQueue(backend)
+    rng = np.random.default_rng(1)
+    xs = {}
+    try:
+        for i in range(6):
+            x = rng.normal(size=(6,)).astype(np.float32)
+            xs[f"d-{i}"] = (x, 2.0)
+            inq.enqueue(f"d-{i}", x, model="double")
+        for i in range(6):
+            x = rng.normal(size=(6,)).astype(np.float32)
+            xs[f"t-{i}"] = (x, 3.0)
+            inq.enqueue(f"t-{i}", x, model="triple")
+        for i in range(4):          # no model field -> primary ("double")
+            x = rng.normal(size=(6,)).astype(np.float32)
+            xs[f"p-{i}"] = (x, 2.0)
+            inq.enqueue(f"p-{i}", x)
+        got = _query_all(backend, xs)
+    finally:
+        serving.stop(drain=False)
+    for uri, (x, factor) in xs.items():
+        kind, val = got[uri]
+        assert kind == "value", (uri, val)
+        np.testing.assert_allclose(val, x * factor, rtol=1e-6)
+    snap = reg.snapshot()
+    assert snap["zoo_serving_records_total"]["value"] == 16
+    assert snap['zoo_serving_model_records_total{model="double"}'][
+        "value"] == 10
+    assert snap['zoo_serving_model_records_total{model="triple"}'][
+        "value"] == 6
+    assert snap["zoo_serving_failures_total"]["value"] == 0
+
+
+def test_unknown_model_answered_addressably():
+    """A record naming a lane the server does not host is answered with
+    the distinct ``unknown model`` error at routing — no dispatch, no
+    dangling trace — and the loop keeps serving."""
+    reg = MetricsRegistry()
+    backend = LocalBackend()
+    serving = ClusterServing(_Scale(2.0), backend=backend, batch_size=4,
+                             registry=reg).start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    try:
+        inq.enqueue("nope", np.zeros(6, np.float32), model="no-such-model")
+        with pytest.raises(ServingError, match="unknown model"):
+            outq.query("nope", timeout=10.0)
+        inq.enqueue("ok", np.ones(6, np.float32))
+        np.testing.assert_allclose(outq.query("ok", timeout=30.0),
+                                   np.ones(6) * 2.0, rtol=1e-6)
+    finally:
+        serving.stop(drain=False)
+    snap = reg.snapshot()
+    assert snap['zoo_serving_failure_errors_total{error="unknown model"}'][
+        "value"] == 1
+    assert snap["zoo_serving_failures_total"]["value"] == 1
+    assert snap["zoo_serving_records_total"]["value"] == 1
+
+
+# ---------------------------------------------------------------------------
+# per-lane isolation (the multi-model chaos proof)
+# ---------------------------------------------------------------------------
+
+def test_lane_poison_isolated_and_reconciles(tmp_path):
+    """One lane's model crashes every dispatch: its records dead-letter
+    (then fast-fail once its dispatch breaker opens) while the OTHER
+    lane answers every one of its records — and the books balance:
+    answered + failed == produced, zero lost, zero dangling traces."""
+    reg = MetricsRegistry()
+    backend = LocalBackend()
+    dlq = DeadLetterQueue(str(tmp_path / "dlq"), registry=reg)
+    serving = ClusterServing(
+        {"good": _Scale(2.0), "bad": _Boom()}, backend=backend,
+        batch_size=4, registry=reg, dlq=dlq,
+        dispatch_breakers={"bad": CircuitBreaker(
+            "serving.dispatch.bad", failure_threshold=2,
+            reset_timeout=60.0, registry=reg)})
+    serving.set_json_events(str(tmp_path / "events.jsonl"))
+    inq = InputQueue(backend)
+    rng = np.random.default_rng(2)
+    xs = {}
+    # interleaved and PRE-enqueued: the first read takes one batch per
+    # lane; the bad lane's batch crash + first solo crash (threshold 2)
+    # open its breaker, so the second read's bad records fast-fail
+    for i in range(8):
+        xg = rng.normal(size=(6,)).astype(np.float32)
+        xb = rng.normal(size=(6,)).astype(np.float32)
+        xs[f"g-{i}"] = xg
+        xs[f"b-{i}"] = xb
+        inq.enqueue(f"g-{i}", xg, model="good")
+        inq.enqueue(f"b-{i}", xb, model="bad")
+    serving.start()
+    try:
+        got = _query_all(backend, xs)
+    finally:
+        serving.stop(drain=False)
+    # the healthy lane is untouched by its neighbor's outage
+    for i in range(8):
+        kind, val = got[f"g-{i}"]
+        assert kind == "value", f"good record g-{i} failed: {val}"
+        np.testing.assert_allclose(val, xs[f"g-{i}"] * 2.0, rtol=1e-6)
+    # every poisoned record is answered addressably (dead-letter from
+    # the solo-retry path, or model-unavailable after the breaker trip)
+    bad_errors = {}
+    for i in range(8):
+        kind, val = got[f"b-{i}"]
+        assert kind == "error", f"bad record b-{i} got a value"
+        bad_errors[f"b-{i}"] = val
+    assert any("dead-lettered" in e for e in bad_errors.values())
+    assert any("model unavailable" in e for e in bad_errors.values())
+    snap = reg.snapshot()
+    assert snap["zoo_serving_records_total"]["value"] == 8
+    assert snap["zoo_serving_failures_total"]["value"] == 8
+    assert snap['zoo_serving_model_records_total{model="good"}'][
+        "value"] == 8
+    assert snap['zoo_serving_model_records_total{model="bad"}'][
+        "value"] == 0
+    # answered + shed + dead-lettered == produced
+    assert (snap["zoo_serving_records_total"]["value"]
+            + snap["zoo_serving_failures_total"]["value"]) == 16
+    # every failed record spilled durably for replay after a model fix
+    assert dlq.depth == 8
+    # the bad lane's breaker is open; the good lane's closed
+    models = serving._health_info()["serving"]["models"]
+    assert models["bad"]["breaker"] == "open"
+    assert models["good"]["breaker"] == "closed"
+    assert models["good"]["records"] == 8
+    # zero dangling traces: good traces end in publish, bad in failed
+    by_trace = {}
+    for e in read_events(str(tmp_path / "events.jsonl"), kind="request"):
+        by_trace.setdefault(e["trace"], []).append(e["phase"])
+    assert len(by_trace) == 16
+    terminal = [p for phases in by_trace.values()
+                for p in phases if p in ("publish", "failed")]
+    assert len(terminal) == 16
+    # DLQ records carry their lane, so replay routes them back to it
+    assert {rec.get("model") for _s, rec in dlq.scan()} == {"bad"}
+
+
+def test_lane_breaker_recovers_via_half_open_probe():
+    """A lane whose model was down and comes back: the open breaker's
+    half-open probe dispatches a REAL batch once the reset window
+    passes; its successful readback closes the breaker and the lane
+    serves again — success is recorded at readback, not dispatch
+    enqueue, so a model that kept failing at collect() could never have
+    held the breaker closed."""
+    class Gated:
+        def __init__(self):
+            self.broken = True
+
+        def predict(self, x):
+            if self.broken:
+                raise RuntimeError("model down")
+            return np.asarray(x) * 2.0
+
+    reg = MetricsRegistry()
+    backend = LocalBackend()
+    gated = Gated()
+    serving = ClusterServing(
+        {"m": gated}, backend=backend, batch_size=4, registry=reg,
+        dispatch_retries=0,             # whole-batch failures, no solos
+        dispatch_breakers={"m": CircuitBreaker(
+            "serving.dispatch.m", failure_threshold=2,
+            reset_timeout=0.1, registry=reg)}).start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    try:
+        for i in range(8):              # >= 2 crashing batches: trips it
+            inq.enqueue(f"down-{i}", np.ones(6, np.float32), model="m")
+        for i in range(8):
+            with pytest.raises(ServingError):
+                outq.query(f"down-{i}", timeout=30.0)
+        snap = reg.snapshot()
+        assert snap['zoo_breaker_transitions_total'
+                    '{breaker="serving.dispatch.m",state="open"}'][
+            "value"] >= 1
+        # the model recovers; after the reset window the probe closes it
+        gated.broken = False
+        time.sleep(0.15)
+        for i in range(8):
+            inq.enqueue(f"up-{i}", np.ones(6, np.float32), model="m")
+        for i in range(8):
+            np.testing.assert_allclose(outq.query(f"up-{i}", timeout=30.0),
+                                       np.ones(6) * 2.0, rtol=1e-6)
+        assert serving._lanes["m"].breaker.state == "closed"
+    finally:
+        serving.stop(drain=False)
+
+
+def test_weights_for_unknown_lane_rejected():
+    """A typo'd weights= / dispatch_breakers= key must refuse loudly —
+    silently falling back to weight 1.0 would flatten the operator's
+    intended admission ratio."""
+    with pytest.raises(ValueError, match="unknown lane"):
+        ClusterServing({"a": _Scale(1.0)}, backend=LocalBackend(),
+                       weights={"b": 2.0})
+    with pytest.raises(ValueError, match="unknown lane"):
+        ClusterServing({"a": _Scale(1.0)}, backend=LocalBackend(),
+                       dispatch_breakers={"b": CircuitBreaker("x")})
+
+
+def test_dlq_replay_restamps_model_field(tmp_path):
+    """A replayed dead letter re-enqueues with its original ``model``
+    field — a multiplexed server routes it back to the SAME lane."""
+    dlq = DeadLetterQueue(str(tmp_path / "dlq"))
+    dlq.append("u-1", np.arange(6, dtype=np.float32), reason="dispatch",
+               trace="abcdef0123456789", error="boom", model="int8")
+    backend = LocalBackend()
+    assert dlq.replay(backend, stream="replay_stream") == 1
+    entries = backend.xread("replay_stream", 10, block_ms=100)
+    assert len(entries) == 1
+    fields = entries[0][1]
+    assert fields["model"] == "int8"
+    assert fields["replay_of"] == "abcdef0123456789"
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair admission under shed pressure
+# ---------------------------------------------------------------------------
+
+def test_weighted_fair_admission_under_shed():
+    """With the backlog above the watermark, each lane keeps a share of
+    the admission window proportional to its weight (3:1 here), filled
+    oldest-first from its own records; the rest shed — deterministic,
+    reconciled against the per-model counters."""
+    reg = MetricsRegistry()
+    backend = LocalBackend()
+    inq = InputQueue(backend)
+    rng = np.random.default_rng(3)
+    # 40 interleaved records (20 per lane), pre-enqueued: the first
+    # admission window (want = 2 lanes x batch 4 = 8) admits 6 a-records
+    # and 2 b-records (weights 3:1), sheds the other 28 read for that
+    # purpose; the remaining 4 stream entries are under the watermark
+    # and all serve -> a answers 8, b answers 4, 28 shed
+    uris = []
+    for i in range(20):
+        for name in ("a", "b"):
+            uri = f"{name}-{i}"
+            uris.append(uri)
+            inq.enqueue(uri, rng.normal(size=(6,)).astype(np.float32),
+                        model=name)
+    serving = ClusterServing(
+        {"a": {"model": _Scale(2.0), "weight": 3.0},
+         "b": {"model": _Scale(3.0), "weight": 1.0}},
+        backend=backend, batch_size=4, registry=reg, block_ms=20,
+        shed_watermark=4).start()
+    try:
+        got = _query_all(backend, uris)
+    finally:
+        serving.stop(drain=False)
+    served = {u for u, (k, _v) in got.items() if k == "value"}
+    shed = {u for u, (k, v) in got.items()
+            if k == "error" and "shed" in v}
+    assert served | shed == set(uris) and not (served & shed)
+    snap = reg.snapshot()
+    assert snap['zoo_serving_shed_total{reason="depth"}']["value"] == 28
+    assert snap['zoo_serving_model_records_total{model="a"}']["value"] == 8
+    assert snap['zoo_serving_model_records_total{model="b"}']["value"] == 4
+    # the weighted quotas admit each lane's OLDEST records first
+    assert {f"a-{i}" for i in range(6)} <= served
+    assert {"b-0", "b-1"} <= served
+
+
+# ---------------------------------------------------------------------------
+# compiled-shape bucketing (the retrace guard)
+# ---------------------------------------------------------------------------
+
+def test_ragged_traffic_compiles_once_per_bucket():
+    """Ragged traffic against explicit buckets {4, 16}: every dispatch
+    is padded up to a bucket, so the jit entry point compiles exactly
+    once per bucket — ``zoo_jit_retrace_total`` equals bucket count - 1
+    (the first compile is not a retrace), NOT the distinct-read-size
+    count — and the padding rows never leak into published results or
+    the record accounting."""
+    reg = MetricsRegistry()
+    net = _toy_net()
+    im = InferenceModel(registry=reg).from_keras(net)
+    oracle = InferenceModel().from_keras(net)   # its compiles land elsewhere
+    backend = LocalBackend()
+    serving = ClusterServing(im, backend=backend, batch_size=16,
+                             registry=reg, shape_buckets="4,16").start()
+    inq = InputQueue(backend)
+    rng = np.random.default_rng(4)
+    xs = {}
+
+    def enqueue_wave(tag, k):
+        for i in range(k):
+            x = rng.normal(size=(6,)).astype(np.float32)
+            xs[f"{tag}-{i}"] = x
+            inq.enqueue(f"{tag}-{i}", x)
+
+    try:
+        # one full pre-enqueued wave: a single 16-record read -> bucket 16
+        enqueue_wave("full", 16)
+        got = _query_all(backend, [f"full-{i}" for i in range(16)])
+        # ragged trickle: read sizes 1..3 all pad up to bucket 4
+        for wave, k in enumerate((1, 3, 2, 3, 1)):
+            enqueue_wave(f"w{wave}", k)
+            got.update(_query_all(
+                backend, [f"w{wave}-{i}" for i in range(k)]))
+    finally:
+        serving.stop(drain=False)
+    for uri, x in xs.items():
+        kind, val = got[uri]
+        assert kind == "value", (uri, val)
+        np.testing.assert_allclose(val, oracle.predict(x[None])[0],
+                                   rtol=1e-5, atol=1e-6)
+    snap = reg.snapshot()
+    n = len(xs)
+    # ragged read sizes {1, 2, 3, 16} -> compiled sizes {4, 16} only
+    assert snap["zoo_jit_compile_total"]["value"] == 2
+    retraces = sum(v["value"] for k, v in snap.items()
+                   if k.startswith("zoo_jit_retrace_total"))
+    assert retraces == 1        # == bucket count - 1, not distinct sizes
+    # padding is accounted and invisible: every produced record answered
+    # exactly once, the batch-size histogram sums to REAL records only
+    assert snap["zoo_serving_records_total"]["value"] == n
+    assert snap["zoo_serving_batch_size"]["sum"] == n
+    assert snap['zoo_serving_bucket_pad_rows_total{model="default"}'][
+        "value"] > 0
+
+
+def test_bucket_spec_validation():
+    from analytics_zoo_tpu.serving.server import _parse_buckets
+    assert _parse_buckets("", 32) == (1, 2, 4, 8, 16, 32)
+    assert _parse_buckets("4,16", 16) == (4, 16)
+    assert _parse_buckets([8], 12) == (8, 12)   # batch_size tops the set
+    with pytest.raises(ValueError):
+        _parse_buckets("0,4", 8)
+    with pytest.raises(ValueError):
+        _parse_buckets("64", 32)
+    with pytest.raises(ValueError):
+        ClusterServing(_Scale(1.0), backend=LocalBackend(),
+                       batch_size=8, shape_buckets="9")
+
+
+# ---------------------------------------------------------------------------
+# the int8 serving dtype path
+# ---------------------------------------------------------------------------
+
+def test_int8_lane_wraps_kerasnet_and_serves_fp32_wire():
+    """A lane spec naming a bare KerasNet with ``dtype="int8"`` is
+    wrapped in an InferenceModel on the int8 weight-only path (int8
+    weights in HBM); requests and results stay fp32 on the wire, and
+    answers track the fp32 oracle."""
+    net = _toy_net()
+    backend = LocalBackend()
+    serving = ClusterServing({"q": {"model": net, "dtype": "int8"}},
+                             backend=backend, batch_size=4).start()
+    lane_model = serving._lanes["q"].model
+    assert isinstance(lane_model, InferenceModel)
+    assert lane_model._scales is not None       # int8 weight-only loaded
+    assert serving._health_info()["serving"]["models"]["q"][
+        "dtype"] == "int8"
+    oracle = InferenceModel().from_keras(net)
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    rng = np.random.default_rng(5)
+    try:
+        for i in range(8):
+            x = rng.normal(size=(6,)).astype(np.float32)
+            inq.enqueue(f"q-{i}", x, model="q")
+            got = outq.query(f"q-{i}", timeout=30.0)
+            assert got is not None and got.dtype == np.float32
+            # weight-only int8: close to the fp32 oracle, not bit-equal
+            np.testing.assert_allclose(got, oracle.predict(x[None])[0],
+                                       atol=0.05)
+    finally:
+        serving.stop(drain=False)
+
+
+def test_bad_dtype_rejected():
+    with pytest.raises(ValueError, match="dtype"):
+        ClusterServing(_Scale(1.0), backend=LocalBackend(), dtype="fp17")
+    with pytest.raises(ValueError, match="dtype"):
+        ClusterServing({"a": {"model": _Scale(1.0), "dtype": "fp17"}},
+                       backend=LocalBackend())
+
+
+# ---------------------------------------------------------------------------
+# /statusz models block + CLI rendering
+# ---------------------------------------------------------------------------
+
+def _run_status_cli(args, env):
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    return subprocess.run(
+        [sys.executable, os.path.join(scripts, "cluster-serving-status"),
+         *args],
+        capture_output=True, text=True, env=env, timeout=120)
+
+
+def _cli_env():
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(scripts) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_statusz_models_block_and_cli_rendering():
+    """The /statusz ``models`` block carries one row per lane (batch
+    target, bucket hit-rate, breaker state), and the status CLI renders
+    it per replica AND as a fleet rollup across endpoints."""
+    env = _cli_env()
+    servers, endpoints, backends = [], [], []
+    try:
+        for r in range(2):
+            reg = MetricsRegistry()
+            backend = LocalBackend()
+            serving = ClusterServing(
+                {"double": _Scale(2.0), "triple": _Scale(3.0)},
+                backend=backend, batch_size=4, registry=reg)
+            scrape = serving.serve_metrics(port=0)
+            serving.start()
+            servers.append(serving)
+            backends.append(backend)
+            endpoints.append(f"{scrape.host}:{scrape.port}")
+            inq = InputQueue(backend)
+            rng = np.random.default_rng(10 + r)
+            uris = []
+            for i in range(6):
+                uri = f"m{r}-{i}"
+                uris.append(uri)
+                inq.enqueue(uri, rng.normal(size=(6,)).astype(np.float32),
+                            model=("double", "triple")[i % 2])
+            got = _query_all(backend, uris)
+            assert all(k == "value" for k, _v in got.values())
+        # the raw /statusz JSON carries the block
+        with urllib.request.urlopen(
+                f"http://{endpoints[0]}/statusz", timeout=10) as resp:
+            status = json.loads(resp.read())
+        models = status["serving"]["models"]
+        assert set(models) == {"double", "triple"}
+        for row in models.values():
+            assert {"batch_target", "bucket_hit_rate", "breaker",
+                    "records", "weight", "dtype"} <= set(row)
+            assert row["breaker"] == "closed"
+        # single replica: per-model rows under "models"
+        r1 = _run_status_cli([endpoints[0]], env)
+        assert r1.returncode == 0, r1.stderr[-2000:]
+        assert "models" in r1.stdout
+        assert "double" in r1.stdout and "triple" in r1.stdout
+        assert "breaker" in r1.stdout
+        # fleet: one rollup table per model name, records summed
+        r2 = _run_status_cli(endpoints, env)
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        assert "fleet roll-up across 2 replica(s)" in r2.stdout
+        fleet_lines = [ln for ln in r2.stdout.splitlines()
+                       if ln.startswith(("double", "triple"))]
+        assert len(fleet_lines) == 2
+        # each replica answered 3 per lane -> 6 fleet-wide per model
+        for ln in fleet_lines:
+            assert ln.split()[-1] == "6"
+    finally:
+        for s in servers:
+            s.stop(drain=False)
+
+
+def test_zero_size_tensor_row_cannot_kill_loop():
+    """A validated v2 record with a zero-size shape ("0" passes the
+    bounds check) must ride the arena copy without crashing a decode
+    worker (the reshape must never be ambiguous) — and the loop keeps
+    serving."""
+    from analytics_zoo_tpu.serving.client import INPUT_STREAM
+    backend = LocalBackend()
+    serving = ClusterServing(_Scale(2.0), backend=backend,
+                             batch_size=4).start()
+    inq, outq = InputQueue(backend), OutputQueue(backend)
+    try:
+        backend.xadd(INPUT_STREAM, {"uri": "empty", "data": b"",
+                                    "dtype": "<f4", "shape": "0",
+                                    "v": "2"})
+        res = outq.query("empty", timeout=30.0)
+        assert res is not None and res.shape == (0,)
+        inq.enqueue("after", np.ones(6, np.float32))
+        np.testing.assert_allclose(outq.query("after", timeout=30.0),
+                                   np.ones(6) * 2.0, rtol=1e-6)
+        assert serving._thread.is_alive()
+    finally:
+        serving.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: refused-permit records ride the next step
+# ---------------------------------------------------------------------------
+
+def test_buffered_records_ride_next_dispatch_not_lost():
+    """A model that refuses the non-blocking dispatch probe (permit in
+    flight) leaves records in the lane's admitted buffer; they must ride
+    a later device step — never be dropped, never deadlock."""
+
+    class OnePermit:
+        """predict_async with a single permit, like concurrent_num=1."""
+
+        def __init__(self):
+            self._busy = False
+
+        def predict_async(self, batch, block=True):
+            if self._busy and not block:
+                return None
+            self._busy = True
+            preds = np.asarray(batch) * 5.0
+
+            def collect():
+                self._busy = False
+                return preds
+            return collect
+
+    backend = LocalBackend()
+    serving = ClusterServing(OnePermit(), backend=backend,
+                             batch_size=2).start()
+    inq = InputQueue(backend)
+    rng = np.random.default_rng(6)
+    xs = {f"c-{i}": rng.normal(size=(6,)).astype(np.float32)
+          for i in range(12)}
+    try:
+        for uri, x in xs.items():
+            inq.enqueue(uri, x)
+        got = _query_all(backend, xs)
+    finally:
+        serving.stop(drain=False)
+    for uri, x in xs.items():
+        kind, val = got[uri]
+        assert kind == "value", (uri, val)
+        np.testing.assert_allclose(val, x * 5.0, rtol=1e-6)
+    assert serving.served == 12

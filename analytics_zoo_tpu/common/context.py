@@ -56,6 +56,13 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.train.fused_ce": "auto",        # fused blockwise LM-head CE: auto (V>=1024) | true | false
     "zoo.train.fused_ce_chunk": 512,     # rows per streamed logits tile (O(chunk*V) memory)
     "zoo.train.remat": False,            # scan-body remat: false | true/dots | full
+    "zoo.train.seq_attention": "off",    # force seq-parallel attention in the
+    #   training step: off | ring | ulysses (needs a seq mesh axis; fallback
+    #   to full attention becomes an error instead of a warning)
+    "zoo.train.pipe_stages": 0,          # >0: cut the model's homogeneous block
+    #   run into this many GPipe stages over the pipe mesh axis (0 = off)
+    "zoo.train.pipe_microbatch": 0,      # GPipe microbatches per step (0 = the
+    #   pipe-axis size; raise it to amortize the (n_micro + P - 1) bubble)
     # -- anomaly sentinels / self-healing training (docs/guides/TRAINING.md)
     "zoo.train.sentinel": "off",         # off | warn | recover: on-device
     #   nan-loss / nan-grad / grad-norm-spike checks folded into the step
@@ -89,6 +96,12 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.serving.dtype": "float32",      # serving precision path for models
     #   the server wraps (KerasNet lane specs): float32 | bfloat16 | int8
     #   (int8 = weight-only quantized inference, fp32 results on the wire)
+    "zoo.serving.lane_max_inflight": "",  # per-lane dispatch-window ceilings,
+    #   "lane:n,lane:n" — a big model's lane caps its in-flight batches so it
+    #   cannot starve the other lanes ("" = the server-wide max_inflight)
+    "zoo.serving.lane_batch_size": "",   # per-lane batch-size ceilings,
+    #   "lane:n,lane:n" — caps the lane's dispatch size, bucket ladder, AIMD
+    #   ceiling and arena rows ("" = the server-wide batch_size)
     "zoo.serving.dlq_dir": "",           # non-empty: spill dead-lettered records
     #   to this append-only on-disk DLQ (scripts/zoo-dlq replays them)
     "zoo.serving.dlq_max_bytes": 64 << 20,  # DLQ disk bound; oldest sealed

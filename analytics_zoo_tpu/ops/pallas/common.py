@@ -161,3 +161,38 @@ def ce_vmem_bytes(block_n: int, block_v: int, hidden: int, itemsize: int,
     comp = [((bn, bv), 4), ((bn, bv), 4)]       # logits and p tiles, f32
     return kernel_vmem_bytes(operands=ops, outputs=outs, scratch=scr,
                              compute=comp)
+
+
+def ce_bwd_vmem_bytes(block_n: int, block_v: int, hidden: int,
+                      itemsize: int, has_bias: bool = True) -> int:
+    """Estimated per-grid-cell VMEM of the fused-CE BACKWARD kernel pair
+    (``cross_entropy.fused_ce_backward``): the max of the dh kernel
+    (dh output + (block_n, H) f32 accumulator) and the dW/db kernel
+    ((H, block_v) f32 accumulator + outputs), each over the shared
+    operand set — h/w windows, the int32 label broadcast, the f32
+    lse/scale rows and the optional bias slice — plus the f32 logits,
+    probability and dlogits compute tiles the tile re-formation holds
+    live. The block selectors, the runtime budget clamp and zoolint's
+    static ZL024 check all price through this one formula."""
+    h_eff = round_up(max(hidden, 1), LANES)
+    bn = round_up(max(block_n, 1), SUBLANES)
+    bv = round_up(max(block_v, 1), LANES)
+    ops = [((bn, h_eff), itemsize),             # h window
+           ((h_eff, bv), itemsize),             # w window
+           ((bn, LANES), 4),                    # labels (int32 broadcast)
+           ((bn, LANES), 4),                    # saved row lse
+           ((bn, LANES), 4)]                    # grad scale
+    if has_bias:
+        ops.append(((SUBLANES, bv), 4))         # f32 bias slice
+    comp = [((bn, bv), 4), ((bn, bv), 4), ((bn, bv), 4)]  # logits/p/dl
+    dh = kernel_vmem_bytes(
+        operands=ops, outputs=[((bn, h_eff), 4)],
+        scratch=[((bn, h_eff), 4)], compute=comp)
+    dw_outs = [((h_eff, bv), 4)]
+    dw_scr = [((h_eff, bv), 4)]
+    if has_bias:
+        dw_outs.append(((SUBLANES, bv), 4))
+        dw_scr.append(((SUBLANES, bv), 4))
+    dw = kernel_vmem_bytes(operands=ops, outputs=dw_outs, scratch=dw_scr,
+                           compute=comp)
+    return max(dh, dw)

@@ -28,7 +28,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import compat
 from . import mesh as mesh_lib
@@ -36,6 +36,30 @@ from . import mesh as mesh_lib
 
 def _rotate_perm(size: int):
     return [(i, (i + 1) % size) for i in range(size)]
+
+
+def _pin_replicated(tree, mesh):
+    """Commit a replicated layout on shard_map operands computed INSIDE
+    an enclosing jit (the training-step path stacks stage params at
+    trace time). Without the pin, GSPMD is free to pick any layout for
+    the intermediate, and a layout that disagrees with the shard_map
+    in_specs enters the manual region UNREDUCED on this jax version —
+    measured as every stage's params arriving multiplied by the
+    data-axis size (data^S after S stages). Eager callers and jit
+    arguments already carry committed layouts; the pin is a no-op for
+    them.
+
+    Replicated, NOT ``P(pipe)``: the memory-preserving stage-sharded pin
+    was tried and hits the same unreduced-entry bug (a P(pipe)-committed
+    in-jit stack still arrived ×data-size per stage on jax 0.4.37, see
+    ``tests/test_pipeline_parallel.py``'s in-jit regression test's
+    history), so per-rank stage-param memory scaling from inside a jit
+    waits on the upstream fix. The training-loop path replicates these
+    params anyway (no layer declares a pipe param spec), so today this
+    costs nothing it wasn't already paying."""
+    repl = NamedSharding(mesh, P())
+    return jax.tree.map(
+        lambda a: jax.lax.with_sharding_constraint(a, repl), tree)
 
 
 def gpipe_apply(stage_fn: Callable, stacked_params, x, *, mesh,
@@ -117,7 +141,7 @@ def gpipe_apply(stage_fn: Callable, stacked_params, x, *, mesh,
                            mesh_lib.PIPE_AXIS)
         return out.reshape(x_loc.shape)
 
-    return run(stacked_params, x)
+    return run(_pin_replicated(stacked_params, mesh), x)
 
 
 def hetero_gpipe_apply(stage_fns, stacked_vec, x_wire, *, mesh,
@@ -184,7 +208,7 @@ def hetero_gpipe_apply(stage_fns, stacked_vec, x_wire, *, mesh,
                            mesh_lib.PIPE_AXIS)
         return out.reshape(x_loc.shape)
 
-    return run(stacked_vec, x_wire)
+    return run(_pin_replicated(stacked_vec, mesh), x_wire)
 
 
 def sequential_apply(stage_fn: Callable, stacked_params, x, n_stages: int,

@@ -153,8 +153,27 @@ def intercept_layer_calls(hook):
     to substitute the call, or ``None`` to run the layer normally. Used by
     the inference runtime for int8 activation calibration (record input
     ranges eagerly) and quantized execution (swap in ``quantized_call`` at
-    trace time); sub-layers invoked *inside* wrapper layers (TimeDistributed,
-    Bidirectional) are not dispatched through containers and stay float."""
+    trace time), by the fused LM-head loss (head → identity) and by the
+    pipeline-parallel step builder (block run → ``gpipe_apply``);
+    sub-layers invoked *inside* wrapper layers (TimeDistributed,
+    Bidirectional) are not dispatched through containers and stay float.
+
+    Nested scopes CHAIN: the innermost hook is consulted first and a
+    ``None`` return falls through to the enclosing one — so the
+    fused-loss head intercept composes with the training loop's pipeline
+    intercept instead of silently replacing it. Entering a scope with
+    ``hook=None`` keeps the historical meaning — interception DISABLED
+    for the scope (the int8 runtime's ``qhook if act_scales else None``
+    idiom), not a crash and not a chain link."""
+    prev = _LAYER_HOOK.get()
+    if prev is not None and hook is not None:
+        inner = hook
+
+        def hook(layer, params, state, x, training, rng):
+            out = inner(layer, params, state, x, training, rng)
+            if out is not None:
+                return out
+            return prev(layer, params, state, x, training, rng)
     token = _LAYER_HOOK.set(hook)
     try:
         yield

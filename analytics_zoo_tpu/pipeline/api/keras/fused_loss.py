@@ -28,6 +28,16 @@ Dense output node), or any layer exposing ``fused_head() -> (dense,
 param_path)`` (``tfpark``'s ``_BertClassifierNet`` does). The full-logits
 objective remains the oracle: ``evaluate``/``predict`` and every
 non-matching model keep it.
+
+On a mesh with a ``model`` axis whose size divides the head width — the
+predicate under which ``mesh.param_shardings`` actually shards the head
+kernel ``P(None, model)`` — the resolved spec additionally routes the
+loss through the VOCAB-SHARDED fused CE
+(``ops.fused_cross_entropy.sharded_fused_cross_entropy_rows``): each rank
+streams only its ``(chunk, V/n)`` weight slice and ``dW`` stays sharded
+end to end, so the model-parallel LM head trains without a full-vocab
+tensor ever forming on any chip. The ``zoo_train_fused_ce`` gauge carries
+a ``sharded`` label so the scrape shows which form is live.
 """
 
 from __future__ import annotations
@@ -36,7 +46,9 @@ import logging
 from typing import Callable, Optional, Tuple
 
 from ....ops.fused_cross_entropy import (AUTO_MIN_VOCAB,
-                                         fused_sparse_cross_entropy)
+                                         fused_sparse_cross_entropy,
+                                         sharded_fused_sparse_cross_entropy,
+                                         vocab_shard_count)
 
 log = logging.getLogger("analytics_zoo_tpu.training")
 
@@ -67,11 +79,16 @@ def find_head(model) -> Optional[Tuple[object, Tuple[str, ...]]]:
 
 class FusedHeadSpec:
     """A resolved head: applies the trunk (head intercepted to identity)
-    and the fused blockwise loss over the head's own params."""
+    and the fused blockwise loss over the head's own params. ``sharded``
+    marks the vocab-sharded (model-parallel) form — resolved once per
+    loop from the mesh, so every step builder of a loop compiles the
+    same collective structure."""
 
-    def __init__(self, head, param_path: Tuple[str, ...]):
+    def __init__(self, head, param_path: Tuple[str, ...],
+                 sharded: bool = False):
         self.head = head
         self.param_path = tuple(param_path)
+        self.sharded = bool(sharded)
 
     def head_params(self, params):
         p = params
@@ -109,7 +126,11 @@ class FusedHeadSpec:
         labels = jnp.asarray(y).reshape(-1).astype(jnp.int32)
         labels = jnp.where(labels < -v, v,
                            jnp.where(labels < 0, labels + v, labels))
-        loss = fused_sparse_cross_entropy(labels, h, w, hp.get("b"))
+        if self.sharded:
+            loss = sharded_fused_sparse_cross_entropy(labels, h, w,
+                                                      hp.get("b"))
+        else:
+            loss = fused_sparse_cross_entropy(labels, h, w, hp.get("b"))
         return loss, ns
 
 
@@ -155,4 +176,18 @@ def resolve_fused_loss(model, loss_fn: Callable) -> Optional[FusedHeadSpec]:
         return None
     if mode == "auto" and head.output_dim < AUTO_MIN_VOCAB:
         return None
-    return FusedHeadSpec(head, path)
+    return FusedHeadSpec(head, path, sharded=_head_sharded(head))
+
+
+def _head_sharded(head) -> bool:
+    """Whether the resolved head's kernel is model-sharded under the
+    current mesh — the same divisibility predicate
+    ``mesh.param_shardings`` applies before committing the Dense
+    ``P(None, model)`` spec (an indivisible head falls back to the
+    replicated kernel AND the unsharded fused loss together, so the loss
+    collectives always match the param layout)."""
+    try:
+        n_model = vocab_shard_count()
+    except Exception:  # zoolint: disable=ZL007 no mesh constructible
+        return False
+    return n_model > 1 and head.output_dim % n_model == 0

@@ -153,14 +153,18 @@ class MultiHeadSelfAttention(Layer):
     def _seq_fallback(self, reason: str, probe: bool = False):
         """A seq mesh exists but this call can't ride it. Default: warn ONCE
         — falling back to full O(T^2) attention at long-context scale is an
-        OOM surprise, not a detail. ``zoo.seq.strict=True``: raise instead
-        (VERDICT r4 weak #6 — a user who built a seq mesh must not silently
-        get zero sequence parallelism)."""
+        OOM surprise, not a detail. ``zoo.seq.strict=True`` — or a
+        training-loop-forced mode (``zoo.train.seq_attention``, which is
+        an explicit contract): raise instead (VERDICT r4 weak #6 — a user
+        who built a seq mesh must not silently get zero sequence
+        parallelism)."""
         from .....common.context import get_zoo_context
+        from ..seq_pipe import forced_seq_mode
         try:
             strict = bool(get_zoo_context().get("zoo.seq.strict", False))
         except Exception:
             strict = False
+        strict = strict or forced_seq_mode() in ("ring", "ulysses")
         if strict and not probe:
             raise RuntimeError(
                 f"{self.name}: zoo.seq.strict is set and {reason} — "
@@ -185,6 +189,11 @@ class MultiHeadSelfAttention(Layer):
         dropout runs in-ring with block-position-keyed masks. Only
         genuinely per-query masks (and dropout without an rng) stay on the
         full XLA op."""
+        from ..seq_pipe import forced_seq_mode
+        if forced_seq_mode() == "off":
+            # inside a pipeline stage (or an explicit disable scope):
+            # no seq routing, no warning — the caller made the choice
+            return None
         try:
             from .....parallel import mesh as mesh_lib
             mesh = mesh_lib.global_mesh()
@@ -217,12 +226,20 @@ class MultiHeadSelfAttention(Layer):
     def _seq_routing(self, n_seq: int) -> str:
         """``zoo.seq.mode``: ``ring`` (default), ``ulysses``, or ``auto``
         (ulysses when n_head divides the seq axis — two all-to-alls beat
-        n-1 ppermutes when the dense local score block fits)."""
+        n-1 ppermutes when the dense local score block fits). A
+        training-loop-forced mode (``zoo.train.seq_attention``, scoped
+        over the step trace) wins over the layer-level knob."""
         from .....common.context import get_zoo_context
-        try:
-            mode = str(get_zoo_context().get("zoo.seq.mode", "ring")).lower()
-        except Exception:
-            mode = "ring"
+        from ..seq_pipe import forced_seq_mode
+        forced = forced_seq_mode()
+        if forced in ("ring", "ulysses"):
+            mode = forced
+        else:
+            try:
+                mode = str(get_zoo_context().get("zoo.seq.mode",
+                                                 "ring")).lower()
+            except Exception:
+                mode = "ring"
         if mode not in ("ring", "ulysses", "auto"):
             raise ValueError(f"zoo.seq.mode must be ring|ulysses|auto, "
                              f"got {mode!r}")

@@ -1,0 +1,277 @@
+"""Sequence- and pipeline-parallel training-step integration — wires
+``parallel/ring_attention.py`` and ``parallel/pipeline.py`` into the
+training loop's step builders WITHOUT touching model code, the same
+intercept-layer mechanism the fused LM-head loss uses.
+
+Two conf flags, both resolved ONCE per :class:`TrainingLoop` (like the
+fused-loss resolution, so every step builder of a loop compiles the same
+collective structure):
+
+* ``zoo.train.seq_attention = off | ring | ulysses`` — ``off`` (default)
+  keeps the layer-level self-routing (``zoo.seq.mode`` on a seq mesh);
+  ``ring``/``ulysses`` FORCE that routing for every attention layer in
+  the step: the mode wins over ``zoo.seq.mode``, a missing ``seq`` mesh
+  axis fails fast at step-build time, and an attention call that cannot
+  ride the mesh (per-query mask, dropout without an rng, indivisible
+  shapes) raises instead of silently degrading to full O(T²) attention
+  — asking the TRAINING LOOP for sequence parallelism is an explicit
+  contract, not a hint.
+* ``zoo.train.pipe_stages = S`` — cut the model's homogeneous block run
+  (a Sequential's consecutive same-shape, same-type layers, e.g. a
+  ``TransformerBlock`` stack) into ``S`` pipeline stages and run it
+  through ``gpipe_apply`` over the ``pipe`` mesh axis: the run's params
+  stack into one ``(S, ...)`` tree sharded over ``pipe``, the first run
+  layer's container dispatch is intercepted to the GPipe schedule, the
+  rest become identities. On a mesh without a ``pipe`` axis the same
+  stack runs through ``sequential_apply`` — portable from 1 chip to a
+  pipelined slice unchanged. ``zoo.train.pipe_microbatch`` sets the
+  GPipe microbatch count (0 = the pipe-axis size).
+
+Inside a pipeline stage the attention layers run with seq routing
+DISABLED (a nested shard_map over ``seq`` inside the ``pipe`` shard_map
+is not a thing) — pick ONE of sequence or pipeline parallelism per
+layer run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+from typing import List, Optional
+
+log = logging.getLogger("analytics_zoo_tpu.training")
+
+#: trace-time seq-attention override for layers' ``_seq_routing``:
+#: None = unset (layer self-routing), "off" = routing disabled (inside
+#: pipeline stages), "ring"/"ulysses" = forced mode + strict fallback
+_FORCED_SEQ_MODE: contextvars.ContextVar = contextvars.ContextVar(
+    "zoo_forced_seq_mode", default=None)
+
+
+def forced_seq_mode() -> Optional[str]:
+    """The training loop's seq-attention override for the current trace
+    scope (see module docstring)."""
+    return _FORCED_SEQ_MODE.get()
+
+
+@contextlib.contextmanager
+def seq_attention_scope(mode: Optional[str]):
+    """Scope the seq-attention override over a step trace; ``None`` is a
+    no-op (the layer-level routing stands)."""
+    if mode is None:
+        yield
+        return
+    token = _FORCED_SEQ_MODE.set(mode)
+    try:
+        yield
+    finally:
+        _FORCED_SEQ_MODE.reset(token)
+
+
+def resolve_seq_attention() -> Optional[str]:
+    """``zoo.train.seq_attention`` → None (off) or the forced mode, with
+    the mesh validated at step-build time: forcing sequence parallelism
+    without a ``seq`` mesh axis is a configuration error, not a warning
+    buried in a training log."""
+    from ....common.context import FALSE_FLAG_SPELLINGS, get_zoo_context
+    from ....parallel import mesh as mesh_lib
+
+    mode = str(get_zoo_context().get("zoo.train.seq_attention",
+                                     "off")).strip().lower()
+    if mode in FALSE_FLAG_SPELLINGS or mode in ("none", "off"):
+        return None
+    if mode not in ("ring", "ulysses"):
+        raise ValueError(f"zoo.train.seq_attention must be "
+                         f"off|ring|ulysses, got {mode!r}")
+    mesh = mesh_lib.global_mesh()
+    n_seq = int(mesh.shape[mesh_lib.SEQ_AXIS])
+    if n_seq <= 1:
+        raise ValueError(
+            f"zoo.train.seq_attention={mode} needs a seq mesh axis > 1 "
+            f"(current mesh: {dict(mesh.shape)}); set zoo.mesh.seq")
+    log.info("sequence-parallel attention forced for this training loop: "
+             "%s over seq=%d (zoo.train.seq_attention)", mode, n_seq)
+    return mode
+
+
+class PipeStageSpec:
+    """A resolved pipeline cut: the consecutive homogeneous layer run a
+    Sequential's step intercepts into one GPipe schedule."""
+
+    def __init__(self, layers: List, mesh, pipe_size: int,
+                 stages_per_rank: int, n_micro: int):
+        self.layers = list(layers)
+        self.mesh = mesh
+        self.pipe_size = int(pipe_size)
+        self.stages_per_rank = int(stages_per_rank)
+        self.n_micro = int(n_micro)
+
+    def hook(self, params, training: bool):
+        """The intercept-layer hook: the run's FIRST layer dispatch runs
+        the whole stacked-and-sharded pipeline; the remaining run
+        members become identities (their compute already happened inside
+        the schedule)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ....parallel import pipeline as pipe_lib
+
+        first = self.layers[0]
+        members = {id(l) for l in self.layers}
+        ref = first
+        spec = self
+
+        def stage_fn(p_stage, h, srng):
+            # one homogeneous stage = one run layer's code on the
+            # stacked param row; seq routing is disabled inside (no
+            # nested shard_map over the seq axis from a pipe stage)
+            with seq_attention_scope("off"):
+                return ref.call(p_stage, h, training=training, rng=srng)
+
+        def _hook(layer, p, s, x, training_, rng):
+            if id(layer) not in members:
+                return None
+            if layer is not first:
+                return x, s         # already computed inside the schedule
+            if not hasattr(x, "shape"):
+                raise ValueError(
+                    "zoo.train.pipe_stages: the pipelined block run must "
+                    "take a single array input (multi-input runs — e.g. "
+                    "masked BERT blocks — cannot stack)")
+            per_layer = [params[l.name] for l in spec.layers]
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+            if spec.pipe_size > 1:
+                y = pipe_lib.gpipe_apply(
+                    stage_fn, stacked, x, mesh=spec.mesh,
+                    n_micro=spec.n_micro, rng=rng,
+                    stages_per_rank=spec.stages_per_rank)
+            else:
+                y = pipe_lib.sequential_apply(stage_fn, stacked, x,
+                                              len(spec.layers), rng=rng)
+            return y, s
+
+        return _hook
+
+
+def _config_sig(layer, depth: int = 2):
+    """A layer's hyperparameter signature: every public, non-Layer,
+    non-name attribute (plus sub-layers' signatures one level down —
+    a TransformerBlock's causal/attn_drop live on its attention
+    sub-layer). Stage homogeneity must compare CONFIG, not just param
+    shapes: ``Dense(V, activation="relu")`` and ``Dense(V,
+    activation="tanh")`` stack identically but compute differently, and
+    the schedule applies the FIRST layer's code to every stage — a
+    config mismatch must break the run, never be silently overwritten."""
+    from .engine import Layer
+
+    out = {}
+    for k, v in sorted(vars(layer).items()):
+        if k.startswith("_") or k == "name":
+            continue
+        if isinstance(v, Layer):
+            out[k] = _config_sig(v, depth - 1) if depth > 0 else type(v)
+        elif isinstance(v, (list, tuple)) and any(
+                isinstance(e, Layer) for e in v):
+            out[k] = tuple(_config_sig(e, depth - 1) if depth > 0
+                           else type(e) for e in v)
+        elif callable(v):
+            # registry activations resolve by NAME (the same "relu"
+            # from two Dense ctors may or may not be one object; repr
+            # would compare addresses)
+            out[k] = getattr(v, "__name__", repr(v))
+        else:
+            out[k] = repr(v)
+    return (type(layer).__name__, tuple(out.items()))
+
+
+def _stackable_run(model) -> List:
+    """The longest run of consecutive Sequential layers with identical
+    type, CONFIG and param structure/shapes (the stacked-stage
+    precondition) and no net state. Requires built params."""
+    import jax
+
+    layers = getattr(model, "layers", None)
+    params = getattr(model, "params", None)
+    if not layers or params is None:
+        return []
+    state = getattr(model, "net_state", None) or {}
+
+    def sig(layer):
+        p = params.get(layer.name)
+        if p is None or layer.name in state:
+            return None
+        shapes = jax.tree.map(lambda a: tuple(getattr(a, "shape", ())), p)
+        return (_config_sig(layer), str(shapes))
+
+    best: List = []
+    run: List = []
+    prev_sig = None
+    for layer in layers:
+        s = sig(layer)
+        if s is not None and s == prev_sig:
+            run.append(layer)
+        else:
+            run = [layer] if s is not None else []
+        prev_sig = s
+        if len(run) > len(best):
+            best = list(run)
+    return best if len(best) >= 2 else []
+
+
+def resolve_pipe_spec(model) -> Optional[PipeStageSpec]:
+    """``zoo.train.pipe_stages`` → the resolved :class:`PipeStageSpec`
+    (or None when off). Mis-configuration fails fast at step-build time:
+    a pipeline the model cannot be cut into must not silently train
+    un-pipelined."""
+    from ....common.context import get_zoo_context
+    from ....parallel import mesh as mesh_lib
+    from .engine import Sequential
+
+    stages = int(get_zoo_context().get("zoo.train.pipe_stages", 0) or 0)
+    if stages <= 0:
+        return None
+    if not isinstance(model, Sequential):
+        raise ValueError(
+            "zoo.train.pipe_stages needs a Sequential model (the stage "
+            "cut stacks a consecutive layer run); got "
+            f"{type(model).__name__}")
+    run = _stackable_run(model)
+    if len(run) != stages:
+        raise ValueError(
+            f"zoo.train.pipe_stages={stages} but the model's stackable "
+            f"block run has {len(run)} layer(s) "
+            f"({[l.name for l in run]}) — the stage count must equal "
+            f"the homogeneous run length")
+    mesh = mesh_lib.global_mesh()
+    pipe_size = int(mesh.shape[mesh_lib.PIPE_AXIS])
+    if pipe_size > 1 and stages % pipe_size != 0:
+        raise ValueError(
+            f"zoo.train.pipe_stages={stages} does not divide by the "
+            f"pipe mesh axis ({pipe_size})")
+    n_micro = int(get_zoo_context().get("zoo.train.pipe_microbatch", 0)
+                  or 0)
+    if n_micro <= 0:
+        n_micro = max(pipe_size, 1)
+    log.info("pipeline-parallel block run resolved: %d stage(s) over "
+             "pipe=%d, %d microbatch(es) (zoo.train.pipe_stages; %s)",
+             stages, pipe_size, n_micro,
+             "GPipe schedule" if pipe_size > 1
+             else "sequential fallback — no pipe mesh axis")
+    return PipeStageSpec(run, mesh, pipe_size,
+                         stages_per_rank=max(stages // max(pipe_size, 1),
+                                             1),
+                         n_micro=n_micro)
+
+
+@contextlib.contextmanager
+def pipe_intercept(spec: Optional[PipeStageSpec], params, training: bool):
+    """Scope the pipeline intercept over a step trace; no-op for
+    ``spec=None``. Chains under any inner intercept (the fused-loss
+    head hook) via ``intercept_layer_calls``'s nesting."""
+    if spec is None:
+        yield
+        return
+    from .engine import intercept_layer_calls
+    with intercept_layer_calls(spec.hook(params, training)):
+        yield

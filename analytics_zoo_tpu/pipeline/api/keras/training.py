@@ -515,6 +515,14 @@ class TrainingLoop:
             return self._apply_loss
         model, loss_fn = self.model, self.loss
         from .fused_loss import resolve_fused_loss
+        from .seq_pipe import (pipe_intercept, resolve_pipe_spec,
+                               resolve_seq_attention, seq_attention_scope)
+        # sequence/pipeline step integration (zoo.train.seq_attention /
+        # zoo.train.pipe_stages): resolved once per loop like the fused
+        # loss, applied as trace-time scopes around every builder's
+        # forward so existing models ride seq/pipe meshes unchanged
+        seq_mode = resolve_seq_attention()
+        pipe_spec = resolve_pipe_spec(model)
         spec = resolve_fused_loss(model, loss_fn)
         prev = TrainingLoop._last_fused_labels
         if spec is None:
@@ -528,15 +536,21 @@ class TrainingLoop:
                 TrainingLoop._last_fused_labels = None
 
             def apply_loss(p, net_state, x, y, rng):
-                yp, ns = model.apply(p, net_state, x, training=True, rng=rng)
+                with seq_attention_scope(seq_mode), \
+                        pipe_intercept(pipe_spec, p, training=True):
+                    yp, ns = model.apply(p, net_state, x, training=True,
+                                         rng=rng)
                 return loss_fn(y, yp), ns
             self._apply_loss = apply_loss
             return apply_loss
-        log.info("fused LM-head cross-entropy engaged: head=%s vocab=%d "
+        log.info("fused LM-head cross-entropy engaged: head=%s vocab=%d%s "
                  "(zoo.train.fused_ce; the (N, V) logits tensor is never "
-                 "materialized)", spec.head.name, spec.head.output_dim)
+                 "materialized)", spec.head.name, spec.head.output_dim,
+                 " VOCAB-SHARDED over the model axis" if spec.sharded
+                 else "")
         labels = {"head": spec.head.name,
-                  "vocab": str(spec.head.output_dim)}
+                  "vocab": str(spec.head.output_dim),
+                  "sharded": "1" if spec.sharded else "0"}
         if prev is not None and prev != labels:
             # stale-series zeroing, same bounded head=/vocab= set
             self._registry.gauge("zoo_train_fused_ce",  # zoolint: disable=ZL015 bounded label set
@@ -548,7 +562,10 @@ class TrainingLoop:
         TrainingLoop._last_fused_labels = labels
 
         def apply_loss(p, net_state, x, y, rng):
-            return spec.apply_and_loss(model, p, net_state, x, y, rng=rng)
+            with seq_attention_scope(seq_mode), \
+                    pipe_intercept(pipe_spec, p, training=True):
+                return spec.apply_and_loss(model, p, net_state, x, y,
+                                           rng=rng)
         self._apply_loss = apply_loss
         return apply_loss
 

@@ -261,6 +261,30 @@ def _parse_buckets(spec, batch_size: int):
     return tuple(sorted(set(sizes)))
 
 
+def _parse_lane_overrides(spec, what: str):
+    """Per-lane integer overrides out of a ``"lane:value,lane:value"``
+    conf string (or a ``{lane: value}`` mapping) — the
+    ``zoo.serving.lane_max_inflight`` / ``zoo.serving.lane_batch_size``
+    form. Empty spec = no overrides."""
+    if not spec:
+        return {}
+    if isinstance(spec, dict):
+        return {str(k): int(v) for k, v in spec.items()}
+    if not isinstance(spec, str):
+        raise ValueError(f"{what} must be a 'lane:value' comma-joined "
+                         f"string or a mapping, got {spec!r}")
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, val = part.rpartition(":")
+        if not sep or not name.strip():
+            raise ValueError(f"{what}: entry {part!r} is not 'lane:value'")
+        out[name.strip()] = int(val)
+    return out
+
+
 class _ArenaPool:
     """Reusable preallocated batch buffers keyed by (shape, dtype).
 
@@ -338,12 +362,27 @@ class _Lane:
         if self.weight <= 0:
             raise ValueError(f"lane {name!r}: admission weight must be > 0")
         self.dtype = dtype or "float32"
-        self.buckets = buckets
+        #: per-lane ceilings (zoo.serving.lane_batch_size /
+        #: lane_max_inflight, or lane-spec entries): a big model's lane
+        #: caps its own dispatch size and window so its device time and
+        #: arena memory can't starve the small models multiplexed next
+        #: to it — the shared serve loop interleaves lanes per read, so
+        #: without a cap one lane's batch_size-deep dispatches monopolize
+        #: the device between polls
+        self.batch_size = int(batch_size)
+        if self.batch_size < 1:
+            raise ValueError(f"lane {name!r}: batch_size must be >= 1")
+        self.max_inflight = max(int(max_inflight), 1)
+        # the shared bucket ladder capped to this lane's ceiling (dedup
+        # keeps the compile count at most the shared ladder's)
+        self.buckets = tuple(sorted({min(b, self.batch_size)
+                                     for b in buckets}))
         self.pendings: "collections.deque[_Pending]" = collections.deque()
         self.buffer: "collections.deque[_Item]" = collections.deque()
-        self.arena_pool = _ArenaPool(batch_size, cap=max_inflight + 2)
+        self.arena_pool = _ArenaPool(self.batch_size,
+                                     cap=self.max_inflight + 2)
         self.batch_ctl = batch_ctl if batch_ctl is not None \
-            else AIMDController(floor=1, ceiling=batch_size)
+            else AIMDController(floor=1, ceiling=self.batch_size)
         #: guards THIS model's dispatches: consecutive crashes open it
         #: and the lane fast-fails (addressable error + DLQ spill)
         #: instead of burning the shared loop on a dead model; the
@@ -610,6 +649,29 @@ class ClusterServing:
                 raise ValueError(
                     f"{param} names unknown lane(s) {sorted(unknown)}; "
                     f"configured lanes: {sorted(str(n) for n in specs)}")
+        # per-lane ceilings (mixed model sizes): a big model's lane can
+        # cap its own dispatch size / in-flight window below the shared
+        # defaults so it cannot starve the other lanes' device time or
+        # arena memory. Conf overrides win over lane-spec entries
+        # (operator knob beats deployment code); both sit under the
+        # server-wide values, which remain the ceilings' ceiling.
+        lane_inflight = _parse_lane_overrides(
+            self._conf("zoo.serving.lane_max_inflight", ""),
+            "zoo.serving.lane_max_inflight")
+        lane_batch = _parse_lane_overrides(
+            self._conf("zoo.serving.lane_batch_size", ""),
+            "zoo.serving.lane_batch_size")
+        for key, overrides in (
+                ("zoo.serving.lane_max_inflight", lane_inflight),
+                ("zoo.serving.lane_batch_size", lane_batch)):
+            unknown = set(overrides) - {str(n) for n in specs}
+            if unknown:
+                # conf is process-global (other servers may own those
+                # lanes) — warn, don't refuse
+                log.warning("%s names lane(s) %s not configured on this "
+                            "server (lanes: %s) — ignored here", key,
+                            sorted(unknown),
+                            sorted(str(n) for n in specs))
         self._lanes: "collections.OrderedDict[str, _Lane]" = \
             collections.OrderedDict()
         for i, (name, spec) in enumerate(specs.items()):
@@ -624,20 +686,32 @@ class ClusterServing:
             if lane_dtype not in _LANE_DTYPES:
                 raise ValueError(f"lane {name!r}: unknown dtype "
                                  f"{lane_dtype!r}; use one of {_LANE_DTYPES}")
+            lane_bs = int(lane_batch.get(
+                name, opts.get("batch_size", self.batch_size)))
+            lane_mi = int(lane_inflight.get(
+                name, opts.get("max_inflight", self.max_inflight)))
+            if not 1 <= lane_bs <= self.batch_size:
+                raise ValueError(
+                    f"lane {name!r}: batch_size ceiling {lane_bs} outside "
+                    f"[1, batch_size={self.batch_size}]")
+            if not 1 <= lane_mi <= self.max_inflight:
+                raise ValueError(
+                    f"lane {name!r}: max_inflight {lane_mi} outside "
+                    f"[1, max_inflight={self.max_inflight}]")
             self._lanes[name] = _Lane(
                 name=name,
                 model=self._wrap_model(opts["model"], lane_dtype),
                 weight=weights.get(name, opts.get("weight", 1.0)),
                 dtype=lane_dtype,
                 buckets=self.shape_buckets,
-                batch_size=self.batch_size,
-                max_inflight=self.max_inflight,
+                batch_size=lane_bs,
+                max_inflight=lane_mi,
                 # the ctor's batch_controller names the PRIMARY lane's
                 # controller (single-model back-compat)
                 batch_ctl=(batch_controller if i == 0 else None),
                 breaker=dispatch_breakers.get(name),
                 metrics=m,
-                initial_target=self.batch_size)
+                initial_target=lane_bs)
         #: the primary lane: first configured — takes records without a
         #: ``model`` wire field, and backs the single-model aliases
         self._primary = next(iter(self._lanes))
@@ -774,9 +848,12 @@ class ClusterServing:
         return model
 
     def _lane_target(self, lane: _Lane) -> int:
-        """The lane's current per-dispatch batch target."""
-        return (lane.batch_ctl.value if self.adaptive_batch
-                else self.batch_size)
+        """The lane's current per-dispatch batch target, capped by its
+        batch-size ceiling (the primary lane's injected controller may
+        carry a wider ceiling)."""
+        target = (lane.batch_ctl.value if self.adaptive_batch
+                  else self.batch_size)
+        return min(target, lane.batch_size)
 
     def _lane_name(self, fields) -> Optional[str]:
         """Route one record's ``model`` wire field to a lane name; no
@@ -921,6 +998,8 @@ class ClusterServing:
                 "weight": lane.weight,
                 "dtype": lane.dtype,
                 "batch_target": self._lane_target(lane),
+                "batch_ceiling": lane.batch_size,
+                "max_inflight": lane.max_inflight,
                 "buckets": list(lane.buckets),
                 "bucket_hit_rate": None if hit is None else round(hit, 4),
                 "breaker": lane.breaker.state,
@@ -1818,7 +1897,7 @@ class ClusterServing:
             rowbytes = first.arr.nbytes
         k = len(items)
         recs = [i.rec for i in items]
-        if rowbytes * self.batch_size > _MAX_ARENA_BYTES:
+        if rowbytes * lane.batch_size > _MAX_ARENA_BYTES:
             batch = np.stack([self._item_array(i) for i in items])
             lane.dispatches += 1
             lane.bucket_hits += 1   # no padding on the fallback path
@@ -1910,8 +1989,9 @@ class ClusterServing:
                 break
             recs, batch, arena = self._lane_assemble(lane, items)
             self._dispatch(lane, recs, batch, arena)
-            while len(lane.pendings) >= self.max_inflight:
-                # the dispatch window: publish the oldest batch once
+            while len(lane.pendings) >= lane.max_inflight:
+                # the dispatch window (per lane — a capped big-model
+                # lane drains earlier): publish the oldest batch once
                 # max_inflight are dispatched-but-unread
                 self._flush(lane.pendings.popleft())
         if lane.pendings and (blocked

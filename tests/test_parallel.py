@@ -480,3 +480,86 @@ def test_ulysses_attention_dropout():
     assert not np.allclose(np.asarray(d1), np.asarray(base))
     with pytest.raises(ValueError, match="dropout_rng"):
         ulysses_self_attention(q, k, v, mesh=mesh, dropout_rate=0.4)
+
+
+def test_ring_combined_causal_mask_dropout_odd_tlocal():
+    """The combined parity cell of the matrix (ISSUE 15): causal AND
+    key-padding mask AND dropout on one call, at T=24 over seq=4 —
+    T_local=6, NOT divisible by the 8-sublane block size, so the
+    per-rank blocks are genuinely ragged against the hardware tile.
+    Without dropout the ring must equal the dense oracle on real rows;
+    with dropout it must be deterministic in the key, actually drop,
+    and keep rate=0 bitwise-identical to the no-dropout path."""
+    from analytics_zoo_tpu.ops.attention import dot_product_attention
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+    from analytics_zoo_tpu.parallel.ring_attention import ring_self_attention
+
+    init_zoo_context(mesh_data=2, mesh_seq=4)
+    mesh = mesh_lib.global_mesh()
+    rng = np.random.default_rng(8)
+    t = 24
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 2, t, 8)).astype(np.float32))
+               for _ in range(3))
+    lengths = np.array([17, 24])          # ragged real lengths too
+    mask = jnp.asarray(np.arange(t)[None, :] < lengths[:, None])
+    key = jax.random.key(9)
+
+    ring = ring_self_attention(q, k, v, mesh=mesh, causal=True, mask=mask)
+    full = dot_product_attention(
+        q, k, v, mask=mask.astype(jnp.float32)[:, None, None, :],
+        causal=True)
+    for bi in range(2):
+        np.testing.assert_allclose(
+            np.asarray(ring)[bi, :, :lengths[bi]],
+            np.asarray(full)[bi, :, :lengths[bi]], rtol=2e-4, atol=2e-5)
+
+    zero = ring_self_attention(q, k, v, mesh=mesh, causal=True, mask=mask,
+                               dropout_rate=0.0, dropout_rng=key)
+    np.testing.assert_array_equal(np.asarray(ring), np.asarray(zero))
+    d1 = ring_self_attention(q, k, v, mesh=mesh, causal=True, mask=mask,
+                             dropout_rate=0.4, dropout_rng=key)
+    d2 = ring_self_attention(q, k, v, mesh=mesh, causal=True, mask=mask,
+                             dropout_rate=0.4, dropout_rng=key)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    assert not np.allclose(np.asarray(d1), np.asarray(ring))
+    assert np.isfinite(np.asarray(d1)).all()
+
+
+@pytest.mark.slow
+def test_ulysses_combined_causal_mask_dropout_odd_tlocal():
+    """Same combined cell for the Ulysses routing (T=24, T_local=6,
+    heads divide the seq axis). Slow marker: the ulysses causal+mask
+    and dropout halves are separately tier-1-covered
+    (test_ulysses_attention_matches_full / _dropout); this is the
+    combined-rerun cell of the full matrix."""
+    from analytics_zoo_tpu.ops.attention import dot_product_attention
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+    from analytics_zoo_tpu.parallel.ring_attention import (
+        ulysses_self_attention)
+
+    init_zoo_context(mesh_data=2, mesh_seq=4)
+    mesh = mesh_lib.global_mesh()
+    rng = np.random.default_rng(10)
+    t = 24
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 4, t, 8)).astype(np.float32))
+               for _ in range(3))
+    lengths = np.array([19, 24])
+    mask = jnp.asarray(np.arange(t)[None, :] < lengths[:, None])
+    key = jax.random.key(11)
+
+    uly = ulysses_self_attention(q, k, v, mesh=mesh, causal=True, mask=mask)
+    full = dot_product_attention(
+        q, k, v, mask=mask.astype(jnp.float32)[:, None, None, :],
+        causal=True)
+    for bi in range(2):
+        np.testing.assert_allclose(
+            np.asarray(uly)[bi, :, :lengths[bi]],
+            np.asarray(full)[bi, :, :lengths[bi]], rtol=2e-4, atol=2e-5)
+
+    d1 = ulysses_self_attention(q, k, v, mesh=mesh, causal=True, mask=mask,
+                                dropout_rate=0.4, dropout_rng=key)
+    d2 = ulysses_self_attention(q, k, v, mesh=mesh, causal=True, mask=mask,
+                                dropout_rate=0.4, dropout_rng=key)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    assert not np.allclose(np.asarray(d1), np.asarray(uly))
+    assert np.isfinite(np.asarray(d1)).all()

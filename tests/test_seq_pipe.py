@@ -1,0 +1,296 @@
+"""Training-step integration of sequence/pipeline parallelism (ISSUE 15):
+``zoo.train.seq_attention`` forces ring/ulysses routing through the step
+builders (strict — no silent fallback), ``zoo.train.pipe_stages`` cuts a
+Sequential's homogeneous block run into a GPipe schedule via the same
+intercept-layer mechanism the fused loss uses — existing models ride
+``seq``/``pipe`` meshes with zero model changes, numerically equal to the
+plain step."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from analytics_zoo_tpu.common.context import (init_zoo_context,
+                                              reset_zoo_context)
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.engine import Lambda, reset_uids
+from analytics_zoo_tpu.pipeline.api.keras.layers import (Dense,
+                                                         TransformerBlock)
+
+T, H = 16, 8
+
+
+def _blocks_model(n_block=4, head=4):
+    layers = [TransformerBlock(H, 2, causal=True, hidden_drop=0.0,
+                               attn_drop=0.0,
+                               **({"input_shape": (T, H)} if i == 0 else {}))
+              for i in range(n_block)]
+    return Sequential(layers + [Lambda(lambda h: h[:, -1, :], name="last"),
+                                Dense(head)])
+
+
+def _data(n=16, head=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, T, H)).astype(np.float32)
+    y = rng.integers(0, head, n).astype(np.int32)
+    return x, y
+
+
+def _fit(conf=None, nb_epoch=2, model_fn=_blocks_model, **kw):
+    reset_zoo_context()
+    init_zoo_context(conf=conf or {}, **kw)
+    reset_uids()
+    x, y = _data()
+    m = model_fn()
+    m.compile(optimizer=optax.adam(1e-2), loss="scce_with_logits")
+    h = m.fit(x, y, batch_size=16, nb_epoch=nb_epoch, shuffle=False)
+    return h["loss"], m
+
+
+#: the plain-step baseline losses, computed once per epochs value — four
+#: tests compare against the identical pure-DP run, and re-fitting it
+#: per test is pure tier-1 wall-clock
+_BASE = {}
+
+
+def _base_losses(nb_epoch=2):
+    if nb_epoch not in _BASE:
+        _BASE[nb_epoch] = _fit(nb_epoch=nb_epoch)[0]
+    return _BASE[nb_epoch]
+
+
+# ---------------------------------------------------------------------------
+# zoo.train.seq_attention
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", [
+    "ring",
+    # the ulysses ROUTING proof also lives in the (faster) override
+    # test below; the full parity rerun rides the slow marker
+    pytest.param("ulysses", marks=pytest.mark.slow),
+])
+def test_forced_seq_attention_matches_plain_step(mode):
+    """Forcing ring/ulysses from the training loop on a seq mesh trains
+    numerically identical to the pure-DP step — and the routing is
+    PROVEN taken (call counter), not inferred from equal numbers."""
+    from analytics_zoo_tpu.parallel import ring_attention as ra
+
+    l_base = _base_losses()
+    target = ("ring_self_attention" if mode == "ring"
+              else "ulysses_self_attention")
+    calls = {"n": 0}
+    orig = getattr(ra, target)
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    setattr(ra, target, counting)
+    try:
+        l_sp, _ = _fit({"zoo.train.seq_attention": mode},
+                       mesh_seq=2)
+    finally:
+        setattr(ra, target, orig)
+    assert calls["n"] > 0, f"{mode} was never routed"
+    np.testing.assert_allclose(l_base, l_sp, rtol=1e-4, atol=1e-5)
+
+
+def test_forced_seq_attention_needs_seq_mesh():
+    with pytest.raises(ValueError, match="seq mesh axis"):
+        _fit({"zoo.train.seq_attention": "ring"})
+
+
+def test_forced_seq_attention_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="off|ring|ulysses"):
+        _fit({"zoo.train.seq_attention": "spiral"}, mesh_seq=2)
+
+
+def test_forced_mode_overrides_layer_knob_and_is_strict():
+    """The training flag wins over ``zoo.seq.mode`` (ulysses forced while
+    the layer knob says ring), and a call that cannot ride the mesh
+    raises instead of warning — the loop-level flag is a contract."""
+    from analytics_zoo_tpu.parallel import ring_attention as ra
+
+    calls = {"n": 0}
+    orig = ra.ulysses_self_attention
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    ra.ulysses_self_attention = counting
+    try:
+        _fit({"zoo.train.seq_attention": "ulysses", "zoo.seq.mode": "ring"},
+             mesh_seq=2)
+    finally:
+        ra.ulysses_self_attention = orig
+    assert calls["n"] > 0, "forced ulysses did not override zoo.seq.mode"
+
+    # T=16 over seq... a shape that can't split: T % n_seq != 0 via a
+    # per-query mask is awkward to build here; indivisible T is the
+    # robust trigger — 16 % 3 is impossible on this fixture, so use
+    # dropout-without-rng instead: training=False evaluate path never
+    # forces, so drive the strict error through attn_drop with rng=None
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        MultiHeadSelfAttention)
+    from analytics_zoo_tpu.pipeline.api.keras.seq_pipe import (
+        seq_attention_scope)
+
+    reset_zoo_context()
+    init_zoo_context(mesh_seq=2)
+    attn = MultiHeadSelfAttention(H, 2, attn_drop=0.5)
+    p = attn.build(jax.random.key(0), (8, T, H))
+    x = jax.numpy.asarray(np.random.default_rng(0)
+                          .normal(size=(8, T, H)).astype(np.float32))
+    with seq_attention_scope("ring"):
+        with pytest.raises(RuntimeError, match="strict"):
+            attn.call(p, x, training=True, rng=None)
+
+
+def test_seq_scope_off_disables_routing():
+    """The "off" scope (what pipeline stages run under): attention on a
+    seq mesh takes the plain path with no warning and no strict error."""
+    from analytics_zoo_tpu.parallel import ring_attention as ra
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        MultiHeadSelfAttention)
+    from analytics_zoo_tpu.pipeline.api.keras.seq_pipe import (
+        seq_attention_scope)
+
+    reset_zoo_context()
+    init_zoo_context(mesh_seq=2)
+    attn = MultiHeadSelfAttention(H, 2)
+    p = attn.build(jax.random.key(0), (8, T, H))
+    x = jax.numpy.asarray(np.random.default_rng(0)
+                          .normal(size=(8, T, H)).astype(np.float32))
+    calls = {"n": 0}
+    orig = ra.ring_self_attention
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    ra.ring_self_attention = counting
+    try:
+        with seq_attention_scope("off"):
+            y = attn.call(p, x)
+    finally:
+        ra.ring_self_attention = orig
+    assert calls["n"] == 0
+    assert np.isfinite(np.asarray(y)).all()
+
+
+# ---------------------------------------------------------------------------
+# zoo.train.pipe_stages
+# ---------------------------------------------------------------------------
+
+def test_pipe_stages_matches_plain_step():
+    """The GPipe cut trains to the same per-epoch losses as the plain
+    step on {pipe:2} and {pipe:4} with the stage run resolved from the
+    model's layer list — no model changes. (Param trees are not
+    compared element-wise here: adam amplifies f32 reassociation drift
+    on near-zero gradients — g/(sqrt(v)+eps) with tiny g — into visible
+    but loss-irrelevant weight noise; the exact GRADIENT parity gate is
+    test_pipeline_parallel's test_gpipe_grad_parity_vs_sequential.)"""
+    l_base = _base_losses()
+    l_pipe, _ = _fit({"zoo.train.pipe_stages": 4,
+                      "zoo.train.pipe_microbatch": 2},
+                     mesh_pipe=2)
+    np.testing.assert_allclose(l_base, l_pipe, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_pipe_stages_matches_plain_step_pipe4():
+    """The deeper cut: 4 stages over {pipe:4}, one stage per rank, 4
+    microbatches (slow marker: same code path as {pipe:2}, a second
+    mesh shape for the full matrix)."""
+    l_base = _base_losses()
+    l_pipe, _ = _fit({"zoo.train.pipe_stages": 4,
+                      "zoo.train.pipe_microbatch": 4},
+                     mesh_pipe=4)
+    np.testing.assert_allclose(l_base, l_pipe, rtol=1e-5, atol=1e-6)
+
+
+def test_pipe_stages_sequential_fallback_without_pipe_mesh():
+    """pipe_stages on a mesh without a pipe axis: the same stacked run
+    goes through sequential_apply — portable, numerically identical."""
+    l_base = _base_losses()
+    l_seq, _ = _fit({"zoo.train.pipe_stages": 4})
+    np.testing.assert_allclose(l_base, l_seq, rtol=1e-5, atol=1e-6)
+
+
+def test_pipe_stages_validation():
+    with pytest.raises(ValueError, match="stackable"):
+        _fit({"zoo.train.pipe_stages": 3})     # run has 4 blocks, not 3
+    with pytest.raises(ValueError, match="divide"):
+        _fit({"zoo.train.pipe_stages": 4}, mesh_pipe=8,
+             model_fn=lambda: _blocks_model(n_block=4))
+
+
+def test_pipe_composes_with_fused_ce_head():
+    """Hook chaining: the fused LM-head loss intercept (head → identity)
+    nests INSIDE the pipeline intercept — both engage in one step, and
+    the losses match the plain full-logits run."""
+    def fused_head():
+        # explicit fused_ce=true has no vocab threshold — a small head
+        # exercises the same hook chain at a fraction of the compile
+        return _blocks_model(head=64)
+
+    l_base, _ = _fit({"zoo.train.fused_ce": False}, model_fn=fused_head)
+    l_both, m = _fit({"zoo.train.fused_ce": True,
+                      "zoo.train.pipe_stages": 4}, mesh_pipe=2,
+                     model_fn=fused_head)
+    np.testing.assert_allclose(l_base, l_both, rtol=1e-5, atol=1e-6)
+    # the fused gauge proves the head intercept engaged alongside gpipe
+    from analytics_zoo_tpu.observability import default_registry
+    snap = default_registry().snapshot()
+    assert any(k.startswith("zoo_train_fused_ce")
+               and (v["value"] if isinstance(v, dict) else v) == 1
+               for k, v in snap.items())
+
+
+def test_intercept_layer_calls_chain():
+    """Nested intercept scopes chain innermost-first with None falling
+    through — the mechanism pipe + fused-loss + int8 calibration all
+    share."""
+    from analytics_zoo_tpu.pipeline.api.keras.engine import (
+        dispatch_layer, intercept_layer_calls)
+
+    class _L:
+        name = "l"
+
+        def apply(self, p, s, x, training=False, rng=None):
+            return x + 1, s
+
+    lay = _L()
+    seen = []
+
+    def outer(layer, p, s, x, training, rng):
+        seen.append("outer")
+        return x * 10, s
+
+    def inner(layer, p, s, x, training, rng):
+        seen.append("inner")
+        return None                      # falls through to outer
+
+    with intercept_layer_calls(outer):
+        with intercept_layer_calls(inner):
+            y, _ = dispatch_layer(lay, {}, {}, 2)
+    assert y == 20 and seen == ["inner", "outer"]
+    # inner can also short-circuit
+    with intercept_layer_calls(outer):
+        with intercept_layer_calls(lambda *a: (99, {})):
+            y, _ = dispatch_layer(lay, {}, {}, 2)
+    assert y == 99
+    # and outside any scope the layer runs normally
+    y, _ = dispatch_layer(lay, {}, {}, 2)
+    assert y == 3
+    # hook=None nested inside an active scope keeps its historical
+    # meaning — interception DISABLED for the scope (the int8 runtime's
+    # `qhook if act_scales else None` idiom), not a crash
+    with intercept_layer_calls(outer):
+        with intercept_layer_calls(None):
+            y, _ = dispatch_layer(lay, {}, {}, 2)
+        assert dispatch_layer(lay, {}, {}, 2)[0] == 20  # outer restored
+    assert y == 3

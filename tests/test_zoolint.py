@@ -3093,3 +3093,100 @@ def test_changed_only_anchors_git_at_scanned_tree(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "bad.py" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# ZL023/ZL024 resolve the CE-backward kernel's block derivations (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+#: the fused_ce_backward derivation chain in miniature: tile-floor
+#: clamp (min + round_up), then the shared shrink-loop helper whose
+#: tuple return must carry its alignment facts through one level of
+#: local-helper resolution — the pattern ZL023 must PROVE, not skip
+ZL0XX_CE_BWD = """
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from analytics_zoo_tpu.ops.pallas.common import round_up
+LANES = 128
+SUBLANES = 8
+def budget_blocks(block_n, block_v):
+    while block_n * block_v > 131072 and (block_n > SUBLANES
+                                          or block_v > LANES):
+        if block_v >= 2 * block_n and block_v > LANES:
+            block_v = max(LANES, block_v // 2 // LANES * LANES)
+        else:
+            block_n = max(SUBLANES, block_n // 2 // SUBLANES * SUBLANES)
+    return block_n, block_v
+def ce_bwd(h, w, block_n, block_v):
+    n, hidden = h.shape
+    v = w.shape[1]
+    block_n = round_up(min(block_n, max(n, 1)), SUBLANES)
+    block_v = round_up(min(block_v, max(v, 1)), LANES)
+    block_n, block_v = budget_blocks(block_n, block_v)
+    return pl.pallas_call(k, grid=(4, 4),
+        in_specs=[pl.BlockSpec((block_n, hidden), lambda ri, vi: (ri, 0)),
+                  pl.BlockSpec((hidden, block_v), lambda ri, vi: (0, vi))],
+        out_specs=pl.BlockSpec((block_n, hidden),
+                               lambda ri, vi: (ri, 0)),
+        scratch_shapes=[pltpu.VMEM((block_n, hidden), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct(h.shape, h.dtype))(h, w)
+"""
+
+
+def test_zl023_proves_ce_bwd_block_derivation():
+    """The CLEAN direction: the backward kernel's real derivation chain
+    (min → round_up onto the floors → shrink-loop helper with floored
+    halving, resolved one level deep) is PROVEN aligned — no ZL023, no
+    silence-by-skip (the trigger below shares the structure and fires,
+    so the rule demonstrably looked)."""
+    assert not ids(lint_source(ZL0XX_CE_BWD, PKG), "ZL023")
+
+
+def test_zl023_ce_bwd_derivation_without_realign_triggers():
+    """The TRIGGER direction: strip BOTH re-alignment layers from the
+    same chain — the round_up clamp AND the floored shrink loop (either
+    alone still proves the tiles, which is the point: the real kernel
+    is safe twice over) — and ZL023 fires on the now raw-min-derived
+    dims (the clamp bug class PR 8's review caught by hand)."""
+    broken = ZL0XX_CE_BWD.replace(
+        "    block_n = round_up(min(block_n, max(n, 1)), SUBLANES)",
+        "    block_n = min(block_n, max(n, 1))").replace(
+        "    block_v = round_up(min(block_v, max(v, 1)), LANES)",
+        "    block_v = min(block_v, max(v, 1))").replace(
+        "    block_n, block_v = budget_blocks(block_n, block_v)\n", "")
+    zl = [f for f in lint_source(broken, PKG) if f.rule_id == "ZL023"]
+    assert zl and all("clamp" in f.message for f in zl)
+    # each re-alignment layer ALONE also proves: round_up without the
+    # helper...
+    no_helper = ZL0XX_CE_BWD.replace(
+        "    block_n, block_v = budget_blocks(block_n, block_v)\n", "")
+    assert not ids(lint_source(no_helper, PKG), "ZL023")
+    # ...and the helper's floored shrink loop without the round_up
+    no_roundup = ZL0XX_CE_BWD.replace(
+        "    block_n = round_up(min(block_n, max(n, 1)), SUBLANES)",
+        "    block_n = min(block_n, max(n, 1))").replace(
+        "    block_v = round_up(min(block_v, max(v, 1)), LANES)",
+        "    block_v = min(block_v, max(v, 1))")
+    assert not ids(lint_source(no_roundup, PKG), "ZL023")
+
+
+def test_zl024_prices_ce_bwd_dw_accumulator():
+    """The dW/db kernel's (H, block_v) f32 accumulator is what can
+    outgrow VMEM at wide hidden dims: a fixture with a provably-huge
+    constant accumulator fails ZL024, the real floor-priced symbolic
+    form stays clean, and the ce_bwd_vmem_bytes formula the runtime
+    clamps with is the SAME one the standalone lint module exposes."""
+    huge = ZL0XX_CE_BWD.replace(
+        "scratch_shapes=[pltpu.VMEM((block_n, hidden), jnp.float32)]",
+        "scratch_shapes=[pltpu.VMEM((8192, 1024), jnp.float32)]")
+    zl = [f for f in lint_source(huge, PKG) if f.rule_id == "ZL024"]
+    assert len(zl) == 1 and "MiB" in zl[0].message
+    assert not ids(lint_source(ZL0XX_CE_BWD, PKG), "ZL024")
+    from analytics_zoo_tpu.analysis.device import footprint_module
+    import analytics_zoo_tpu.ops.pallas.common as runtime_common
+    mod = footprint_module()
+    assert mod is not None
+    assert mod.ce_bwd_vmem_bytes(256, 512, 512, 2) == \
+        runtime_common.ce_bwd_vmem_bytes(256, 512, 512, 2)

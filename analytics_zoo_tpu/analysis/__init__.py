@@ -12,10 +12,18 @@ surfaces (metric registrations, conf keys, fault sites incl. their test
 coverage, rule ids) against their documented catalogs in both
 directions, and a **device-semantics pass** (``device.py``, ZL021–ZL024)
 that abstract-interprets staged and Pallas code for dtype-promotion
-hazards, mesh-axis discipline, tile alignment and static VMEM budgets.
+hazards, mesh-axis discipline, tile alignment and static VMEM budgets,
+and an **SPMD collective-semantics pass** (``spmd.py``, ZL025–ZL028)
+that abstract-interprets ``shard_map`` bodies over a distribution-state
+lattice (replicated / sharded / partial_sum / unknown) to catch unbound
+collective axes, unreduced outputs escaping through ``out_specs``,
+divergent collectives under traced control flow, and PartitionSpec
+hygiene slips — with a collective catalog in PARALLELISM.md reconciled
+both directions by ``--contracts``.
 
 CLI:     ``python -m analytics_zoo_tpu.analysis [paths...] [--contracts]
-         [--changed-only [--base REF]] [--ci] [--format json]``
+         [--changed-only [--base REF]] [--ci [--profile]]
+         [--format json|sarif]``
 Gate:    ``tests/test_zoolint.py`` (tier-1) asserts zero errors and a
          clean contract reconciliation.
 Docs:    ``docs/guides/STATIC_ANALYSIS.md``
@@ -27,10 +35,13 @@ from .core import (ERROR, WARNING, Finding, ModuleContext, Rule, all_rules,
                    lint_file, lint_paths, lint_source, register)
 from .project import (ProjectContext, ProjectRule, all_project_rules,
                       lint_project, register_project)
+from .spmd import (DistState, dot_transfer, interp_source_fn,
+                   iter_shard_map_sites, join)
 from .cli import main
 
 __all__ = ["ERROR", "WARNING", "Finding", "ModuleContext", "Rule",
            "all_rules", "lint_file", "lint_paths", "lint_source",
            "register", "ProjectContext", "ProjectRule",
            "all_project_rules", "lint_project", "register_project",
-           "main"]
+           "DistState", "dot_transfer", "interp_source_fn",
+           "iter_shard_map_sites", "join", "main"]

@@ -15,14 +15,21 @@ project passes.
 ``--changed-only`` scopes the per-file scan to files changed against
 the merge-base with ``--base`` (default ``main``) plus untracked files
 — fast local iteration; outside a git repo it degrades to the full
-scan. ``--ci`` is the one-invocation CI entry point: per-file +
+scan (the diff is read with ``--name-status`` so rename targets scan
+too — ``--name-only`` prints a rename's OLD path, which no longer
+exists). ``--ci`` is the one-invocation CI entry point: per-file +
 ``--contracts`` with findings mirrored as JSON lines to a results
-file, configured by a committed ``.zoolint.json`` — the tier-1 gate
-and external CI run the identical command (``scripts/zoolint --ci``).
+file (schema ``RESULTS_SCHEMA``: a header object naming the rules
+that ran, then one finding per line), configured by a committed
+``.zoolint.json`` — the tier-1 gate and external CI run the identical
+command (``scripts/zoolint --ci``).
 
 ``--format json`` emits one finding per line as a JSON object
 (``rule``/``file``/``line``/``severity``/``message``) for CI and editor
-consumption; the human summary line moves to stderr.
+consumption; ``--format sarif`` emits a single SARIF 2.1.0 document
+(registry-sourced rule metadata, line-independent fingerprints) for
+code-scanning UIs; in both, the human summary line moves to stderr.
+``--profile`` prints per-rule wall-time to stderr after any scan.
 """
 
 from __future__ import annotations
@@ -37,6 +44,13 @@ from typing import List, Optional, Set
 from .core import (ERROR, all_rules, iter_py_files, lint_context,
                    lint_file, lint_paths)
 from .project import ProjectContext, all_project_rules, lint_project
+
+
+#: version of the ``--ci`` results-file format: line 1 is a header
+#: object ``{"zoolint_results_schema": N, "rules": [ids that ran]}``,
+#: every following line one finding object. Bump when the line shape
+#: changes; ``.zoolint.json`` pins the schema CI expects.
+RESULTS_SCHEMA = 2
 
 
 class _Parser(argparse.ArgumentParser):
@@ -103,14 +117,82 @@ def git_changed_files(base: str,
         print(f"zoolint: --base {base} has no merge-base here; "
               f"diffing against HEAD", file=sys.stderr)
         ref = "HEAD"
-    diff = _git(anchor, "diff", "--name-only", ref)
+    # --name-status, not --name-only: under rename detection (-M, on by
+    # default in many configs) --name-only prints the OLD path of a
+    # rename — which no longer exists and silently drops the renamed
+    # file from the scan. Status lines are TAB-separated; rename/copy
+    # rows (R###/C###) carry "old<TAB>new" — keep both (the old path
+    # vanishes harmlessly in iter_py_files; the NEW path is the fix).
+    diff = _git(anchor, "diff", "--name-status", ref)
     if diff.returncode == 0:
-        names.update(ln for ln in diff.stdout.splitlines() if ln.strip())
+        for ln in diff.stdout.splitlines():
+            fields = ln.split("\t")
+            if len(fields) < 2 or not fields[0].strip():
+                continue
+            status = fields[0].strip()
+            if status[0] in ("R", "C") and len(fields) >= 3:
+                names.update(f for f in fields[1:3] if f.strip())
+            else:
+                names.add(fields[1])
     untracked = _git(anchor, "ls-files", "--others", "--exclude-standard")
     if untracked.returncode == 0:
         names.update(ln for ln in untracked.stdout.splitlines()
                      if ln.strip())
     return {os.path.realpath(os.path.join(root, n)) for n in names}
+
+
+def _sarif_doc(findings, contracts: bool) -> dict:
+    """A SARIF 2.1.0 document: rule metadata straight from the
+    registries (id, docstring, default level) and one result per
+    finding. ``partialFingerprints`` hashes rule|file-basename|message —
+    deliberately line-independent, so a finding that merely moves when
+    unrelated lines are inserted keeps its identity in code-scanning
+    UIs instead of reopening as new."""
+    import hashlib
+    import re
+    rules_meta, seen = [], set()
+    pool = list(all_rules()) + (list(all_project_rules())
+                                if contracts else [])
+    for rule in pool:
+        if rule.id in seen:
+            continue
+        seen.add(rule.id)
+        doc = " ".join((rule.__doc__ or "").split())
+        rules_meta.append({
+            "id": rule.id,
+            "shortDescription": {"text": (doc or rule.id)[:280]},
+            "defaultConfiguration": {
+                "level": "error" if rule.severity == ERROR
+                else "warning"},
+        })
+    results = []
+    for f in findings:
+        # digit runs are masked: messages routinely cite line numbers
+        # ("key consumed on line 3"), which would defeat the
+        # line-independence the fingerprint exists for
+        norm = re.sub(r"\d+", "#", f.message)
+        fp = hashlib.sha256(
+            f"{f.rule_id}|{os.path.basename(f.path)}|{norm}"
+            .encode("utf-8")).hexdigest()
+        results.append({
+            "ruleId": f.rule_id,
+            "level": "error" if f.severity == ERROR else "warning",
+            "message": {"text": f.message},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {
+                    "uri": f.path.replace(os.sep, "/")},
+                "region": {"startLine": max(int(f.line), 1)}}}],
+            "partialFingerprints": {"zoolintFingerprint/v1": fp},
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "zoolint",
+                                "rules": rules_meta}},
+            "results": results,
+        }],
+    }
 
 
 def _find_ci_config(paths: List[str]) -> Optional[str]:
@@ -177,9 +259,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "gate runs")
     ap.add_argument("--results", metavar="FILE",
                     help="(--ci) override the JSON results file")
-    ap.add_argument("--format", choices=("human", "json"), default="human",
-                    help="output format: human lines (default) or one "
-                         "JSON object per finding")
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
+                    default="human",
+                    help="output format: human lines (default), one JSON "
+                         "object per finding, or a single SARIF 2.1.0 "
+                         "document for code-scanning UIs")
+    ap.add_argument("--profile", action="store_true",
+                    help="print per-rule wall-time to stderr after the "
+                         "scan (slow rules surface before they bloat the "
+                         "tier-1 gate)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print every registered rule and exit")
     args = ap.parse_args(argv)
@@ -211,6 +299,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 args.select = ",".join(cfg["select"])
             if args.ignore is None and cfg.get("ignore"):
                 args.ignore = ",".join(cfg["ignore"])
+            # a config written for a different results-file shape must
+            # fail loudly, not feed CI lines it will misparse
+            pinned = cfg.get("results_schema")
+            if pinned is not None and pinned != RESULTS_SCHEMA:
+                ap.error(f"{cfg_path} pins results_schema={pinned} but "
+                         f"this zoolint writes schema {RESULTS_SCHEMA}")
 
     if args.list_rules:
         for rule in all_rules():
@@ -265,15 +359,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             if changed is None or os.path.realpath(p) in changed:
                 yield p
 
+    profile: Optional[dict] = {} if args.profile else None
     project_findings: List = []
     if not args.contracts:
         if changed is None:
-            findings = lint_paths(paths, select=select, ignore=ignore)
+            findings = lint_paths(paths, select=select, ignore=ignore,
+                                  profile=profile)
         else:
             findings = []
             for path in scan_files():
                 findings.extend(lint_file(path, select=select,
-                                          ignore=ignore))
+                                          ignore=ignore, profile=profile))
     else:
         # the contract surfaces govern SHIPPED package code: the project
         # pass indexes the scanned directories that are package roots
@@ -310,12 +406,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         for path in scan_files():
             ctx = project.by_path.get(path)
             findings.extend(
-                lint_context(ctx, select=select, ignore=ignore)
+                lint_context(ctx, select=select, ignore=ignore,
+                             profile=profile)
                 if ctx is not None
-                else lint_file(path, select=select, ignore=ignore))
+                else lint_file(path, select=select, ignore=ignore,
+                               profile=profile))
         project_findings = lint_project(
             project=project, select=select, ignore=ignore,
-            report_unparseable=False)
+            report_unparseable=False, profile=profile)
         findings = findings + project_findings
         findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
     if args.errors_only:
@@ -327,19 +425,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                            "message": f.message}, sort_keys=True)
 
     if args.ci and results_path:
+        # schema 2: the first line is a header object naming the rule
+        # ids that RAN, so the gate can assert a pass actually executed
+        # (a silently-unregistered pass previously read as a green run)
+        ran = {r.id for r in all_rules()}
+        if args.contracts:
+            ran |= {r.id for r in all_project_rules()}
+        if select is not None:
+            ran &= set(select)
+        ran -= set(ignore or ())
+        header = json.dumps({"zoolint_results_schema": RESULTS_SCHEMA,
+                             "rules": sorted(ran)}, sort_keys=True)
         try:
             with open(results_path, "w", encoding="utf-8") as out:
+                out.write(header + "\n")
                 for f in findings:
                     out.write(_jsonl(f) + "\n")
         except OSError as e:
             # an unwritable results file must not mask the scan verdict
             print(f"zoolint: cannot write results file "
                   f"{results_path}: {e}", file=sys.stderr)
-    for f in findings:
-        if args.format == "json":
-            print(_jsonl(f))
-        else:
-            print(f.format())
+    if args.format == "sarif":
+        # one SARIF 2.1.0 document on stdout — uploadable to
+        # code-scanning UIs as-is
+        print(json.dumps(_sarif_doc(findings, args.contracts),
+                         indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            if args.format == "json":
+                print(_jsonl(f))
+            else:
+                print(f.format())
     errors = sum(1 for f in findings if f.severity == ERROR)
     warnings = len(findings) - errors
     n_rules = len(all_rules()) + (len(all_project_rules())
@@ -347,8 +463,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     summary = (f"zoolint: {errors} error(s), {warnings} warning(s), "
                f"{n_rules} rule(s)"
                + (" [contracts]" if args.contracts else ""))
-    # json mode keeps stdout machine-parseable: one object per line
-    print(summary, file=sys.stderr if args.format == "json" else sys.stdout)
+    # json/sarif modes keep stdout machine-parseable
+    print(summary,
+          file=sys.stderr if args.format in ("json", "sarif")
+          else sys.stdout)
+    if profile is not None:
+        # slowest first; project-pass rules keyed ZLxxx[project]
+        for rid, secs in sorted(profile.items(), key=lambda kv: -kv[1]):
+            print(f"zoolint-profile: {rid} {secs:.3f}s", file=sys.stderr)
     if args.contracts:
         # the exit codes stay distinguishable: 2 = the CONTRACT surface
         # drifted (project-pass findings), 1 = only per-file code

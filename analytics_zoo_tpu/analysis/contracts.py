@@ -563,6 +563,7 @@ CATALOG_FILES = {
     "conf": "CONFIG.md",
     "faults": "RELIABILITY.md",
     "rules": "STATIC_ANALYSIS.md",
+    "collectives": "PARALLELISM.md",
 }
 
 

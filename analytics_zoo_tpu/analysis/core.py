@@ -20,6 +20,7 @@ import dataclasses
 import io
 import os
 import re
+import time
 import tokenize
 from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
@@ -598,6 +599,7 @@ def register(cls):
 def all_rules() -> List[Rule]:
     from . import rules  # noqa: F401  (registers on first import)
     from . import device  # noqa: F401  (device-semantics rules ZL021-ZL024)
+    from . import spmd  # noqa: F401  (SPMD collective rules ZL025-ZL028)
     return sorted(_REGISTRY.values(), key=lambda r: r.id)
 
 
@@ -616,10 +618,15 @@ def _zl000_kept(select: Optional[Iterable[str]],
 
 def lint_context(ctx: ModuleContext,
                  select: Optional[Iterable[str]] = None,
-                 ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+                 ignore: Optional[Iterable[str]] = None,
+                 profile: Optional[Dict[str, float]] = None
+                 ) -> List[Finding]:
     """All non-suppressed per-file findings for an ALREADY-PARSED module
     — the reuse surface the ``--contracts`` CLI path goes through so the
-    project pass and the per-file rules share one parse per file."""
+    project pass and the per-file rules share one parse per file.
+    ``profile`` (a dict the caller owns) accumulates per-rule wall-clock
+    seconds across every file — the ``--profile`` surface that keeps
+    slow rules visible before they bloat the tier-1 gate."""
     select = set(select) if select else None
     ignore = set(ignore) if ignore else set()
     out: List[Finding] = []
@@ -629,7 +636,12 @@ def lint_context(ctx: ModuleContext,
             continue
         if rule.id in ignore:
             continue
-        for f in rule.check(ctx):
+        t0 = time.perf_counter() if profile is not None else 0.0
+        found = list(rule.check(ctx))
+        if profile is not None:
+            profile[rule.id] = profile.get(rule.id, 0.0) \
+                + (time.perf_counter() - t0)
+        for f in found:
             key = (f.rule_id, f.line, f.message)
             if key in seen or ctx.suppressed(f):
                 continue
@@ -641,7 +653,9 @@ def lint_context(ctx: ModuleContext,
 
 def lint_source(source: str, path: str = "<string>",
                 select: Optional[Iterable[str]] = None,
-                ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+                ignore: Optional[Iterable[str]] = None,
+                profile: Optional[Dict[str, float]] = None
+                ) -> List[Finding]:
     """All non-suppressed findings for one module's source text."""
     try:
         ctx = ModuleContext(path, source)
@@ -651,7 +665,7 @@ def lint_source(source: str, path: str = "<string>",
             return []
         return [Finding("ZL000", ERROR, path, getattr(e, "lineno", 1) or 1,
                         f"syntax error: {getattr(e, 'msg', None) or e}")]
-    return lint_context(ctx, select=select, ignore=ignore)
+    return lint_context(ctx, select=select, ignore=ignore, profile=profile)
 
 
 def lint_file(path: str, **kw) -> List[Finding]:

@@ -37,6 +37,13 @@ The estimator module is loaded straight off ``ops/pallas/common.py``
 with ``importlib`` (no package ``__init__`` chain, so the linter stays
 jax-free); when the file is missing (linting a foreign tree) the
 tile-floor constants fall back to the hardware values and ZL024 skips.
+
+The fourth stage (``spmd.py``, ZL025–ZL028) builds on this module: it
+reuses the collective-call table (``_COLLECTIVES``), the axis-name
+folding and mesh-vocabulary extraction (``_fold_axis_names``,
+``extract_axis_decls``, ``package_axis_vocabulary``) and the
+staged-region discovery (``staged_fns``) — changes to those helpers
+are shared contract surface for both passes.
 """
 
 from __future__ import annotations

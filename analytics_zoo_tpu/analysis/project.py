@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import ast
 import os
+import time
 from typing import Dict, Iterable, Iterator, List, Optional, Set
 
 from .core import (ERROR, Finding, ModuleContext, iter_py_files)
@@ -60,6 +61,7 @@ def register_project(cls):
 def all_project_rules() -> List[ProjectRule]:
     from . import contracts  # noqa: F401  (registers on first import)
     from . import device  # noqa: F401  (ZL022's declaration direction)
+    from . import spmd  # noqa: F401  (ZL025's collective-catalog half)
     return sorted(_PROJECT_REGISTRY.values(), key=lambda r: r.id)
 
 
@@ -221,14 +223,18 @@ def lint_project(paths: Optional[Iterable[str]] = None,
                  select: Optional[Iterable[str]] = None,
                  ignore: Optional[Iterable[str]] = None,
                  project: Optional["ProjectContext"] = None,
-                 report_unparseable: bool = True) -> List[Finding]:
+                 report_unparseable: bool = True,
+                 profile: Optional[Dict[str, float]] = None
+                 ) -> List[Finding]:
     """Run every project rule over the package tree rooted at ``paths``
     (or a prebuilt ``project`` — the CLI reuses one so files parse once
     for both passes); returns non-suppressed findings, sorted by
     path/line/rule. ``tests_root`` switches on the test-coverage
     reconciliations (ZL019's site census). ``report_unparseable=False``
     drops the project pass's own ZL000 findings — for callers whose
-    per-file scan already reported the same broken files."""
+    per-file scan already reported the same broken files. ``profile``
+    accumulates per-rule wall-clock seconds (keyed ``ZLxxx[project]``
+    so the two ZL022/ZL025 halves stay distinguishable)."""
     if project is None:
         if paths is None:
             raise ValueError("lint_project needs paths or a project")
@@ -246,7 +252,13 @@ def lint_project(paths: Optional[Iterable[str]] = None,
             continue
         if rule.id in ignore_set:
             continue
-        for f in rule.check(project):
+        t0 = time.perf_counter() if profile is not None else 0.0
+        found = list(rule.check(project))
+        if profile is not None:
+            key = f"{rule.id}[project]"
+            profile[key] = profile.get(key, 0.0) \
+                + (time.perf_counter() - t0)
+        for f in found:
             key = (f.rule_id, f.path, f.line, f.message)
             if key in seen:
                 continue

@@ -1,6 +1,8 @@
 """zoolint per-file rules ZL001–ZL015 — the JAX/TPU hazards that bite
 this stack (the whole-project rules ZL016–ZL020 live in ``project.py``/
-``contracts.py``).
+``contracts.py``; the device-semantics pass ZL021–ZL024 in
+``device.py``; the SPMD collective-semantics pass ZL025–ZL028 in
+``spmd.py``).
 
 Every rule documents its rationale in the class docstring (surfaced by
 ``--list-rules`` and docs/guides/STATIC_ANALYSIS.md). Severities:

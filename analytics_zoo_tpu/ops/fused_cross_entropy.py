@@ -381,6 +381,9 @@ def _sharded_fwd_local(h, w, b, labels, chunk, use_pallas, interpret):
         m_i, l_i = lse_i, jnp.ones_like(lse_i)
     else:
         m_i, l_i, ll_i = _fwd_scan_parts(h, w, b, lab_loc, chunk)
+    # the pmax/psum pair rides PARALLELISM.md's collective-catalog rows
+    # for the `model` axis; adding a collective here needs a row too
+    # (ZL025 reconciles both directions).
     m = jax.lax.pmax(m_i, mesh_lib.MODEL_AXIS)
     m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
     scaled = jnp.where(jnp.isneginf(m_i), 0.0,

@@ -56,7 +56,13 @@ def _pin_replicated(tree, mesh):
     history), so per-rank stage-param memory scaling from inside a jit
     waits on the upstream fix. The training-loop path replicates these
     params anyway (no layer declares a pipe param spec), so today this
-    costs nothing it wasn't already paying."""
+    costs nothing it wasn't already paying.
+
+    zoolint's ZL026 caller prong enforces this bug class: a trace-time
+    stacked tree passed into a shard_map site must route through a
+    ``with_sharding_constraint`` pin (this helper qualifies), so new
+    step builders that skip the pin fail lint instead of training
+    ×data-size."""
     repl = NamedSharding(mesh, P())
     return jax.tree.map(
         lambda a: jax.lax.with_sharding_constraint(a, repl), tree)

@@ -68,6 +68,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
     semantics — dropout applied to the normalized weights, no
     self-normalization bias.
     """
+    # axis_name is caller-supplied, so the collectives below must stay
+    # within the axes documented for psum/axis_index/ppermute in
+    # PARALLELISM.md's collective catalog (reconciled by ZL025).
     n_shards = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, h, t_local, d = q.shape

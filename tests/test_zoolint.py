@@ -3021,7 +3021,14 @@ def test_ci_mode_is_the_tier1_gate():
     assert os.path.exists(results)
     import json as _json
     with open(results, encoding="utf-8") as f:
-        objs = [_json.loads(ln) for ln in f if ln.strip()]
+        lines = [_json.loads(ln) for ln in f if ln.strip()]
+    # schema 2: line one is the header naming every rule id that RAN —
+    # the gate's proof that a pass didn't silently unregister
+    header, objs = lines[0], lines[1:]
+    assert header["zoolint_results_schema"] == 2
+    for rid in ("ZL001", "ZL016", "ZL021", "ZL025", "ZL026", "ZL027",
+                "ZL028"):
+        assert rid in header["rules"], rid
     assert all({"rule", "file", "line", "severity", "message"}
                <= set(o) for o in objs)
     # zero errors is the gate; warnings may legitimately appear
@@ -3045,7 +3052,9 @@ def test_ci_mode_exit_contract(tmp_path):
              + os.environ.get("PYTHONPATH", "")})
     assert proc.returncode == 2, proc.stdout + proc.stderr
     with open(str(tmp_path / "out.jsonl"), encoding="utf-8") as f:
-        objs = [_json.loads(ln) for ln in f if ln.strip()]
+        lines = [_json.loads(ln) for ln in f if ln.strip()]
+    assert lines[0]["zoolint_results_schema"] == 2
+    objs = lines[1:]
     assert [o for o in objs if o["rule"] == "ZL018"]
 
 
@@ -3190,3 +3199,469 @@ def test_zl024_prices_ce_bwd_dw_accumulator():
     assert mod is not None
     assert mod.ce_bwd_vmem_bytes(256, 512, 512, 2) == \
         runtime_common.ce_bwd_vmem_bytes(256, 512, 512, 2)
+
+
+# ---------------------------------------------------------------------------
+# SPMD pass (ZL025-ZL028): lattice units, rule fixtures, catalog, CLI
+# ---------------------------------------------------------------------------
+
+from analytics_zoo_tpu.analysis.spmd import (DistState, dot_transfer,
+                                             interp_source_fn, join)
+
+
+def test_spmd_join_lattice():
+    """join is the least upper bound for both control-flow merges and
+    elementwise arithmetic: hazards on either side survive, unknown
+    absorbs everything."""
+    rep = DistState.replicated()
+    sh = DistState.sharded_over(["data"])
+    assert join(rep, sh).sharded == frozenset({"data"})
+    assert not join(rep, sh).partial
+    ps = DistState.partial_over(["model"])
+    j = join(sh, ps)
+    assert j.sharded == frozenset({"data"})
+    assert j.partial == frozenset({"model"})
+    assert not join(rep, DistState.unknown()).known
+    assert join(rep, rep).is_replicated
+    # commutative and idempotent on these points
+    assert join(sh, rep) == join(rep, sh)
+    assert join(sh, sh) == sh
+
+
+def test_spmd_dot_transfer_contracting_dims():
+    """A dot of two operands sharded over the SAME axis at DIFFERENT
+    dim positions (Megatron row-parallel) yields partial_sum over that
+    axis; same positions (batch sharding, the ring-attention einsum
+    shape) stay sharded; unprovable positions are never accused."""
+    x = DistState.sharded_over(["model"], {"model": 1})
+    w = DistState.sharded_over(["model"], {"model": 0})
+    out = dot_transfer(x, w)
+    assert out.partial == frozenset({"model"})
+    assert "model" not in out.sharded
+    # batch-style: both sharded on dim 0 -> stays sharded, no partial
+    a = DistState.sharded_over(["data"], {"data": 0})
+    b = DistState.sharded_over(["data"], {"data": 0})
+    out = dot_transfer(a, b)
+    assert out.sharded == frozenset({"data"}) and not out.partial
+    # no dim facts -> benefit of the doubt
+    out = dot_transfer(DistState.sharded_over(["seq"]),
+                       DistState.sharded_over(["seq"]))
+    assert out.sharded == frozenset({"seq"}) and not out.partial
+    # unknown absorbs
+    assert not dot_transfer(x, DistState.unknown()).known
+
+
+def test_spmd_partial_propagates_through_add_dot_where():
+    """partial_sum rides through elementwise arithmetic and where, and
+    only a psum over the axis clears it."""
+    src = """
+import jax
+import jax.numpy as jnp
+
+def body(x, c):
+    y = x + 1.0
+    z = jnp.where(c, y, y * 2.0)
+    return z
+
+def fixed(x, c):
+    y = x + 1.0
+    z = jnp.where(c, y, y * 2.0)
+    return jax.lax.psum(z, "model")
+"""
+    seeds = {"x": DistState.partial_over(["model"]),
+             "c": DistState.replicated()}
+    _, rets = interp_source_fn(src, "body", dict(seeds))
+    assert rets and rets[0][1].partial == frozenset({"model"})
+    _, rets = interp_source_fn(src, "fixed", dict(seeds))
+    assert rets and rets[0][1].is_replicated
+
+
+def test_spmd_helper_call_carries_state():
+    """One level of local-helper resolution: a psum INSIDE the helper
+    clears the partial sum at the call site; an unresolvable call
+    degrades to unknown, never to a false accusation."""
+    src = """
+import jax
+
+def reduce_model(v):
+    return jax.lax.psum(v, "model")
+
+def body(x):
+    return reduce_model(x * 2.0)
+
+def opaque(x):
+    return some_foreign_call(x)
+"""
+    seeds = {"x": DistState.partial_over(["model"])}
+    _, rets = interp_source_fn(src, "body", dict(seeds))
+    assert rets and rets[0][1].is_replicated
+    _, rets = interp_source_fn(src, "opaque", dict(seeds))
+    assert rets and not rets[0][1].known
+
+
+SPMD_HDR = """
+import functools
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+"""
+
+
+def test_zl025_submesh_unbound_axis():
+    """A collective naming an axis the site's OWN mesh does not bind
+    fires even when the axis exists in a wider in-file mesh — the
+    submesh case ZL022's vocabulary check cannot see."""
+    src = SPMD_HDR + """
+big = Mesh(jax.devices(), ("data", "model", "pipe"))
+small = Mesh(jax.devices(), ("data", "model"))
+
+@functools.partial(shard_map, mesh=small, in_specs=(P("data"),),
+                   out_specs=P("data"))
+def run(x):
+    return jax.lax.psum(x, "pipe")
+"""
+    zl = [f for f in lint_source(src, PKG) if f.rule_id == "ZL025"]
+    assert len(zl) == 1 and zl[0].severity == ERROR
+    assert "'pipe'" in zl[0].message and "data" in zl[0].message
+    clean = src.replace('jax.lax.psum(x, "pipe")',
+                        'jax.lax.psum(x, "model")')
+    assert not ids(lint_source(clean, PKG), "ZL025")
+    sup = src.replace(
+        'return jax.lax.psum(x, "pipe")',
+        'return jax.lax.psum(x, "pipe")  # zoolint: disable=ZL025')
+    assert not ids(lint_source(sup, PKG), "ZL025")
+
+
+def test_zl026_row_parallel_dot_without_psum():
+    """The body prong: a Megatron row-parallel dot (x sharded over
+    'model' on dim 1, w on dim 0) returned under out_specs claiming
+    full replication is an unreduced partial sum — inserting the psum
+    makes it clean, and claiming P(None, 'model') (sharded, not
+    summed) is equally wrong."""
+    src = SPMD_HDR + """
+mesh = Mesh(jax.devices(), ("data", "model"))
+
+@functools.partial(shard_map, mesh=mesh,
+                   in_specs=(P(None, "model"), P("model", None)),
+                   out_specs=P(None, None))
+def matmul(x, w):
+    return jnp.dot(x, w)
+"""
+    zl = [f for f in lint_source(src, PKG) if f.rule_id == "ZL026"]
+    assert len(zl) == 1 and zl[0].severity == ERROR
+    assert "partial sum" in zl[0].message and "psum" in zl[0].message
+    fixed = src.replace("return jnp.dot(x, w)",
+                        "return jax.lax.psum(jnp.dot(x, w), 'model')")
+    assert not ids(lint_source(fixed, PKG), "ZL026")
+    claimed_sharded = src.replace('out_specs=P(None, None)',
+                                  'out_specs=P(None, "model")')
+    zl = [f for f in lint_source(claimed_sharded, PKG)
+          if f.rule_id == "ZL026"]
+    assert len(zl) == 1 and "psum_scatter" in zl[0].message
+    sup = src.replace(
+        "return jnp.dot(x, w)",
+        "return jnp.dot(x, w)  # zoolint: disable=ZL026")
+    assert not ids(lint_source(sup, PKG), "ZL026")
+
+
+GPIPE_FORM = SPMD_HDR + """
+mesh = Mesh(jax.devices(), ("pipe", "data"))
+
+@jax.jit
+def apply(params_list, x):
+    stacked = jnp.stack(params_list)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P("pipe"), P("data")),
+                       out_specs=P("data"))
+    def run(p, xb):
+        return xb
+    return run(stacked, x)
+"""
+
+
+def test_zl026_gpipe_unpinned_stacked_params_fires_at_call_line():
+    """THE PR-14 regression form: in-jit stacked stage params entering
+    the shard_map manual region without the replicated pin — fires at
+    the offending call line; routing through with_sharding_constraint
+    (directly or via a _pin_replicated-style helper) passes without
+    suppression, exactly like the fixed live code."""
+    zl = [f for f in lint_source(GPIPE_FORM, PKG)
+          if f.rule_id == "ZL026"]
+    assert len(zl) == 1 and zl[0].severity == ERROR
+    offending = GPIPE_FORM.splitlines().index(
+        "    return run(stacked, x)") + 1
+    assert zl[0].line == offending
+    assert "UNREDUCED" in zl[0].message
+    assert "with_sharding_constraint" in zl[0].message
+    pinned = GPIPE_FORM.replace(
+        "return run(stacked, x)",
+        "return run(jax.lax.with_sharding_constraint("
+        "stacked, spec), x)")
+    assert not ids(lint_source(pinned, PKG), "ZL026")
+    helper_pinned = GPIPE_FORM.replace(
+        "@jax.jit",
+        "def _pin_replicated(t):\n"
+        "    return jax.lax.with_sharding_constraint(t, None)\n\n"
+        "@jax.jit").replace("return run(stacked, x)",
+                            "return run(_pin_replicated(stacked), x)")
+    assert not ids(lint_source(helper_pinned, PKG), "ZL026")
+    # a tree.map trace-time producer is the same hazard
+    treemap = GPIPE_FORM.replace(
+        "stacked = jnp.stack(params_list)",
+        "stacked = jax.tree.map(jnp.asarray, params_list)")
+    assert len(ids(lint_source(treemap, PKG), "ZL026")) == 1
+
+
+def test_zl027_divergent_collective_in_cond_branch():
+    """A collective in only one lax.cond branch deadlocks the ranks
+    that take the other branch; matching collectives in BOTH branches
+    are a rendezvous every rank reaches and stay clean."""
+    src = """
+import jax
+
+def step(pred, x):
+    def _yes(v):
+        return jax.lax.psum(v, "data")
+    def _no(v):
+        return v
+    return jax.lax.cond(pred, _yes, _no, x)
+"""
+    zl = [f for f in lint_source(src, PKG) if f.rule_id == "ZL027"]
+    assert len(zl) == 1 and zl[0].severity == ERROR
+    assert "branch" in zl[0].message and "deadlock" in zl[0].message
+    both = src.replace("        return v\n",
+                       '        return jax.lax.psum(v, "data") * 0.0\n')
+    assert not ids(lint_source(both, PKG), "ZL027")
+    sup = src.replace(
+        'return jax.lax.psum(v, "data")',
+        'return jax.lax.psum(v, "data")  # zoolint: disable=ZL027')
+    assert not ids(lint_source(sup, PKG), "ZL027")
+
+
+def test_zl027_collective_in_while_loop_flagged_scan_exempt():
+    """Any collective under a lax.while_loop is a deadlock risk (the
+    traced trip count can differ per rank); a lax.scan body is the
+    static-trip ring/GPipe schedule and stays clean."""
+    src = """
+import jax
+
+def loop(x):
+    def cond(c):
+        return c[1] < 10
+    def body(c):
+        return (jax.lax.psum(c[0], "data"), c[1] + 1)
+    return jax.lax.while_loop(cond, body, (x, 0))
+"""
+    zl = [f for f in lint_source(src, PKG) if f.rule_id == "ZL027"]
+    assert len(zl) == 1 and "while_loop" in zl[0].message
+    scan = """
+import jax
+
+def ring(x):
+    def tick(carry, _):
+        return jax.lax.ppermute(carry, "seq", [(0, 1)]), None
+    return jax.lax.scan(tick, x, None, length=4)
+"""
+    assert not ids(lint_source(scan, PKG), "ZL027")
+
+
+def test_zl028_partition_spec_hygiene():
+    """Duplicate axis in one spec, in_specs arity vs the body's
+    parameter count, and out_specs arity vs a proven returned tuple —
+    each fires; the matched form is clean."""
+    dup = SPMD_HDR + """
+bad = P("data", "data")
+"""
+    zl = [f for f in lint_source(dup, PKG) if f.rule_id == "ZL028"]
+    assert len(zl) == 1 and "twice" in zl[0].message
+    arity = SPMD_HDR + """
+mesh = Mesh(jax.devices(), ("data", "model"))
+
+@functools.partial(shard_map, mesh=mesh,
+                   in_specs=(P("data"), P("model"), P(None)),
+                   out_specs=P("data"))
+def run(x, y):
+    return x + y
+"""
+    zl = [f for f in lint_source(arity, PKG) if f.rule_id == "ZL028"]
+    assert len(zl) == 1 and "3 spec(s)" in zl[0].message \
+        and "2 parameter(s)" in zl[0].message
+    out_arity = arity.replace('in_specs=(P("data"), P("model"), P(None))',
+                              'in_specs=(P("data"), P("model"))') \
+                     .replace('out_specs=P("data")',
+                              'out_specs=(P("data"), P("model"), P(None))') \
+                     .replace("return x + y", "return x, y")
+    zl = [f for f in lint_source(out_arity, PKG) if f.rule_id == "ZL028"]
+    assert len(zl) == 1 and "2-tuple" in zl[0].message
+    clean = arity.replace('in_specs=(P("data"), P("model"), P(None))',
+                          'in_specs=(P("data"), P("model"))')
+    assert not ids(lint_source(clean, PKG), "ZL028")
+    sup = dup.replace('bad = P("data", "data")',
+                      'bad = P("data", "data")  # zoolint: disable=ZL028')
+    assert not ids(lint_source(sup, PKG), "ZL028")
+
+
+def test_spmd_rules_live_package_scans_clean():
+    """ZL025-ZL028 over the live package + tests + bench: zero errors —
+    the fixed gpipe/_pin_replicated path, ring attention's scan-borne
+    ppermutes and the fused-CE reductions all pass without
+    suppression."""
+    findings = lint_paths(
+        [os.path.join(REPO, "analytics_zoo_tpu"),
+         os.path.join(REPO, "tests"), os.path.join(REPO, "bench.py")],
+        select=["ZL025", "ZL026", "ZL027", "ZL028"])
+    errs = errors(findings)
+    assert not errs, "SPMD-pass errors:\n" + "\n".join(
+        f.format() for f in errs)
+
+
+def test_zl025_collective_catalog_drift_both_directions(tmp_path):
+    """The --contracts half: an undocumented collective site anchors at
+    the call line, a stale catalog row at the doc line, and a tree with
+    no collective sites leaves the rule inert (no catalog demanded)."""
+    from analytics_zoo_tpu.analysis.project import lint_project
+    pkg = tmp_path / "analytics_zoo_tpu"
+    (pkg / "parallel").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "parallel" / "__init__.py").write_text("")
+    (pkg / "parallel" / "ring.py").write_text(
+        "import jax\n\n"
+        "def f(x):\n"
+        '    return jax.lax.psum(x, "data")\n')
+    docs = tmp_path / "docs" / "guides"
+    docs.mkdir(parents=True)
+    (docs / "PARALLELISM.md").write_text(
+        "| collective | axes | effect |\n| --- | --- | --- |\n"
+        "| `pmean` | `data` | stale row |\n")
+    fs = lint_project([str(pkg)], docs_root=str(tmp_path),
+                      select=["ZL025"])
+    assert len(fs) == 2
+    site = [f for f in fs if f.path.endswith("ring.py")]
+    row = [f for f in fs if f.path.endswith("PARALLELISM.md")]
+    assert len(site) == 1 and "psum" in site[0].message \
+        and site[0].line == 4
+    assert len(row) == 1 and "pmean" in row[0].message
+    # documenting the site and pruning the stale row reconciles
+    (docs / "PARALLELISM.md").write_text(
+        "| collective | axes | effect |\n| --- | --- | --- |\n"
+        "| `psum` | `data` | cross-rank sum |\n")
+    assert not lint_project([str(pkg)], docs_root=str(tmp_path),
+                            select=["ZL025"])
+    # a caller-supplied axis site reconciles against any row wildcard
+    (pkg / "parallel" / "ring.py").write_text(
+        "import jax\n\n"
+        "def f(x, axis_name):\n"
+        "    return jax.lax.psum(x, axis_name)\n")
+    assert not lint_project([str(pkg)], docs_root=str(tmp_path),
+                            select=["ZL025"])
+    # no collective sites at all -> inert, even with no catalog
+    (pkg / "parallel" / "ring.py").write_text("x = 1\n")
+    (docs / "PARALLELISM.md").unlink()
+    assert not lint_project([str(pkg)], docs_root=str(tmp_path),
+                            select=["ZL025"])
+
+
+def test_zl025_live_collective_catalog_reconciles():
+    """Every collective site in parallel/+ops/ has its PARALLELISM.md
+    row and every row a live site — both directions, on the real
+    tree."""
+    from analytics_zoo_tpu.analysis.project import lint_project
+    fs = lint_project([os.path.join(REPO, "analytics_zoo_tpu")],
+                      docs_root=REPO, select=["ZL025"])
+    assert not fs, "\n".join(f.format() for f in fs)
+
+
+def test_cli_sarif_format(tmp_path):
+    """--format sarif emits one valid SARIF 2.1.0 document: registry
+    rule metadata, level per finding, file/line locations and a stable
+    line-independent fingerprint; the summary moves to stderr."""
+    import json as _json
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n"
+                   "def f(rng):\n"
+                   "    a = jax.random.normal(rng, (2,))\n"
+                   "    b = jax.random.normal(rng, (2,))\n"
+                   "    return a + b\n")
+    proc = _run_cli(["--format", "sarif", str(bad)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "error(s)" in proc.stderr and "error(s)" not in proc.stdout
+    doc = _json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0" and "sarif-2.1.0" in doc["$schema"]
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "zoolint"
+    by_id = {r["id"]: r for r in driver["rules"]}
+    assert "ZL001" in by_id and "ZL026" in by_id
+    assert by_id["ZL001"]["defaultConfiguration"]["level"] == "error"
+    assert by_id["ZL001"]["shortDescription"]["text"]
+    results = doc["runs"][0]["results"]
+    assert len(results) == 1
+    r = results[0]
+    assert r["ruleId"] == "ZL001" and r["level"] == "error"
+    loc = r["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["region"]["startLine"] == 4
+    fp = r["partialFingerprints"]["zoolintFingerprint/v1"]
+    # the fingerprint must survive a pure line shift (stable identity
+    # in code-scanning UIs)
+    bad.write_text("# moved\n# down\n" + bad.read_text())
+    proc2 = _run_cli(["--format", "sarif", str(bad)])
+    doc2 = _json.loads(proc2.stdout)
+    r2 = doc2["runs"][0]["results"][0]
+    assert r2["partialFingerprints"]["zoolintFingerprint/v1"] == fp
+    assert r2["locations"][0]["physicalLocation"]["region"][
+        "startLine"] == 6
+
+
+def test_cli_profile_output_shape(tmp_path):
+    """--profile prints one `zoolint-profile: <rule> <seconds>s` line
+    per rule that ran, on stderr, slowest first."""
+    import re as _re
+    f = tmp_path / "ok.py"
+    f.write_text("x = 1\n")
+    proc = _run_cli(["--profile", "--select", "ZL001,ZL002", str(f)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [ln for ln in proc.stderr.splitlines()
+             if ln.startswith("zoolint-profile:")]
+    assert len(lines) == 2
+    pat = _re.compile(r"^zoolint-profile: (ZL\d{3}) (\d+\.\d{3})s$")
+    seen = {}
+    for ln in lines:
+        m = pat.match(ln)
+        assert m, ln
+        seen[m.group(1)] = float(m.group(2))
+    assert set(seen) == {"ZL001", "ZL002"}
+    times = [float(pat.match(ln).group(2)) for ln in lines]
+    assert times == sorted(times, reverse=True)
+
+
+def test_changed_only_scans_rename_targets(tmp_path):
+    """--changed-only must scan the NEW path of a rename: --name-only
+    under -M prints the old path (which no longer exists) and silently
+    dropped the renamed file from the scan; --name-status keeps the
+    target."""
+    repo = tmp_path / "r"
+    repo.mkdir()
+    assert _git(repo, "init", "-q", "-b", "main").returncode == 0
+    _git(repo, "config", "user.email", "t@t")
+    _git(repo, "config", "user.name", "t")
+    (repo / "old_name.py").write_text(
+        "import jax\n"
+        "def f(rng):\n"
+        "    a = jax.random.normal(rng, (2,))\n"
+        "    return a + jax.random.uniform(rng, (2,))\n")
+    _git(repo, "add", "old_name.py")
+    assert _git(repo, "commit", "-qm", "init").returncode == 0
+    assert _git(repo, "mv", "old_name.py", "new_name.py").returncode == 0
+    # a small edit keeps it a detected rename (similarity < 100%)
+    (repo / "new_name.py").write_text(
+        (repo / "new_name.py").read_text() + "# moved\n")
+    proc = subprocess.run(
+        [os.path.join(REPO, "scripts", "zoolint"),
+         "--changed-only", "--base", "main", "."],
+        capture_output=True, text=True, cwd=str(repo),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "new_name.py" in proc.stdout
+    assert "ZL001" in proc.stdout

@@ -182,17 +182,93 @@ def _loop_bound_literals(ctx: ModuleContext, node: ast.AST) -> Set[str]:
     return out
 
 
+def _helper_kind(func: ast.AST) -> Optional[str]:
+    """The metric kind a ``*_<kind>`` helper-constructor name implies
+    (``alert_gauge`` → ``gauge``, ``collector_counter`` → ``counter``),
+    or None for anything else."""
+    if isinstance(func, ast.Name):
+        leaf = func.id
+    elif isinstance(func, ast.Attribute):
+        leaf = func.attr
+    else:
+        return None
+    head, sep, tail = leaf.rpartition("_")
+    return tail if sep and head and tail in METRIC_KINDS else None
+
+
+def _helper_scan(ctx: ModuleContext) -> Tuple[Set[int], Set[str], Set[str]]:
+    """Classify this module's ``*_<kind>`` helper definitions:
+    ``(shim_call_ids, shim_helpers, local_helpers)``.
+
+    A **forwarding shim** (``def alert_gauge(registry, name, ...):
+    return registry.gauge(name, ...)``) registers whatever its CALLER
+    names — so the inner call is excluded from the scan
+    (``shim_call_ids``) and the helper's call sites become the
+    registration sites. A ``*_<kind>``-named local function that is
+    NOT a shim (it registers its own constant name, e.g. tracing's
+    ``_span_histogram``) keeps its inner call as the site and its
+    call sites stay out of the scan."""
+    shim_calls: Set[int] = set()
+    shim_helpers: Set[str] = set()
+    local_helpers: Set[str] = set()
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        kind = _helper_kind(ast.Name(id=fn.name))
+        if kind is None:
+            continue
+        local_helpers.add(fn.name)
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)}
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == kind
+                    and _is_registry_recv(node.func.value)):
+                continue
+            name_node = node.args[0] if node.args else None
+            if name_node is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name_node = kw.value
+            if isinstance(name_node, ast.Name) \
+                    and name_node.id in params:
+                shim_calls.add(id(node))
+                shim_helpers.add(fn.name)
+    return shim_calls, shim_helpers, local_helpers
+
+
 def iter_metric_sites(ctx: ModuleContext) -> Iterator[MetricSite]:
-    """Every metric registration call in one module."""
+    """Every metric registration call in one module — direct
+    ``<registry>.<kind>(name, ...)`` attribute calls plus calls
+    through ``*_<kind>`` helper constructors whose first argument is a
+    registry (``alert_gauge(registry, name, ...)``); the forwarding
+    shim inside such a helper is attributed to its callers (see
+    :func:`_shim_call_ids`)."""
+    shims, shim_helpers, local_helpers = _helper_scan(ctx)
     for node in ast.walk(ctx.tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
+        if not isinstance(node, ast.Call) or id(node) in shims:
+            continue
+        if (isinstance(node.func, ast.Attribute)
                 and node.func.attr in METRIC_KINDS
                 and _is_registry_recv(node.func.value)):
-            continue
+            kind = node.func.attr
+            pos_args = node.args
+        else:
+            kind = _helper_kind(node.func)
+            if kind is None or not node.args \
+                    or not _is_registry_recv(node.args[0]):
+                continue
+            leaf = node.func.id if isinstance(node.func, ast.Name) \
+                else node.func.attr
+            if leaf in local_helpers and leaf not in shim_helpers:
+                # a self-registering wrapper (its inner call is the
+                # site), not a forwarding shim
+                continue
+            pos_args = node.args[1:]
         name_node: Optional[ast.AST] = None
-        if node.args:
-            name_node = node.args[0]
+        if pos_args:
+            name_node = pos_args[0]
         else:
             for kw in node.keywords:
                 if kw.arg == "name":
@@ -236,7 +312,7 @@ def iter_metric_sites(ctx: ModuleContext) -> Iterator[MetricSite]:
         yield MetricSite(
             name=None if folded is None else folded[0],
             exact=folded is not None and folded[1],
-            kind=node.func.attr, path=ctx.path, line=node.lineno,
+            kind=kind, path=ctx.path, line=node.lineno,
             label_keys=tuple(keys), dynamic_label_keys=tuple(dynamic),
             opaque_labels=opaque)
 

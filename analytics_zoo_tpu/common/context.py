@@ -116,6 +116,15 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.serving.fleet_backpressure": False,  # InputQueue.enqueue consults
     #   the fleet registry and refuses/slows producers when EVERY live
     #   replica reports itself saturated (FleetSaturatedError)
+    # -- telemetry plane: ring-buffer TSDB + fleet collector ----------------
+    "zoo.telemetry.sample_interval_s": 1.0,  # cadence of the local registry
+    #   sampler, the device-memory sampler and the fleet collector's
+    #   scrape loop
+    "zoo.telemetry.retention_s": 900.0,  # per-series history window; ring
+    #   capacity = retention / sample interval (bounded, oldest evicted)
+    "zoo.telemetry.device_memory": True,  # poll jax.Device.memory_stats()
+    #   into zoo_device_hbm_bytes and the /statusz device block
+    #   (graceful no-op off-TPU)
     "zoo.log.level": "INFO",
 }
 

@@ -48,9 +48,13 @@ writes, so an outage plan hits exactly the publishes), ``resp.send`` /
 wire ops — exercises the reconnect/idempotency rules against a real
 socket), the checkpoint writer's ``ckpt.write`` (per tree file) /
 ``ckpt.manifest`` / ``ckpt.rename`` (the manifest commit,
-``utils/checkpoint.py``), and the training loop's ``train.grads`` (one
+``utils/checkpoint.py``), the training loop's ``train.grads`` (one
 per dispatched optimizer step when the anomaly sentinels are armed —
-``pipeline/api/keras/training.py``).
+``pipeline/api/keras/training.py``), and the fleet collector's
+``collector.scrape`` (``observability/collector.py``: one fire per
+scrape attempt per target, retry attempts included — a disconnect
+plan drops a replica mid-scrape and the breaker/alert chaos tests
+reconcile against it).
 
 Determinism: each site keeps a 0-based call counter; a spec fires when
 its site's counter is in ``at`` (or, for rate-based specs, when the

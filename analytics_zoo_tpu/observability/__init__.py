@@ -15,6 +15,14 @@ accounting, with three export sinks:
   ``utils.tensorboard.EventFileWriter`` (the reference's only channel
   keeps working unchanged).
 
+On top of the point-in-time registry sits the **fleet telemetry
+plane** (docs/guides/OBSERVABILITY.md "Fleet telemetry & alerting"):
+bounded ring-buffer time series with windowed ``rate``/``avg``/
+``slope``/quantile queries (``timeseries``), the continuous fleet
+collector + ``/fleetz`` aggregate endpoint (``collector``), the
+declarative burn-rate alert engine (``alerts``), and device HBM
+telemetry (``device``).
+
 Instrumented layers: ``serving/server.py`` (stream depth, batch size,
 queue-wait/dispatch/e2e latency histograms + p50/p95/p99 summaries,
 error + clock-skew counters, per-request enqueue→dequeue→dispatch→publish
@@ -40,6 +48,16 @@ from .tracing import current_span, new_trace_id, span
 from .compile import instrument_jit
 from .export import (JsonEventSink, ScrapeServer, TensorBoardSink, dump,
                      parse_prometheus, read_events, render_prometheus)
+from .timeseries import (RegistrySampler, RingBuffer, SummarySample,
+                         TimeSeriesStore, rehydrate_digest)
+from .device import (DeviceMemorySampler, device_memory_stats,
+                     sample_device_memory)
+from .alerts import (AlertEngine, AlertRule, StoreSignals,
+                     burn_rate_rule, default_ruleset,
+                     quantile_burn_rule)
+from .collector import (FleetCollector, FleetSignals, FleetzServer,
+                        base_url, endpoint_rows, fleet_rows,
+                        summary_points)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "QuantileDigest", "Summary",
@@ -47,4 +65,11 @@ __all__ = [
     "span", "current_span", "new_trace_id", "instrument_jit",
     "JsonEventSink", "ScrapeServer", "TensorBoardSink",
     "dump", "parse_prometheus", "read_events", "render_prometheus",
+    "RingBuffer", "SummarySample", "TimeSeriesStore", "RegistrySampler",
+    "rehydrate_digest",
+    "DeviceMemorySampler", "device_memory_stats", "sample_device_memory",
+    "AlertEngine", "AlertRule", "StoreSignals", "burn_rate_rule",
+    "quantile_burn_rule", "default_ruleset",
+    "FleetCollector", "FleetSignals", "FleetzServer",
+    "summary_points", "fleet_rows", "endpoint_rows", "base_url",
 ]

@@ -1,0 +1,137 @@
+"""Device memory telemetry — HBM headroom as first-class gauges.
+
+``jax.Device.memory_stats()`` exposes per-device allocator state on
+TPU (and CUDA) backends: bytes in use, peak bytes, and the bytes
+limit. This module polls it into
+
+    ``zoo_device_hbm_bytes{device=,kind=in_use|peak|limit}``
+
+so the bench snapshot, ``/statusz``, and the fleet collector all see
+HBM headroom the same way they see queue depth. Off-TPU (CPU jax, or
+no jax importable at all) every entry point is a graceful no-op — the
+gauges simply never appear, matching the catalog's off-device
+behavior for the jit counters.
+
+Entry points:
+
+* :func:`device_memory_stats` — one poll, plain dicts (the
+  ``/statusz`` block and the bench channel).
+* :func:`sample_device_memory` — one poll **into a registry** (bench
+  calls this right before embedding its snapshot).
+* :class:`DeviceMemorySampler` — daemon thread sampling on the
+  ``zoo.telemetry.sample_interval_s`` cadence for long-running
+  servers.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from .metrics import MetricsRegistry, default_registry
+
+log = logging.getLogger("analytics_zoo_tpu.observability")
+
+__all__ = ["device_memory_stats", "sample_device_memory",
+           "DeviceMemorySampler"]
+
+#: memory_stats() key per exported ``kind=`` label value
+_KIND_KEYS = (("in_use", "bytes_in_use"),
+              ("peak", "peak_bytes_in_use"),
+              ("limit", "bytes_limit"))
+
+
+def device_memory_stats() -> List[Dict[str, float]]:
+    """One poll of every local device's allocator stats:
+    ``[{"device": "tpu:0", "in_use": ..., "peak": ..., "limit": ...},
+    ...]``. Devices without ``memory_stats`` support (CPU backend)
+    are skipped; no jax at all returns ``[]``."""
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    out: List[Dict[str, float]] = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        entry: Dict[str, float] = {
+            "device": f"{d.platform}:{d.id}"}
+        for kind, key in _KIND_KEYS:
+            if key in stats:
+                entry[kind] = float(stats[key])
+        if len(entry) > 1:
+            out.append(entry)
+    return out
+
+
+def sample_device_memory(
+        registry: Optional[MetricsRegistry] = None
+) -> List[Dict[str, float]]:
+    """Poll once and set the ``zoo_device_hbm_bytes`` gauges; returns
+    the polled stats (empty off-device, in which case no gauge is
+    registered — absent beats lying zero)."""
+    stats = device_memory_stats()
+    if not stats:
+        return stats
+    reg = registry if registry is not None else default_registry()
+    for entry in stats:
+        device = entry["device"]
+        for kind, _key in _KIND_KEYS:
+            if kind not in entry:
+                continue
+            reg.gauge(   # zoolint: disable=ZL015 bounded label set —
+                # device ids are fixed by the local topology and kind
+                # ranges over the literal _KIND_KEYS enumeration
+                "zoo_device_hbm_bytes",
+                "device allocator bytes per local device "
+                "(kind=in_use|peak|limit)",
+                labels={"device": device, "kind": kind},
+            ).set(entry[kind])
+    return stats
+
+
+class DeviceMemorySampler:
+    """Daemon thread calling :func:`sample_device_memory` on a cadence
+    (``zoo.telemetry.sample_interval_s`` by default). Safe to start
+    off-device: each tick is a no-op."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 interval_s: Optional[float] = None):
+        if interval_s is None:
+            from .timeseries import _conf
+            interval_s = _conf("zoo.telemetry.sample_interval_s", 1.0)
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "DeviceMemorySampler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="zoo-device-memory-sampler",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                sample_device_memory(self.registry)
+            except Exception:       # telemetry must never kill a host
+                log.exception("device memory sample failed")
+
+    def close(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None

@@ -184,17 +184,42 @@ class JsonEventSink:
     seconds) and ``kind``; producers add flat payload fields. Writes are
     serialized under a lock so concurrent writers (serving loop + span
     exits on producer threads) can never interleave bytes — the schema
-    stability the exposition tests assert."""
+    stability the exposition tests assert.
 
-    def __init__(self, path: str):
+    ``max_bytes`` > 0 switches on size-based rotation: when the active
+    file reaches the limit it is atomically renamed to ``path.1``
+    (``os.replace``, the DLQ segments' crash-safe idiom — readers see
+    either the old name or the new, never a torn file), older segments
+    shift up, and at most ``keep`` rotated segments survive (oldest
+    dropped). :func:`read_events` reads across the whole chain, oldest
+    first."""
+
+    def __init__(self, path: str, max_bytes: int = 0, keep: int = 3):
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self.path = path
+        self.max_bytes = int(max_bytes)
+        self.keep = max(int(keep), 1)
         # line-buffered: each event reaches the OS as it happens, so a
         # crash loses at most the in-flight line — the events nearest a
         # failure are exactly the ones diagnosis needs
         self._f = open(path, "a", encoding="utf-8", buffering=1)
+        self._size = os.path.getsize(path) if os.path.exists(path) else 0
         self._lock = threading.Lock()
+
+    def _rotate_locked(self) -> None:
+        self._f.flush()
+        self._f.close()
+        oldest = f"{self.path}.{self.keep}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.keep - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._f = open(self.path, "a", encoding="utf-8", buffering=1)
+        self._size = 0
 
     def write(self, event: Dict[str, Any]) -> None:
         line = json.dumps(event, sort_keys=True, default=str)
@@ -205,6 +230,9 @@ class JsonEventSink:
                 # event beats crashing the instrumented thread
                 return
             self._f.write(line + "\n")
+            self._size += len(line) + 1
+            if self.max_bytes > 0 and self._size >= self.max_bytes:
+                self._rotate_locked()
 
     def flush(self) -> None:
         with self._lock:
@@ -218,16 +246,27 @@ class JsonEventSink:
 
 
 def read_events(path: str, kind: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Parse a JSON-lines event log back, optionally filtered by kind."""
+    """Parse a JSON-lines event log back, optionally filtered by kind.
+    Rotated segments (``path.N`` … ``path.1``, highest = oldest) are
+    read before the active file, so the result is one chronological
+    stream regardless of how many rotations happened."""
+    chain: List[str] = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        chain.append(f"{path}.{i}")
+        i += 1
+    chain.reverse()                      # oldest segment first
+    chain.append(path)
     out: List[Dict[str, Any]] = []
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            event = json.loads(line)
-            if kind is None or event.get("kind") == kind:
-                out.append(event)
+    for seg in chain:
+        with open(seg, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                event = json.loads(line)
+                if kind is None or event.get("kind") == kind:
+                    out.append(event)
     return out
 
 
@@ -283,7 +322,21 @@ class _ScrapeHandler(http.server.BaseHTTPRequestHandler):
         except Exception as e:          # jax-free process: still report
             info["device"] = {"platform": "unavailable",
                               "error": f"{type(e).__name__}: {e}"}
+        if self._device_memory_enabled():
+            from .device import device_memory_stats
+            mem = device_memory_stats()
+            if mem:                     # off-TPU: absent beats lying zero
+                info["device"]["memory"] = mem
         return info
+
+    @staticmethod
+    def _device_memory_enabled() -> bool:
+        try:
+            from ..common.context import get_zoo_context
+            return bool(get_zoo_context().get(
+                "zoo.telemetry.device_memory", True))
+        except Exception:               # jax-free process: default on
+            return True
 
     def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
         path = self.path.split("?", 1)[0]

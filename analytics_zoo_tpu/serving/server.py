@@ -1101,6 +1101,10 @@ class ClusterServing:
             "utilization": round(util, 4),
             "batch_target": (self._batch_ctl.value if self.adaptive_batch
                              else self.batch_size),
+            # the scrape address (serve_metrics) — what the fleet
+            # collector discovers targets from; None until mounted
+            "endpoint": (f"{self._scrape.host}:{self._scrape.port}"
+                         if self._scrape is not None else None),
         })
 
     # -- lifecycle ----------------------------------------------------------

@@ -1353,9 +1353,13 @@ def main(argv=None):
     # zoo_* registry families (serving counters/latencies, inference batch
     # times, train step times) make the end-to-end numbers diagnosable
     # round over round (docs/guides/OBSERVABILITY.md)
-    from analytics_zoo_tpu.observability import default_registry
+    from analytics_zoo_tpu.observability import (default_registry,
+                                                 sample_device_memory)
     if selected("ncf") and mfu is not None:
         default_registry().gauge("zoo_train_mfu").set(mfu)
+    # one device-memory poll right before the snapshot: on TPU the
+    # zoo_device_hbm_bytes gauges ride along (no-op on CPU jax)
+    sample_device_memory(default_registry())
     out["observability"] = default_registry().snapshot(compact=True)
     # serving latency percentiles, promoted out of the snapshot into ONE
     # top-level record (ms): p50/p95/p99 for queue-wait, dispatch, and

@@ -244,6 +244,45 @@ def test_json_events_visible_before_close(tmp_path):
     sink.close()
 
 
+def test_json_sink_size_rotation_bounds_segments(tmp_path):
+    """``max_bytes`` rotation: the active file is atomically renamed to
+    ``path.1``, older segments shift up, at most ``keep`` survive — so
+    total disk stays bounded while :func:`obs.read_events` still returns
+    one chronological stream across the whole chain."""
+    import os
+    path = str(tmp_path / "rot.jsonl")
+    sink = obs.JsonEventSink(path, max_bytes=200, keep=2)
+    for i in range(50):
+        sink.write({"ts": float(i), "kind": "tick", "seq": i})
+    sink.close()
+    segments = sorted(p for p in os.listdir(tmp_path)
+                      if p.startswith("rot.jsonl."))
+    assert segments == ["rot.jsonl.1", "rot.jsonl.2"]   # keep=2, no more
+    for seg in segments:
+        assert os.path.getsize(tmp_path / seg) >= 200
+    events = obs.read_events(path)
+    seqs = [e["seq"] for e in events]
+    # a contiguous suffix of the written sequence, newest always kept,
+    # oldest dropped with the reaped segments
+    assert seqs == list(range(seqs[0], 50))
+    assert 0 < len(seqs) < 50
+
+
+def test_json_sink_rotation_survives_reader_midstream(tmp_path):
+    """Rotation under a live writer: every event written is either in
+    the chain or dropped-from-the-oldest-end — never torn, never
+    duplicated — and a sink without ``max_bytes`` never rotates."""
+    import os
+    path = str(tmp_path / "norot.jsonl")
+    sink = obs.JsonEventSink(path)          # rotation off by default
+    for i in range(200):
+        sink.write({"ts": float(i), "kind": "tick", "seq": i})
+    sink.close()
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith("norot.jsonl.")]
+    assert [e["seq"] for e in obs.read_events(path)] == list(range(200))
+
+
 # ---------------------------------------------------------------------------
 # JSON events: schema-stable under concurrent writers
 # ---------------------------------------------------------------------------

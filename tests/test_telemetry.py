@@ -1,0 +1,706 @@
+"""Fleet telemetry plane — ring-buffer TSDB, alert state machine,
+continuous collector, and the chaos/reconciliation proofs
+(docs/guides/OBSERVABILITY.md "Fleet telemetry & alerting"):
+
+* **time-series store**: bounded ring buffers, counter-reset-aware
+  ``rate()``, least-squares ``slope()``, and windowed quantiles whose
+  digest rehydration weights every interval by its actual traffic,
+* **alert engine**: the pending→firing→resolved machine driven tick by
+  tick under an injectable clock, with the transition counter, the
+  returned transition records, and the ``alert.fire``/``alert.resolve``
+  events reconciling EXACTLY,
+* **end-to-end fleet proof**: 3 live replicas on one stream, a live
+  collector discovering them from the fleet registry — fleet-summed
+  answered+shed+dead-lettered off ``/fleetz`` equals the sum of
+  per-replica scrapes equals the produced count at every sample, the
+  windowed ``rate()`` matches the counter math, and the ``/metrics``
+  re-export carries only catalog families,
+* **burn-rate lifecycle**: an injected publish outage drives the
+  multi-window burn-rate alert inactive→pending→firing→resolved on a
+  deterministic fake-time schedule,
+* **collector chaos**: a ``collector.scrape`` disconnect plan drops a
+  replica mid-scrape — the per-target breaker opens after exactly
+  ``failure_threshold`` failures, fleet counter totals stay monotonic
+  through the loss, the ``replica_down`` alert fires after ``for_s``
+  and resolves on recovery, and ``plan.fired`` reconciles exactly.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common.context import init_zoo_context
+from analytics_zoo_tpu.common.faults import FaultPlan
+from analytics_zoo_tpu.observability import (AlertEngine, AlertRule,
+                                             FleetCollector, FleetzServer,
+                                             MetricsRegistry,
+                                             RegistrySampler, RingBuffer,
+                                             ScrapeServer, StoreSignals,
+                                             TimeSeriesStore,
+                                             burn_rate_rule,
+                                             default_ruleset,
+                                             parse_prometheus)
+from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                       LocalBackend, OutputQueue)
+from analytics_zoo_tpu.serving.client import INPUT_STREAM
+
+
+# ---------------------------------------------------------------------------
+# time-series store
+# ---------------------------------------------------------------------------
+
+def test_ring_buffer_bounded_overwrite():
+    rb = RingBuffer(4)
+    for i in range(10):
+        rb.append(float(i), i * 10)
+    assert len(rb) == 4
+    assert rb.capacity == 4
+    assert rb.items() == [(6.0, 60), (7.0, 70), (8.0, 80), (9.0, 90)]
+    assert rb.last() == (9.0, 90)
+    assert rb.since(8.0) == [(8.0, 80), (9.0, 90)]
+
+
+def test_store_capacity_follows_retention_over_interval():
+    store = TimeSeriesStore(retention_s=10.0, sample_interval_s=1.0)
+    for i in range(100):
+        store.record("g", "gauge", float(i), float(i))
+    pts = store.window("g", 1e9, now=99.0)
+    assert len(pts) == 11           # retention/interval + 1
+    assert pts[0] == (89.0, 89.0)   # oldest overwritten, newest kept
+
+
+def test_rate_is_counter_reset_aware():
+    store = TimeSeriesStore(retention_s=100.0, sample_interval_s=1.0)
+    # 0 → 10 → 20 → RESET to 5 → 15: increments 10+10+5+10 over 4 s
+    for ts, v in enumerate([0.0, 10.0, 20.0, 5.0, 15.0]):
+        store.record("c", "counter", float(ts), v)
+    assert store.rate("c", 100.0, now=4.0) == pytest.approx(35.0 / 4.0)
+    # a single point is no-data, not zero
+    store2 = TimeSeriesStore(retention_s=100.0, sample_interval_s=1.0)
+    store2.record("c", "counter", 0.0, 7.0)
+    assert store2.rate("c", 100.0, now=1.0) is None
+
+
+def test_gauge_stats_and_slope():
+    store = TimeSeriesStore(retention_s=100.0, sample_interval_s=1.0)
+    for i in range(5):
+        store.record("g", "gauge", float(i), 2.0 * i)
+    assert store.avg("g", 100.0, now=4.0) == pytest.approx(4.0)
+    assert store.max("g", 100.0, now=4.0) == pytest.approx(8.0)
+    assert store.min("g", 100.0, now=4.0) == pytest.approx(0.0)
+    assert store.slope("g", 100.0, now=4.0) == pytest.approx(2.0)
+    # windowing: only the last 2 s of a kinked series
+    store.record("g", "gauge", 5.0, 0.0)
+    assert store.min("g", 1.5, now=5.0) == pytest.approx(0.0)
+
+
+def test_windowed_quantile_weights_the_window_not_the_lifetime():
+    """Three sampler snapshots of one summary: 100 observations at
+    10 ms, then two intervals of 100 at 1 s. A window covering only the
+    recent all-slow interval reads 1 s even at a low quantile, while a
+    window reaching back over the interval whose points still carry the
+    fast cluster reads lower — count-delta weighting at work."""
+    from analytics_zoo_tpu.observability import rehydrate_digest
+    reg = MetricsRegistry()
+    s = reg.summary("zoo_serving_e2e_quantiles_seconds", "t")
+    store = TimeSeriesStore(retention_s=100.0, sample_interval_s=1.0)
+    sampler = RegistrySampler(reg, store=store)
+    for _ in range(100):
+        s.observe(0.01)
+    sampler.sample_once(now=0.0)
+    for _ in range(100):
+        s.observe(1.0)
+    sampler.sample_once(now=10.0)
+    for _ in range(100):
+        s.observe(1.0)
+    sampler.sample_once(now=20.0)
+    key = "zoo_serving_e2e_quantiles_seconds"
+    # recent window: only the last interval's pair — all-slow traffic,
+    # so even the LOW quantile reads 1 s
+    q_recent = store.quantile(key, 0.25, window_s=11.0, now=20.0)
+    # full window: includes the interval whose quantile points still
+    # carry the early fast cluster, dragging the low quantile down
+    q_all = store.quantile(key, 0.25, window_s=25.0, now=20.0)
+    assert q_recent == pytest.approx(1.0)
+    assert q_all is not None and q_all < q_recent
+    # a window past all traffic falls back to the lifetime distribution
+    last = store.latest(key)[1]
+    lifetime = rehydrate_digest(last.points, last.count).quantile(0.25)
+    assert store.quantile(key, 0.25, window_s=0.5, now=20.0) \
+        == pytest.approx(lifetime)
+    # sampler also lands counters/gauges as plain series
+    reg.counter("zoo_serving_records_total", "t").inc(8)
+    sampler.sample_once(now=20.0)
+    assert "zoo_serving_records_total" in store.keys()
+
+
+# ---------------------------------------------------------------------------
+# alert engine
+# ---------------------------------------------------------------------------
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+
+    def write(self, event):
+        self.events.append(event)
+
+
+class _Value:
+    """A signals stub: every expr in these tests reads ``.v``."""
+
+    def __init__(self, v=None):
+        self.v = v
+
+
+def _transition_counts(reg, alert):
+    out = {}
+    for key, entry in reg.snapshot(compact=True).items():
+        if key.startswith("zoo_alert_transitions_total{") \
+                and f'alert="{alert}"' in key:
+            state = key.split('state="', 1)[1].split('"', 1)[0]
+            out[state] = entry["value"]
+    return out
+
+
+def test_alert_state_machine_exact_reconciliation():
+    reg = MetricsRegistry()
+    sink = _Sink()
+    reg.add_event_sink(sink)
+    rule = AlertRule("depth_high", lambda s: s.v, threshold=10.0,
+                     for_s=10.0, severity="page", summary="backlog")
+    eng = AlertEngine([rule], registry=reg, clock=lambda: 0.0)
+    sig = _Value()
+
+    all_transitions = []
+    sig.v = 5.0
+    all_transitions += eng.evaluate(sig, now=0.0)
+    assert eng.state("depth_high") == "inactive" and not all_transitions
+
+    sig.v = 50.0                                    # breach: pending
+    all_transitions += eng.evaluate(sig, now=0.0)
+    assert eng.state("depth_high") == "pending"
+    all_transitions += eng.evaluate(sig, now=5.0)   # held, no transition
+    assert eng.state("depth_high") == "pending"
+    all_transitions += eng.evaluate(sig, now=12.0)  # held >= for_s: firing
+    assert eng.state("depth_high") == "firing"
+    assert eng.firing() == ["depth_high"]
+    sig.v = 1.0                                     # recover: resolved
+    all_transitions += eng.evaluate(sig, now=20.0)
+    assert eng.state("depth_high") == "inactive"
+
+    # the three surfaces agree exactly: returned records, the
+    # transition counter, and the event log
+    assert [(t["state"], t["ts"]) for t in all_transitions] == [
+        ("pending", 0.0), ("firing", 12.0), ("resolved", 20.0)]
+    assert _transition_counts(reg, "depth_high") == {
+        "pending": 1.0, "firing": 1.0, "resolved": 1.0}
+    fired = [e for e in sink.events if e["kind"] == "alert.fire"]
+    resolved = [e for e in sink.events if e["kind"] == "alert.resolve"]
+    assert len(fired) == 1 and len(resolved) == 1
+    assert fired[0]["alert"] == "depth_high"
+    assert fired[0]["value"] == 50.0
+    assert fired[0]["threshold"] == 10.0
+    assert fired[0]["severity"] == "page"
+    # gauge tracks the state machine
+    snap = reg.snapshot(compact=True)
+    assert snap['zoo_alert_state{alert="depth_high"}']["value"] == 0.0
+
+
+def test_alert_pending_recovery_never_resolves():
+    """A breach shorter than ``for_s`` goes quietly back to inactive:
+    it never fired, so nothing pages and nothing 'resolves'."""
+    reg = MetricsRegistry()
+    rule = AlertRule("blip", lambda s: s.v, threshold=1.0, for_s=30.0)
+    eng = AlertEngine([rule], registry=reg, clock=lambda: 0.0)
+    sig = _Value(5.0)
+    t1 = eng.evaluate(sig, now=0.0)
+    sig.v = 0.0
+    t2 = eng.evaluate(sig, now=10.0)
+    assert [t["state"] for t in t1] == ["pending"] and t2 == []
+    assert eng.state("blip") == "inactive"
+    assert _transition_counts(reg, "blip") == {"pending": 1.0}
+
+
+def test_alert_no_data_and_broken_expr_never_breach():
+    reg = MetricsRegistry()
+
+    def boom(s):
+        raise RuntimeError("expr blew up")
+
+    eng = AlertEngine([
+        AlertRule("no_data", lambda s: None, threshold=0.0),
+        AlertRule("nan", lambda s: float("nan"), threshold=0.0),
+        AlertRule("broken", boom, threshold=0.0),
+        AlertRule("low", lambda s: 1.0, threshold=5.0, cmp="<"),
+    ], registry=reg, clock=lambda: 0.0)
+    transitions = eng.evaluate(_Value(), now=0.0)
+    assert [t["alert"] for t in transitions] == ["low"]    # cmp="<" fires
+    for name in ("no_data", "nan", "broken"):
+        assert eng.state(name) == "inactive"
+
+
+def test_alert_engine_rejects_duplicate_names():
+    with pytest.raises(ValueError):
+        AlertEngine([AlertRule("x", lambda s: 0.0, 1.0),
+                     AlertRule("x", lambda s: 0.0, 2.0)],
+                    registry=MetricsRegistry())
+
+
+class _CannedRates:
+    """Signals stub returning canned per-(family, window) rates — the
+    multi-window math under a microscope."""
+
+    def __init__(self, table):
+        self.table = table
+
+    def rate(self, family, window):
+        return self.table.get((family, window))
+
+
+def test_burn_rate_rule_takes_the_minimum_window():
+    rule = burn_rate_rule("burn", "bad", "good", slo=0.99,
+                          fast_s=300.0, slow_s=3600.0)
+    # fast window burning hot, slow window fine: min() holds the page
+    v = rule.expr(_CannedRates({("bad", 300.0): 1.0, ("good", 300.0): 1.0,
+                                ("bad", 3600.0): 0.001,
+                                ("good", 3600.0): 0.999}))
+    assert v == pytest.approx(0.1, rel=1e-6)      # slow ratio 0.001/0.01
+    assert not rule.breached(v)
+    # both windows burning: the min breaches 14.4
+    v = rule.expr(_CannedRates({("bad", 300.0): 1.0, ("good", 300.0): 1.0,
+                                ("bad", 3600.0): 0.5,
+                                ("good", 3600.0): 0.5}))
+    assert v == pytest.approx(50.0)
+    assert rule.breached(v)
+    # no data in either family: no-data, never a breach
+    assert rule.expr(_CannedRates({})) is None
+
+
+def test_default_ruleset_covers_the_documented_failure_modes():
+    names = {r.name for r in default_ruleset()}
+    assert names == {"publish_breaker_open", "dlq_growth", "shed_rate",
+                     "replica_down", "clock_skew", "fleet_saturated",
+                     "e2e_burn_rate"}
+    # StoreSignals over an empty store: every rule reads no-data or a
+    # non-breaching value — a cold engine never pages
+    eng = AlertEngine(default_ruleset(), registry=MetricsRegistry(),
+                      clock=lambda: 0.0)
+    eng.evaluate(StoreSignals(TimeSeriesStore(retention_s=10.0,
+                                              sample_interval_s=1.0),
+                              clock=lambda: 0.0), now=0.0)
+    assert eng.firing() == []
+
+
+# ---------------------------------------------------------------------------
+# end-to-end fleet proof
+# ---------------------------------------------------------------------------
+
+class _Double:
+    def predict(self, x):
+        return np.asarray(x) * 2.0
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10.0) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _get_text(url):
+    with urllib.request.urlopen(url, timeout=10.0) as r:
+        return r.read().decode("utf-8")
+
+
+def _family_total(families, name):
+    fam = families.get(name)
+    if not fam:
+        return 0.0
+    return sum(v for s_name, _lab, v in fam["samples"] if s_name == name)
+
+
+def _wait_until(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_fleet_collector_end_to_end_reconciles_exactly():
+    """The acceptance run: 3 in-process replicas on one stream, each
+    with ``serve_metrics`` mounted, a live collector discovering them
+    from the fleet registry. At every sample the fleet-summed
+    answered+shed+dead-lettered from ``/fleetz`` reconciles exactly
+    against the per-replica scrapes AND the produced count; the
+    windowed ``rate()`` matches the counter math; the Prometheus
+    re-export carries only ``zoo_*`` families."""
+    init_zoo_context()
+    backend = LocalBackend()
+    regs = [MetricsRegistry() for _ in range(3)]
+    servers = [ClusterServing(_Double(), backend=backend, registry=regs[i],
+                              batch_size=4, block_ms=20,
+                              consumer_name=f"tele-{i}",
+                              heartbeat_s=0.05)
+               for i in range(3)]
+    scrapes = [srv.serve_metrics(port=0) for srv in servers]
+    endpoints = [f"{sc.host}:{sc.port}" for sc in scrapes]
+    collector = None
+    fz = None
+    try:
+        for srv in servers:
+            srv.start()
+        now = [1000.0]
+        creg = MetricsRegistry()
+        collector = FleetCollector(
+            backend=backend, stream=INPUT_STREAM, registry=creg,
+            interval_s=1.0, clock=lambda: now[0])
+        fz = FleetzServer(collector, port=0)
+        # registry discovery: all 3 replicas advertise their scrape
+        # endpoint via heartbeats (each probe poll advances the fake
+        # clock so no two samples share a timestamp)
+        def _discovered():
+            now[0] += 1.0
+            return collector.poll() == 3
+
+        _wait_until(_discovered, msg="collector discovered 3 replicas")
+        page = _get_json(fz.url)
+        assert set(page["replicas"]) == set(endpoints)
+        assert all(r["source"] == "registry"
+                   for r in page["replicas"].values())
+
+        inq, outq = InputQueue(backend), OutputQueue(backend)
+        rng = np.random.default_rng(23)
+        produced = 0
+        totals_seen = []
+        for round_no in range(3):
+            for i in range(12):
+                inq.enqueue(f"t{round_no}-{i}",
+                            rng.normal(size=(6,)).astype(np.float32))
+            for i in range(12):
+                assert outq.query(f"t{round_no}-{i}",
+                                  timeout=30.0) is not None
+            produced += 12
+
+            # settle: every answered record's counter increment has
+            # landed in some replica registry before we reconcile
+            def _scrape_all():
+                return [parse_prometheus(
+                    _get_text(f"http://{ep}/metrics"))
+                    for ep in endpoints]
+
+            def _answered(fams_list):
+                return sum(
+                    _family_total(f, "zoo_serving_records_total")
+                    + _family_total(f, "zoo_serving_shed_total")
+                    + _family_total(f, "zoo_serving_dead_letter_total")
+                    for f in fams_list)
+
+            _wait_until(lambda: _answered(_scrape_all()) == produced,
+                        msg="per-replica counters settled")
+
+            now[0] += 30.0
+            assert collector.poll() == 3
+            replica_fams = _scrape_all()
+            page = _get_json(fz.url)
+            totals = page["fleet"]["totals"]
+
+            # fleet == sum(per-replica scrapes) == produced, exactly
+            fleet_answered = (
+                totals.get("zoo_serving_records_total", 0.0)
+                + sum(v for k, v in totals.items()
+                      if k.startswith("zoo_serving_shed_total"))
+                + totals.get("zoo_serving_dead_letter_total", 0.0))
+            assert fleet_answered == _answered(replica_fams) == produced
+            assert page["fleet"]["replicas_live"] == 3
+            totals_seen.append(totals.get("zoo_serving_records_total",
+                                          0.0))
+
+        # counters are monotonic across samples
+        assert totals_seen == sorted(totals_seen)
+        # windowed rate matches the counter math: 24 records over the
+        # last two 30 s sampling intervals
+        expected = (totals_seen[-1] - totals_seen[0]) / 60.0
+        rate = page["rates"]["zoo_serving_records_total"]
+        assert rate == pytest.approx(expected, rel=1e-6)
+        assert expected > 0
+
+        # the saturation block is the documented autoscaler surface
+        sat = page["saturation"]
+        for field in ("verdict", "saturated", "saturated_replicas",
+                      "replicas_live", "utilization",
+                      "utilization_mean", "utilization_trend",
+                      "depth", "depth_slope"):
+            assert field in sat
+        assert sat["verdict"] in ("scale_up", "steady", "scale_down")
+        assert sat["replicas_live"] == 3
+        assert set(sat["utilization"]) == set(endpoints)
+
+        # fleet quantiles: merged count-weighted, count == records
+        q = page["fleet"]["quantiles"].get(
+            "zoo_serving_e2e_quantiles_seconds")
+        assert q is not None and q["count"] == produced
+
+        # the /metrics re-export: aggregated zoo_* families only, and
+        # the summed counter round-trips through parse_prometheus
+        refams = parse_prometheus(_get_text(
+            f"http://{fz.host}:{fz.port}/metrics"))
+        assert _family_total(refams, "zoo_serving_records_total") \
+            == produced
+        assert not [f for f in refams if not f.startswith("zoo_")]
+        health = _get_json(f"http://{fz.host}:{fz.port}/healthz")
+        assert health["replicas_live"] == 3
+    finally:
+        if fz is not None:
+            fz.close()
+        if collector is not None:
+            collector.close()
+        for srv in servers:
+            srv.stop(drain=False)
+
+
+def test_burn_rate_alert_lifecycle_over_publish_outage():
+    """A publish outage on a scraped replica (failures counted against
+    ``zoo_serving_failure_errors_total{error="result publish failed"}``
+    while the record counter stalls) drives the multi-window burn-rate
+    alert inactive→pending→firing→resolved on a deterministic
+    fake-time schedule, with exact transition-counter
+    reconciliation."""
+    reg = MetricsRegistry()
+    records = reg.counter("zoo_serving_records_total", "t")
+    failures = reg.counter("zoo_serving_failure_errors_total", "t",
+                           labels={"error": "result publish failed"})
+    scrape = ScrapeServer(reg, port=0)
+    collector = None
+    try:
+        creg = MetricsRegistry()
+        sink = _Sink()
+        creg.add_event_sink(sink)
+        now = [0.0]
+        collector = FleetCollector(
+            endpoints=[f"{scrape.host}:{scrape.port}"],
+            registry=creg, interval_s=30.0, clock=lambda: now[0],
+            rules=[burn_rate_rule("e2e_burn_rate",
+                                  "zoo_serving_failure_errors_total",
+                                  "zoo_serving_records_total",
+                                  slo=0.99, for_s=60.0,
+                                  fast_s=300.0, slow_s=3600.0)])
+        states = {}
+
+        def step(dt, d_records, d_failures):
+            records.inc(d_records)
+            failures.inc(d_failures)
+            now[0] += dt
+            collector.poll()
+            states[now[0]] = collector.alerts.state("e2e_burn_rate")
+
+        step(0.0, 100, 0)               # t=0: baseline sample
+        step(30.0, 100, 0)              # t=30: healthy, rate known
+        assert states[30.0] == "inactive"
+        for t in (60.0, 90.0, 120.0, 150.0, 180.0):    # the outage
+            step(30.0, 50, 50)
+        assert states[60.0] == "pending"        # ratio 0.2 → burn 20
+        assert states[90.0] == "pending"        # held < for_s
+        assert states[120.0] == "firing"        # held 60 s
+        assert states[180.0] == "firing"
+        t = 180.0
+        while t < 480.0:                # recovery: failures stop
+            step(30.0, 100, 0)
+            t += 30.0
+        # the fast window has slid fully past the outage: burn == 0
+        assert states[480.0] == "inactive"
+        resolved_at = min(ts for ts, s in states.items()
+                          if ts > 180.0 and s == "inactive")
+
+        # exact reconciliation: counter == log == events
+        assert _transition_counts(creg, "e2e_burn_rate") == {
+            "pending": 1.0, "firing": 1.0, "resolved": 1.0}
+        assert [(tr["state"], tr["ts"])
+                for tr in collector.transitions_log] == [
+            ("pending", 60.0), ("firing", 120.0),
+            ("resolved", resolved_at)]
+        fire = [e for e in sink.events if e["kind"] == "alert.fire"]
+        resolve = [e for e in sink.events
+                   if e["kind"] == "alert.resolve"]
+        assert len(fire) == 1 and len(resolve) == 1
+        assert fire[0]["alert"] == "e2e_burn_rate"
+        assert fire[0]["value"] > 14.4
+    finally:
+        if collector is not None:
+            collector.close()
+        scrape.close()
+
+
+# ---------------------------------------------------------------------------
+# collector chaos: losing a replica mid-scrape
+# ---------------------------------------------------------------------------
+
+def test_collector_chaos_replica_loss_reconciles_against_plan():
+    """A ``collector.scrape`` disconnect plan drops one replica for
+    three consecutive polls: its breaker opens after exactly
+    ``failure_threshold`` failures (the next poll records
+    ``breaker_open`` WITHOUT reaching the fault site), fleet counter
+    totals never dip while the replica is dark (last-known values hold),
+    the ``replica_down`` alert fires after ``for_s`` and resolves when
+    the half-open probe succeeds — and ``plan.fired`` reconciles
+    exactly."""
+    init_zoo_context(faults_enabled=True)
+    rega = MetricsRegistry()
+    regb = MetricsRegistry()
+    ca = rega.counter("zoo_serving_records_total", "t")
+    cb = regb.counter("zoo_serving_records_total", "t")
+    ca.inc(100)
+    cb.inc(100)
+    sa, sb = ScrapeServer(rega, port=0), ScrapeServer(regb, port=0)
+    ep_a, ep_b = (f"{sa.host}:{sa.port}", f"{sb.host}:{sb.port}")
+    order = sorted([ep_a, ep_b])
+    idx_b = order.index(ep_b)           # B's slot in the scrape order
+    collector = None
+    try:
+        creg = MetricsRegistry()
+        now = [0.0]
+        from analytics_zoo_tpu.common.reliability import RetryPolicy
+        collector = FleetCollector(
+            endpoints=[ep_a, ep_b], registry=creg,
+            interval_s=30.0, clock=lambda: now[0],
+            retry=RetryPolicy(max_attempts=1),   # 1 attempt = 1 site fire
+            breaker_threshold=3, breaker_reset_s=2.0,
+            rules=[AlertRule("replica_down",
+                             lambda s: s.replicas_down(),
+                             threshold=0.5, for_s=60.0)])
+        target_b = collector._targets[ep_b]
+
+        # scrape order is sorted; each poll fires the site once per
+        # allowed target, so B's attempts in polls 2,3,4 are call
+        # indices 2+idx_b, 4+idx_b, 6+idx_b
+        plan = FaultPlan().add("collector.scrape", "disconnect",
+                               at=(2 + idx_b, 4 + idx_b, 6 + idx_b))
+        totals_by_poll = []
+
+        def poll():
+            ca.inc(10)                  # A keeps serving throughout
+            now[0] += 30.0
+            collector.poll()
+            totals_by_poll.append(
+                collector.fleet_totals()["zoo_serving_records_total"])
+
+        with faults.activate(plan):
+            poll()                                      # poll 1: both ok
+            assert collector.replicas_live() == 2
+            for _ in range(3):                          # polls 2-4: B dark
+                poll()
+            assert target_b.breaker.state == "open"
+            assert not target_b.healthy
+            assert collector.alerts.state("replica_down") == "firing"
+            poll()                                      # poll 5: open skips
+            time.sleep(2.1)                             # breaker reset
+            poll()                                      # poll 6: probe ok
+        assert target_b.healthy
+        assert target_b.breaker.state == "closed"
+        assert collector.alerts.state("replica_down") == "inactive"
+
+        # exact plan reconciliation: three disconnects at B's slots,
+        # and poll 5 never reached the site for B (breaker open)
+        assert plan.fired_at("collector.scrape") == [
+            ("collector.scrape", "disconnect", 2 + idx_b),
+            ("collector.scrape", "disconnect", 4 + idx_b),
+            ("collector.scrape", "disconnect", 6 + idx_b)]
+        assert plan.calls("collector.scrape") == 11    # 2+2+2+2+1+2
+
+        # scrape-outcome counters reconcile with the schedule
+        snap = creg.snapshot(compact=True)
+
+        def outcome(o):
+            return snap.get(
+                f'zoo_collector_scrapes_total{{outcome="{o}"}}',
+                {"value": 0.0})["value"]
+
+        assert outcome("error") == 3.0
+        assert outcome("breaker_open") == 1.0
+        assert outcome("ok") == 8.0
+        assert snap["zoo_collector_replicas_live"]["value"] == 2.0
+
+        # fleet counter totals are monotonic THROUGH the loss: B's
+        # last-known 100 holds while only A advances
+        assert totals_by_poll == sorted(totals_by_poll)
+        assert totals_by_poll == [210.0, 220.0, 230.0, 240.0, 250.0,
+                                  260.0]
+
+        # the alert lifecycle reconciles exactly: B unhealthy first at
+        # poll 2 (t=60), fired once held 60 s (t=120), resolved at the
+        # successful probe (t=180)
+        assert [(tr["state"], tr["ts"])
+                for tr in collector.transitions_log] == [
+            ("pending", 60.0), ("firing", 120.0), ("resolved", 180.0)]
+        assert _transition_counts(creg, "replica_down") == {
+            "pending": 1.0, "firing": 1.0, "resolved": 1.0}
+    finally:
+        if collector is not None:
+            collector.close()
+        sa.close()
+        sb.close()
+
+
+# ---------------------------------------------------------------------------
+# zoo-fleet CLI
+# ---------------------------------------------------------------------------
+
+def test_zoo_fleet_check_cli_exit_codes(tmp_path):
+    """``zoo-fleet check``: 0 against a healthy replica, 3 once a
+    second (dead) endpoint makes ``replica_down`` fire, 1 with nothing
+    reachable."""
+    import os
+    import subprocess
+    import sys
+
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(scripts) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+
+    reg = MetricsRegistry()
+    reg.counter("zoo_serving_records_total", "t").inc(5)
+    scrape = ScrapeServer(reg, port=0)
+    cli = os.path.join(scripts, "zoo-fleet")
+    try:
+        live = f"{scrape.host}:{scrape.port}"
+        r = subprocess.run([sys.executable, cli, "check", live],
+                           capture_output=True, text=True, env=env,
+                           timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "1 live" in r.stdout
+        assert "all inactive" in r.stdout
+
+        # a dead second endpoint: fleet still reachable, but the
+        # replica_down page fires → exit 3
+        r = subprocess.run([sys.executable, cli, "check", live,
+                            "127.0.0.1:59997"],
+                           capture_output=True, text=True, env=env,
+                           timeout=120)
+        assert r.returncode == 3, r.stderr[-2000:]
+        assert "replica_down" in r.stderr
+
+        # --json emits the /fleetz document
+        r = subprocess.run([sys.executable, cli, "check", live,
+                            "--json"],
+                           capture_output=True, text=True, env=env,
+                           timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        doc = json.loads(r.stdout)
+        assert doc["fleet"]["replicas_live"] == 1
+        assert "saturation" in doc and "alerts" in doc
+    finally:
+        scrape.close()
+    # nothing reachable → exit 1, the status-CLI contract
+    r = subprocess.run([sys.executable, cli, "check",
+                        f"{scrape.host}:{scrape.port}"],
+                       capture_output=True, text=True, env=env,
+                       timeout=120)
+    assert r.returncode == 1
+    assert "no replica reachable" in r.stderr

@@ -2249,6 +2249,46 @@ def test_zl017_label_key_mismatch(tmp_path):
     assert "stage" in fs[0].message and "phase" in fs[0].message
 
 
+def test_zl017_forwarding_helper_attributes_to_call_site(tmp_path):
+    """A ``*_counter`` forwarding shim (``mini_counter(reg, name, ...)``
+    → ``reg.counter(name, ...)``) registers whatever its CALLER names:
+    the inner call is not a site, the call site is — so an undocumented
+    name surfaces at the caller, and a doc row reconciles it."""
+    pkg = _mini_project(tmp_path)
+    (pkg / "helpers.py").write_text(
+        "def mini_counter(registry, name, help='', labels=None):\n"
+        "    return registry.counter(name, help, labels=labels)\n"
+        "\n"
+        "def use(reg):\n"
+        "    return mini_counter(reg, 'zoo_mini_helper_total',\n"
+        "                        'via shim')\n")
+    fs = _project_findings(tmp_path, pkg, select=["ZL017"])
+    assert len(fs) == 1 and "zoo_mini_helper_total" in fs[0].message
+    assert fs[0].path.endswith("helpers.py") and fs[0].line == 5
+    obs_md = tmp_path / "OBSERVABILITY.md"
+    obs_md.write_text(obs_md.read_text()
+                      + "| `zoo_mini_helper_total` | counter "
+                        "| via shim |\n")
+    assert not _project_findings(tmp_path, pkg, select=["ZL017"])
+
+
+def test_zl017_self_registering_wrapper_is_its_own_site(tmp_path):
+    """A ``*_counter``-named local that registers a CONSTANT name is
+    not a shim: its inner call stays the (single) site and its call
+    sites are skipped — one finding, anchored at the wrapper."""
+    pkg = _mini_project(tmp_path)
+    (pkg / "wrapper.py").write_text(
+        "def span_counter(reg):\n"
+        "    return reg.counter('zoo_mini_span_total',\n"
+        "                       'self-registered')\n"
+        "\n"
+        "def use(reg):\n"
+        "    return span_counter(reg)\n")
+    fs = _project_findings(tmp_path, pkg, select=["ZL017"])
+    assert len(fs) == 1 and "zoo_mini_span_total" in fs[0].message
+    assert fs[0].path.endswith("wrapper.py") and fs[0].line == 2
+
+
 def test_zl017_fstring_name_reconciles_as_wildcard(tmp_path):
     """`zoo_mini_{leaf}_seconds` must match the `zoo_mini_op_seconds`
     row — and with the row dropped, the pattern itself is reported."""

@@ -950,3 +950,65 @@ class RuleCatalogDrift(ProjectRule):
                     self.id, ERROR, entry.path, entry.line,
                     f"rule {rid} is documented here but no rule class "
                     f"declares it — prune the row or restore the rule")
+
+
+@register_project
+class AlertRulesetCatalogDrift(ProjectRule):
+    """**Alert-ruleset reconciliation (code↔OBSERVABILITY.md).** Every
+    alert rule constructed inside ``default_ruleset()`` (an
+    ``AlertRule(...)`` call or any ``*_rule(...)`` factory whose first
+    argument is the rule name) must have a row in OBSERVABILITY.md's
+    default-ruleset table (header ``rule``), and every documented rule
+    name a construction site — the table is the page/warn contract
+    operators hold the fleet plane to, and a silently-added or
+    silently-dropped rule is an undocumented page (or a documented one
+    that never fires)."""
+
+    id = "ZL029"
+    severity = ERROR
+
+    def check(self, project: ProjectContext) -> Iterator[Finding]:
+        path = project.catalog_path("metrics")
+        if path is None:
+            yield _missing_catalog(self, project, "metrics")
+            return
+        code: Dict[str, Tuple[str, int]] = {}
+        for ctx in project.modules:
+            for fn in ast.walk(ctx.tree):
+                if not (isinstance(fn, ast.FunctionDef)
+                        and fn.name == "default_ruleset"):
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    leaf = dotted(node.func)
+                    leaf = leaf.rsplit(".", 1)[-1] if leaf else ""
+                    if leaf != "AlertRule" and not leaf.endswith("_rule"):
+                        continue
+                    if not (node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            and isinstance(node.args[0].value, str)):
+                        continue
+                    code.setdefault(node.args[0].value,
+                                    (ctx.path, node.lineno))
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        doc: Dict[str, Tuple[str, int]] = {}
+        for cell, line, _rest in md_table_column(text, path, "rule"):
+            for tok in _cell_tokens(cell):
+                if re.match(r"[a-z][a-z0-9_]*\Z", tok):
+                    doc.setdefault(tok, (path, line))
+        for name, (cpath, cline) in sorted(code.items()):
+            if name not in doc:
+                yield Finding(
+                    self.id, ERROR, cpath, cline,
+                    f"alert rule '{name}' is built by default_ruleset "
+                    f"but has no row in {os.path.basename(path)}'s "
+                    f"default-ruleset table — an undocumented page")
+        for name, (dpath, dline) in sorted(doc.items()):
+            if name not in code:
+                yield Finding(
+                    self.id, ERROR, dpath, dline,
+                    f"alert rule '{name}' is documented here but "
+                    f"default_ruleset no longer builds it — prune the "
+                    f"row or restore the rule")

@@ -125,6 +125,18 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.telemetry.device_memory": True,  # poll jax.Device.memory_stats()
     #   into zoo_device_hbm_bytes and the /statusz device block
     #   (graceful no-op off-TPU)
+    # -- performance attribution: goodput ledger + profiler trigger ---------
+    "zoo.goodput.enabled": True,  # per-fit / per-replica GoodputLedger:
+    #   attribute every wall-clock second to an exclusive category
+    #   (zoo_goodput_ratio, zoo_badput_seconds_total{category=})
+    "zoo.profiler.dir": "",       # ProfilerTrigger trace-dir root
+    #   ("" = ./zoo-profiles); captures land in capture-NNNN-<trigger>/
+    "zoo.profiler.keep": 3,       # newest capture dirs retained; older
+    #   ones evicted after each arm (never the in-flight capture)
+    "zoo.profiler.duration_s": 10.0,  # time bound per capture (daemon
+    #   timer stops the trace); used when zoo.profiler.steps == 0
+    "zoo.profiler.steps": 0,      # >0 bounds a capture by step()
+    #   notifications from the hosting loop instead of wall time
     "zoo.log.level": "INFO",
 }
 

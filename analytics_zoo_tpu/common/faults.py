@@ -50,11 +50,15 @@ socket), the checkpoint writer's ``ckpt.write`` (per tree file) /
 ``ckpt.manifest`` / ``ckpt.rename`` (the manifest commit,
 ``utils/checkpoint.py``), the training loop's ``train.grads`` (one
 per dispatched optimizer step when the anomaly sentinels are armed —
-``pipeline/api/keras/training.py``), and the fleet collector's
+``pipeline/api/keras/training.py``), the fleet collector's
 ``collector.scrape`` (``observability/collector.py``: one fire per
 scrape attempt per target, retry attempts included — a disconnect
 plan drops a replica mid-scrape and the breaker/alert chaos tests
-reconcile against it).
+reconcile against it), and the profiler trigger's ``profiler.capture``
+(``observability/profiler.py``: one fire per capture-arm attempt,
+before the trace starts — a capture failure degrades to a counter
+bump + event and must never kill the serve/fit loop hosting the
+trigger).
 
 Determinism: each site keeps a 0-based call counter; a spec fires when
 its site's counter is in ``at`` (or, for rate-based specs, when the

@@ -250,7 +250,8 @@ class _ThreadedIterator:
 
 
 def prefetch_to_device(it: Iterator, mesh=None, *, buffer_size: int = 2,
-                       threaded: bool = True, sharding=None) -> Iterator:
+                       threaded: bool = True, sharding=None,
+                       ledger=None) -> Iterator:
     """Double-buffered device transfer: keep ``buffer_size`` batches already
     dispatched to the devices while the current one computes. ``device_put``
     is async in JAX, so this pipeline hides both host batch assembly (via the
@@ -258,7 +259,17 @@ def prefetch_to_device(it: Iterator, mesh=None, *, buffer_size: int = 2,
 
     ``sharding`` overrides the default leading-dim data sharding — used by the
     multi-step scan path, whose chunks are ``(K, batch, ...)`` and shard the
-    *second* axis."""
+    *second* axis.
+
+    ``ledger`` (a :class:`~..observability.goodput.GoodputLedger`)
+    attributes the step/data seam from inside the pipeline: time spent
+    in here — the blocking source pull (prefetch starvation) plus batch
+    assembly and transfer dispatch — is ``data_wait``; the consumer's
+    time between a yielded batch and its next ``next()`` is the
+    training step (``device_step``); spin-up before the first yield is
+    ``idle``. The notes run on the consumer's thread (generators
+    execute in their caller), which is exactly the thread the ledger
+    accounts."""
     if sharding is None:
         sharding = mesh_lib.batch_sharding(mesh)
 
@@ -267,16 +278,26 @@ def prefetch_to_device(it: Iterator, mesh=None, *, buffer_size: int = 2,
             lambda a: jax.device_put(jnp.asarray(a), sharding) if a is not None else None,
             item, is_leaf=lambda a: a is None or not isinstance(a, (list, tuple, dict)))
 
+    def note(category):
+        if ledger is not None:
+            ledger.note(category)
+
     src = _ThreadedIterator(it, buffer_size=buffer_size + 2) if threaded else it
     buf: collections.deque = collections.deque()
+    note("idle")                    # body first runs at the first next()
     try:
         for item in src:
             buf.append(put(item))
             if len(buf) > buffer_size:
+                note("data_wait")
                 yield buf.popleft()
+                note("device_step")
         while buf:
+            note("data_wait")
             yield buf.popleft()
+            note("device_step")
     finally:
+        note("data_wait")           # close the pipeline's own tail
         if threaded:
             src.close()
 

@@ -21,7 +21,12 @@ bounded ring-buffer time series with windowed ``rate``/``avg``/
 ``slope``/quantile queries (``timeseries``), the continuous fleet
 collector + ``/fleetz`` aggregate endpoint (``collector``), the
 declarative burn-rate alert engine (``alerts``), and device HBM
-telemetry (``device``).
+telemetry (``device``). The **performance-attribution layer**
+(docs/guides/OBSERVABILITY.md "Goodput & performance attribution")
+closes the loop from "what is happening" to "what it costs":
+goodput/badput wall-clock accounting per training run / serving
+replica (``goodput``) and alert-triggered bounded ``jax.profiler``
+captures (``profiler``).
 
 Instrumented layers: ``serving/server.py`` (stream depth, batch size,
 queue-wait/dispatch/e2e latency histograms + p50/p95/p99 summaries,
@@ -58,6 +63,10 @@ from .alerts import (AlertEngine, AlertRule, StoreSignals,
 from .collector import (FleetCollector, FleetSignals, FleetzServer,
                         base_url, endpoint_rows, fleet_rows,
                         summary_points)
+from .goodput import (GOOD_CATEGORY, SERVE_CATEGORIES, TRAIN_CATEGORIES,
+                      GoodputLedger, goodput_enabled)
+from .goodput import registry_snapshot as goodput_snapshot
+from .profiler import ProfilerTrigger
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "QuantileDigest", "Summary",
@@ -72,4 +81,7 @@ __all__ = [
     "quantile_burn_rule", "default_ruleset",
     "FleetCollector", "FleetSignals", "FleetzServer",
     "summary_points", "fleet_rows", "endpoint_rows", "base_url",
+    "GoodputLedger", "GOOD_CATEGORY", "TRAIN_CATEGORIES",
+    "SERVE_CATEGORIES", "goodput_enabled", "goodput_snapshot",
+    "ProfilerTrigger",
 ]

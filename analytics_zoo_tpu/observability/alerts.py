@@ -41,6 +41,7 @@ reconciliation with the rule name as the label value.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
@@ -132,6 +133,7 @@ class AlertEngine:
         self._state: Dict[str, str] = {}
         self._since: Dict[str, float] = {}       # pending start ts
         self._value: Dict[str, Optional[float]] = {}
+        self._hooks: List[Callable[[dict], None]] = []
         for r in self.rules:
             self._state[r.name] = INACTIVE
             alert_gauge(   # zoolint: disable=ZL015 bounded label set —
@@ -139,6 +141,15 @@ class AlertEngine:
                 self.registry, "zoo_alert_state",
                 "alert state machine: 0 inactive, 1 pending, 2 firing",
                 labels={"alert": r.name}).set(0.0)
+
+    def add_transition_hook(self, fn: Callable[[dict], None]) -> None:
+        """Register ``fn(transition)`` to run for every transition
+        record :meth:`evaluate` produces (e.g.
+        :meth:`~.profiler.ProfilerTrigger.on_alert` auto-captures a
+        trace when a rule fires). Hooks run after the evaluation lock
+        is released; a raising hook is logged-equivalent swallowed —
+        it can never wedge the alert plane."""
+        self._hooks.append(fn)
 
     # -- state machine -------------------------------------------------------
     def _enter(self, rule: AlertRule, state: str,
@@ -206,6 +217,15 @@ class AlertEngine:
                 elif state == FIRING and not breached:
                     self._enter(rule, "resolved", value, now,
                                 transitions)
+        for tr in transitions:      # outside the lock: hooks may call
+            for hook in self._hooks:            # back into the engine
+                try:
+                    hook(tr)
+                except Exception:   # a hook failure never wedges alerts
+                    logging.getLogger(
+                        "analytics_zoo_tpu.observability").warning(
+                        "alert transition hook failed for %r",
+                        tr.get("alert"), exc_info=True)
         return transitions
 
     # -- introspection -------------------------------------------------------
@@ -369,6 +389,17 @@ def quantile_burn_rule(name: str, family: str, q: float,
                 f"both windows")
 
 
+def _hbm_in_use_fraction(s) -> Optional[float]:
+    """in_use / limit over the ``zoo_device_hbm_bytes`` gauge family
+    (PR 17's device-memory telemetry); no-data until both kinds have
+    been sampled, and a zero limit (CPU hosts) reads as no-data too."""
+    used = s.gauge_sum("zoo_device_hbm_bytes", labels={"kind": "in_use"})
+    limit = s.gauge_sum("zoo_device_hbm_bytes", labels={"kind": "limit"})
+    if used is None or not limit:
+        return None
+    return used / limit
+
+
 def default_ruleset(for_s: float = 30.0,
                     shed_rate_threshold: float = 0.0,
                     replica_down_for_s: float = 10.0) -> List[AlertRule]:
@@ -409,6 +440,12 @@ def default_ruleset(for_s: float = 30.0,
             lambda s: s.saturated_fraction(),
             threshold=0.99, for_s=for_s, severity="page",
             summary="every live replica reports saturated"),
+        AlertRule(
+            "hbm_high_watermark",
+            _hbm_in_use_fraction,
+            threshold=0.92, for_s=for_s, severity="page",
+            summary="device HBM in_use above 92% of limit — next "
+                    "compile or batch-size step likely OOMs"),
         burn_rate_rule(
             "e2e_burn_rate", "zoo_serving_failure_errors_total",
             "zoo_serving_records_total", slo=0.99, for_s=for_s),

@@ -621,7 +621,7 @@ class FleetCollector:
                     .get("scaling") or {}
                 entry["scaling"] = {k: sc.get(k) for k in (
                     "consumer", "stream_depth", "pending_entries",
-                    "utilization", "batch_size_target")}
+                    "utilization", "batch_size_target", "goodput")}
             replicas[ep] = entry
         quantiles = {
             fam: {"count": s.count,

@@ -287,6 +287,7 @@ def _registry_value(reg: MetricsRegistry, name: str) -> float:
 class _ScrapeHandler(http.server.BaseHTTPRequestHandler):
     registry: MetricsRegistry = None  # type: ignore[assignment]
     health_fn: Optional[Callable[[], Dict[str, Any]]] = None
+    profiler: Optional[Any] = None     # a ProfilerTrigger, when mounted
     started_at: float = 0.0
 
     def _send(self, body: bytes, content_type: str, code: int = 200) -> None:
@@ -327,7 +328,21 @@ class _ScrapeHandler(http.server.BaseHTTPRequestHandler):
             mem = device_memory_stats()
             if mem:                     # off-TPU: absent beats lying zero
                 info["device"]["memory"] = mem
+        info["performance"] = self._performance_payload()
         return info
+
+    def _performance_payload(self) -> Dict[str, Any]:
+        """The goodput/attribution block: ratio + per-category badput
+        read back off the registry (so it works whether the ledger
+        lives in this process's fit loop or serve loop), plus the
+        in-flight profiler capture when one is mounted."""
+        from .goodput import registry_snapshot
+        perf: Dict[str, Any] = registry_snapshot(self.registry)
+        prof = type(self).profiler
+        if prof is not None:
+            perf["profiler"] = {"in_flight": prof.in_flight(),
+                                "trace_dir": prof.trace_dir}
+        return perf
 
     @staticmethod
     def _device_memory_enabled() -> bool:
@@ -353,6 +368,23 @@ class _ScrapeHandler(http.server.BaseHTTPRequestHandler):
         else:
             self.send_error(404)
 
+    def do_POST(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+        path = self.path.split("?", 1)[0]
+        if path != "/profilez":
+            self.send_error(404)
+            return
+        prof = type(self).profiler
+        if prof is None:
+            self._send(json.dumps(
+                {"armed": False, "error": "no profiler mounted"}
+            ).encode("utf-8"), "application/json", code=404)
+            return
+        cap_dir = prof.arm(trigger="http", reason="POST /profilez")
+        body = {"armed": cap_dir is not None, "dir": cap_dir,
+                "in_flight": prof.in_flight()}
+        self._send(json.dumps(body).encode("utf-8"), "application/json",
+                   code=200 if cap_dir is not None else 409)
+
     def log_message(self, *args):  # scrapes must not spam stderr
         pass
 
@@ -368,11 +400,19 @@ class ScrapeServer:
     dict merged into both payloads — ``ClusterServing.serve_metrics``
     passes its serve-loop introspection (stream depth, last-flush age)
     this way. It runs on the scrape thread, so it must be cheap and must
-    not take locks the serve loop holds across dispatches."""
+    not take locks the serve loop holds across dispatches.
+
+    ``/statusz`` additionally carries a ``performance`` block (goodput
+    ratio + per-category badput seconds read off the registry), and
+    passing ``profiler=`` (a :class:`~.profiler.ProfilerTrigger`)
+    mounts ``POST /profilez`` — arm a bounded trace capture over HTTP;
+    200 with the capture dir on success, 409 when one is already in
+    flight (or the start failed and degraded)."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  port: int = 0, host: str = "127.0.0.1",
-                 health_fn: Optional[Callable[[], Dict[str, Any]]] = None):
+                 health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 profiler: Optional[Any] = None):
         attrs: Dict[str, Any] = {
             "registry": registry if registry is not None
             else default_registry(),
@@ -380,6 +420,8 @@ class ScrapeServer:
         }
         if health_fn is not None:
             attrs["health_fn"] = staticmethod(health_fn)
+        if profiler is not None:
+            attrs["profiler"] = profiler
         handler = type("Handler", (_ScrapeHandler,), attrs)
         self._httpd = http.server.ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self._httpd.server_address[:2]

@@ -491,6 +491,18 @@ class TrainingLoop:
         self._anomalous_steps: set = set()   # {(epoch, ordinal)} flagged
         self._rollback_budget: Optional[RetryBudget] = None
         self._rollback_pending = False
+        # goodput/badput attribution (docs/guides/OBSERVABILITY.md
+        # "Goodput & performance attribution"): one ledger per fit,
+        # created at fit_feature_set entry when zoo.goodput.enabled
+        self._goodput = None
+        self._gp_restarting = False   # a retry attempt's resume pending
+
+    # -- goodput attribution -------------------------------------------------
+    def _gp_note(self, category: str) -> None:
+        """Attribute wall clock since the ledger's mark to ``category``
+        (no-op outside an accounted fit)."""
+        if self._goodput is not None:
+            self._goodput.note(category)
 
     # -- jitted steps -------------------------------------------------------
     #: the labels of the most recent fused-CE gauge write in this process —
@@ -1004,6 +1016,7 @@ class TrainingLoop:
             # same compiled model still produces an MFU reading
             return 0.0
         from ....utils import profiling
+        self._gp_note("device_step")    # close the step interval first
         t = time.perf_counter()
         try:
             flops = profiling.compiled_flops(fn.lower(*args).compile())
@@ -1012,6 +1025,7 @@ class TrainingLoop:
         # 0.0 latches "tried and unavailable" so the compile isn't retried
         self._flops_per_example = (
             flops / examples_per_dispatch if flops else 0.0)
+        self._gp_note("compile")
         return time.perf_counter() - t
 
     def _observe_fit_metrics(self, steps: int, dt: float,
@@ -1043,7 +1057,8 @@ class TrainingLoop:
         if keep is None:  # keep=0 means keep-all, so no falsy check
             keep = int(ctx.get("zoo.checkpoint.keep", 3))
         return CheckpointManager(spec["path"], keep=keep,
-                                 registry=self._registry)
+                                 registry=self._registry,
+                                 ledger=self._goodput)
 
     def _ckpt_trigger(self) -> Trigger:
         spec = getattr(self.model, "_checkpoint", None) or {}
@@ -1305,6 +1320,7 @@ class TrainingLoop:
         sen = self._sentinel_config()
         self._anomalous_steps = set()
         self._rollback_pending = False
+        self._gp_restarting = False
         self._rollback_budget = (
             RetryBudget(capacity=sen.max_rollbacks, deposit=0.0,
                         name="train.rollback", registry=self._registry)
@@ -1349,6 +1365,14 @@ class TrainingLoop:
                             "is not on the main thread; SIGTERM "
                             "checkpointing disabled for this fit")
         from ....utils import profiling
+        # goodput/badput ledger for this fit (zoo.goodput.enabled):
+        # every wall-clock second between here and the finally below is
+        # attributed to exactly one category
+        from ....observability.goodput import GoodputLedger, goodput_enabled
+        self._goodput = (GoodputLedger("train", registry=self._registry)
+                         if goodput_enabled() else None)
+        if self._goodput is not None:
+            self._goodput.open()
         try:
             with profiling.trace(profile_dir), span("train.fit",
                                                     registry=self._registry):
@@ -1360,6 +1384,8 @@ class TrainingLoop:
                     retry_times=retry_times, window_sec=window_sec,
                     attempts=attempts, window_start=window_start)
         finally:
+            # close the ledger's last open interval — teardown is idle
+            self._gp_note("idle")
             # the boundary clone holds whole param trees — never past fit
             self._boundary_ref = None
             self._segment_t0 = None
@@ -1411,6 +1437,8 @@ class TrainingLoop:
                         f"{rb} — rollback budget exhausted "
                         f"(zoo.train.max_rollbacks); the model holds the "
                         f"last known-good state") from rb
+                # unwind cost up to here is replay overhead on the ledger
+                self._gp_note("rollback_replay")
                 self._m_rollback.inc()
                 self._registry.emit("train.rollback", epoch=rb.epoch,
                                     skips=rb.skips,
@@ -1446,6 +1474,9 @@ class TrainingLoop:
                 log.warning("training step failed (attempt %d/%d); reloading "
                             "latest checkpoint and retrying", attempts,
                             retry_times, exc_info=True)
+                # failed-attempt unwind + upcoming reload is restart cost
+                self._gp_note("restart")
+                self._gp_restarting = True
                 # the next _fit_impl attempt restores params/opt_state from
                 # the latest snapshot via _try_resume
             except BaseException:
@@ -1550,6 +1581,12 @@ class TrainingLoop:
             params, opt_state, net_state, meta = self._try_resume(
                 mgr, params, opt_state, net_state, psh, repl,
                 allow_regress=rollback)
+            # restore work belongs to the recovery path that demanded
+            # it; a clean first attempt's resume probe is just spin-up
+            self._gp_note("rollback_replay" if rollback
+                          else "restart" if self._gp_restarting
+                          else "idle")
+            self._gp_restarting = False
             if meta is not None and meta.get("epoch") is not None:
                 resumed_epoch = int(meta["epoch"]) - (
                     0 if meta.get("epoch_finished") else 1)
@@ -1753,6 +1790,9 @@ class TrainingLoop:
 
         epoch = model.finished_epochs  # so nb_epoch=0 is a clean no-op
         for epoch in range(model.finished_epochs + 1, target_epoch + 1):
+            # epoch-boundary overhead (metrics, callbacks, validation of
+            # the previous epoch) since the last step lands on idle
+            self._gp_note("idle")
             t0 = time.time()
             losses = []
             n_seen = 0
@@ -1777,6 +1817,7 @@ class TrainingLoop:
                     params, opt_state, net_state, base_rng, it0, shuffle_rng,
                     xs_dev, ys_dev)
                 self._segment_end()
+                self._gp_note("device_step")   # whole-epoch dispatch
                 losses.append(l)
                 loop_state.iteration += n_steps
                 n_seen += n_steps * batch_size
@@ -1794,11 +1835,13 @@ class TrainingLoop:
                                           drop_last=True)
                 stream = prefetch_to_device(
                     _chunked(batches, scan_steps), self.mesh,
-                    sharding=mesh_lib.stacked_batch_sharding(self.mesh))
+                    sharding=mesh_lib.stacked_batch_sharding(self.mesh),
+                    ledger=self._goodput)
             else:
                 batches = fs.iter_batches(batch_size, epoch=ctx.seed + epoch,
                                           drop_last=True)
-                stream = prefetch_to_device(batches, self.mesh)
+                stream = prefetch_to_device(batches, self.mesh,
+                                            ledger=self._goodput)
             for bx_d, by_d in stream:
                 prev_iter = loop_state.iteration
                 k = jax.tree.leaves(bx_d)[0].shape[0] if scan_steps > 1 \
@@ -1814,6 +1857,7 @@ class TrainingLoop:
                     # original attempt
                     loop_state.iteration += k
                     monitor.note_replay_skip(k)
+                    self._gp_note("anomaly_skip")
                     if mgr is not None and _fired_within(
                             ckpt_trigger, loop_state, prev_iter):
                         self._save_checkpoint(mgr, loop_state, params,

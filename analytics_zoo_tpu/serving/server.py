@@ -558,6 +558,7 @@ class ClusterServing:
         self._last_flush_wall = None   # epoch s of the newest publish
         self._events = None         # JsonEventSink (set_json_events)
         self._scrape = None         # ScrapeServer (serve_metrics)
+        self._profiler = None       # ProfilerTrigger (serve_metrics)
         # -- reliability (docs/guides/RELIABILITY.md) -----------------------
         #: crashes each supervised loop survives per start() before the
         #: supervisor gives up and /healthz reads down
@@ -611,6 +612,15 @@ class ClusterServing:
                 "producer-stamped deadline",
                 labels={"reason": reason})
             for reason in ("depth", "deadline")}
+        # -- goodput attribution (docs/guides/OBSERVABILITY.md "Goodput
+        # & performance attribution"): the lane loop notes the
+        # read/shed/route/pump seams so every second of this replica's
+        # wall clock lands in exactly one category
+        if bool(self._conf("zoo.goodput.enabled", True)):
+            from ..observability.goodput import GoodputLedger
+            self._goodput = GoodputLedger("serve", registry=m)
+        else:
+            self._goodput = None
         #: AIMD batch-size control, off by default — `batch_size` is the
         #: ceiling, the live backlog/queue-wait signals drive the target
         self.adaptive_batch = bool(
@@ -905,16 +915,21 @@ class ClusterServing:
         registry — ``/metrics`` (Prometheus exposition), ``/healthz``
         (liveness + serve-loop state), ``/statusz`` (operator page:
         uptime, stream depth, last-flush age, jit-compile totals,
-        device info). Returns the :class:`ScrapeServer` (bound port on
+        device info, the goodput ``performance`` block) and ``POST
+        /profilez`` (arm a bounded profiler capture on this replica).
+        Returns the :class:`ScrapeServer` (bound port on
         ``.port``); closed automatically by :meth:`stop`. Pretty-print
         it from a shell with ``scripts/cluster-serving-status``.
         ``host="0.0.0.0"`` exposes it to an off-host Prometheus scraper
         (the default binds loopback only)."""
-        from ..observability import ScrapeServer
+        from ..observability import ProfilerTrigger, ScrapeServer
         if self._scrape is not None:
             self._scrape.close()
+        if self._profiler is None:
+            self._profiler = ProfilerTrigger(registry=self.metrics)
         self._scrape = ScrapeServer(self.metrics, port=port, host=host,
-                                    health_fn=self._health_info)
+                                    health_fn=self._health_info,
+                                    profiler=self._profiler)
         return self._scrape
 
     def _health_info(self) -> dict:
@@ -986,6 +1001,9 @@ class ClusterServing:
             "pending_entries": self._own_pending(),
             "utilization": round(self._utilization("health"), 4),
             "batch_size_target": overload["batch_size_target"],
+            "goodput": (None if self._goodput is None
+                        or self._goodput.wall() <= 0
+                        else round(self._goodput.ratio(), 4)),
         }
         # the models block: one row per lane — what the status CLI
         # renders per replica and rolls up fleet-wide. Reads are cheap
@@ -1323,6 +1341,9 @@ class ClusterServing:
         if self._scrape is not None:
             self._scrape.close()
             self._scrape = None
+        if self._profiler is not None:
+            self._profiler.close()   # stop an in-flight capture cleanly
+            self._profiler = None
         if self._events is not None:
             self.metrics.remove_event_sink(self._events)
             self._events.close()
@@ -1333,6 +1354,12 @@ class ClusterServing:
             self._dlq.close()
 
     # -- the loop -----------------------------------------------------------
+    def _gp_note(self, category: str) -> None:
+        """Attribute wall clock since the ledger's mark to ``category``
+        (no-op when goodput accounting is disabled)."""
+        if self._goodput is not None:
+            self._goodput.note(category)
+
     def _loop(self) -> None:
         """The continuous dispatch pipeline: per lane, up to
         ``max_inflight`` batches run their device time + dispatch
@@ -1346,6 +1373,8 @@ class ClusterServing:
         out a read window — the device idles only when the stream is
         truly empty."""
         lanes = self._lanes
+        if self._goodput is not None:
+            self._goodput.open()
         try:
             while not self._stop.is_set():
                 it0 = time.perf_counter()
@@ -1391,13 +1420,18 @@ class ClusterServing:
                         idle_s = time.perf_counter() - t_read
                     else:
                         entries = []
+                    # read wait (and the pre-read sweep) is idle time —
+                    # the device had nothing admitted to chew on
+                    self._gp_note("idle")
                     if not entries and not reclaimed and not buffered:
                         self._drain_all()
+                        self._gp_note("publish")
                         continue
                     if len(entries) > want_read:
                         admitted, shed = self._admit_fair(entries,
                                                           want_read)
                         self._shed(shed, reason="depth")
+                        self._gp_note("shed")
                         entries = admitted
                     entries = reclaimed + entries
                     # ONE depth probe per read feeds both the gauge and
@@ -1408,6 +1442,7 @@ class ClusterServing:
                     self._m_depth.set(depth)
                     routed = self._route(entries,
                                          n_reclaimed=len(reclaimed))
+                    self._gp_note("host_decode")
                     for name, items in routed.items():
                         lane = lanes[name]
                         lane.buffer.extend(items)
@@ -1417,6 +1452,7 @@ class ClusterServing:
                             self._update_batch_target(lane)
                     for lane in lanes.values():
                         self._pump(lane, depth)
+                    self._gp_note("device_dispatch")
                 finally:
                     # utilization accounting: everything this iteration
                     # did except the blocking read wait counts as busy;
@@ -1425,6 +1461,10 @@ class ClusterServing:
                     self._busy_s += max(
                         time.perf_counter() - it0 - idle_s, 0.0)
                     self._heartbeat()
+                    # residual per-iteration overhead (heartbeat,
+                    # breaker bookkeeping, error unwind) lands on idle
+                    # so no interval is ever left unattributed
+                    self._gp_note("idle")
         finally:
             # exit — clean stop, crash (the supervisor may restart us),
             # or kill: dispatch what was already admitted (the records

@@ -193,12 +193,19 @@ class CheckpointManager:
     """Directory of snapshots with asynchronous verified save,
     checksum-verified restore with corruption fallback, and pruning."""
 
-    def __init__(self, directory: str, keep: int = 3, registry=None):
+    def __init__(self, directory: str, keep: int = 3, registry=None,
+                 ledger=None):
         if keep < 0:
             raise ValueError(
                 f"keep must be >= 0 (0 = keep every snapshot), got {keep}")
         self.directory = directory
         self.keep = keep
+        # goodput attribution: when the owning loop passes its
+        # GoodputLedger, save() charges the SYNCHRONOUS window (join of
+        # the previous in-flight write + host snapshot + any sync write)
+        # to ckpt_stall; the async background write stays hidden — by
+        # construction only the stall the step path actually felt counts
+        self._ledger = ledger
         os.makedirs(directory, exist_ok=True)
         # -- async writer state (at most one save in flight) ---------------
         self._lock = threading.Lock()
@@ -287,6 +294,19 @@ class CheckpointManager:
         restore under a different mesh say so instead of guessing.
         Returns the final snapshot path (committed only once the
         manifest lands)."""
+        led = self._ledger
+        if led is not None:
+            # close the caller's interval first, then charge everything
+            # this call blocks on (join/snapshot/sync write) to
+            # ckpt_stall via the finally below
+            led.note(led.good)
+        try:
+            return self._save_blocking(step, trees, meta, sync, mesh)
+        finally:
+            if led is not None:
+                led.note("ckpt_stall")
+
+    def _save_blocking(self, step, trees, meta, sync, mesh) -> str:
         self.join()
         host = {name: _snapshot_leaves(tree) for name, tree in trees.items()}
         meta = {"step": step, **(meta or {})}

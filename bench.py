@@ -1361,6 +1361,13 @@ def main(argv=None):
     # zoo_device_hbm_bytes gauges ride along (no-op on CPU jax)
     sample_device_memory(default_registry())
     out["observability"] = default_registry().snapshot(compact=True)
+    # goodput/badput attribution rides along too: every accounted fit/
+    # serve loop in this round exported into the default registry, so
+    # the record says where the round's wall clock went, not just how
+    # fast the winners ran (docs/guides/OBSERVABILITY.md "Goodput &
+    # performance attribution")
+    from analytics_zoo_tpu.observability import goodput_snapshot
+    out["goodput"] = goodput_snapshot(default_registry())
     # serving latency percentiles, promoted out of the snapshot into ONE
     # top-level record (ms): p50/p95/p99 for queue-wait, dispatch, and
     # end-to-end are the numbers an SLO discussion actually quotes. Kept

@@ -285,7 +285,7 @@ def test_default_ruleset_covers_the_documented_failure_modes():
     names = {r.name for r in default_ruleset()}
     assert names == {"publish_breaker_open", "dlq_growth", "shed_rate",
                      "replica_down", "clock_skew", "fleet_saturated",
-                     "e2e_burn_rate"}
+                     "hbm_high_watermark", "e2e_burn_rate"}
     # StoreSignals over an empty store: every rule reads no-data or a
     # non-breaching value — a cold engine never pages
     eng = AlertEngine(default_ruleset(), registry=MetricsRegistry(),

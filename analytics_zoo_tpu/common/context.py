@@ -45,6 +45,7 @@ DEFAULT_CONF: Dict[str, Any] = {
     "zoo.pallas.cross_entropy": "auto",  # fused-CE forward kernel: auto (TPU) | true | false
     "zoo.pallas.block_sweep": False,     # one-shot on-device block sweep per kernel signature
     "zoo.pallas.vmem_budget_mb": 0,      # 0 = the per-core default (16 MiB) for block selection
+    "zoo.pallas.embed_gather": "auto",   # one-hot MXU expand-gather: auto (TPU) | true | false
     "zoo.rng.impl": "auto",              # auto (rbg on TPU) | default | rbg
     "zoo.seq.mode": "ring",              # seq-parallel routing: ring | ulysses | auto
     "zoo.seq.strict": False,             # fail (not warn) when attention can't ride the seq mesh
@@ -73,6 +74,12 @@ DEFAULT_CONF: Dict[str, Any] = {
     #   epoch escalate to rollback-to-last-good-checkpoint
     "zoo.train.max_rollbacks": 3,        # rollbacks per fit before the loop
     #   fails loudly with TrainingDiverged (RetryBudget-backed)
+    # -- out-of-core sharded embeddings (docs/guides/TRAINING.md)
+    "zoo.embed.sharded": "auto",         # row-partitioned dedup'd lookup for plain
+    #   Embedding layers: auto (model>1 and rows divide) | true | false
+    "zoo.embed.dedup": True,             # per-step unique-id dedup in the lookup
+    "zoo.embed.hot_rows_budget_mb": 64,  # device budget for the oocore hot tier
+    "zoo.embed.prefetch_depth": 2,       # staged plans ahead of the consuming step
     "zoo.metrics.flops": False,          # fit(): cost-analysis pass feeding the MFU gauge
     "zoo.failure.retry_times": 5,        # ≅ bigdl.failure.retryTimes (Topology.scala:1172)
     "zoo.failure.retry_window_sec": 3600,

@@ -58,7 +58,13 @@ reconcile against it), and the profiler trigger's ``profiler.capture``
 (``observability/profiler.py``: one fire per capture-arm attempt,
 before the trace starts — a capture failure degrades to a counter
 bump + event and must never kill the serve/fit loop hosting the
-trigger).
+trigger), and the out-of-core embedding cache's ``embed.host_fetch``
+(``ops/sharded_embedding.py``: one fire per batched host-RAM row fetch,
+whichever thread runs it — injected latency surfaces as ``data_wait``
+badput on the consuming step's ledger) / ``embed.prefetch`` (one fire
+per background plan-staging attempt in ``stream`` — an error degrades
+that batch to a synchronous fetch on the consumer thread, counted by
+``zoo_embed_prefetch_errors_total``, and must never wedge the step).
 
 Determinism: each site keeps a 0-based call counter; a spec fires when
 its site's counter is in ``at`` (or, for rate-based specs, when the

@@ -10,6 +10,12 @@ matrix-factorization tower (separate embeddings, elementwise product), concat
 TPU notes: both towers are embedding gathers feeding dense matmuls — the
 whole model is one fused XLA program on the MXU; embedding tables live in
 HBM and shard over the ``model`` axis when tensor parallelism is on.
+Production-scale tables opt into the out-of-core row-partitioned engine
+purely through configuration — ``zoo.embed.sharded`` upgrades the plain
+``Embedding`` gathers at step-build time (``keras/sharded_embed.py``:
+dedup'd unique-row gathers, sparse scatter-add grads, host-RAM cold
+tier) with no change to this model code; parity vs the dense lookup is
+asserted in ``tests/test_sharded_embedding.py``.
 """
 
 from __future__ import annotations

@@ -17,6 +17,11 @@ Inputs (by model_type):
   deep:        [ind_ids, embed_ids, continuous]
 (empty groups are omitted; ``ColumnFeatureInfo.input_arrays`` builds these
 from a column dict, the ``row2Sample`` role.)
+
+The per-column ``Embedding`` tables of the deep part ride the out-of-core
+sharded embedding engine (``zoo.embed.sharded``, ``keras/sharded_embed.py``)
+without model-code changes — tables row-partition over the ``model`` axis
+with dedup'd gathers and sparse scatter-add grads once they outgrow a chip.
 """
 
 from __future__ import annotations

@@ -5,5 +5,6 @@ Kernels auto-select interpreter mode off-TPU so the same code paths test on
 the CPU mesh."""
 
 from .cross_entropy import fused_ce_forward  # noqa: F401
+from .embedding import embed_expand  # noqa: F401
 from .flash_attention import flash_attention  # noqa: F401
 from .quantized import int8_matmul  # noqa: F401

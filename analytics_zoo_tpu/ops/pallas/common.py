@@ -163,6 +163,24 @@ def ce_vmem_bytes(block_n: int, block_v: int, hidden: int, itemsize: int,
                              compute=comp)
 
 
+def embed_gather_vmem_bytes(block_n: int, capacity: int, d: int,
+                            itemsize: int) -> int:
+    """Estimated per-grid-cell VMEM of the embedding expand-gather
+    kernel (``embedding.embed_expand``): the whole unique-row block +
+    the int32 index broadcast as operands, the expanded row window as
+    output, and the (block_n, capacity) one-hot selection tile the MXU
+    contraction holds live. The runtime budget fallback and zoolint's
+    static ZL024 check price through this one formula."""
+    cap = round_up(max(capacity, 1), LANES)
+    d_eff = round_up(max(d, 1), LANES)
+    bn = round_up(max(block_n, 1), SUBLANES)
+    ops = [((cap, d_eff), itemsize),            # unique-row block (whole)
+           ((bn, LANES), 4)]                    # inverse ids (int32)
+    outs = [((bn, d_eff), itemsize)]            # expanded rows
+    comp = [((bn, cap), itemsize)]              # one-hot selection tile
+    return kernel_vmem_bytes(operands=ops, outputs=outs, compute=comp)
+
+
 def ce_bwd_vmem_bytes(block_n: int, block_v: int, hidden: int,
                       itemsize: int, has_bias: bool = True) -> int:
     """Estimated per-grid-cell VMEM of the fused-CE BACKWARD kernel pair

@@ -153,7 +153,9 @@ def intercept_layer_calls(hook):
     to substitute the call, or ``None`` to run the layer normally. Used by
     the inference runtime for int8 activation calibration (record input
     ranges eagerly) and quantized execution (swap in ``quantized_call`` at
-    trace time), by the fused LM-head loss (head → identity) and by the
+    trace time), by the fused LM-head loss (head → identity), by the
+    sharded embedding engine (plain ``Embedding`` → row-partitioned
+    dedup'd lookup, ``keras/sharded_embed.py``) and by the
     pipeline-parallel step builder (block run → ``gpipe_apply``);
     sub-layers invoked *inside* wrapper layers (TimeDistributed,
     Bidirectional) are not dispatched through containers and stay float.

@@ -3,7 +3,8 @@ from .core import (Activation, Dense, Dropout, Flatten, Reshape, Permute,  # noq
                    RepeatVector, Merge, merge, Select, Squeeze, ExpandDim,
                    Narrow, Masking, GaussianNoise, GaussianDropout,
                    TimeDistributed, Highway, SparseDense, get_activation)
-from .embeddings import Embedding, SparseEmbedding, WordEmbedding  # noqa: F401
+from .embeddings import (Embedding, ShardedEmbedding, SparseEmbedding,  # noqa: F401
+                         WordEmbedding)
 from .normalization import BatchNormalization, LayerNorm, L2Normalize  # noqa: F401
 from .convolution import (AtrousConvolution1D, AtrousConvolution2D,  # noqa: F401
                           Convolution1D, Convolution2D, Cropping1D,

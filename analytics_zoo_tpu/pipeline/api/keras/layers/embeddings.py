@@ -1,9 +1,13 @@
 """Embedding layers — parity with ``keras/layers/Embedding.scala``,
 ``SparseEmbedding.scala``, ``WordEmbedding.scala``.
 
-TPU note: embedding lookup compiles to a gather from an HBM-resident table;
-for model-parallel meshes the table shards along the vocab axis and XLA turns
-the lookup into a sharded gather + psum.
+TPU note: embedding lookup compiles to a gather from an HBM-resident table.
+``Embedding`` shards the embedding (column) dim over ``model`` so the gather
+stays shard-local; :class:`ShardedEmbedding` row-partitions the table instead
+and owns the cross-shard merge explicitly (``ops/sharded_embedding.py`` —
+dedup'd gathers, sparse scatter-add grads). Plain ``Embedding`` layers can be
+upgraded to the sharded engine at step-build time without model-code changes
+via ``zoo.embed.sharded`` (``keras/sharded_embed.py``).
 """
 
 from __future__ import annotations
@@ -39,15 +43,45 @@ class Embedding(Layer):
         return {"embeddings": w}
 
     def param_sharding(self, params):
-        """Shard the embedding dim over ``model`` (the gather stays local to
-        each shard; rows are never split)."""
+        """Shard the embedding (column) dim over ``model`` — the gather
+        stays local to each shard. When ``output_dim`` doesn't divide by
+        the axis size, ``parallel.mesh.param_shardings`` falls back to
+        replicating the leaf and says so through its coalesced
+        replicated-fallback warning (``analytics_zoo_tpu.mesh``) — the
+        degradation is visible, not silent. Rows CAN be split instead:
+        :class:`ShardedEmbedding` (or the ``zoo.embed.sharded``
+        step-build upgrade, which flips this spec to row partitioning)
+        shards the vocab axis with explicit collectives."""
         from jax.sharding import PartitionSpec as P
         from .....parallel.mesh import MODEL_AXIS
+        if getattr(self, "_row_shard", False):
+            return {"embeddings": P(MODEL_AXIS, None)}
         return {"embeddings": P(None, MODEL_AXIS)}
 
     def call(self, params, x, *, training=False, rng=None):
         ids = x.astype(jnp.int32)
         return jnp.take(params["embeddings"], ids, axis=0)
+
+
+class ShardedEmbedding(Embedding):
+    """Row-partitioned out-of-core-capable embedding: the ``(V, D)``
+    table shards vocab-wise ``P(model, None)`` and the lookup runs
+    through ``ops.sharded_embedding.sharded_embedding_lookup`` — dedup'd
+    unique-row gathers (each distinct row crosses the interconnect
+    once), one explicit psum merge, and a sparse scatter-add VJP whose
+    optimizer cost is proportional to touched rows. Drop-in for
+    ``Embedding``; on a ``model == 1`` mesh the lookup degrades to the
+    unsharded dedup'd gather with identical numerics."""
+
+    def param_sharding(self, params):
+        from jax.sharding import PartitionSpec as P
+        from .....parallel.mesh import MODEL_AXIS
+        return {"embeddings": P(MODEL_AXIS, None)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        from .....ops.sharded_embedding import sharded_embedding_lookup
+        return sharded_embedding_lookup(params["embeddings"],
+                                        x.astype(jnp.int32))
 
 
 class SparseEmbedding(Layer):

@@ -1,0 +1,131 @@
+"""Sharded-embedding resolution — wires ``ops/sharded_embedding`` into
+the training loop without touching model code.
+
+``resolve_sharded_embeddings(model)`` recognizes plain ``Embedding``
+layers at step-build time (the ``fused_loss.resolve_fused_loss``
+pattern) and returns an ``engine.intercept_layer_calls`` hook that
+routes their container dispatch through the row-partitioned dedup'd
+lookup: the ``(V, D)`` table shards ``P(model, None)``, each distinct id
+crosses the interconnect once, and the backward is the sparse
+scatter-add VJP. NeuralCF / WideAndDeep / SessionRecommender opt in
+purely through configuration — their model code keeps calling the plain
+layer.
+
+Mode (``zoo.embed.sharded``: auto | true | false):
+
+* ``auto`` engages on a mesh with ``model > 1`` for tables whose row
+  count divides the axis size — the predicate under which
+  ``mesh.param_shardings`` can actually commit the ``P(model, None)``
+  row spec the intercepted lookup assumes;
+* explicit ``true`` engages every plain ``Embedding`` whenever
+  ``model > 1`` — an indivisible table is padded inside the lookup and
+  its param leaf rides ``param_shardings``'s coalesced
+  replicated-fallback warning, so the degradation is visible;
+* ``false`` disengages (the layer's own ``jnp.take`` path).
+
+Resolution happens ONCE per loop (``training._loss_application``), so
+every step builder compiles the same collective structure; engaged
+layers get ``_row_shard`` flipped BEFORE ``param_shardings`` reads the
+spec tree (step build precedes sharding resolution in ``fit``). The
+``zoo_embed_sharded_tables`` gauge reports how many tables are live on
+the sharded engine.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+log = logging.getLogger("analytics_zoo_tpu.training")
+
+
+def find_embeddings(model) -> List[object]:
+    """The container-dispatched plain ``Embedding`` layers of ``model``
+    (exactly ``Embedding`` — ``ShardedEmbedding`` already routes through
+    the engine itself; ``SparseEmbedding``/``WordEmbedding`` don't
+    gather trainable rows by id)."""
+    from .engine import Model, Sequential
+    from .layers.embeddings import Embedding
+
+    if isinstance(model, Sequential):
+        layers = list(model.layers)
+    elif isinstance(model, Model):
+        layers = [n.layer for n in model._topo]
+    else:
+        # ZooModel facade (NeuralCF, WideAndDeep, ...): the layers live
+        # in the wrapped graph — the ``fused_head()`` see-through idiom
+        inner = getattr(model, "model", None)
+        if inner is not None and inner is not model:
+            return find_embeddings(inner)
+        layers = []
+    out, seen = [], set()
+    for layer in layers:
+        if type(layer) is Embedding and id(layer) not in seen:
+            seen.add(id(layer))
+            out.append(layer)
+    return out
+
+
+def _mode() -> str:
+    from ....common.context import tri_state_conf
+    flag = tri_state_conf("zoo.embed.sharded")
+    if flag == "auto":
+        return "auto"
+    return "on" if flag else "off"
+
+
+def resolve_sharded_embeddings(model) -> Optional[Callable]:
+    """The layer-dispatch intercept hook for ``model``'s embeddings when
+    the sharded engine applies, else None. Flips ``_row_shard`` on every
+    engaged layer whose row count divides the ``model`` axis so
+    ``param_shardings`` commits the row partitioning the lookup's
+    shard_map in_specs declare."""
+    from ....ops.sharded_embedding import (model_row_shard_count,
+                                           sharded_embedding_lookup)
+
+    mode = _mode()
+    if mode == "off":
+        return None
+    candidates = find_embeddings(model)
+    if not candidates:
+        return None
+    try:
+        n_model = model_row_shard_count()
+    except Exception:  # zoolint: disable=ZL007 no mesh constructible
+        n_model = 1
+    if n_model <= 1:
+        return None
+    if mode == "auto":
+        engaged = [l for l in candidates if l.input_dim % n_model == 0]
+    else:
+        engaged = list(candidates)
+    if not engaged:
+        return None
+    for layer in engaged:
+        layer._row_shard = layer.input_dim % n_model == 0
+    indivisible = sum(1 for l in engaged if not l._row_shard)
+    log.info(
+        "sharded embedding engine engaged for %d table(s) over model=%d"
+        "%s", len(engaged), n_model,
+        f" ({indivisible} padded, param leaf replicated)"
+        if indivisible else "")
+    _record_engaged(len(engaged))
+    engaged_ids = frozenset(id(l) for l in engaged)
+
+    def hook(layer, params, state, x, training, rng):
+        if id(layer) not in engaged_ids:
+            return None
+        import jax.numpy as jnp
+        out = sharded_embedding_lookup(params["embeddings"],
+                                       x.astype(jnp.int32))
+        return out, state
+
+    return hook
+
+
+def _record_engaged(n: int) -> None:
+    from ....observability import default_registry
+    default_registry().gauge(
+        "zoo_embed_sharded_tables",
+        "embedding tables routed through the row-partitioned sharded "
+        "lookup in the live training loop").set(n)

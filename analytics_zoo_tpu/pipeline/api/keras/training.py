@@ -529,12 +529,27 @@ class TrainingLoop:
         from .fused_loss import resolve_fused_loss
         from .seq_pipe import (pipe_intercept, resolve_pipe_spec,
                                resolve_seq_attention, seq_attention_scope)
+        from .sharded_embed import resolve_sharded_embeddings
         # sequence/pipeline step integration (zoo.train.seq_attention /
         # zoo.train.pipe_stages): resolved once per loop like the fused
         # loss, applied as trace-time scopes around every builder's
         # forward so existing models ride seq/pipe meshes unchanged
         seq_mode = resolve_seq_attention()
         pipe_spec = resolve_pipe_spec(model)
+        # row-sharded embedding engine (zoo.embed.sharded): resolved once
+        # per loop too — it flips engaged layers' param spec to row
+        # partitioning, which must happen before fit resolves shardings
+        embed_hook = resolve_sharded_embeddings(model)
+
+        def embed_scope():
+            # intercept_layer_calls(None) would DISABLE outer scopes for
+            # the duration — only open a scope when a hook resolved
+            import contextlib
+
+            from .engine import intercept_layer_calls
+            if embed_hook is None:
+                return contextlib.nullcontext()
+            return intercept_layer_calls(embed_hook)
         spec = resolve_fused_loss(model, loss_fn)
         prev = TrainingLoop._last_fused_labels
         if spec is None:
@@ -549,7 +564,8 @@ class TrainingLoop:
 
             def apply_loss(p, net_state, x, y, rng):
                 with seq_attention_scope(seq_mode), \
-                        pipe_intercept(pipe_spec, p, training=True):
+                        pipe_intercept(pipe_spec, p, training=True), \
+                        embed_scope():
                     yp, ns = model.apply(p, net_state, x, training=True,
                                          rng=rng)
                 return loss_fn(y, yp), ns
@@ -574,8 +590,11 @@ class TrainingLoop:
         TrainingLoop._last_fused_labels = labels
 
         def apply_loss(p, net_state, x, y, rng):
+            # scopes chain: the fused head's own intercept (opened inside
+            # apply_and_loss) composes with the embedding hook
             with seq_attention_scope(seq_mode), \
-                    pipe_intercept(pipe_spec, p, training=True):
+                    pipe_intercept(pipe_spec, p, training=True), \
+                    embed_scope():
                 return spec.apply_and_loss(model, p, net_state, x, y,
                                            rng=rng)
         self._apply_loss = apply_loss

@@ -51,6 +51,9 @@ CASES = {
     "TimeDistributed": (lambda: L.TimeDistributed(L.Dense(5)), (3, 4), "float"),
     "Highway": (lambda: L.Highway(), (4,), "float"),
     "Embedding": (lambda: L.Embedding(7, 6), (3,), "int"),
+    # row-sharded engine: on the default model=1 mesh this is the
+    # unsharded dedup'd lookup, numerically the plain gather
+    "ShardedEmbedding": (lambda: L.ShardedEmbedding(7, 6), (3,), "int"),
     # multi-hot bag over the vocab (not id list): input width = vocab size
     "SparseEmbedding": (lambda: L.SparseEmbedding(7, 6), (7,), "float"),
     "WordEmbedding": (lambda: L.WordEmbedding(

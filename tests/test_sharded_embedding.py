@@ -1,0 +1,468 @@
+"""Out-of-core sharded embedding engine (``ops/sharded_embedding.py``) —
+numerical parity against the plain ``jnp.take`` oracle (f32 bit-exact
+forward, scatter-add grads at float tolerance), the host-RAM cold tier,
+the ``embed.host_fetch`` / ``embed.prefetch`` chaos drills, and the
+keras wiring (``keras/sharded_embed.py`` + ``zoo.embed.sharded``)."""
+
+import logging
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.common.context import (init_zoo_context,
+                                              reset_zoo_context)
+from analytics_zoo_tpu.common.faults import FaultPlan
+from analytics_zoo_tpu.observability import MetricsRegistry
+from analytics_zoo_tpu.ops.sharded_embedding import (
+    EmbeddingFetchPlan, OutOfCoreEmbeddingCache, dedup_capacity,
+    dedup_embedding_lookup, oocore_gather, sharded_embedding_lookup)
+
+GTOL = dict(rtol=1e-6, atol=1e-5)
+
+
+def _fams(reg):
+    out = {}
+    for m in reg.metrics():
+        out[m.name] = out.get(m.name, 0.0) + m.value
+    return out
+
+
+def _table_ids(v=96, d=16, n=(4, 7), seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    table = jnp.asarray(rng.normal(size=(v, d)).astype(dtype))
+    ids = jnp.asarray(rng.integers(0, v, size=n).astype(np.int32))
+    return table, ids
+
+
+# ---------------------------------------------------------------------------
+# capacity bucketing (the PR-13 retrace guard)
+# ---------------------------------------------------------------------------
+
+def test_dedup_capacity_buckets():
+    # floor 8, pow2 bucketing, capped at the sublane-rounded id count
+    assert dedup_capacity(1, 10) == 8
+    assert dedup_capacity(100, 50) == 64     # vocab-bounded → pow2 bucket
+    assert dedup_capacity(100, 1000) == 104  # id-count cap round_up(100, 8)
+    assert dedup_capacity(1000, 1000) == 1000
+    # nearby problem sizes share a compiled shape once the vocab bounds
+    # the bucket (the id-count cap otherwise tracks the sublane rounding)
+    assert dedup_capacity(520, 512) == dedup_capacity(1000, 512) == 512
+    # NEVER below the worst-case unique count — jnp.unique can't truncate
+    for n in (1, 7, 65, 513, 4097):
+        for v in (8, 100, 8192):
+            assert dedup_capacity(n, v) >= min(n, v)
+
+
+# ---------------------------------------------------------------------------
+# unsharded dedup'd lookup (model == 1)
+# ---------------------------------------------------------------------------
+
+def test_dedup_lookup_matches_take_bit_exact():
+    init_zoo_context()
+    table, ids = _table_ids()
+    # repeated ids in every batch row — the dedup path must expand back
+    ids = ids.at[:, :3].set(ids[0, 0])
+    out = dedup_embedding_lookup(table, ids)
+    ref = jnp.take(table, ids, axis=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_dedup_lookup_out_of_range_ids_clamp():
+    init_zoo_context()
+    table, _ = _table_ids(v=31)
+    ids = jnp.asarray([-5, 0, 30, 31, 1000], jnp.int32)
+    out = dedup_embedding_lookup(table, ids)
+    ref = jnp.take(table, jnp.clip(ids, 0, 30), axis=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_dedup_lookup_grads_match_dense_transpose():
+    """Sparse scatter-add VJP == the dense take transpose: repeated ids
+    collide additively (f32 accumulation), untouched rows get exact
+    zeros, and nothing dense of shape (V, D) is ever needed."""
+    init_zoo_context()
+    table, ids = _table_ids()
+    ids = ids.at[:, :3].set(ids[0, 0])
+    gd = jax.grad(lambda t: jnp.sum(jnp.sin(
+        dedup_embedding_lookup(t, ids))))(table)
+    gr = jax.grad(lambda t: jnp.sum(jnp.sin(
+        jnp.take(t, ids, axis=0))))(table)
+    np.testing.assert_allclose(np.asarray(gd), np.asarray(gr), **GTOL)
+    # untouched rows: exactly zero, not merely small
+    touched = np.zeros(table.shape[0], bool)
+    touched[np.asarray(ids).reshape(-1)] = True
+    assert np.all(np.asarray(gd)[~touched] == 0.0)
+
+
+# ---------------------------------------------------------------------------
+# row-sharded lookup (model > 1) — explicit-collective custom VJP
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("meshkw", [
+    {"mesh_model": 2},
+    {"mesh_data": 4, "mesh_model": 2},
+    {"mesh_data": 2, "mesh_model": 2, "mesh_seq": 2},
+])
+def test_sharded_lookup_matches_take(meshkw):
+    """Forward is a bit-exact SELECT (non-owners psum exact zeros), the
+    backward the same scatter-adds the dense transpose performs — on
+    every row-sharding mesh shape."""
+    reset_zoo_context()
+    init_zoo_context(**meshkw)
+    table, ids = _table_ids()
+    ids = ids.at[:, :3].set(ids[0, 0])
+    out = sharded_embedding_lookup(table, ids)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(jnp.take(table, ids, axis=0)))
+    gs = jax.grad(lambda t: jnp.sum(jnp.sin(
+        sharded_embedding_lookup(t, ids))))(table)
+    gr = jax.grad(lambda t: jnp.sum(jnp.sin(
+        jnp.take(t, ids, axis=0))))(table)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gr), **GTOL)
+
+
+def test_sharded_lookup_indivisible_vocab_pads():
+    """V=97 under model=2: the table pads internally; pad rows are never
+    gathered and their grad slots transpose to the sliced-off region."""
+    reset_zoo_context()
+    init_zoo_context(mesh_model=2)
+    table, _ = _table_ids(v=97, seed=3)
+    ids = jnp.asarray(
+        np.random.default_rng(3).integers(0, 97, size=(30,)).astype(
+            np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(sharded_embedding_lookup(table, ids)),
+        np.asarray(jnp.take(table, ids, axis=0)))
+    g1 = jax.grad(lambda t: jnp.sum(jnp.cos(
+        sharded_embedding_lookup(t, ids))))(table)
+    g2 = jax.grad(lambda t: jnp.sum(jnp.cos(
+        jnp.take(t, ids, axis=0))))(table)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), **GTOL)
+
+
+def test_sharded_lookup_bf16():
+    """bf16 table: the forward stays the bit-exact select (bf16→f32→bf16
+    round-trips exactly through the psum of zeros); grads carry the f32
+    accumulation vs the oracle's bf16 scatter — tolerance, not bits."""
+    reset_zoo_context()
+    init_zoo_context(mesh_model=2)
+    table, ids = _table_ids(dtype=np.float32)
+    table = table.astype(jnp.bfloat16)
+    out = sharded_embedding_lookup(table, ids)
+    ref = jnp.take(table, ids, axis=0)
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32))
+    gs = jax.grad(lambda t: jnp.sum(jnp.sin(
+        sharded_embedding_lookup(t, ids).astype(jnp.float32))))(table)
+    gr = jax.grad(lambda t: jnp.sum(jnp.sin(
+        jnp.take(t, ids, axis=0).astype(jnp.float32))))(table)
+    np.testing.assert_allclose(np.asarray(gs, np.float32),
+                               np.asarray(gr, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_sharded_lookup_dedup_off_and_capacity_guard():
+    reset_zoo_context()
+    init_zoo_context(mesh_model=2)
+    table, ids = _table_ids()
+    out = sharded_embedding_lookup(table, ids, dedup=False)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(jnp.take(table, ids, axis=0)))
+    # a capacity below the worst-case per-shard unique count would let
+    # jnp.unique silently truncate — refused loudly instead
+    with pytest.raises(ValueError, match="silently truncate"):
+        sharded_embedding_lookup(table, ids, capacity=4)
+
+
+# ---------------------------------------------------------------------------
+# host-RAM cold tier
+# ---------------------------------------------------------------------------
+
+def _cache(v=200, d=8, hot_rows=64, seed=5, **kw):
+    rng = np.random.default_rng(seed)
+    table = rng.normal(size=(v, d)).astype(np.float32)
+    reg = MetricsRegistry()
+    cache = OutOfCoreEmbeddingCache(table, hot_rows=hot_rows,
+                                    registry=reg, **kw)
+    return table, cache, reg
+
+
+def test_oocore_plan_rows_match_take():
+    table, cache, reg = _cache()
+    # hot-tier ids, cold-tier ids, repeats, out-of-range — one batch
+    ids = np.array([0, 3, 3, 63, 64, 150, 150, 199, 400, -2])
+    plan = cache.plan(ids)
+    np.testing.assert_array_equal(
+        np.asarray(cache.rows(plan)), table[np.clip(ids, 0, 199)])
+    fams = _fams(reg)
+    assert fams["zoo_embed_ids_total"] == ids.size
+    # uniq after clamp: {0, 3, 63, 64, 150, 199} → 4 repeats saved
+    assert fams["zoo_embed_dedup_saved_rows_total"] == 4
+    assert fams["zoo_embed_cache_misses_total"] == 3  # 64, 150, 199
+    # a replay is all hits: the staged LRU serves the cold rows
+    cache.plan(ids)
+    fams = _fams(reg)
+    assert fams["zoo_embed_cache_misses_total"] == 3
+
+
+def test_oocore_host_tier_only_ids():
+    """Every id beyond the hot tier — including hot_rows=0, where the
+    WHOLE table is host-resident."""
+    table, cache, _ = _cache()
+    ids = np.arange(64, 128)
+    plan = cache.plan(ids)
+    np.testing.assert_array_equal(np.asarray(cache.rows(plan)),
+                                  table[ids])
+    table0, cache0, _ = _cache(hot_rows=0)
+    plan0 = cache0.plan(ids)
+    assert cache0.hot.shape[0] == 0
+    np.testing.assert_array_equal(np.asarray(cache0.rows(plan0)),
+                                  table0[ids])
+
+
+def test_oocore_grad_reconstruction_matches_take():
+    """grad through oocore_gather, reassembled dense by scatter_grad ==
+    the oracle's take transpose — the two-tier split is invisible to
+    the optimizer."""
+    table, cache, _ = _cache()
+    ids = np.random.default_rng(9).integers(0, 200, size=(64,))
+    plan = cache.plan(ids)
+    gh, gc = jax.grad(
+        lambda h, c: jnp.sum(jnp.sin(
+            oocore_gather(h, c, jnp.asarray(plan.remap)))),
+        argnums=(0, 1))(cache.hot, jnp.asarray(plan.cold))
+    dw = plan.scatter_grad(gh, gc)
+    dw_ref = jax.grad(lambda t: jnp.sum(jnp.sin(
+        jnp.take(t, jnp.asarray(ids), axis=0))))(jnp.asarray(table))
+    np.testing.assert_allclose(dw, np.asarray(dw_ref), **GTOL)
+
+
+def test_oocore_stream_prefetches_and_counts():
+    table, cache, reg = _cache()
+    rng = np.random.default_rng(11)
+    # skewed ids: plenty of per-batch repeats → dedup savings must show
+    batches = [rng.integers(0, 40, size=(128,)) for _ in range(6)]
+    seen = 0
+    for ids, plan in cache.stream(iter(batches)):
+        np.testing.assert_array_equal(np.asarray(cache.rows(plan)),
+                                      table[np.clip(ids, 0, 199)])
+        seen += 1
+    assert seen == len(batches)
+    fams = _fams(reg)
+    assert fams["zoo_embed_dedup_saved_rows_total"] > 0
+    assert fams["zoo_embed_ids_total"] == 6 * 128
+    assert fams["zoo_embed_prefetch_errors_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos drills — embed.host_fetch / embed.prefetch (RELIABILITY.md rows)
+# ---------------------------------------------------------------------------
+
+def test_fault_host_fetch_latency_charged_to_data_wait():
+    """A latency fault on ``embed.host_fetch`` stalls the prefetch
+    thread; the consumer's blocked pull is charged to ``data_wait`` on
+    the ledger — slow host fetches surface as badput, never vanish."""
+    from analytics_zoo_tpu.observability.goodput import GoodputLedger
+    reset_zoo_context()
+    init_zoo_context(faults_enabled=True)
+    delay = 0.4
+    table, cache, reg = _cache()
+    ledger = GoodputLedger("train", registry=reg)
+    plan = FaultPlan(seed=7).add("embed.host_fetch", "latency",
+                                 at=(0,), delay_s=delay)
+    batches = [np.arange(64, 128), np.arange(100, 160)]
+    with faults.activate(plan):
+        for ids, p in cache.stream(iter(batches), ledger=ledger):
+            np.testing.assert_array_equal(np.asarray(cache.rows(p)),
+                                          table[ids])
+    assert plan.fired_at("embed.host_fetch") == \
+        [("embed.host_fetch", "latency", 0)]
+    waited = ledger.seconds()["data_wait"]
+    assert waited >= 0.5 * delay, \
+        f"injected {delay}s host-fetch stall, data_wait saw {waited}s"
+
+
+def test_fault_prefetch_error_degrades_to_sync_fetch():
+    """An error fault on ``embed.prefetch`` kills individual staging
+    attempts; every batch still arrives (rebuilt synchronously on the
+    consumer) and the degradations are counted — a step can stall,
+    never wedge."""
+    reset_zoo_context()
+    init_zoo_context(faults_enabled=True)
+    table, cache, reg = _cache()
+    rng = np.random.default_rng(13)
+    batches = [rng.integers(0, 200, size=(64,)) for _ in range(5)]
+    plan = FaultPlan(seed=7).add("embed.prefetch", "error", at=(0, 2))
+    with faults.activate(plan):
+        seen = 0
+        for ids, p in cache.stream(iter(batches)):
+            np.testing.assert_array_equal(np.asarray(cache.rows(p)),
+                                          table[np.clip(ids, 0, 199)])
+            seen += 1
+    assert seen == len(batches)
+    fired = plan.fired_at("embed.prefetch")
+    assert [f[2] for f in fired] == [0, 2]
+    fams = _fams(reg)
+    assert fams["zoo_embed_prefetch_errors_total"] == len(fired)
+
+
+# ---------------------------------------------------------------------------
+# keras wiring — layers, resolution, fallback visibility, fit parity
+# ---------------------------------------------------------------------------
+
+def test_sharded_embedding_layer_parity():
+    from analytics_zoo_tpu.parallel.mesh import MODEL_AXIS
+    from analytics_zoo_tpu.pipeline.api.keras.layers import ShardedEmbedding
+    from jax.sharding import PartitionSpec as P
+    reset_zoo_context()
+    init_zoo_context(mesh_model=2)
+    layer = ShardedEmbedding(64, 8, input_shape=(5,))
+    params = layer.build(jax.random.PRNGKey(0), (None, 5))
+    assert layer.param_sharding(params) == {"embeddings": P(MODEL_AXIS,
+                                                            None)}
+    ids = jnp.asarray(np.random.default_rng(1).integers(
+        0, 64, size=(4, 5)).astype(np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(layer.call(params, ids)),
+        np.asarray(jnp.take(params["embeddings"], ids, axis=0)))
+
+
+def test_embedding_replicated_fallback_warning(caplog):
+    """Satellite 1: an Embedding whose spec'd dim can't divide the model
+    axis rides param_shardings' COALESCED warning — the degradation is
+    visible in one summary line, never silent."""
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Embedding
+    reset_zoo_context()
+    init_zoo_context(mesh_model=2)
+    mesh = mesh_lib.global_mesh()
+    bad = Sequential([Embedding(50, 7, input_shape=(4,))])  # D=7 % 2 != 0
+    bad.init_weights()
+    with caplog.at_level(logging.WARNING, logger="analytics_zoo_tpu.mesh"):
+        mesh_lib.param_shardings(bad, bad.params, mesh)
+    assert any("replicated instead of model-sharded" in r.message
+               for r in caplog.records)
+    caplog.clear()
+    good = Sequential([Embedding(50, 8, input_shape=(4,))])
+    good.init_weights()
+    with caplog.at_level(logging.WARNING, logger="analytics_zoo_tpu.mesh"):
+        mesh_lib.param_shardings(good, good.params, mesh)
+    assert not caplog.records
+
+
+def test_resolve_sharded_embeddings_modes():
+    """auto engages only row-divisible tables; explicit on engages every
+    plain Embedding (indivisible ones padded, ``_row_shard`` False so the
+    param leaf stays replicated); off / model==1 resolve to None."""
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Embedding
+    from analytics_zoo_tpu.pipeline.api.keras.sharded_embed import \
+        resolve_sharded_embeddings
+
+    def models():
+        even = Embedding(64, 8, input_shape=(4,))
+        odd = Embedding(97, 8, input_shape=(4,))
+        return even, odd, Sequential([even]), Sequential([odd])
+
+    reset_zoo_context()
+    init_zoo_context(conf={"zoo.embed.sharded": "auto"}, mesh_model=2)
+    even, odd, m_even, m_odd = models()
+    assert resolve_sharded_embeddings(m_even) is not None
+    assert even._row_shard is True
+    assert resolve_sharded_embeddings(m_odd) is None  # auto skips 97
+    assert not getattr(odd, "_row_shard", False)
+
+    reset_zoo_context()
+    init_zoo_context(conf={"zoo.embed.sharded": True}, mesh_model=2)
+    even, odd, m_even, m_odd = models()
+    assert resolve_sharded_embeddings(m_odd) is not None  # forced on
+    assert odd._row_shard is False  # padded lookup, replicated leaf
+    hook = resolve_sharded_embeddings(m_even)
+    params = even.build(jax.random.PRNGKey(0), (None, 4))
+    ids = jnp.asarray([[1, 2, 2, 63]], jnp.int32)
+    y, _ = hook(even, params, {}, ids, False, None)
+    np.testing.assert_array_equal(
+        np.asarray(y), np.asarray(jnp.take(params["embeddings"], ids,
+                                           axis=0)))
+
+    reset_zoo_context()
+    init_zoo_context(conf={"zoo.embed.sharded": False}, mesh_model=2)
+    _, _, m_even, _ = models()
+    assert resolve_sharded_embeddings(m_even) is None
+
+    reset_zoo_context()
+    init_zoo_context(conf={"zoo.embed.sharded": True})  # model == 1
+    _, _, m_even, _ = models()
+    assert resolve_sharded_embeddings(m_even) is None
+
+
+def _fit_ncf(conf):
+    reset_zoo_context()
+    init_zoo_context(conf=conf)
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    from analytics_zoo_tpu.pipeline.api.keras.engine import reset_uids
+    reset_uids()
+    rng = np.random.default_rng(3)
+    x = np.stack([rng.integers(1, 63, 96),
+                  rng.integers(1, 127, 96)], axis=1).astype(np.int32)
+    y = ((x[:, 0] + x[:, 1]) % 5).astype(np.int32)
+    # +1 in the ctor → 64/128-row tables, divisible under model=2
+    m = NeuralCF(user_count=63, item_count=127, class_num=5,
+                 user_embed=8, item_embed=8, hidden_layers=(16,),
+                 include_mf=False)
+    m.compile(optimizer="adam", loss="scce", lr=0.01)
+    hist = m.fit(x, y, batch_size=32, nb_epoch=2)
+    return hist["loss"], m.params
+
+
+def test_ncf_fit_sharded_embedding_parity(caplog):
+    """End to end, no model-code changes: NeuralCF under {model:2} with
+    ``zoo.embed.sharded`` on (the log proves the engine engaged) trains
+    to the same losses and params as the plain-lookup control — the
+    row-partitioned dedup'd lookup is a layout choice, not a numerics
+    change."""
+    l_off, p_off = _fit_ncf({"zoo.embed.sharded": False,
+                             "zoo.mesh.model": 2})
+    with caplog.at_level(logging.INFO, logger="analytics_zoo_tpu.training"):
+        l_on, p_on = _fit_ncf({"zoo.embed.sharded": True,
+                               "zoo.mesh.model": 2})
+    assert any("sharded embedding engine engaged for 2 table(s)"
+               in r.getMessage() for r in caplog.records)
+    np.testing.assert_allclose(l_off, l_on, rtol=1e-5, atol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), p_off, p_on)
+
+
+# ---------------------------------------------------------------------------
+# pallas expand-gather (interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_pallas_embed_expand_matches_take(dtype):
+    """The one-hot MXU expansion is a 0/1 matmul — bit-identical to
+    rows[inv] in any dtype."""
+    from analytics_zoo_tpu.ops.pallas.embedding import embed_expand
+    rng = np.random.default_rng(17)
+    rows = jnp.asarray(rng.normal(size=(64, 24)).astype(np.float32)
+                       ).astype(dtype)
+    inv = jnp.asarray(rng.integers(0, 64, size=(50,)).astype(np.int32))
+    out = embed_expand(rows, inv, interpret=True)
+    ref = jnp.take(rows, inv, axis=0)
+    assert out.dtype == ref.dtype
+    np.testing.assert_array_equal(np.asarray(out, np.float32),
+                                  np.asarray(ref, np.float32))
+
+
+def test_dedup_lookup_via_pallas_expand():
+    init_zoo_context()
+    table, ids = _table_ids()
+    out = dedup_embedding_lookup(table, ids, use_pallas=True,
+                                 interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(jnp.take(table, ids, axis=0)))
